@@ -1,0 +1,55 @@
+"""dhqr_tpu — a TPU-native distributed dense linear-algebra framework.
+
+A brand-new JAX / XLA / shard_map / Pallas framework with the capabilities of
+the reference package ``jwscook/DistributedHouseholderQR.jl`` (see SURVEY.md):
+
+* in-place Householder QR factorization of dense real and complex m x n
+  matrices (m >= n), storing the reflectors below the diagonal with the
+  ``||v||^2 = 2`` convention (no tau array) and R's diagonal in a separate
+  ``alpha`` vector — the exact storage scheme of the reference
+  (reference src/DistributedHouseholderQR.jl:122-148, 296-309);
+* overdetermined least-squares solves ``x = qr(A) \\ b`` via applying Q^H and
+  back-substituting with R (reference src:215-294, 317-321);
+* execution tiers chosen by configuration rather than by array type
+  dispatch: single-device unblocked and single-device blocked compact-WY
+  (MXU GEMM trailing updates), plus the mesh-sharded tier in
+  ``dhqr_tpu.parallel`` (columns partitioned over a ``jax.sharding.Mesh``
+  axis, the reference's per-column reflector broadcast lowered to a single
+  ``psum`` per panel inside one compiled program — replacing the
+  Distributed.jl ``@spawnat`` round-trips of reference src:141-143).
+
+Public API (layer L4 of SURVEY.md §1):
+
+    >>> fact = dhqr_tpu.qr(A)            # QRFactorization(H, alpha)
+    >>> x = fact.solve(b)                # least-squares solve
+    >>> x = dhqr_tpu.lstsq(A, b)         # one-shot
+"""
+
+from dhqr_tpu.models.qr_model import (
+    QRFactorization,
+    lstsq,
+    qr,
+    solve,
+)
+from dhqr_tpu.ops.householder import alphafactor, householder_qr
+from dhqr_tpu.ops.blocked import blocked_householder_qr
+from dhqr_tpu.ops.solve import apply_q, apply_qt, back_substitute, solve_least_squares
+from dhqr_tpu.utils.config import DHQRConfig
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "QRFactorization",
+    "qr",
+    "lstsq",
+    "solve",
+    "householder_qr",
+    "blocked_householder_qr",
+    "apply_qt",
+    "apply_q",
+    "back_substitute",
+    "solve_least_squares",
+    "alphafactor",
+    "DHQRConfig",
+    "__version__",
+]

@@ -1,0 +1,1 @@
+"""User-facing factorization objects and solve API (layer L4 of SURVEY.md §1)."""

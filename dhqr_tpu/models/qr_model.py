@@ -1,0 +1,140 @@
+"""Factorization object + public API (layer L4 of SURVEY.md §1).
+
+TPU-native equivalent of the reference's user surface
+(reference src/DistributedHouseholderQR.jl:296-321):
+
+* ``DistributedHouseholderQRStruct{T1,T2}(A, alpha)``  ->  :class:`QRFactorization`
+  — a pytree dataclass holding the overwritten matrix (reflectors below the
+  diagonal, R's strict upper triangle above) and ``alpha`` (R's diagonal);
+* ``qr!(A)``  ->  :func:`qr` — functional (JAX arrays are immutable; XLA
+  donation recovers the in-place behavior under jit);
+* ``H \\ b``  ->  :meth:`QRFactorization.solve` / :func:`solve` /
+  :func:`lstsq`.
+
+Where the reference picks its execution tier by array type (Matrix /
+SharedArray / DArray multiple dispatch, src:113-120), the TPU framework picks
+it by configuration and sharding: the same functions run unblocked, blocked
+compact-WY, or mesh-sharded (see ``dhqr_tpu.parallel``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from dhqr_tpu.ops import blocked as _blocked
+from dhqr_tpu.ops import householder as _hh
+from dhqr_tpu.ops import solve as _solve
+from dhqr_tpu.utils.config import DHQRConfig
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class QRFactorization:
+    """Packed Householder QR factorization of a tall matrix A (m x n, m >= n).
+
+    Fields (the reference's exact storage scheme, src:296-309):
+      H: (m, n) — reflectors v_j (||v_j||^2 = 2) in rows j:m of column j;
+         R's strict upper triangle in rows < j.
+      alpha: (n,) — R's diagonal.
+      block_size: compact-WY panel width used to *apply* Q/Q^H in solves
+        (static aux data, not a leaf).
+    """
+
+    H: jax.Array
+    alpha: jax.Array
+    block_size: int = _blocked.DEFAULT_BLOCK_SIZE
+
+    # -- pytree plumbing ---------------------------------------------------
+    def tree_flatten(self):
+        return (self.H, self.alpha), (self.block_size,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        H, alpha = leaves
+        return cls(H, alpha, block_size=aux[0])
+
+    # -- derived quantities ------------------------------------------------
+    @property
+    def shape(self):
+        return self.H.shape
+
+    @property
+    def dtype(self):
+        return self.H.dtype
+
+    def r_matrix(self) -> jax.Array:
+        """Dense n x n upper-triangular R."""
+        return _solve.r_matrix(self.H, self.alpha)
+
+    def q_columns(self, k: Optional[int] = None) -> jax.Array:
+        """Materialize the first k columns of Q (default n) — test/debug aid;
+        the reference never forms Q explicitly."""
+        m, n = self.H.shape
+        k = n if k is None else k
+        eye = jnp.eye(m, k, dtype=self.H.dtype)
+        return _blocked.blocked_apply_q(self.H, self.alpha, eye, self.block_size)
+
+    # -- solves ------------------------------------------------------------
+    def solve(self, b: jax.Array) -> jax.Array:
+        """Least-squares solve ``x = argmin ||A x - b||`` — reference ``H \\ b``
+        (src:317-321): apply Q^H, back-substitute R, truncate to n."""
+        c = _blocked.blocked_apply_qt(self.H, self.alpha, b, self.block_size)
+        return _solve.back_substitute(self.H, self.alpha, c)
+
+    def matmul_q(self, b: jax.Array) -> jax.Array:
+        """Q @ b (b of length m, or (m, k))."""
+        return _blocked.blocked_apply_q(self.H, self.alpha, b, self.block_size)
+
+    def matmul_qt(self, b: jax.Array) -> jax.Array:
+        """Q^H @ b."""
+        return _blocked.blocked_apply_qt(self.H, self.alpha, b, self.block_size)
+
+
+def qr(
+    A: jax.Array,
+    config: Optional[DHQRConfig] = None,
+    donate: bool = False,
+    **overrides,
+) -> QRFactorization:
+    """Factor A: the reference's ``qr!(A)`` (src:311-315), tier chosen by config.
+
+    >>> fact = qr(A)                       # blocked compact-WY (MXU path)
+    >>> fact = qr(A, blocked=False)        # unblocked reference-parity path
+    >>> fact = qr(A, donate=True)          # true in-place: A's buffer is reused
+                                           # (and invalidated), like qr!'s overwrite
+    """
+    cfg = dataclasses.replace(config or DHQRConfig(), **overrides)
+    if cfg.blocked:
+        H, alpha = _blocked.blocked_householder_qr(A, cfg.block_size, donate=donate)
+    else:
+        if donate:
+            raise ValueError("donate=True is only supported on the blocked path")
+        H, alpha = _hh.householder_qr(A)
+    return QRFactorization(H, alpha, block_size=cfg.block_size)
+
+
+def solve(fact: QRFactorization, b: jax.Array) -> jax.Array:
+    """Functional form of ``fact.solve(b)`` — the reference's ``\\`` operator."""
+    return fact.solve(b)
+
+
+@partial(jax.jit, static_argnames=("block_size", "blocked"))
+def _lstsq_impl(A, b, block_size, blocked):
+    if blocked:
+        H, alpha = _blocked.blocked_householder_qr(A, block_size)
+        c = _blocked.blocked_apply_qt(H, alpha, b, block_size)
+    else:
+        H, alpha = _hh.householder_qr(A)
+        c = _solve.apply_qt(H, alpha, b)
+    return _solve.back_substitute(H, alpha, c)
+
+
+def lstsq(A: jax.Array, b: jax.Array, config: Optional[DHQRConfig] = None, **overrides) -> jax.Array:
+    """One-shot least squares ``x = qr(A) \\ b`` as a single jitted program."""
+    cfg = dataclasses.replace(config or DHQRConfig(), **overrides)
+    return _lstsq_impl(A, b, cfg.block_size, cfg.blocked)

@@ -1,0 +1,1 @@
+"""Compute ops: factorization engines, solvers, Pallas kernels (layers L0, L2, L3)."""

@@ -1,0 +1,157 @@
+"""Blocked compact-WY Householder QR — the MXU path (SURVEY.md §7 stage 3).
+
+The reference's trailing update is a per-column rank-1 axpy
+(reference src/DistributedHouseholderQR.jl:150-213), which is memory-bound by
+design. On TPU the FLOPs must flow through the MXU as large GEMMs, so this
+engine accumulates ``nb`` reflectors per panel and applies the panel's
+aggregate transform
+
+    H_nb ... H_1 = I - Y T^H Y^H        (each H_i = I - v_i v_i^H, ||v||^2=2)
+
+to the trailing matrix as two GEMMs plus a small triangular solve. Because
+the reference's scaling convention makes every tau equal 1, the T factor has
+the closed form ``T = (I + triu(Y^H Y, 1))^{-1}`` — we never invert it,
+applying ``T^H`` via a unit-diagonal triangular solve instead.
+
+The panel loop is a Python loop over *static* panel offsets, so every slice
+has a static shape under ``jit`` and the trailing GEMM genuinely shrinks —
+no wasted flops, unlike the masked full-width unblocked path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dhqr_tpu.ops.householder import _householder_qr_impl
+
+DEFAULT_BLOCK_SIZE = 128
+
+
+def wy_upper(Y: jax.Array) -> jax.Array:
+    """U = I + triu(Y^H Y, 1), the inverse of the compact-WY T factor.
+
+    Derivation: with tau_i = 1, T satisfies the larft recurrence
+    ``T[:i, i] = -T[:i, :i] (Y[:, :i]^H y_i)``, whose inverse is the unit
+    upper-triangular matrix carrying the strictly-upper part of Y^H Y.
+    One (nb x m)@(m x nb) GEMM — MXU work, not a scalar recurrence.
+    """
+    nb = Y.shape[1]
+    S = jnp.conj(Y.T) @ Y
+    return jnp.eye(nb, dtype=Y.dtype) + jnp.triu(S, k=1)
+
+
+def apply_block_reflector_h(Y: jax.Array, C: jax.Array) -> jax.Array:
+    """C <- (I - Y T^H Y^H) C, i.e. apply H_nb ... H_1 (the Q^H direction)."""
+    U = wy_upper(Y)
+    W = jnp.conj(Y.T) @ C
+    Z = lax.linalg.triangular_solve(
+        U, W, left_side=True, lower=False, transpose_a=True, conjugate_a=True,
+        unit_diagonal=True,
+    )
+    return C - Y @ Z
+
+
+def apply_block_reflector(Y: jax.Array, C: jax.Array) -> jax.Array:
+    """C <- (I - Y T Y^H) C, i.e. apply H_1 ... H_nb (the Q direction)."""
+    U = wy_upper(Y)
+    W = jnp.conj(Y.T) @ C
+    Z = lax.linalg.triangular_solve(
+        U, W, left_side=True, lower=False, transpose_a=False, conjugate_a=False,
+        unit_diagonal=True,
+    )
+    return C - Y @ Z
+
+
+@partial(jax.jit, static_argnames=("block_size",))
+def _blocked_qr_impl(A, block_size):
+    m, n = A.shape
+    nb = block_size
+    H = A
+    alpha = jnp.zeros((n,), dtype=A.dtype)
+    for k in range(0, n, nb):
+        b = min(nb, n - k)
+        panel = lax.slice(H, (k, k), (m, k + b))
+        pf, alpha_k = _householder_qr_impl(panel)
+        H = H.at[k:, k : k + b].set(pf)
+        alpha = alpha.at[k : k + b].set(alpha_k)
+        if k + b < n:
+            Y = jnp.tril(pf)  # reflectors incl. diagonal; R part masked off
+            C = lax.slice(H, (k, k + b), (m, n))
+            H = H.at[k:, k + b :].set(apply_block_reflector_h(Y, C))
+    return H, alpha
+
+
+_blocked_qr_impl_donate = partial(
+    jax.jit, static_argnames=("block_size",), donate_argnums=(0,)
+)(_blocked_qr_impl.__wrapped__)
+
+
+def blocked_householder_qr(
+    A: jax.Array, block_size: int = DEFAULT_BLOCK_SIZE, donate: bool = False
+):
+    """Factor ``A`` (m x n, m >= n): returns ``(H, alpha)`` in packed storage.
+
+    Identical storage and numerics to :func:`householder_qr` (reflectors with
+    ||v||^2 = 2 below/on the diagonal, R strict-upper in H, R diagonal in
+    alpha — reference src:122-148, 296-309), but organized panel-wise so the
+    trailing update runs on the MXU.
+
+    With ``donate=True`` the input buffer is donated to XLA — the functional
+    spelling of the reference's in-place ``householder!`` (src:113), halving
+    peak memory; the caller's array is invalidated, so it is opt-in.
+    """
+    m, n = A.shape
+    if m < n:
+        raise ValueError(f"blocked_householder_qr requires m >= n, got {A.shape}")
+    impl = _blocked_qr_impl_donate if donate else _blocked_qr_impl
+    return impl(A, int(block_size))
+
+
+@partial(jax.jit, static_argnames=("block_size",))
+def _apply_qt_impl(H, b, block_size):
+    m, n = H.shape
+    nb = block_size
+    vec = b.ndim == 1
+    B = b[:, None] if vec else b
+    for k in range(0, n, nb):
+        bsz = min(nb, n - k)
+        Y = jnp.tril(lax.slice(H, (k, k), (m, k + bsz)))
+        B = B.at[k:].set(apply_block_reflector_h(Y, B[k:]))
+    return B[:, 0] if vec else B
+
+
+def blocked_apply_qt(
+    H: jax.Array, alpha: jax.Array, b: jax.Array, block_size: int = DEFAULT_BLOCK_SIZE
+) -> jax.Array:
+    """b <- Q^H b using the compact-WY form, panel by panel.
+
+    Blocked counterpart of the reference's stage-1 solve (src:215-242);
+    accepts a vector (m,) or a block of right-hand sides (m, k).
+    """
+    del alpha
+    return _apply_qt_impl(H, b, int(block_size))
+
+
+@partial(jax.jit, static_argnames=("block_size",))
+def _apply_q_impl(H, b, block_size):
+    m, n = H.shape
+    nb = block_size
+    vec = b.ndim == 1
+    B = b[:, None] if vec else b
+    for k in reversed(range(0, n, nb)):
+        bsz = min(nb, n - k)
+        Y = jnp.tril(lax.slice(H, (k, k), (m, k + bsz)))
+        B = B.at[k:].set(apply_block_reflector(Y, B[k:]))
+    return B[:, 0] if vec else B
+
+
+def blocked_apply_q(
+    H: jax.Array, alpha: jax.Array, b: jax.Array, block_size: int = DEFAULT_BLOCK_SIZE
+) -> jax.Array:
+    """b <- Q b using the compact-WY form, panels in reverse order."""
+    del alpha
+    return _apply_q_impl(H, b, int(block_size))

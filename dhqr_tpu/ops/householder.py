@@ -1,0 +1,125 @@
+"""Unblocked Householder QR — the factorization engine (layer L2 of SURVEY.md §1).
+
+TPU-native re-design of the reference panel loop
+(reference src/DistributedHouseholderQR.jl:122-213): one traced
+``lax.fori_loop`` over columns with masked (static-shape) row ranges instead of
+the reference's ragged ``j:m`` views, and the whole-column trailing update as a
+single GEMV + rank-1 update instead of the reference's per-column
+``partialdot``/``hotloop!`` pair (src:198-213).
+
+Numerics follow the reference exactly:
+
+* sign choice ``alpha = s * alphafactor(a_jj)`` avoiding cancellation
+  (src:8-9, 130);
+* reflector scale ``f = 1 / sqrt(s * (s + |a_jj|))`` (src:131), which makes
+  the stored reflector satisfy ``||v||^2 = 2`` so each elementary reflector is
+  exactly ``H_j = I - v_j v_j^H`` — no tau array is needed;
+* the reflector (including its diagonal entry) overwrites column j's rows
+  ``j:m`` in place; R's strict upper triangle stays in H; R's *diagonal* lives
+  in ``alpha`` (src:296-309).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dhqr_tpu.ops.summation import accurate_norm
+
+
+def alphafactor(x: jax.Array) -> jax.Array:
+    """Sign factor for the Householder diagonal shift (reference src:8-9).
+
+    Real: ``-sign(x)``; complex: ``-exp(i * angle(x)) = -x / |x|``.
+    For ``x == 0`` the reference's real path returns ``-0`` (and would then
+    divide by zero); we return ``-1`` in both the real and complex cases,
+    which matches the complex path's ``-exp(i*angle(0)) = -1`` and keeps the
+    factorization finite on a zero pivot.
+    """
+    if jnp.iscomplexobj(x):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, -jnp.ones_like(x), -x / jnp.where(mag == 0, 1, mag))
+    return jnp.where(x >= 0, -jnp.ones_like(x), jnp.ones_like(x))
+
+
+def _real_dtype(dtype) -> jnp.dtype:
+    return jnp.finfo(dtype).dtype if not jnp.issubdtype(dtype, jnp.complexfloating) \
+        else jnp.zeros((), dtype).real.dtype
+
+
+def householder_reflector(col: jax.Array, j: jax.Array):
+    """Compute one Householder reflector from (the full m-vector of) column j.
+
+    ``col`` is the whole column; rows above ``j`` are R entries belonging to
+    previous steps and are masked out. Returns ``(v, alpha_j)`` where ``v`` is
+    the m-vector reflector (zero in rows < j, ``||v||^2 = 2``) and ``alpha_j``
+    is R's diagonal entry. Mirrors reference src:129-135 with masks in place
+    of the ragged ``j:m`` range.
+    """
+    m = col.shape[0]
+    dtype = col.dtype
+    rdtype = _real_dtype(dtype)
+    rows = lax.iota(jnp.int32, m)
+    mask = rows >= j
+    colm = jnp.where(mask, col, jnp.zeros_like(col))
+    # s = ||A[j:m, j]||  (reference src:129). XLA's reduce-sum carries
+    # O(10-100) ulps and the error is amplified by ~sqrt(m) in the trailing
+    # update, so use the compensated tree reduction (see ops/summation.py).
+    s = accurate_norm(colm).astype(rdtype)
+    a_jj = col[j]
+    alpha_j = (s.astype(dtype) * alphafactor(a_jj)).astype(dtype)
+    denom = s * (s + jnp.abs(a_jj).astype(rdtype))
+    # f = 1/sqrt(s(s+|a_jj|)) (src:131); guarded so a zero column yields v=0.
+    # NB: not lax.rsqrt — its ~1e2-ulp error makes each reflector slightly
+    # non-unitary and costs a digit of backward error over n reflectors.
+    f = jnp.where(denom > 0, 1.0 / jnp.sqrt(jnp.where(denom > 0, denom, 1)), 0).astype(rdtype)
+    shifted = colm - alpha_j * (rows == j).astype(dtype)  # H[j,j] -= alpha (src:132)
+    v = (shifted * f.astype(dtype)).astype(dtype)  # scale rows j:m by f (src:133-135)
+    return v, alpha_j
+
+
+def _qr_step(j: jax.Array, carry):
+    """One column step: reflector + whole-matrix trailing update.
+
+    The trailing update ``A[:, j+1:] -= v (v^H A[:, j+1:])`` is expressed
+    full-width with a column mask so shapes stay static under ``jit``; the
+    GEMV + rank-1 pair is what XLA fuses onto the MXU/VPU. This replaces the
+    reference's broadcast + per-column hot loop (src:141-143, 198-213).
+    """
+    H, alpha = carry
+    m, n = H.shape
+    col = lax.dynamic_slice_in_dim(H, j, 1, axis=1)[:, 0]
+    v, alpha_j = householder_reflector(col, j)
+    rows = lax.iota(jnp.int32, m)
+    # Column j now stores the reflector in rows j:m; rows < j keep R entries.
+    newcol = jnp.where(rows >= j, v, col)
+    H = lax.dynamic_update_slice_in_dim(H, newcol[:, None], j, axis=1)
+    alpha = lax.dynamic_update_slice_in_dim(alpha, alpha_j[None], j, axis=0)
+    # Trailing update on columns > j (masked; v is already zero in rows < j).
+    w = jnp.conj(v) @ H  # (n,) partial dots — reference's partialdot (src:42-59)
+    cmask = lax.iota(jnp.int32, n) > j
+    w = jnp.where(cmask, w, jnp.zeros_like(w))
+    H = H - v[:, None] * w[None, :]  # reference's hotloop! axpy (src:150-196)
+    return H, alpha
+
+
+@jax.jit
+def _householder_qr_impl(A):
+    n = A.shape[1]
+    alpha = jnp.zeros((n,), dtype=A.dtype)
+    return lax.fori_loop(0, n, _qr_step, (A, alpha))
+
+
+def householder_qr(A: jax.Array):
+    """Factor ``A`` (m x n, m >= n) in place: returns ``(H, alpha)``.
+
+    ``H`` holds the reflectors (rows j:m of column j, ``||v||^2 = 2``) and R's
+    strict upper triangle; ``alpha`` holds R's diagonal. Equivalent of
+    reference ``householder!``/``_householder!`` (src:113-148) as one compiled
+    ``fori_loop`` program.
+    """
+    m, n = A.shape
+    if m < n:
+        raise ValueError(f"householder_qr requires m >= n, got {A.shape}")
+    return _householder_qr_impl(A)

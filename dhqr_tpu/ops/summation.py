@@ -1,0 +1,72 @@
+"""Accurate vectorized reductions (layer L0 of SURVEY.md §1).
+
+The reference's L0 is hand-vectorized SIMD micro-kernels for dot products
+(reference src/DistributedHouseholderQR.jl:42-59, 162-196). On TPU the raw
+throughput comes for free from XLA, but *accuracy* does not: XLA's
+``reduce-sum`` carries O(10-100) ulp error, and in Householder QR the column
+norm's error is amplified by ~sqrt(m) in the trailing update, costing two
+digits of backward error versus LAPACK. These helpers restore ~1 ulp
+reductions using a compensated pairwise (TwoSum) tree: fully vectorized,
+log2(m) levels, static shapes — no sequential carry chain, so it maps onto
+the VPU cleanly.
+
+TwoSum has no multiplies, so XLA's FMA contraction cannot break the error
+algebra; XLA performs no other unsafe floating-point reassociation on an
+explicit op graph.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _two_sum(a: jax.Array, b: jax.Array):
+    """Knuth TwoSum: s + e == a + b exactly (s = fl(a+b))."""
+    s = a + b
+    z = s - a
+    e = (a - (s - z)) + (b - z)
+    return s, e
+
+
+def tree_sum(x: jax.Array) -> jax.Array:
+    """Compensated pairwise sum of a 1-D vector, accurate to ~1 ulp."""
+    n = x.shape[0]
+    if n == 0:
+        return jnp.zeros((), x.dtype)
+    err = jnp.zeros_like(x)
+    while n > 1:
+        if n % 2:
+            x = jnp.concatenate([x, jnp.zeros((1,), x.dtype)])
+            err = jnp.concatenate([err, jnp.zeros((1,), err.dtype)])
+            n += 1
+        s, e = _two_sum(x[0::2], x[1::2])
+        err = err[0::2] + err[1::2] + e  # error terms are tiny; plain add is fine
+        x = s
+        n //= 2
+    return x[0] + err[0]
+
+
+def accurate_sumsq(x: jax.Array) -> jax.Array:
+    """sum(|x|^2) to ~1 ulp (real result, works for real and complex x)."""
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        y = jnp.real(x) ** 2 + jnp.imag(x) ** 2
+    else:
+        y = x * x
+    return tree_sum(y)
+
+
+def accurate_norm(x: jax.Array) -> jax.Array:
+    """||x||_2 to ~1 ulp — the reference's ``norm(view(Hl, j:m, j))`` (src:129)."""
+    return jnp.sqrt(accurate_sumsq(x))
+
+
+def accurate_vdot(a: jax.Array, b: jax.Array) -> jax.Array:
+    """conj(a)·b with a compensated pairwise sum over the products.
+
+    The reference's ``partialdot`` (src:42-59); ragged ranges are handled by
+    masking the inputs to structural zeros before calling. Product rounding
+    (one ulp each, uncompensated) is below the tree's accumulation error for
+    non-cancelling data.
+    """
+    return tree_sum(jnp.conj(a) * b)

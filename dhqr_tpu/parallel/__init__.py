@@ -1,0 +1,27 @@
+"""Distribution layer (layer L1 of SURVEY.md §1) + sharded engines.
+
+The reference makes ``Matrix`` / ``SharedArray`` / ``DArray`` look alike via
+index shims and a ``LocalColumnBlock`` wrapper (reference
+src/DistributedHouseholderQR.jl:11-40). Here the same seam is a
+``jax.sharding.Mesh`` with a single column axis: the engines are written once
+against local blocks inside ``shard_map`` and run unchanged from 1 device
+(serial tier) to N devices (distributed tier).
+"""
+
+from dhqr_tpu.parallel.layout import (
+    ColumnBlock,
+    area_balanced_splits,
+    column_block_ranges,
+    local_column_block,
+)
+from dhqr_tpu.parallel.mesh import column_mesh, column_sharding, replicated_sharding
+
+__all__ = [
+    "ColumnBlock",
+    "area_balanced_splits",
+    "column_block_ranges",
+    "local_column_block",
+    "column_mesh",
+    "column_sharding",
+    "replicated_sharding",
+]

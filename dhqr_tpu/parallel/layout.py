@@ -1,0 +1,79 @@
+"""Column layouts and local-block bookkeeping (layer L1 of SURVEY.md §1).
+
+TPU-native counterpart of the reference's index/locality shims and
+``LocalColumnBlock`` wrapper (reference src/DistributedHouseholderQR.jl:11-40):
+``local_column_block`` gives, per mesh position, the global column offset and
+width of the local block — the information ``LocalColumnBlock`` carries as
+``Δj``/``colrange`` (src:26-36). Inside ``shard_map`` the block itself is just
+the local array; only the offset arithmetic is needed.
+
+Also carries the reference's area-balancing split formula
+(test/runtests.jl:36-38) as a documented utility and test oracle. On TPU,
+XLA shards in *even* blocks, so load-balancing is instead achieved by a
+column-cyclic permutation applied before sharding; the sqrt formula remains
+the reference semantics for uneven blocks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnBlock:
+    """A device's contiguous block of global columns [start, stop).
+
+    ``start`` plays the role of the reference's ``Δj`` column offset and
+    ``range(start, stop)`` its ``colrange`` (src:26-36).
+    """
+
+    start: int
+    stop: int
+
+    @property
+    def width(self) -> int:
+        return self.stop - self.start
+
+    def contains(self, j: int) -> bool:
+        return self.start <= j < self.stop
+
+
+def local_column_block(n: int, n_devices: int, device_index: int) -> ColumnBlock:
+    """Even column-block layout: the block XLA gives shard ``device_index``.
+
+    Matches ``NamedSharding(mesh, P(None, "cols"))`` placement for n divisible
+    by n_devices (the supported case, mirroring the reference's even-block
+    ``DArray`` constructor at runtests.jl:71).
+    """
+    if n % n_devices != 0:
+        raise ValueError(
+            f"n={n} must divide evenly over {n_devices} devices; pad the matrix"
+        )
+    w = n // n_devices
+    return ColumnBlock(device_index * w, (device_index + 1) * w)
+
+
+def column_block_ranges(n: int, n_devices: int) -> list[ColumnBlock]:
+    """All devices' blocks — the reference's ``columnblocks`` table (src:18-19)."""
+    return [local_column_block(n, n_devices, p) for p in range(n_devices)]
+
+
+def area_balanced_splits(n_devices: int, n: int) -> list[ColumnBlock]:
+    """The reference's uneven, area-balancing split (test/runtests.jl:36-38).
+
+    ``splits(np, N, p) = round(N * (1 - sqrt((np - p) / np)))`` gives later
+    blocks fewer columns, equalizing per-worker trailing-update *area* in the
+    right-looking factorization. Kept as a semantic oracle; the TPU engines
+    use even blocks (+ cyclic permutation) instead, since XLA shardings are
+    even by construction.
+    """
+    def split(p: int) -> int:
+        return round(n * (1.0 - math.sqrt((n_devices - p) / n_devices)))
+
+    blocks = []
+    for p in range(1, n_devices + 1):
+        lo = max(1, split(p - 1) + 1)  # 1-based, as in lorange (runtests.jl:37)
+        hi = min(n, split(p))          # hirange (runtests.jl:38)
+        blocks.append(ColumnBlock(lo - 1, hi))  # half-open 0-based
+    return blocks

@@ -1,0 +1,55 @@
+"""Mesh construction and sharding specs — the worker-pool equivalent.
+
+The reference's execution resources are ``np`` Distributed.jl worker
+processes created by ``addprocs(np)`` (reference test/runtests.jl:9) holding
+one column block each (``DArray`` distributed ``(1, nworkers())``,
+runtests.jl:71). Here the resources are a 1-D ``jax.sharding.Mesh`` over a
+``"cols"`` axis; matrices are placed with ``P(None, "cols")`` so rows are
+never partitioned — the invariant the reference asserts at src:33.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DEFAULT_AXIS = "cols"
+
+
+def column_mesh(
+    n_devices: Optional[int] = None,
+    axis_name: str = DEFAULT_AXIS,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """1-D device mesh over the column axis.
+
+    ``n_devices=None`` uses every visible device — the analogue of
+    ``addprocs(np)`` sizing the worker pool (runtests.jl:4,9).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices but only {len(devices)} visible"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def column_sharding(mesh: Mesh, axis_name: str = DEFAULT_AXIS) -> NamedSharding:
+    """Sharding for an (m, n) matrix: columns split over the mesh, rows whole.
+
+    The reference's ``DArray(..., (1, nworkers()))`` layout (runtests.jl:71)
+    with the rows-unpartitioned invariant (src:33) encoded in the spec.
+    """
+    return NamedSharding(mesh, P(None, axis_name))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated placement — the analogue of the reference's
+    ``SharedArray`` side channel for alpha and b (src:302, 318)."""
+    return NamedSharding(mesh, P())

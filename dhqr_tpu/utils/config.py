@@ -1,0 +1,51 @@
+"""Framework configuration (SURVEY.md §5 "config/flag system").
+
+The reference's configuration surface is a positional worker count
+(reference test/runtests.jl:4), worker ``exeflags`` (runtests.jl:9) and an
+import-time BLAS thread setting (src:6). Here it is an explicit dataclass,
+overridable from the environment, passed to the API entry points.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+
+@dataclasses.dataclass(frozen=True)
+class DHQRConfig:
+    """Knobs for the factorization/solve engines.
+
+    Attributes:
+      block_size: compact-WY panel width nb (MXU-friendly multiple of 128
+        where possible; the engine handles ragged final panels).
+      mesh_axis: name of the mesh axis columns are sharded over — the TPU
+        equivalent of the reference's Distributed.jl worker dimension.
+      blocked: use the compact-WY engine (True) or the unblocked
+        reference-parity engine (False).
+      use_pallas: route the unblocked trailing update through the fused
+        Pallas kernel where shapes allow ("auto"), always ("always"), or
+        never ("never").
+    """
+
+    block_size: int = 128
+    mesh_axis: str = "cols"
+    blocked: bool = True
+    use_pallas: str = "auto"
+
+    @staticmethod
+    def from_env(**overrides) -> "DHQRConfig":
+        """Build a config from ``DHQR_*`` environment variables + overrides."""
+        env = {}
+        if "DHQR_BLOCK_SIZE" in os.environ:
+            env["block_size"] = int(os.environ["DHQR_BLOCK_SIZE"])
+        if "DHQR_MESH_AXIS" in os.environ:
+            env["mesh_axis"] = os.environ["DHQR_MESH_AXIS"]
+        if "DHQR_BLOCKED" in os.environ:
+            env["blocked"] = os.environ["DHQR_BLOCKED"].strip().lower() not in (
+                "0", "false", "no", "off", "n", "",
+            )
+        if "DHQR_USE_PALLAS" in os.environ:
+            env["use_pallas"] = os.environ["DHQR_USE_PALLAS"]
+        env.update(overrides)
+        return DHQRConfig(**env)

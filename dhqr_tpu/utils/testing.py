@@ -1,0 +1,56 @@
+"""Test oracles and acceptance criteria (SURVEY.md §4).
+
+The reference's acceptance metric is the normal-equations residual
+``||A^H A x - A^H b||`` compared against the LAPACK oracle's, with tolerance
+factor 8 (reference test/runtests.jl:49-51, 62, 81). We adopt the exact same
+criterion, with numpy's LAPACK as the oracle.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+TOLERANCE_FACTOR = 8.0  # reference test/runtests.jl:62,81
+
+
+def normal_equations_residual(A, x, b) -> float:
+    """||A^H A x - A^H b|| — the reference's correctness metric."""
+    A = np.asarray(A)
+    x = np.asarray(x)
+    b = np.asarray(b)
+    Ah = A.conj().T
+    return float(np.linalg.norm(Ah @ A @ x - Ah @ b))
+
+
+def lapack_lstsq(A, b):
+    """Oracle least-squares solve via LAPACK *QR* (reference runtests.jl:49).
+
+    The reference oracle is ``qr!(A, NoPivot()) \\ b`` — unpivoted Householder
+    QR + back-substitution, not an SVD solve — so we build the same thing from
+    numpy's geqrf-backed ``np.linalg.qr``.
+    """
+    A = np.asarray(A)
+    b = np.asarray(b)
+    Q, R = np.linalg.qr(A, mode="reduced")
+    import scipy.linalg
+
+    return scipy.linalg.solve_triangular(R, Q.conj().T @ b, lower=False)
+
+
+def oracle_residual(A, b) -> float:
+    """The LAPACK oracle's own normal-equations residual (runtests.jl:51)."""
+    return normal_equations_residual(A, lapack_lstsq(A, b), b)
+
+
+def random_problem(m: int, n: int, dtype, seed: int = 0):
+    """Random tall least-squares problem, matching runtests.jl:45-46 shapes."""
+    rng = np.random.default_rng(seed)
+    if np.issubdtype(np.dtype(dtype), np.complexfloating):
+        rdt = np.finfo(dtype).dtype
+        A = (rng.random((m, n)) + 1j * rng.random((m, n))).astype(dtype)
+        b = (rng.random(m) + 1j * rng.random(m)).astype(dtype)
+        del rdt
+    else:
+        A = rng.random((m, n)).astype(dtype)
+        b = rng.random(m).astype(dtype)
+    return A, b

@@ -1,0 +1,25 @@
+"""Pytest bring-up: force a virtual 8-device CPU platform.
+
+This is how multi-"chip" behavior is tested without TPU hardware — the moral
+equivalent of the reference's local-process fake cluster
+(reference test/runtests.jl:9 ``addprocs(np)``), per SURVEY.md §4.
+
+Note the host environment pins JAX_PLATFORMS to the real TPU (axon) and a
+sitecustomize hook registers that plugin at interpreter start, so the env
+var is decided before conftest runs; ``jax.config.update`` after import is
+the reliable override. XLA_FLAGS is only read at first backend init, so
+setting it here (before any jax use) still works.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)  # Float64/ComplexF64 parity with reference

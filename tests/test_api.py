@@ -1,0 +1,93 @@
+"""API layer tests — QRFactorization / qr / lstsq (reference src:296-321 parity)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import dhqr_tpu
+from dhqr_tpu import QRFactorization, lstsq, qr, solve
+from dhqr_tpu.utils.testing import (
+    TOLERANCE_FACTOR,
+    normal_equations_residual,
+    oracle_residual,
+    random_problem,
+)
+
+
+@pytest.mark.parametrize("blocked", [True, False])
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_qr_solve_roundtrip(blocked, dtype):
+    A, b = random_problem(110, 100, dtype, seed=21)
+    fact = qr(jnp.asarray(A), blocked=blocked, block_size=32)
+    x = np.asarray(fact.solve(jnp.asarray(b)))
+    assert normal_equations_residual(A, x, b) < TOLERANCE_FACTOR * max(
+        oracle_residual(A, b), 1e-300
+    )
+    # functional form agrees
+    x2 = np.asarray(solve(fact, jnp.asarray(b)))
+    np.testing.assert_allclose(x2, x)
+
+
+def test_lstsq_one_shot_jitted():
+    A, b = random_problem(88, 80, np.float64, seed=22)
+    x = np.asarray(lstsq(jnp.asarray(A), jnp.asarray(b), block_size=16))
+    assert normal_equations_residual(A, x, b) < TOLERANCE_FACTOR * max(
+        oracle_residual(A, b), 1e-300
+    )
+
+
+def test_factorization_is_pytree():
+    A, _ = random_problem(20, 10, np.float64, seed=23)
+    fact = qr(jnp.asarray(A), block_size=8)
+    leaves, treedef = jax.tree_util.tree_flatten(fact)
+    assert len(leaves) == 2
+    rebuilt = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert isinstance(rebuilt, QRFactorization)
+    assert rebuilt.block_size == 8
+    # jit through the pytree
+    solved = jax.jit(lambda f, b: f.solve(b))(fact, jnp.ones(20, jnp.float64))
+    assert solved.shape == (10,)
+
+
+def test_q_columns_orthonormal():
+    A, _ = random_problem(60, 40, np.float64, seed=24)
+    fact = qr(jnp.asarray(A), block_size=16)
+    Q = np.asarray(fact.q_columns())
+    np.testing.assert_allclose(Q.conj().T @ Q, np.eye(40), atol=1e-10)
+
+
+def test_qr_backward_error_target():
+    """BASELINE.md north-star metric: ||QR - A|| / ||A|| < 1e-5 (f32)."""
+    A, _ = random_problem(256, 128, np.float32, seed=25)
+    fact = qr(jnp.asarray(A), block_size=32)
+    Q = np.asarray(fact.q_columns())
+    R = np.asarray(fact.r_matrix())
+    err = np.linalg.norm(Q @ R - A) / np.linalg.norm(A)
+    assert err < 1e-5
+
+
+def test_multi_rhs_solve():
+    """solve/back_substitute accept (m, k) blocks of right-hand sides."""
+    A, _ = random_problem(30, 20, np.float64, seed=26)
+    B = np.random.default_rng(27).random((30, 3))
+    fact = qr(jnp.asarray(A), block_size=8)
+    X = np.asarray(fact.solve(jnp.asarray(B)))
+    assert X.shape == (20, 3)
+    for i in range(3):
+        x_i = np.asarray(fact.solve(jnp.asarray(B[:, i])))
+        np.testing.assert_allclose(X[:, i], x_i, rtol=1e-12, atol=1e-14)
+    # unblocked one-shot path too
+    X2 = np.asarray(lstsq(jnp.asarray(A), jnp.asarray(B), blocked=False))
+    np.testing.assert_allclose(X2, X, rtol=1e-9, atol=1e-11)
+
+
+def test_donate_unblocked_rejected():
+    with pytest.raises(ValueError):
+        qr(jnp.ones((4, 3)), blocked=False, donate=True)
+
+
+def test_version_and_exports():
+    assert dhqr_tpu.__version__
+    for name in dhqr_tpu.__all__:
+        assert hasattr(dhqr_tpu, name), name

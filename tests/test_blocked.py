@@ -1,0 +1,96 @@
+"""Blocked compact-WY engine tests: must match the unblocked engine exactly
+in exact arithmetic and to rounding in floating point (SURVEY.md §7 stage 3)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dhqr_tpu.ops.blocked import (
+    apply_block_reflector,
+    apply_block_reflector_h,
+    blocked_apply_q,
+    blocked_apply_qt,
+    blocked_householder_qr,
+    wy_upper,
+)
+from dhqr_tpu.ops.householder import householder_qr
+from dhqr_tpu.ops.solve import apply_qt, back_substitute, r_matrix
+from dhqr_tpu.utils.testing import (
+    TOLERANCE_FACTOR,
+    normal_equations_residual,
+    oracle_residual,
+    random_problem,
+)
+
+
+@pytest.mark.parametrize("m,n,nb", [(64, 48, 16), (100, 100, 32), (130, 90, 32), (70, 50, 128)])
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_blocked_matches_unblocked(m, n, nb, dtype):
+    A, _ = random_problem(m, n, dtype, seed=11)
+    H0, a0 = householder_qr(jnp.asarray(A))
+    H1, a1 = blocked_householder_qr(jnp.asarray(A), block_size=nb)
+    np.testing.assert_allclose(np.asarray(H1), np.asarray(H0), rtol=1e-9, atol=1e-11)
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(a0), rtol=1e-9, atol=1e-11)
+
+
+def test_wy_identity():
+    """(I - Y T^H Y^H) must equal the product H_nb ... H_1 of reflectors."""
+    rng = np.random.default_rng(12)
+    m, nb = 40, 8
+    A, _ = random_problem(m, nb, np.float64, seed=13)
+    pf, alpha = householder_qr(jnp.asarray(A))
+    Y = np.tril(np.asarray(pf))
+    # explicit product of reflectors applied to identity
+    P = np.eye(m)
+    for j in range(nb):  # apply H_1 first => product is H_nb ... H_1
+        v = Y[:, j]
+        P = P - np.outer(v, v.conj() @ P)
+    C = rng.random((m, 5))
+    out = np.asarray(apply_block_reflector_h(jnp.asarray(Y), jnp.asarray(C)))
+    np.testing.assert_allclose(out, P @ C, rtol=1e-10, atol=1e-12)
+    # and the Q direction is its adjoint
+    out_q = np.asarray(apply_block_reflector(jnp.asarray(Y), jnp.asarray(C)))
+    np.testing.assert_allclose(out_q, P.conj().T @ C, rtol=1e-10, atol=1e-12)
+
+
+def test_wy_upper_is_t_inverse():
+    """U = T^{-1}: check via the scalar larft recurrence with tau = 1."""
+    A, _ = random_problem(30, 6, np.float64, seed=14)
+    pf, _ = householder_qr(jnp.asarray(A))
+    Y = np.tril(np.asarray(pf))
+    nb = Y.shape[1]
+    T = np.zeros((nb, nb))
+    for i in range(nb):
+        T[i, i] = 1.0
+        if i:
+            T[:i, i] = -T[:i, :i] @ (Y[:, :i].conj().T @ Y[:, i])
+    U = np.asarray(wy_upper(jnp.asarray(Y)))
+    np.testing.assert_allclose(U @ T, np.eye(nb), atol=1e-12)
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128, np.float32])
+def test_blocked_lstsq_8x_criterion(dtype):
+    m, n, nb = 220, 200, 32
+    A, b = random_problem(m, n, dtype, seed=15)
+    H, alpha = blocked_householder_qr(jnp.asarray(A), block_size=nb)
+    c = blocked_apply_qt(H, alpha, jnp.asarray(b), block_size=nb)
+    x = np.asarray(back_substitute(H, alpha, c))
+    assert normal_equations_residual(A, x, b) < TOLERANCE_FACTOR * max(
+        oracle_residual(A, b), 1e-300
+    )
+
+
+def test_blocked_qt_matches_unblocked_qt():
+    A, b = random_problem(90, 60, np.complex128, seed=16)
+    H, alpha = householder_qr(jnp.asarray(A))
+    c0 = np.asarray(apply_qt(H, alpha, jnp.asarray(b)))
+    c1 = np.asarray(blocked_apply_qt(H, alpha, jnp.asarray(b), block_size=16))
+    np.testing.assert_allclose(c1, c0, rtol=1e-10, atol=1e-12)
+
+
+def test_blocked_q_inverts_qt():
+    A, b = random_problem(90, 60, np.float64, seed=17)
+    H, alpha = blocked_householder_qr(jnp.asarray(A), block_size=16)
+    c = blocked_apply_qt(H, alpha, jnp.asarray(b), block_size=16)
+    b_back = np.asarray(blocked_apply_q(H, alpha, c, block_size=16))
+    np.testing.assert_allclose(b_back, b, rtol=1e-9, atol=1e-11)

@@ -1,0 +1,62 @@
+"""Layout/mesh tests (layer L1) — including the reference split-formula oracle."""
+
+import jax
+import numpy as np
+import pytest
+
+from dhqr_tpu.parallel import (
+    ColumnBlock,
+    area_balanced_splits,
+    column_block_ranges,
+    column_mesh,
+    column_sharding,
+    local_column_block,
+    replicated_sharding,
+)
+
+
+def test_even_blocks_partition():
+    blocks = column_block_ranges(64, 8)
+    assert blocks[0] == ColumnBlock(0, 8)
+    assert blocks[-1] == ColumnBlock(56, 64)
+    covered = [j for blk in blocks for j in range(blk.start, blk.stop)]
+    assert covered == list(range(64))
+
+
+def test_uneven_n_rejected():
+    with pytest.raises(ValueError):
+        local_column_block(10, 4, 0)
+
+
+def test_area_balanced_splits_match_reference_formula():
+    """Oracle: splits(np,N,p) = round(N(1-sqrt((np-p)/np))) (runtests.jl:36-38)."""
+    np_, N = 4, 100
+    blocks = area_balanced_splits(np_, N)
+    # formula's raw split points for p = 0..4: 0, 13, 29, 50, 100
+    expected = [(0, 13), (13, 29), (29, 50), (50, 100)]
+    assert [(b.start, b.stop) for b in blocks] == expected
+    # partition covers all columns exactly once
+    covered = [j for b in blocks for j in range(b.start, b.stop)]
+    assert covered == list(range(N))
+    # the sqrt law gives later workers *wider* blocks (13, 16, 21, 50)
+    widths = [b.width for b in blocks]
+    assert widths == sorted(widths)
+
+
+def test_column_mesh_and_shardings():
+    mesh = column_mesh(8)
+    assert mesh.shape == {"cols": 8}
+    cs = column_sharding(mesh)
+    rs = replicated_sharding(mesh)
+    x = jax.device_put(np.zeros((16, 32)), cs)
+    assert x.sharding.spec == cs.spec
+    # rows unpartitioned (reference invariant src:33): each shard has all rows
+    shard = x.addressable_shards[0].data
+    assert shard.shape == (16, 4)
+    y = jax.device_put(np.zeros(32), rs)
+    assert y.addressable_shards[0].data.shape == (32,)
+
+
+def test_column_mesh_too_many_devices():
+    with pytest.raises(ValueError):
+        column_mesh(1000)
