@@ -43,20 +43,24 @@ class QRFactorization:
       alpha: (n,) — R's diagonal.
       block_size: compact-WY panel width used to *apply* Q/Q^H in solves
         (static aux data, not a leaf).
+      mesh: optional — when set, H is column-sharded over this mesh and
+        solves run the distributed engines (the DArray tier of reference
+        src:115-120, selected here by placement rather than array type).
     """
 
     H: jax.Array
     alpha: jax.Array
     block_size: int = _blocked.DEFAULT_BLOCK_SIZE
+    mesh: object = None
 
     # -- pytree plumbing ---------------------------------------------------
     def tree_flatten(self):
-        return (self.H, self.alpha), (self.block_size,)
+        return (self.H, self.alpha), (self.block_size, self.mesh)
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
         H, alpha = leaves
-        return cls(H, alpha, block_size=aux[0])
+        return cls(H, alpha, block_size=aux[0], mesh=aux[1])
 
     # -- derived quantities ------------------------------------------------
     @property
@@ -82,7 +86,14 @@ class QRFactorization:
     # -- solves ------------------------------------------------------------
     def solve(self, b: jax.Array) -> jax.Array:
         """Least-squares solve ``x = argmin ||A x - b||`` — reference ``H \\ b``
-        (src:317-321): apply Q^H, back-substitute R, truncate to n."""
+        (src:317-321): apply Q^H, back-substitute R, truncate to n. Routes to
+        the distributed engines when the factorization is mesh-sharded."""
+        if self.mesh is not None:
+            from dhqr_tpu.parallel.sharded_solve import sharded_solve
+
+            return sharded_solve(
+                self.H, self.alpha, b, self.mesh, block_size=self.block_size
+            )
         c = _blocked.blocked_apply_qt(self.H, self.alpha, b, self.block_size)
         return _solve.back_substitute(self.H, self.alpha, c)
 
@@ -99,6 +110,7 @@ def qr(
     A: jax.Array,
     config: Optional[DHQRConfig] = None,
     donate: bool = False,
+    mesh=None,
     **overrides,
 ) -> QRFactorization:
     """Factor A: the reference's ``qr!(A)`` (src:311-315), tier chosen by config.
@@ -106,9 +118,28 @@ def qr(
     >>> fact = qr(A)                       # blocked compact-WY (MXU path)
     >>> fact = qr(A, blocked=False)        # unblocked reference-parity path
     >>> fact = qr(A, donate=True)          # true in-place: A's buffer is reused
-                                           # (and invalidated), like qr!'s overwrite
+    ...                                    # (and invalidated), like qr!'s overwrite
+    >>> fact = qr(A, mesh=column_mesh(8))  # distributed: the DArray tier
     """
     cfg = dataclasses.replace(config or DHQRConfig(), **overrides)
+    if mesh is not None:
+        if donate:
+            raise ValueError(
+                "donate=True is not supported on the mesh path (the input is "
+                "re-placed onto the mesh, so donation cannot honor its contract)"
+            )
+        from dhqr_tpu.parallel import sharded_qr as _sharded
+        from dhqr_tpu.parallel.layout import fit_block_size
+
+        nloc = A.shape[1] // mesh.shape[cfg.mesh_axis]
+        nb = fit_block_size(nloc, cfg.block_size)
+        if cfg.blocked:
+            H, alpha = _sharded.sharded_blocked_qr(
+                A, mesh, block_size=nb, axis_name=cfg.mesh_axis
+            )
+        else:
+            H, alpha = _sharded.sharded_householder_qr(A, mesh, axis_name=cfg.mesh_axis)
+        return QRFactorization(H, alpha, block_size=nb, mesh=mesh)
     if cfg.blocked:
         H, alpha = _blocked.blocked_householder_qr(A, cfg.block_size, donate=donate)
     else:
@@ -134,7 +165,30 @@ def _lstsq_impl(A, b, block_size, blocked):
     return _solve.back_substitute(H, alpha, c)
 
 
-def lstsq(A: jax.Array, b: jax.Array, config: Optional[DHQRConfig] = None, **overrides) -> jax.Array:
-    """One-shot least squares ``x = qr(A) \\ b`` as a single jitted program."""
+def lstsq(
+    A: jax.Array,
+    b: jax.Array,
+    config: Optional[DHQRConfig] = None,
+    mesh=None,
+    **overrides,
+) -> jax.Array:
+    """One-shot least squares ``x = qr(A) \\ b`` as a single jitted program.
+
+    With ``mesh=`` the whole pipeline runs distributed (the reference's
+    ``DHQR.qr!(A3) \\ b`` DArray path, runtests.jl:77-78).
+    """
     cfg = dataclasses.replace(config or DHQRConfig(), **overrides)
+    if mesh is not None:
+        from dhqr_tpu.parallel.layout import fit_block_size
+        from dhqr_tpu.parallel.sharded_qr import sharded_householder_qr
+        from dhqr_tpu.parallel.sharded_solve import sharded_lstsq, sharded_solve
+
+        nloc = A.shape[1] // mesh.shape[cfg.mesh_axis]
+        nb = fit_block_size(nloc, cfg.block_size)
+        if not cfg.blocked:
+            H, alpha = sharded_householder_qr(A, mesh, axis_name=cfg.mesh_axis)
+            return sharded_solve(
+                H, alpha, b, mesh, block_size=nb, axis_name=cfg.mesh_axis
+            )
+        return sharded_lstsq(A, b, mesh, block_size=nb, axis_name=cfg.mesh_axis)
     return _lstsq_impl(A, b, cfg.block_size, cfg.blocked)

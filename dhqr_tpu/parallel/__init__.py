@@ -15,6 +15,8 @@ from dhqr_tpu.parallel.layout import (
     local_column_block,
 )
 from dhqr_tpu.parallel.mesh import column_mesh, column_sharding, replicated_sharding
+from dhqr_tpu.parallel.sharded_qr import sharded_blocked_qr, sharded_householder_qr
+from dhqr_tpu.parallel.sharded_solve import sharded_lstsq, sharded_solve
 
 __all__ = [
     "ColumnBlock",
@@ -24,4 +26,8 @@ __all__ = [
     "column_mesh",
     "column_sharding",
     "replicated_sharding",
+    "sharded_householder_qr",
+    "sharded_blocked_qr",
+    "sharded_solve",
+    "sharded_lstsq",
 ]

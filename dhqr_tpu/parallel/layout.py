@@ -54,6 +54,18 @@ def local_column_block(n: int, n_devices: int, device_index: int) -> ColumnBlock
     return ColumnBlock(device_index * w, (device_index + 1) * w)
 
 
+def fit_block_size(nloc: int, requested: int) -> int:
+    """Largest panel width <= requested that divides the local block width.
+
+    Keeps the single-owner-per-panel invariant of the sharded compact-WY
+    engine without making users hand-tune nb against n/mesh combinations.
+    """
+    nb = max(1, min(int(requested), nloc))
+    while nloc % nb:
+        nb -= 1
+    return nb
+
+
 def column_block_ranges(n: int, n_devices: int) -> list[ColumnBlock]:
     """All devices' blocks — the reference's ``columnblocks`` table (src:18-19)."""
     return [local_column_block(n, n_devices, p) for p in range(n_devices)]

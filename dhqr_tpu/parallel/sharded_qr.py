@@ -1,0 +1,183 @@
+"""Mesh-sharded factorization engines (SURVEY.md §7 stage 2).
+
+TPU-native re-design of the reference's distributed tier
+(reference src/DistributedHouseholderQR.jl:115-213). The reference runs the
+panel loop by *migrating* control across worker processes — per column it
+serializes the m-element reflector to every worker over TCP and blocks on
+``@sync``/``fetch`` (src:141-143, flagged "this is most expensive"). Here the
+whole factorization is ONE compiled SPMD program over a 1-D column mesh:
+
+* the owner's column/panel is broadcast with a single ``psum`` over ICI
+  (devices contribute zeros except the owner — an all-reduce *is* the
+  broadcast, and XLA lowers it to the fastest collective for the topology);
+* the reflector math is computed redundantly-replicated on every device
+  (cheaper than a second collective);
+* the trailing update touches only local columns, masked by global index —
+  the moral equivalent of ``jjs = intersect(j+1:n, colrange)`` (src:201).
+
+Two engines, mirroring the single-device pair:
+``sharded_householder_qr`` (unblocked, one psum per column) and
+``sharded_blocked_qr`` (compact-WY, one psum per nb-wide panel, trailing
+update as local GEMMs on the MXU).
+
+Constraints (documented, checked): n divisible by the mesh size;
+for the blocked engine the panel width must divide the local block width so
+every panel has a single owner (the reference's panels equal whole worker
+blocks, src:115-120 — ours are finer).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dhqr_tpu.ops.blocked import apply_block_reflector_h
+from dhqr_tpu.ops.householder import _householder_qr_impl, householder_reflector
+from dhqr_tpu.parallel.mesh import DEFAULT_AXIS, column_sharding
+
+
+def _unblocked_shard_body(Al, *, n: int, axis: str):
+    """Per-device body: Al is the local (m, nloc) column block."""
+    m, nloc = Al.shape
+    p = lax.axis_index(axis)
+    delta_j = p * nloc  # global column offset — LocalColumnBlock.Δj (src:34)
+    rows = lax.iota(jnp.int32, m)
+    gidx = delta_j + lax.iota(jnp.int32, nloc)  # global indices of local cols
+
+    def step(j, carry):
+        Al, alpha = carry
+        jl = jnp.clip(j - delta_j, 0, nloc - 1)
+        mine = (j >= delta_j) & (j < delta_j + nloc)
+        col_local = lax.dynamic_slice_in_dim(Al, jl, 1, axis=1)[:, 0]
+        # Broadcast = all-reduce of a one-hot contribution (reference's
+        # per-column Hj serialization to every worker, src:138-143).
+        col = lax.psum(jnp.where(mine, col_local, jnp.zeros_like(col_local)), axis)
+        v, alpha_j = householder_reflector(col, j)
+        newcol = jnp.where(rows >= j, v, col)
+        Al_upd = lax.dynamic_update_slice_in_dim(Al, newcol[:, None], jl, axis=1)
+        Al = jnp.where(mine, Al_upd, Al)
+        alpha = lax.dynamic_update_slice_in_dim(alpha, alpha_j[None], j, axis=0)
+        # Local trailing update, columns with global index > j
+        # (_householder_inner! semantics, src:198-213).
+        w = jnp.conj(v) @ Al
+        w = jnp.where(gidx > j, w, jnp.zeros_like(w))
+        Al = Al - v[:, None] * w[None, :]
+        return Al, alpha
+
+    alpha0 = jnp.zeros((n,), dtype=Al.dtype)
+    return lax.fori_loop(0, n, step, (Al, alpha0))
+
+
+def _blocked_shard_body(Al, *, n: int, nb: int, axis: str):
+    """Per-device body for the compact-WY engine; python loop over panels."""
+    m, nloc = Al.shape
+    p = lax.axis_index(axis)
+    gidx_base = p * nloc + lax.iota(jnp.int32, nloc)
+    alpha = jnp.zeros((n,), dtype=Al.dtype)
+
+    for k in range(0, n, nb):
+        b = min(nb, n - k)
+        owner = k // nloc           # static — panels never straddle blocks
+        kl = k - owner * nloc       # static local offset within owner's block
+        mine = p == owner
+        # Every device factors its own (m-k, b) slice; the psum keeps the
+        # owner's result. SPMD-friendly redundant compute beats a branch.
+        panel = lax.slice(Al, (k, kl), (m, kl + b))
+        pf, alpha_k = _householder_qr_impl(panel)
+        zero = jnp.zeros_like(pf)
+        pf = lax.psum(jnp.where(mine, pf, zero), axis)
+        alpha_k = lax.psum(jnp.where(mine, alpha_k, jnp.zeros_like(alpha_k)), axis)
+        alpha = alpha.at[k : k + b].set(alpha_k)
+        # Owner writes the factored panel back into its block.
+        Al_upd = Al.at[k:, kl : kl + b].set(pf)
+        Al = jnp.where(mine, Al_upd, Al)
+        # Replicated trailing transform: C <- (I - Y T^H Y^H) C on local
+        # columns right of the panel (masked), rows k:m.
+        Y = jnp.tril(pf)  # (m-k, b); zeros above row k handled by slicing
+        C = lax.slice(Al, (k, 0), (m, nloc))
+        C_new = apply_block_reflector_h(Y, C)
+        cmask = (gidx_base >= k + b)[None, :]
+        Al = Al.at[k:, :].set(jnp.where(cmask, C_new, C))
+
+    return Al, alpha
+
+
+@lru_cache(maxsize=None)
+def _build_unblocked(mesh: Mesh, axis_name: str, n: int):
+    body = partial(_unblocked_shard_body, n=n, axis=axis_name)
+    return jax.jit(
+        shard_map(
+            body,
+            mesh=mesh,
+            in_specs=P(None, axis_name),
+            out_specs=(P(None, axis_name), P()),
+            check_vma=False,  # alpha is replicated by construction (psum inputs)
+        )
+    )
+
+
+@lru_cache(maxsize=None)
+def _build_blocked(mesh: Mesh, axis_name: str, n: int, nb: int):
+    body = partial(_blocked_shard_body, n=n, nb=nb, axis=axis_name)
+    return jax.jit(
+        shard_map(
+            body,
+            mesh=mesh,
+            in_specs=P(None, axis_name),
+            out_specs=(P(None, axis_name), P()),
+            check_vma=False,
+        )
+    )
+
+
+def sharded_householder_qr(A: jax.Array, mesh: Mesh, axis_name: str = DEFAULT_AXIS):
+    """Unblocked distributed QR: ``(H, alpha)`` with H column-sharded.
+
+    One psum per column — the compiled-program equivalent of the reference's
+    ``householder!(A::DArray, α)`` control flow (src:115-120) without any
+    host round-trips. ``alpha`` is returned replicated (the reference keeps
+    it in a ``SharedArray``, src:302).
+    """
+    m, n = A.shape
+    nproc = mesh.shape[axis_name]
+    _check_divisibility(m, n, nproc, None)
+    A = jax.device_put(A, column_sharding(mesh, axis_name))
+    return _build_unblocked(mesh, axis_name, n)(A)
+
+
+def sharded_blocked_qr(
+    A: jax.Array, mesh: Mesh, block_size: int = 128, axis_name: str = DEFAULT_AXIS
+):
+    """Compact-WY distributed QR: one psum per panel, GEMM trailing updates.
+
+    The MXU path at scale — SURVEY.md §7 stage 3 layered over stage 2.
+    """
+    m, n = A.shape
+    nproc = mesh.shape[axis_name]
+    nb = min(int(block_size), n // nproc)
+    _check_divisibility(m, n, nproc, nb)
+    A = jax.device_put(A, column_sharding(mesh, axis_name))
+    return _build_blocked(mesh, axis_name, n, nb)(A)
+
+
+def _check_divisibility(m, n, nproc, nb):
+    if m < n:
+        raise ValueError(f"requires m >= n, got {(m, n)}")
+    if n % nproc != 0:
+        raise ValueError(f"n={n} must be divisible by mesh size {nproc}")
+    nloc = n // nproc
+    if nb is not None and nloc % nb != 0 and nb < nloc:
+        raise ValueError(
+            f"panel width {nb} must divide local block width {nloc} "
+            f"(or exceed it; pad n or choose block_size accordingly)"
+        )
+    if nb is not None and nb > nloc:
+        raise ValueError(
+            f"panel width {nb} wider than local block {nloc}: lower block_size "
+            f"to <= {nloc} so each panel has a single owner"
+        )

@@ -1,0 +1,154 @@
+"""Mesh-sharded least-squares solve (SURVEY.md §7 stage 4).
+
+TPU-native replacement for the reference's distributed solve
+(reference src/DistributedHouseholderQR.jl:226-282):
+
+* Stage 1 (apply Q^H): the reference walks workers *sequentially in pid
+  order* — column order is dependency order — mutating b through shared
+  memory (src:226-242). Here each nb-wide panel's reflectors are broadcast
+  with one psum and the panel transform is applied replicated, so the
+  sequential chain is panels, not workers, and lives inside one program.
+* Stage 2 (back-substitution): the reference runs n rounds of
+  scalar partial-row-dot futures, gathered on the master (src:256-282) —
+  the latency-bound tail. Here panels are solved right-to-left: the owner
+  back-substitutes its nb x nb diagonal block and computes its columns'
+  contribution to the remaining rows; one psum per panel broadcasts both
+  (n/nb collectives of O(n) words instead of n rounds of host RPCs).
+
+b stays replicated throughout — the analogue of the reference's
+``SharedArray(b)`` (src:318).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dhqr_tpu.ops.blocked import apply_block_reflector_h
+from dhqr_tpu.parallel.mesh import DEFAULT_AXIS, column_sharding, replicated_sharding
+
+
+def _apply_qt_shard_body(Hl, b, *, n: int, nb: int, axis: str):
+    """b <- Q^H b, panel by panel; Hl is the local (m, nloc) block."""
+    m, nloc = Hl.shape
+    p = lax.axis_index(axis)
+    vec = b.ndim == 1
+    B = b[:, None] if vec else b
+
+    for k in range(0, n, nb):
+        bsz = min(nb, n - k)
+        owner = k // nloc
+        kl = k - owner * nloc
+        mine = p == owner
+        # Broadcast the owner's panel reflectors (rows k:m) — the psum
+        # equivalent of stage 1's per-worker visit (src:227-229).
+        panel = jnp.tril(lax.slice(Hl, (k, kl), (m, kl + bsz)))
+        panel = lax.psum(jnp.where(mine, panel, jnp.zeros_like(panel)), axis)
+        tail = lax.slice(B, (k, 0), B.shape)
+        B = B.at[k:, :].set(apply_block_reflector_h(panel, tail))
+
+    return B[:, 0] if vec else B
+
+
+def _backsub_shard_body(Hl, alpha, c, *, n: int, nb: int, axis: str):
+    """Solve R x = c[:n]; R packed in (Hl strict upper, alpha). Returns x.
+
+    Right-to-left panel sweep replacing the reference's n fetch rounds
+    (src:256-282). Per panel, the owner solves the diagonal block and forms
+    its columns' update to all earlier rows; both ride one psum. ``c`` may
+    be (m,) or (m, k).
+    """
+    m, nloc = Hl.shape
+    p = lax.axis_index(axis)
+    rows_n = lax.iota(jnp.int32, n)[:, None]
+    vec = c.ndim == 1
+    C = (c[:, None] if vec else c)[:n]
+    x = jnp.zeros_like(C)
+
+    for k in reversed(range(0, n, nb)):
+        bsz = min(nb, n - k)
+        owner = k // nloc
+        kl = k - owner * nloc
+        mine = p == owner
+        # Owner's diagonal block: strict upper from H, diagonal from alpha
+        # (the reference's R packing, src:244-254).
+        blk = lax.slice(Hl, (k, kl), (k + bsz, kl + bsz))
+        Rpp = jnp.triu(blk, k=1) + jnp.diag(lax.dynamic_slice_in_dim(alpha, k, bsz))
+        xp = lax.linalg.triangular_solve(
+            Rpp, C[k : k + bsz], left_side=True, lower=False
+        )  # (bsz, nrhs)
+        # Owner's columns' contribution to earlier rows: R[0:k, panel] @ xp.
+        above = lax.slice(Hl, (0, kl), (k, kl + bsz)) if k else jnp.zeros((0, bsz), Hl.dtype)
+        delta = above @ xp  # (k, nrhs)
+        packed = jnp.concatenate(
+            [delta, xp, jnp.zeros((n - k - bsz, xp.shape[1]), C.dtype)]
+        )
+        packed = lax.psum(jnp.where(mine, packed, jnp.zeros_like(packed)), axis)
+        x = jnp.where((rows_n >= k) & (rows_n < k + bsz), packed, x)
+        C = jnp.where(rows_n < k, C - packed, C)
+
+    return x[:, 0] if vec else x
+
+
+@lru_cache(maxsize=None)
+def _build_solve(mesh: Mesh, axis_name: str, n: int, nb: int):
+    def full(Hl, alpha, b):
+        cb = _apply_qt_shard_body(Hl, b, n=n, nb=nb, axis=axis_name)
+        return _backsub_shard_body(Hl, alpha, cb, n=n, nb=nb, axis=axis_name)
+
+    return jax.jit(
+        shard_map(
+            full,
+            mesh=mesh,
+            in_specs=(P(None, axis_name), P(), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+
+
+def sharded_solve(
+    H: jax.Array,
+    alpha: jax.Array,
+    b: jax.Array,
+    mesh: Mesh,
+    block_size: int = 128,
+    axis_name: str = DEFAULT_AXIS,
+) -> jax.Array:
+    """x = argmin ||A x - b|| from the sharded packed factorization.
+
+    The reference's ``solve_householder!`` orchestration (src:284-294) as one
+    compiled program: Q^H apply then panel back-substitution, b replicated.
+    """
+    from dhqr_tpu.parallel.sharded_qr import _check_divisibility
+
+    m, n = H.shape
+    nproc = mesh.shape[axis_name]
+    nb = min(int(block_size), n // nproc)
+    _check_divisibility(m, n, nproc, nb)
+    H = jax.device_put(H, column_sharding(mesh, axis_name))
+    alpha = jax.device_put(alpha, replicated_sharding(mesh))
+    b = jax.device_put(b, replicated_sharding(mesh))
+    return _build_solve(mesh, axis_name, n, nb)(H, alpha, b)
+
+
+def sharded_lstsq(
+    A: jax.Array,
+    b: jax.Array,
+    mesh: Mesh,
+    block_size: int = 128,
+    axis_name: str = DEFAULT_AXIS,
+) -> jax.Array:
+    """One-shot distributed least squares: factor + solve on the mesh.
+
+    The distributed equivalent of ``qr!(A) \\ b`` (reference runtests.jl:77-78).
+    """
+    from dhqr_tpu.parallel.sharded_qr import sharded_blocked_qr
+
+    H, alpha = sharded_blocked_qr(A, mesh, block_size=block_size, axis_name=axis_name)
+    return sharded_solve(H, alpha, b, mesh, block_size=block_size, axis_name=axis_name)

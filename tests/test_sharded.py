@@ -1,0 +1,140 @@
+"""Sharded-engine tests on the virtual 8-device CPU mesh (SURVEY.md §4).
+
+The reference tests multi-process behavior with a local fake cluster
+(test/runtests.jl:9); we test multi-device behavior with
+``--xla_force_host_platform_device_count=8`` (set in conftest). The sharded
+engines must match the single-device engines bit-for-bit in exact arithmetic
+and to rounding otherwise, and satisfy the same 8x acceptance criterion.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dhqr_tpu.ops.blocked import blocked_householder_qr
+from dhqr_tpu.ops.householder import householder_qr
+from dhqr_tpu.parallel.mesh import column_mesh
+from dhqr_tpu.parallel.sharded_qr import sharded_blocked_qr, sharded_householder_qr
+from dhqr_tpu.parallel.sharded_solve import sharded_lstsq, sharded_solve
+from dhqr_tpu.utils.testing import (
+    TOLERANCE_FACTOR,
+    normal_equations_residual,
+    oracle_residual,
+    random_problem,
+)
+
+
+@pytest.fixture(scope="module", params=[2, 8])
+def mesh(request):
+    return column_mesh(request.param)
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_sharded_unblocked_matches_serial(mesh, dtype):
+    A, _ = random_problem(72, 64, dtype, seed=31)
+    H0, a0 = householder_qr(jnp.asarray(A))
+    H1, a1 = sharded_householder_qr(jnp.asarray(A), mesh)
+    np.testing.assert_allclose(np.asarray(H1), np.asarray(H0), rtol=1e-9, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(a0), rtol=1e-9, atol=1e-12)
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_sharded_blocked_matches_serial(mesh, dtype):
+    A, _ = random_problem(100, 64, dtype, seed=32)
+    H0, a0 = blocked_householder_qr(jnp.asarray(A), block_size=8)
+    H1, a1 = sharded_blocked_qr(jnp.asarray(A), mesh, block_size=8)
+    np.testing.assert_allclose(np.asarray(H1), np.asarray(H0), rtol=1e-9, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(a0), rtol=1e-9, atol=1e-12)
+
+
+def test_sharded_output_shardings(mesh):
+    """H comes back column-sharded, alpha replicated (SharedArray analogue)."""
+    A, _ = random_problem(64, 32, np.float64, seed=33)
+    H, alpha = sharded_blocked_qr(jnp.asarray(A), mesh, block_size=4)
+    nshards = mesh.devices.size
+    assert len({s.device for s in H.addressable_shards}) == nshards
+    assert H.addressable_shards[0].data.shape == (64, 32 // nshards)
+    assert alpha.addressable_shards[0].data.shape == (32,)  # replicated
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_sharded_solve_8x_criterion(mesh, dtype):
+    """The reference's distributed acceptance test (runtests.jl:80-82)."""
+    A, b = random_problem(212, 192, dtype, seed=34)
+    H, alpha = sharded_blocked_qr(jnp.asarray(A), mesh, block_size=8)
+    x = np.asarray(sharded_solve(H, alpha, jnp.asarray(b), mesh, block_size=8))
+    assert normal_equations_residual(A, x, b) < TOLERANCE_FACTOR * max(
+        oracle_residual(A, b), 1e-300
+    )
+
+
+def test_sharded_lstsq_matches_serial_lstsq(mesh):
+    import dhqr_tpu
+
+    A, b = random_problem(96, 64, np.float64, seed=35)
+    x_serial = np.asarray(dhqr_tpu.lstsq(jnp.asarray(A), jnp.asarray(b), block_size=8))
+    x_shard = np.asarray(sharded_lstsq(jnp.asarray(A), jnp.asarray(b), mesh, block_size=8))
+    np.testing.assert_allclose(x_shard, x_serial, rtol=1e-8, atol=1e-10)
+
+
+def test_api_mesh_routing(mesh):
+    """qr(A, mesh=...) and lstsq(A, b, mesh=...) run the distributed tier."""
+    import dhqr_tpu
+
+    A, b = random_problem(96, 64, np.float64, seed=37)
+    fact = dhqr_tpu.qr(jnp.asarray(A), mesh=mesh, block_size=8)
+    assert fact.mesh is mesh
+    nshards = mesh.devices.size
+    assert fact.H.addressable_shards[0].data.shape == (96, 64 // nshards)
+    x = np.asarray(fact.solve(jnp.asarray(b)))
+    x2 = np.asarray(dhqr_tpu.lstsq(jnp.asarray(A), jnp.asarray(b), mesh=mesh, block_size=8))
+    x_serial = np.asarray(dhqr_tpu.lstsq(jnp.asarray(A), jnp.asarray(b), block_size=8))
+    np.testing.assert_allclose(x, x_serial, rtol=1e-8, atol=1e-10)
+    np.testing.assert_allclose(x2, x_serial, rtol=1e-8, atol=1e-10)
+
+
+def test_sharded_multi_rhs_solve(mesh):
+    """Distributed solve accepts (m, k) right-hand sides like the serial path."""
+    import dhqr_tpu
+
+    A, _ = random_problem(96, 64, np.float64, seed=38)
+    B = np.random.default_rng(39).random((96, 3))
+    fact = dhqr_tpu.qr(jnp.asarray(A), mesh=mesh, block_size=8)
+    X = np.asarray(fact.solve(jnp.asarray(B)))
+    assert X.shape == (64, 3)
+    for i in range(3):
+        np.testing.assert_allclose(
+            X[:, i], np.asarray(fact.solve(jnp.asarray(B[:, i]))), rtol=1e-11, atol=1e-13
+        )
+
+
+def test_mesh_lstsq_respects_blocked_false(mesh):
+    import dhqr_tpu
+
+    A, b = random_problem(96, 64, np.float64, seed=40)
+    x_b = np.asarray(dhqr_tpu.lstsq(jnp.asarray(A), jnp.asarray(b), mesh=mesh))
+    x_u = np.asarray(dhqr_tpu.lstsq(jnp.asarray(A), jnp.asarray(b), mesh=mesh, blocked=False))
+    np.testing.assert_allclose(x_u, x_b, rtol=1e-9, atol=1e-11)
+
+
+def test_mesh_donate_rejected():
+    import dhqr_tpu
+
+    with pytest.raises(ValueError):
+        dhqr_tpu.qr(jnp.ones((16, 8)), mesh=column_mesh(2), donate=True)
+
+
+def test_indivisible_n_rejected():
+    mesh = column_mesh(8)
+    with pytest.raises(ValueError):
+        sharded_blocked_qr(jnp.ones((20, 10)), mesh)
+
+
+def test_sharded_f32():
+    """TPU dtype on the sharded path."""
+    mesh = column_mesh(4)
+    A, b = random_problem(128, 64, np.float32, seed=36)
+    x = np.asarray(sharded_lstsq(jnp.asarray(A), jnp.asarray(b), mesh, block_size=16))
+    r = normal_equations_residual(A, x, b)
+    assert x.dtype == np.float32 and r < 1e-2
