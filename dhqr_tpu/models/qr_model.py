@@ -46,21 +46,23 @@ class QRFactorization:
       mesh: optional — when set, H is column-sharded over this mesh and
         solves run the distributed engines (the DArray tier of reference
         src:115-120, selected here by placement rather than array type).
+      precision: matmul precision used when applying Q/Q^H in solves.
     """
 
     H: jax.Array
     alpha: jax.Array
     block_size: int = _blocked.DEFAULT_BLOCK_SIZE
     mesh: object = None
+    precision: str = _hh.DEFAULT_PRECISION
 
     # -- pytree plumbing ---------------------------------------------------
     def tree_flatten(self):
-        return (self.H, self.alpha), (self.block_size, self.mesh)
+        return (self.H, self.alpha), (self.block_size, self.mesh, self.precision)
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
         H, alpha = leaves
-        return cls(H, alpha, block_size=aux[0], mesh=aux[1])
+        return cls(H, alpha, block_size=aux[0], mesh=aux[1], precision=aux[2])
 
     # -- derived quantities ------------------------------------------------
     @property
@@ -81,7 +83,9 @@ class QRFactorization:
         m, n = self.H.shape
         k = n if k is None else k
         eye = jnp.eye(m, k, dtype=self.H.dtype)
-        return _blocked.blocked_apply_q(self.H, self.alpha, eye, self.block_size)
+        return _blocked.blocked_apply_q(
+            self.H, self.alpha, eye, self.block_size, precision=self.precision
+        )
 
     # -- solves ------------------------------------------------------------
     def solve(self, b: jax.Array) -> jax.Array:
@@ -92,18 +96,25 @@ class QRFactorization:
             from dhqr_tpu.parallel.sharded_solve import sharded_solve
 
             return sharded_solve(
-                self.H, self.alpha, b, self.mesh, block_size=self.block_size
+                self.H, self.alpha, b, self.mesh,
+                block_size=self.block_size, precision=self.precision,
             )
-        c = _blocked.blocked_apply_qt(self.H, self.alpha, b, self.block_size)
+        c = _blocked.blocked_apply_qt(
+            self.H, self.alpha, b, self.block_size, precision=self.precision
+        )
         return _solve.back_substitute(self.H, self.alpha, c)
 
     def matmul_q(self, b: jax.Array) -> jax.Array:
         """Q @ b (b of length m, or (m, k))."""
-        return _blocked.blocked_apply_q(self.H, self.alpha, b, self.block_size)
+        return _blocked.blocked_apply_q(
+            self.H, self.alpha, b, self.block_size, precision=self.precision
+        )
 
     def matmul_qt(self, b: jax.Array) -> jax.Array:
         """Q^H @ b."""
-        return _blocked.blocked_apply_qt(self.H, self.alpha, b, self.block_size)
+        return _blocked.blocked_apply_qt(
+            self.H, self.alpha, b, self.block_size, precision=self.precision
+        )
 
 
 def qr(
@@ -135,18 +146,28 @@ def qr(
         nb = fit_block_size(nloc, cfg.block_size)
         if cfg.blocked:
             H, alpha = _sharded.sharded_blocked_qr(
-                A, mesh, block_size=nb, axis_name=cfg.mesh_axis
+                A, mesh, block_size=nb, axis_name=cfg.mesh_axis,
+                precision=cfg.precision,
             )
         else:
-            H, alpha = _sharded.sharded_householder_qr(A, mesh, axis_name=cfg.mesh_axis)
-        return QRFactorization(H, alpha, block_size=nb, mesh=mesh)
+            H, alpha = _sharded.sharded_householder_qr(
+                A, mesh, axis_name=cfg.mesh_axis, precision=cfg.precision
+            )
+        return QRFactorization(
+            H, alpha, block_size=nb, mesh=mesh, precision=cfg.precision
+        )
     if cfg.blocked:
-        H, alpha = _blocked.blocked_householder_qr(A, cfg.block_size, donate=donate)
+        H, alpha = _blocked.blocked_householder_qr(
+            A, cfg.block_size, donate=donate, precision=cfg.precision,
+            use_pallas=cfg.use_pallas,
+        )
     else:
         if donate:
             raise ValueError("donate=True is only supported on the blocked path")
-        H, alpha = _hh.householder_qr(A)
-    return QRFactorization(H, alpha, block_size=cfg.block_size)
+        H, alpha = _hh.householder_qr(A, precision=cfg.precision)
+    return QRFactorization(
+        H, alpha, block_size=cfg.block_size, precision=cfg.precision
+    )
 
 
 def solve(fact: QRFactorization, b: jax.Array) -> jax.Array:
@@ -154,14 +175,16 @@ def solve(fact: QRFactorization, b: jax.Array) -> jax.Array:
     return fact.solve(b)
 
 
-@partial(jax.jit, static_argnames=("block_size", "blocked"))
-def _lstsq_impl(A, b, block_size, blocked):
+@partial(jax.jit, static_argnames=("block_size", "blocked", "precision", "use_pallas"))
+def _lstsq_impl(A, b, block_size, blocked, precision, use_pallas):
     if blocked:
-        H, alpha = _blocked.blocked_householder_qr(A, block_size)
-        c = _blocked.blocked_apply_qt(H, alpha, b, block_size)
+        H, alpha = _blocked.blocked_householder_qr(
+            A, block_size, precision=precision, use_pallas=use_pallas
+        )
+        c = _blocked.blocked_apply_qt(H, alpha, b, block_size, precision=precision)
     else:
-        H, alpha = _hh.householder_qr(A)
-        c = _solve.apply_qt(H, alpha, b)
+        H, alpha = _hh.householder_qr(A, precision=precision)
+        c = _solve.apply_qt(H, alpha, b, precision=precision)
     return _solve.back_substitute(H, alpha, c)
 
 
@@ -186,9 +209,17 @@ def lstsq(
         nloc = A.shape[1] // mesh.shape[cfg.mesh_axis]
         nb = fit_block_size(nloc, cfg.block_size)
         if not cfg.blocked:
-            H, alpha = sharded_householder_qr(A, mesh, axis_name=cfg.mesh_axis)
-            return sharded_solve(
-                H, alpha, b, mesh, block_size=nb, axis_name=cfg.mesh_axis
+            H, alpha = sharded_householder_qr(
+                A, mesh, axis_name=cfg.mesh_axis, precision=cfg.precision
             )
-        return sharded_lstsq(A, b, mesh, block_size=nb, axis_name=cfg.mesh_axis)
-    return _lstsq_impl(A, b, cfg.block_size, cfg.blocked)
+            return sharded_solve(
+                H, alpha, b, mesh,
+                block_size=nb, axis_name=cfg.mesh_axis, precision=cfg.precision,
+            )
+        return sharded_lstsq(
+            A, b, mesh,
+            block_size=nb, axis_name=cfg.mesh_axis, precision=cfg.precision,
+        )
+    return _lstsq_impl(
+        A, b, cfg.block_size, cfg.blocked, cfg.precision, cfg.use_pallas
+    )

@@ -26,12 +26,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from dhqr_tpu.ops.householder import _householder_qr_impl
+from dhqr_tpu.ops.householder import DEFAULT_PRECISION, _householder_qr_impl
 
 DEFAULT_BLOCK_SIZE = 128
 
 
-def wy_upper(Y: jax.Array) -> jax.Array:
+def wy_upper(Y: jax.Array, precision=DEFAULT_PRECISION) -> jax.Array:
     """U = I + triu(Y^H Y, 1), the inverse of the compact-WY T factor.
 
     Derivation: with tau_i = 1, T satisfies the larft recurrence
@@ -40,58 +40,110 @@ def wy_upper(Y: jax.Array) -> jax.Array:
     One (nb x m)@(m x nb) GEMM — MXU work, not a scalar recurrence.
     """
     nb = Y.shape[1]
-    S = jnp.conj(Y.T) @ Y
+    S = jnp.matmul(jnp.conj(Y.T), Y, precision=precision)
     return jnp.eye(nb, dtype=Y.dtype) + jnp.triu(S, k=1)
 
 
-def apply_block_reflector_h(Y: jax.Array, C: jax.Array) -> jax.Array:
+def apply_block_reflector_h(
+    Y: jax.Array, C: jax.Array, precision=DEFAULT_PRECISION
+) -> jax.Array:
     """C <- (I - Y T^H Y^H) C, i.e. apply H_nb ... H_1 (the Q^H direction)."""
-    U = wy_upper(Y)
-    W = jnp.conj(Y.T) @ C
+    U = wy_upper(Y, precision)
+    W = jnp.matmul(jnp.conj(Y.T), C, precision=precision)
     Z = lax.linalg.triangular_solve(
         U, W, left_side=True, lower=False, transpose_a=True, conjugate_a=True,
         unit_diagonal=True,
     )
-    return C - Y @ Z
+    return C - jnp.matmul(Y, Z, precision=precision)
 
 
-def apply_block_reflector(Y: jax.Array, C: jax.Array) -> jax.Array:
+def apply_block_reflector(
+    Y: jax.Array, C: jax.Array, precision=DEFAULT_PRECISION
+) -> jax.Array:
     """C <- (I - Y T Y^H) C, i.e. apply H_1 ... H_nb (the Q direction)."""
-    U = wy_upper(Y)
-    W = jnp.conj(Y.T) @ C
+    U = wy_upper(Y, precision)
+    W = jnp.matmul(jnp.conj(Y.T), C, precision=precision)
     Z = lax.linalg.triangular_solve(
         U, W, left_side=True, lower=False, transpose_a=False, conjugate_a=False,
         unit_diagonal=True,
     )
-    return C - Y @ Z
+    return C - jnp.matmul(Y, Z, precision=precision)
 
 
-@partial(jax.jit, static_argnames=("block_size",))
-def _blocked_qr_impl(A, block_size):
+@partial(
+    jax.jit, static_argnames=("block_size", "precision", "pallas", "pallas_interpret")
+)
+def _blocked_qr_impl(
+    A, block_size, precision=DEFAULT_PRECISION, pallas=False, pallas_interpret=False
+):
+    from dhqr_tpu.ops.pallas_panel import _panel_qr_pallas_impl, pallas_panel_supported
+
     m, n = A.shape
     nb = block_size
     H = A
     alpha = jnp.zeros((n,), dtype=A.dtype)
     for k in range(0, n, nb):
         b = min(nb, n - k)
-        panel = lax.slice(H, (k, k), (m, k + b))
-        pf, alpha_k = _householder_qr_impl(panel)
-        H = H.at[k:, k : k + b].set(pf)
-        alpha = alpha.at[k : k + b].set(alpha_k)
+        # phase names = the reference's t1a (panel math) / t1b (trailing
+        # update) timers (src:126-146), visible in XLA/perfetto traces.
+        with jax.named_scope("panel_factor"):
+            panel = lax.slice(H, (k, k), (m, k + b))
+            if pallas and pallas_panel_supported(m - k, b, A.dtype):
+                pf, alpha_k = _panel_qr_pallas_impl(panel, interpret=pallas_interpret)
+            else:
+                pf, alpha_k = _householder_qr_impl(panel, precision=precision)
+            H = H.at[k:, k : k + b].set(pf)
+            alpha = alpha.at[k : k + b].set(alpha_k)
         if k + b < n:
-            Y = jnp.tril(pf)  # reflectors incl. diagonal; R part masked off
-            C = lax.slice(H, (k, k + b), (m, n))
-            H = H.at[k:, k + b :].set(apply_block_reflector_h(Y, C))
+            with jax.named_scope("trailing_update"):
+                Y = jnp.tril(pf)  # reflectors incl. diagonal; R part masked off
+                C = lax.slice(H, (k, k + b), (m, n))
+                H = H.at[k:, k + b :].set(apply_block_reflector_h(Y, C, precision))
     return H, alpha
 
 
 _blocked_qr_impl_donate = partial(
-    jax.jit, static_argnames=("block_size",), donate_argnums=(0,)
+    jax.jit,
+    static_argnames=("block_size", "precision", "pallas", "pallas_interpret"),
+    donate_argnums=(0,),
 )(_blocked_qr_impl.__wrapped__)
 
 
+def _resolve_pallas(mode: str, m: int, nb: int, dtype) -> tuple[bool, bool]:
+    """Map a ``use_pallas`` config value to (enabled, interpret) for a shape.
+
+    "always" forces the fused panel kernel, using the Pallas interpreter
+    off-TPU (the CPU test path); "never" disables it. "auto" currently
+    resolves to the XLA panel path: the kernel's backward error on real
+    hardware has not yet been measured against the <1e-5 target (its norm is
+    a plain f32 sum, not the compensated tree of ops/summation.py), so it
+    stays opt-in until benchmarked accurate — then "auto" flips to
+    shape-gated on-TPU use.
+    """
+    from dhqr_tpu.ops.pallas_panel import pallas_panel_supported
+
+    if mode == "never":
+        return False, False
+    supported = pallas_panel_supported(m, nb, dtype)
+    on_tpu = jax.default_backend() == "tpu"
+    if mode == "always":
+        if not supported:
+            raise ValueError(
+                f"use_pallas='always' but an ({m}, {nb}) {jnp.dtype(dtype).name} "
+                "panel is unsupported (float32-only, must fit VMEM)"
+            )
+        return True, not on_tpu
+    if mode == "auto":
+        return False, False
+    raise ValueError(f"use_pallas must be 'auto', 'always' or 'never', got {mode!r}")
+
+
 def blocked_householder_qr(
-    A: jax.Array, block_size: int = DEFAULT_BLOCK_SIZE, donate: bool = False
+    A: jax.Array,
+    block_size: int = DEFAULT_BLOCK_SIZE,
+    donate: bool = False,
+    precision: str = DEFAULT_PRECISION,
+    use_pallas: str = "auto",
 ):
     """Factor ``A`` (m x n, m >= n): returns ``(H, alpha)`` in packed storage.
 
@@ -107,12 +159,14 @@ def blocked_householder_qr(
     m, n = A.shape
     if m < n:
         raise ValueError(f"blocked_householder_qr requires m >= n, got {A.shape}")
+    nb = int(block_size)
+    pallas, interpret = _resolve_pallas(use_pallas, m, min(nb, n), A.dtype)
     impl = _blocked_qr_impl_donate if donate else _blocked_qr_impl
-    return impl(A, int(block_size))
+    return impl(A, nb, precision=precision, pallas=pallas, pallas_interpret=interpret)
 
 
-@partial(jax.jit, static_argnames=("block_size",))
-def _apply_qt_impl(H, b, block_size):
+@partial(jax.jit, static_argnames=("block_size", "precision"))
+def _apply_qt_impl(H, b, block_size, precision=DEFAULT_PRECISION):
     m, n = H.shape
     nb = block_size
     vec = b.ndim == 1
@@ -120,12 +174,16 @@ def _apply_qt_impl(H, b, block_size):
     for k in range(0, n, nb):
         bsz = min(nb, n - k)
         Y = jnp.tril(lax.slice(H, (k, k), (m, k + bsz)))
-        B = B.at[k:].set(apply_block_reflector_h(Y, B[k:]))
+        B = B.at[k:].set(apply_block_reflector_h(Y, B[k:], precision))
     return B[:, 0] if vec else B
 
 
 def blocked_apply_qt(
-    H: jax.Array, alpha: jax.Array, b: jax.Array, block_size: int = DEFAULT_BLOCK_SIZE
+    H: jax.Array,
+    alpha: jax.Array,
+    b: jax.Array,
+    block_size: int = DEFAULT_BLOCK_SIZE,
+    precision: str = DEFAULT_PRECISION,
 ) -> jax.Array:
     """b <- Q^H b using the compact-WY form, panel by panel.
 
@@ -133,11 +191,11 @@ def blocked_apply_qt(
     accepts a vector (m,) or a block of right-hand sides (m, k).
     """
     del alpha
-    return _apply_qt_impl(H, b, int(block_size))
+    return _apply_qt_impl(H, b, int(block_size), precision=precision)
 
 
-@partial(jax.jit, static_argnames=("block_size",))
-def _apply_q_impl(H, b, block_size):
+@partial(jax.jit, static_argnames=("block_size", "precision"))
+def _apply_q_impl(H, b, block_size, precision=DEFAULT_PRECISION):
     m, n = H.shape
     nb = block_size
     vec = b.ndim == 1
@@ -145,13 +203,17 @@ def _apply_q_impl(H, b, block_size):
     for k in reversed(range(0, n, nb)):
         bsz = min(nb, n - k)
         Y = jnp.tril(lax.slice(H, (k, k), (m, k + bsz)))
-        B = B.at[k:].set(apply_block_reflector(Y, B[k:]))
+        B = B.at[k:].set(apply_block_reflector(Y, B[k:], precision))
     return B[:, 0] if vec else B
 
 
 def blocked_apply_q(
-    H: jax.Array, alpha: jax.Array, b: jax.Array, block_size: int = DEFAULT_BLOCK_SIZE
+    H: jax.Array,
+    alpha: jax.Array,
+    b: jax.Array,
+    block_size: int = DEFAULT_BLOCK_SIZE,
+    precision: str = DEFAULT_PRECISION,
 ) -> jax.Array:
     """b <- Q b using the compact-WY form, panels in reverse order."""
     del alpha
-    return _apply_q_impl(H, b, int(block_size))
+    return _apply_q_impl(H, b, int(block_size), precision=precision)

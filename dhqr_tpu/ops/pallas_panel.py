@@ -1,0 +1,131 @@
+"""Pallas TPU kernel: fused in-VMEM panel factorization (layer L0).
+
+The hot serial region of blocked QR is the panel factorization — nb
+dependent column steps, each a small norm + scale + rank-1 update. Run
+through XLA, every step round-trips the panel through HBM; the whole panel
+loop is latency-bound exactly like the reference's per-column broadcast loop
+(reference src/DistributedHouseholderQR.jl:127-148, flagged "this is most
+expensive" at src:141). This kernel is the TPU counterpart of the
+reference's hand-written SIMD micro-kernels (``partialdot``/``hotloop!``,
+src:42-59, 150-196): it keeps the entire panel resident in VMEM and runs all
+nb column steps in one kernel launch.
+
+Layout: the panel is processed *transposed* — ``At`` is (nb, m), one panel
+column per sublane row — because Pallas/Mosaic supports dynamic indexing on
+the second-to-last (sublane) axis, while the contraction and rank-1 update
+vectorize along the m-length lane axis. Per column j:
+
+    row_j = At[j, :]                     (dynamic sublane read)
+    s     = ||row_j masked to i >= j||
+    v     = f * (row_j - alpha_j e_j)    (reference scaling, ||v||^2 = 2)
+    W     = At @ v                       (all partial dots at once)
+    At   -= W[:, None] * v[None, :]      (all rank-1 axpys at once)
+
+with row masks ``i >= j`` and row masks ``jj > j`` replacing the ragged
+ranges. The reflector formulas match :func:`dhqr_tpu.ops.householder`
+(alpha sign rule src:8-9, ``f = 1/sqrt(s(s+|a_jj|))`` src:131), but the
+column norm is a plain f32 sum of squares, NOT the compensated tree of
+``ops/summation.py`` — rounding differs from the XLA engine by a few ulps
+per column, which is why the kernel stays opt-in (``use_pallas="always"``)
+until its backward error is validated on hardware.
+
+Float32 only (TPU-native dtype; f64 stays on the XLA path, complex is
+unsupported by Mosaic), and the panel must fit in VMEM — callers gate via
+:func:`pallas_panel_supported`.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# VMEM working-set budget for the transposed panel (bytes). The chip has
+# ~16 MiB per core; leave headroom for the output copy and scratch.
+_VMEM_PANEL_BUDGET = 12 * 1024 * 1024
+
+
+def pallas_panel_supported(m: int, nb: int, dtype) -> bool:
+    """True when the fused kernel can factor an (m, nb) f32 panel in VMEM."""
+    if jnp.dtype(dtype) != jnp.float32:
+        return False
+    # input block + output block both resident
+    return 2 * m * nb * 4 <= _VMEM_PANEL_BUDGET
+
+
+def _panel_kernel(at_ref, out_ref, alpha_ref, *, nb: int, m: int):
+    """Factor the transposed panel At (nb, m) in place; alpha out is (nb, 1)."""
+    lane = lax.broadcasted_iota(jnp.int32, (1, m), 1)  # (1, m) global row index
+
+    def step(j, at):
+        row = jax.lax.dynamic_slice_in_dim(at, j, 1, axis=0)  # (1, m)
+        rmask = lane >= j
+        rowm = jnp.where(rmask, row, 0.0)
+        s = jnp.sqrt(jnp.sum(rowm * rowm))
+        a_jj = jnp.sum(jnp.where(lane == j, row, 0.0))
+        alpha_j = jnp.where(a_jj >= 0, -s, s)  # s * alphafactor(a_jj) (src:8-9)
+        denom = s * (s + jnp.abs(a_jj))
+        f = jnp.where(denom > 0, 1.0 / jnp.sqrt(jnp.where(denom > 0, denom, 1.0)), 0.0)
+        v = (rowm - alpha_j * (lane == j)) * f  # (1, m), ||v||^2 = 2
+        # All partial dots at once: W[jj] = <v, At[jj, :]> (contraction over m).
+        # HIGHEST: full-f32 MXU passes — same reason as DEFAULT_PRECISION in
+        # ops/householder.py; bf16 passes here would poison every reflector.
+        W = jax.lax.dot_general(
+            at, v,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )  # (nb, 1)
+        row_ids = lax.broadcasted_iota(jnp.int32, (nb, 1), 0)
+        W = jnp.where(row_ids > j, W, 0.0)  # update only trailing columns
+        at = at - W * v  # rank-1: the reference hotloop! over all jj (src:150-160)
+        # Store the reflector into row j (replaces the old column content).
+        at = jax.lax.dynamic_update_slice_in_dim(
+            at, jnp.where(rmask, v, row), j, axis=0
+        )
+        alpha_ref[j, 0] = alpha_j
+        return at
+
+    out_ref[:, :] = lax.fori_loop(0, nb, step, at_ref[:, :])
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def _panel_qr_pallas_impl(panel, interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    m, nb = panel.shape
+    at = panel.T  # (nb, m): column j -> sublane row j
+    kernel = partial(_panel_kernel, nb=nb, m=m)
+    out, alpha = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((nb, m), panel.dtype),
+            jax.ShapeDtypeStruct((nb, 1), panel.dtype),
+        ),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+    )(at)
+    return out.T, alpha[:, 0]
+
+
+def panel_qr_pallas(panel: jax.Array, interpret: bool = False):
+    """Factor an (m, nb) Float32 panel with the fused VMEM kernel.
+
+    Returns ``(pf, alpha)`` in the same packed storage as
+    :func:`dhqr_tpu.ops.householder.householder_qr`. ``interpret=True`` runs
+    the Pallas interpreter (CPU testing — the moral equivalent of the
+    reference exercising its SIMD kernels in serial tests, SURVEY.md §4).
+    """
+    m, nb = panel.shape
+    if m < nb:
+        raise ValueError(f"panel_qr_pallas requires m >= nb, got {panel.shape}")
+    if panel.dtype != jnp.float32:
+        raise ValueError(f"panel_qr_pallas is float32-only, got {panel.dtype}")
+    return _panel_qr_pallas_impl(panel, interpret=interpret)

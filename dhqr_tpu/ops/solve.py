@@ -12,9 +12,13 @@ scalar reductions (src:256-282).
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from dhqr_tpu.ops.householder import DEFAULT_PRECISION
 
 
 def _reflector_column(H: jax.Array, j: jax.Array) -> jax.Array:
@@ -24,8 +28,10 @@ def _reflector_column(H: jax.Array, j: jax.Array) -> jax.Array:
     return jnp.where(lax.iota(jnp.int32, m) >= j, col, jnp.zeros_like(col))
 
 
-@jax.jit
-def apply_qt(H: jax.Array, alpha: jax.Array, b: jax.Array) -> jax.Array:
+@partial(jax.jit, static_argnames=("precision",))
+def apply_qt(
+    H: jax.Array, alpha: jax.Array, b: jax.Array, precision: str = DEFAULT_PRECISION
+) -> jax.Array:
     """b <- Q^H b by applying reflectors j = 0..n-1 in order.
 
     Per step: ``s = v_j^H b; b -= v_j s`` — the reference's
@@ -40,15 +46,18 @@ def apply_qt(H: jax.Array, alpha: jax.Array, b: jax.Array) -> jax.Array:
 
     def step(j, B):
         v = _reflector_column(H, j)
-        s = jnp.conj(v) @ B  # conj(v)·b per rhs, reference partialdot (src:51-59)
+        # conj(v)·b per rhs, reference partialdot (src:51-59)
+        s = jnp.matmul(jnp.conj(v), B, precision=precision)
         return B - v[:, None] * s[None, :]
 
     out = lax.fori_loop(0, n, step, B)
     return out[:, 0] if vec else out
 
 
-@jax.jit
-def apply_q(H: jax.Array, alpha: jax.Array, b: jax.Array) -> jax.Array:
+@partial(jax.jit, static_argnames=("precision",))
+def apply_q(
+    H: jax.Array, alpha: jax.Array, b: jax.Array, precision: str = DEFAULT_PRECISION
+) -> jax.Array:
     """b <- Q b by applying reflectors in reverse order (reconstruction aid).
 
     The reference never materializes Q; this is the standard companion used
@@ -63,7 +72,7 @@ def apply_q(H: jax.Array, alpha: jax.Array, b: jax.Array) -> jax.Array:
     def step(k, B):
         j = n - 1 - k
         v = _reflector_column(H, j)
-        s = jnp.conj(v) @ B
+        s = jnp.matmul(jnp.conj(v), B, precision=precision)
         return B - v[:, None] * s[None, :]
 
     out = lax.fori_loop(0, n, step, B)
@@ -90,12 +99,13 @@ def back_substitute(H: jax.Array, alpha: jax.Array, c: jax.Array) -> jax.Array:
     block of right-hand sides (m, k).
     """
     n = H.shape[1]
-    R = r_matrix(H, alpha)
-    vec = c.ndim == 1
-    C = c[:n][:, None] if vec else c[:n]
-    x = lax.linalg.triangular_solve(
-        R, C, left_side=True, lower=False, conjugate_a=False
-    )
+    with jax.named_scope("back_substitute"):  # the reference's t2 (src:291-292)
+        R = r_matrix(H, alpha)
+        vec = c.ndim == 1
+        C = c[:n][:, None] if vec else c[:n]
+        x = lax.linalg.triangular_solve(
+            R, C, left_side=True, lower=False, conjugate_a=False
+        )
     return x[:, 0] if vec else x
 
 

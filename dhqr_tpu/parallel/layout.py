@@ -19,6 +19,8 @@ from __future__ import annotations
 import dataclasses
 import math
 
+import numpy as np
+
 
 @dataclasses.dataclass(frozen=True)
 class ColumnBlock:
@@ -69,6 +71,42 @@ def fit_block_size(nloc: int, requested: int) -> int:
 def column_block_ranges(n: int, n_devices: int) -> list[ColumnBlock]:
     """All devices' blocks — the reference's ``columnblocks`` table (src:18-19)."""
     return [local_column_block(n, n_devices, p) for p in range(n_devices)]
+
+
+def cyclic_store_columns(n: int, n_devices: int, nb: int) -> np.ndarray:
+    """Column order that makes contiguous sharding a block-cyclic layout.
+
+    ``A[:, cyclic_store_columns(n, P, nb)]`` sharded in contiguous blocks of
+    ``n // P`` columns gives device p the global column blocks
+    ``{kb : kb % P == p}`` of width nb — the load-balanced layout SURVEY.md
+    §2 prescribes in place of the reference's uneven sqrt-split blocks
+    (test/runtests.jl:36-38): in the right-looking panel sweep every device
+    keeps owning live panels until the end, instead of the leading blocks'
+    owners going idle.
+
+    Entry ``store[pos]`` is the global (natural) column stored at contiguous
+    position ``pos``. Requires ``n % (nb * P) == 0``.
+    """
+    if n % (nb * n_devices) != 0:
+        raise ValueError(
+            f"cyclic layout needs n divisible by nb*P = {nb * n_devices}, got n={n}"
+        )
+    j = np.arange(n)
+    blk = j // nb
+    device = blk % n_devices
+    local = (blk // n_devices) * nb + j % nb
+    pos = device * (n // n_devices) + local
+    store = np.empty(n, dtype=np.int64)
+    store[pos] = j
+    return store
+
+
+def natural_store_positions(n: int, n_devices: int, nb: int) -> np.ndarray:
+    """Inverse of :func:`cyclic_store_columns`: position of natural column j."""
+    store = cyclic_store_columns(n, n_devices, nb)
+    pos = np.empty(n, dtype=np.int64)
+    pos[store] = np.arange(n)
+    return pos
 
 
 def area_balanced_splits(n_devices: int, n: int) -> list[ColumnBlock]:

@@ -37,11 +37,15 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dhqr_tpu.ops.blocked import apply_block_reflector_h
-from dhqr_tpu.ops.householder import _householder_qr_impl, householder_reflector
+from dhqr_tpu.ops.householder import (
+    DEFAULT_PRECISION,
+    _householder_qr_impl,
+    householder_reflector,
+)
 from dhqr_tpu.parallel.mesh import DEFAULT_AXIS, column_sharding
 
 
-def _unblocked_shard_body(Al, *, n: int, axis: str):
+def _unblocked_shard_body(Al, *, n: int, axis: str, precision: str = DEFAULT_PRECISION):
     """Per-device body: Al is the local (m, nloc) column block."""
     m, nloc = Al.shape
     p = lax.axis_index(axis)
@@ -64,7 +68,7 @@ def _unblocked_shard_body(Al, *, n: int, axis: str):
         alpha = lax.dynamic_update_slice_in_dim(alpha, alpha_j[None], j, axis=0)
         # Local trailing update, columns with global index > j
         # (_householder_inner! semantics, src:198-213).
-        w = jnp.conj(v) @ Al
+        w = jnp.matmul(jnp.conj(v), Al, precision=precision)
         w = jnp.where(gidx > j, w, jnp.zeros_like(w))
         Al = Al - v[:, None] * w[None, :]
         return Al, alpha
@@ -73,7 +77,7 @@ def _unblocked_shard_body(Al, *, n: int, axis: str):
     return lax.fori_loop(0, n, step, (Al, alpha0))
 
 
-def _blocked_shard_body(Al, *, n: int, nb: int, axis: str):
+def _blocked_shard_body(Al, *, n: int, nb: int, axis: str, precision: str = DEFAULT_PRECISION):
     """Per-device body for the compact-WY engine; python loop over panels."""
     m, nloc = Al.shape
     p = lax.axis_index(axis)
@@ -88,7 +92,7 @@ def _blocked_shard_body(Al, *, n: int, nb: int, axis: str):
         # Every device factors its own (m-k, b) slice; the psum keeps the
         # owner's result. SPMD-friendly redundant compute beats a branch.
         panel = lax.slice(Al, (k, kl), (m, kl + b))
-        pf, alpha_k = _householder_qr_impl(panel)
+        pf, alpha_k = _householder_qr_impl(panel, precision=precision)
         zero = jnp.zeros_like(pf)
         pf = lax.psum(jnp.where(mine, pf, zero), axis)
         alpha_k = lax.psum(jnp.where(mine, alpha_k, jnp.zeros_like(alpha_k)), axis)
@@ -100,7 +104,7 @@ def _blocked_shard_body(Al, *, n: int, nb: int, axis: str):
         # columns right of the panel (masked), rows k:m.
         Y = jnp.tril(pf)  # (m-k, b); zeros above row k handled by slicing
         C = lax.slice(Al, (k, 0), (m, nloc))
-        C_new = apply_block_reflector_h(Y, C)
+        C_new = apply_block_reflector_h(Y, C, precision)
         cmask = (gidx_base >= k + b)[None, :]
         Al = Al.at[k:, :].set(jnp.where(cmask, C_new, C))
 
@@ -108,8 +112,8 @@ def _blocked_shard_body(Al, *, n: int, nb: int, axis: str):
 
 
 @lru_cache(maxsize=None)
-def _build_unblocked(mesh: Mesh, axis_name: str, n: int):
-    body = partial(_unblocked_shard_body, n=n, axis=axis_name)
+def _build_unblocked(mesh: Mesh, axis_name: str, n: int, precision: str):
+    body = partial(_unblocked_shard_body, n=n, axis=axis_name, precision=precision)
     return jax.jit(
         shard_map(
             body,
@@ -122,8 +126,8 @@ def _build_unblocked(mesh: Mesh, axis_name: str, n: int):
 
 
 @lru_cache(maxsize=None)
-def _build_blocked(mesh: Mesh, axis_name: str, n: int, nb: int):
-    body = partial(_blocked_shard_body, n=n, nb=nb, axis=axis_name)
+def _build_blocked(mesh: Mesh, axis_name: str, n: int, nb: int, precision: str):
+    body = partial(_blocked_shard_body, n=n, nb=nb, axis=axis_name, precision=precision)
     return jax.jit(
         shard_map(
             body,
@@ -135,7 +139,12 @@ def _build_blocked(mesh: Mesh, axis_name: str, n: int, nb: int):
     )
 
 
-def sharded_householder_qr(A: jax.Array, mesh: Mesh, axis_name: str = DEFAULT_AXIS):
+def sharded_householder_qr(
+    A: jax.Array,
+    mesh: Mesh,
+    axis_name: str = DEFAULT_AXIS,
+    precision: str = DEFAULT_PRECISION,
+):
     """Unblocked distributed QR: ``(H, alpha)`` with H column-sharded.
 
     One psum per column — the compiled-program equivalent of the reference's
@@ -147,11 +156,15 @@ def sharded_householder_qr(A: jax.Array, mesh: Mesh, axis_name: str = DEFAULT_AX
     nproc = mesh.shape[axis_name]
     _check_divisibility(m, n, nproc, None)
     A = jax.device_put(A, column_sharding(mesh, axis_name))
-    return _build_unblocked(mesh, axis_name, n)(A)
+    return _build_unblocked(mesh, axis_name, n, precision)(A)
 
 
 def sharded_blocked_qr(
-    A: jax.Array, mesh: Mesh, block_size: int = 128, axis_name: str = DEFAULT_AXIS
+    A: jax.Array,
+    mesh: Mesh,
+    block_size: int = 128,
+    axis_name: str = DEFAULT_AXIS,
+    precision: str = DEFAULT_PRECISION,
 ):
     """Compact-WY distributed QR: one psum per panel, GEMM trailing updates.
 
@@ -162,7 +175,7 @@ def sharded_blocked_qr(
     nb = min(int(block_size), n // nproc)
     _check_divisibility(m, n, nproc, nb)
     A = jax.device_put(A, column_sharding(mesh, axis_name))
-    return _build_blocked(mesh, axis_name, n, nb)(A)
+    return _build_blocked(mesh, axis_name, n, nb, precision)(A)
 
 
 def _check_divisibility(m, n, nproc, nb):

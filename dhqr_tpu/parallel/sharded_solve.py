@@ -30,10 +30,11 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dhqr_tpu.ops.blocked import apply_block_reflector_h
+from dhqr_tpu.ops.householder import DEFAULT_PRECISION
 from dhqr_tpu.parallel.mesh import DEFAULT_AXIS, column_sharding, replicated_sharding
 
 
-def _apply_qt_shard_body(Hl, b, *, n: int, nb: int, axis: str):
+def _apply_qt_shard_body(Hl, b, *, n: int, nb: int, axis: str, precision: str = DEFAULT_PRECISION):
     """b <- Q^H b, panel by panel; Hl is the local (m, nloc) block."""
     m, nloc = Hl.shape
     p = lax.axis_index(axis)
@@ -50,12 +51,12 @@ def _apply_qt_shard_body(Hl, b, *, n: int, nb: int, axis: str):
         panel = jnp.tril(lax.slice(Hl, (k, kl), (m, kl + bsz)))
         panel = lax.psum(jnp.where(mine, panel, jnp.zeros_like(panel)), axis)
         tail = lax.slice(B, (k, 0), B.shape)
-        B = B.at[k:, :].set(apply_block_reflector_h(panel, tail))
+        B = B.at[k:, :].set(apply_block_reflector_h(panel, tail, precision))
 
     return B[:, 0] if vec else B
 
 
-def _backsub_shard_body(Hl, alpha, c, *, n: int, nb: int, axis: str):
+def _backsub_shard_body(Hl, alpha, c, *, n: int, nb: int, axis: str, precision: str = DEFAULT_PRECISION):
     """Solve R x = c[:n]; R packed in (Hl strict upper, alpha). Returns x.
 
     Right-to-left panel sweep replacing the reference's n fetch rounds
@@ -84,7 +85,7 @@ def _backsub_shard_body(Hl, alpha, c, *, n: int, nb: int, axis: str):
         )  # (bsz, nrhs)
         # Owner's columns' contribution to earlier rows: R[0:k, panel] @ xp.
         above = lax.slice(Hl, (0, kl), (k, kl + bsz)) if k else jnp.zeros((0, bsz), Hl.dtype)
-        delta = above @ xp  # (k, nrhs)
+        delta = jnp.matmul(above, xp, precision=precision)  # (k, nrhs)
         packed = jnp.concatenate(
             [delta, xp, jnp.zeros((n - k - bsz, xp.shape[1]), C.dtype)]
         )
@@ -96,10 +97,14 @@ def _backsub_shard_body(Hl, alpha, c, *, n: int, nb: int, axis: str):
 
 
 @lru_cache(maxsize=None)
-def _build_solve(mesh: Mesh, axis_name: str, n: int, nb: int):
+def _build_solve(mesh: Mesh, axis_name: str, n: int, nb: int, precision: str):
     def full(Hl, alpha, b):
-        cb = _apply_qt_shard_body(Hl, b, n=n, nb=nb, axis=axis_name)
-        return _backsub_shard_body(Hl, alpha, cb, n=n, nb=nb, axis=axis_name)
+        cb = _apply_qt_shard_body(
+            Hl, b, n=n, nb=nb, axis=axis_name, precision=precision
+        )
+        return _backsub_shard_body(
+            Hl, alpha, cb, n=n, nb=nb, axis=axis_name, precision=precision
+        )
 
     return jax.jit(
         shard_map(
@@ -119,6 +124,7 @@ def sharded_solve(
     mesh: Mesh,
     block_size: int = 128,
     axis_name: str = DEFAULT_AXIS,
+    precision: str = DEFAULT_PRECISION,
 ) -> jax.Array:
     """x = argmin ||A x - b|| from the sharded packed factorization.
 
@@ -134,7 +140,7 @@ def sharded_solve(
     H = jax.device_put(H, column_sharding(mesh, axis_name))
     alpha = jax.device_put(alpha, replicated_sharding(mesh))
     b = jax.device_put(b, replicated_sharding(mesh))
-    return _build_solve(mesh, axis_name, n, nb)(H, alpha, b)
+    return _build_solve(mesh, axis_name, n, nb, precision)(H, alpha, b)
 
 
 def sharded_lstsq(
@@ -143,6 +149,7 @@ def sharded_lstsq(
     mesh: Mesh,
     block_size: int = 128,
     axis_name: str = DEFAULT_AXIS,
+    precision: str = DEFAULT_PRECISION,
 ) -> jax.Array:
     """One-shot distributed least squares: factor + solve on the mesh.
 
@@ -150,5 +157,10 @@ def sharded_lstsq(
     """
     from dhqr_tpu.parallel.sharded_qr import sharded_blocked_qr
 
-    H, alpha = sharded_blocked_qr(A, mesh, block_size=block_size, axis_name=axis_name)
-    return sharded_solve(H, alpha, b, mesh, block_size=block_size, axis_name=axis_name)
+    H, alpha = sharded_blocked_qr(
+        A, mesh, block_size=block_size, axis_name=axis_name, precision=precision
+    )
+    return sharded_solve(
+        H, alpha, b, mesh,
+        block_size=block_size, axis_name=axis_name, precision=precision,
+    )

@@ -1,0 +1,60 @@
+"""Checkpoint / resume for factorization state (SURVEY.md §5).
+
+The reference has no checkpointing; SURVEY.md §5 notes its factorization
+object ``(A, alpha)`` (reference src/DistributedHouseholderQR.jl:296-299) is
+trivially serializable state, and the TPU build should provide it. A saved
+factorization lets a long least-squares campaign reuse one expensive QR
+across restarts — the packed ``(H, alpha)`` is all that is needed to solve
+any new right-hand side.
+
+Format: a single ``.npz`` with the two arrays plus the static solve
+configuration (block_size, precision). On load, the factorization can be
+re-placed onto a device mesh (`mesh=`) to resume in distributed form — the
+reference's DArray tier has no such portability; here it is just a
+``device_put`` with a different sharding.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def save_factorization(path: str | os.PathLike, fact) -> None:
+    """Serialize a :class:`~dhqr_tpu.models.qr_model.QRFactorization` to .npz."""
+    np.savez(
+        path,
+        H=np.asarray(fact.H),
+        alpha=np.asarray(fact.alpha),
+        block_size=np.asarray(fact.block_size, dtype=np.int64),
+        precision=np.asarray(str(fact.precision)),
+    )
+
+
+def load_factorization(path: str | os.PathLike, mesh=None, axis_name: str = "cols"):
+    """Load a factorization; optionally re-place it onto a column mesh.
+
+    With ``mesh=`` the reloaded H is column-sharded and alpha replicated, so
+    subsequent solves run the distributed engines — checkpoint on one
+    topology, resume on another.
+    """
+    from dhqr_tpu.models.qr_model import QRFactorization
+
+    with np.load(path) as z:
+        H = jnp.asarray(z["H"])
+        alpha = jnp.asarray(z["alpha"])
+        block_size = int(z["block_size"])
+        precision = str(z["precision"])
+    if mesh is not None:
+        from dhqr_tpu.parallel.layout import fit_block_size
+        from dhqr_tpu.parallel.mesh import column_sharding, replicated_sharding
+
+        H = jax.device_put(H, column_sharding(mesh, axis_name))
+        alpha = jax.device_put(alpha, replicated_sharding(mesh))
+        block_size = fit_block_size(H.shape[1] // mesh.shape[axis_name], block_size)
+    return QRFactorization(
+        H, alpha, block_size=block_size, mesh=mesh, precision=precision
+    )

@@ -23,15 +23,22 @@ class DHQRConfig:
         equivalent of the reference's Distributed.jl worker dimension.
       blocked: use the compact-WY engine (True) or the unblocked
         reference-parity engine (False).
-      use_pallas: route the unblocked trailing update through the fused
-        Pallas kernel where shapes allow ("auto"), always ("always"), or
-        never ("never").
+      use_pallas: route the panel factorization through the fused Pallas
+        kernel where shapes allow ("auto"), always ("always"), or never
+        ("never").
+      precision: matmul precision for the accuracy-critical contractions —
+        "highest" (full f32 passes on the MXU; required for the < 1e-5
+        backward-error target in Float32), "float32", or "default" (fast
+        bf16 passes, ~1e-4 relative error; the speed tier). The TPU
+        equivalent of the reference's import-time BLAS configuration
+        (reference src:6) — but per-call, not global state.
     """
 
     block_size: int = 128
     mesh_axis: str = "cols"
     blocked: bool = True
     use_pallas: str = "auto"
+    precision: str = "highest"
 
     @staticmethod
     def from_env(**overrides) -> "DHQRConfig":
@@ -47,5 +54,7 @@ class DHQRConfig:
             )
         if "DHQR_USE_PALLAS" in os.environ:
             env["use_pallas"] = os.environ["DHQR_USE_PALLAS"]
+        if "DHQR_PRECISION" in os.environ:
+            env["precision"] = os.environ["DHQR_PRECISION"]
         env.update(overrides)
         return DHQRConfig(**env)

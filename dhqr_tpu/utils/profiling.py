@@ -1,0 +1,108 @@
+"""Tracing / profiling subsystem (SURVEY.md §5).
+
+The reference carries two profiling mechanisms: wall-clock phase timers
+inside the engine — ``t1a`` (panel math), ``t1b`` (broadcast + trailing
+update) and ``t2`` (back-substitution) via ``@elapsed``, with their ``@show``
+reporting commented out (reference src/DistributedHouseholderQR.jl:126-128,
+136-137, 144-146, 291-292) — and a statistical profiler producing HTML
+flamegraphs in the test harness (test/runtests.jl:40, 64-65). Per SURVEY.md
+§5 the build keeps per-phase timing *first-class, not commented out*:
+
+* :func:`phase` — ``jax.named_scope`` + ``jax.profiler.TraceAnnotation``
+  wrapper used inside the engines, so compiled-program regions carry the
+  phase names (``panel_factor`` = t1a, ``trailing_update`` = t1b,
+  ``back_substitute`` = t2) in XLA/perfetto traces;
+* :class:`PhaseTimer` — explicit wall-clock phase timing with a device-sync
+  readback (``block_until_ready`` is not a reliable barrier under remote
+  TPU tunnels, where dispatch is asynchronous);
+* :func:`trace` — the flamegraph equivalent: a ``jax.profiler.trace``
+  context writing a TensorBoard/perfetto trace directory.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@contextlib.contextmanager
+def phase(name: str) -> Iterator[None]:
+    """Name a region both in traced HLO and in the host profiler timeline."""
+    with jax.named_scope(name), jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def sync(tree) -> None:
+    """Barrier on device work by reading back one scalar per output pytree.
+
+    ``jax.block_until_ready`` returns early under asynchronous remote-TPU
+    dispatch, so a value-dependent host readback is the only trustworthy
+    fence — the same reason the reference puts ``fetch`` after ``@spawnat``
+    (reference src:117).
+    """
+    leaves = [x for x in jax.tree_util.tree_leaves(tree) if hasattr(x, "dtype")]
+    for leaf in leaves[-1:]:  # one readback suffices: it orders the stream
+        jnp.sum(leaf).item()
+
+
+class PhaseTimer:
+    """Wall-clock per-phase timing — the reference's t1a/t1b/t2 made first-class.
+
+    >>> timer = PhaseTimer()
+    >>> with timer.measure("panel_factor"):
+    ...     out = engine(A)          # the context syncs on ``out`` at exit
+    ...     timer.observe(out)
+    >>> timer.report()               # {'panel_factor': [0.0123]}
+
+    Timings include device execution because ``measure`` fences with
+    :func:`sync` on every array the body registered via ``observe``.
+    """
+
+    def __init__(self) -> None:
+        self._records: List[Tuple[str, float]] = []
+        self._pending = None
+
+    def observe(self, tree) -> None:
+        """Register outputs for the end-of-phase device fence."""
+        self._pending = tree
+
+    @contextlib.contextmanager
+    def measure(self, name: str) -> Iterator[None]:
+        self._pending = None
+        t0 = time.perf_counter()
+        with phase(name):
+            yield
+            if self._pending is not None:
+                sync(self._pending)
+        self._records.append((name, time.perf_counter() - t0))
+        self._pending = None
+
+    def report(self) -> Dict[str, List[float]]:
+        out: Dict[str, List[float]] = {}
+        for name, dt in self._records:
+            out.setdefault(name, []).append(dt)
+        return out
+
+    def total(self, name: str) -> float:
+        return sum(dt for n, dt in self._records if n == name)
+
+    def reset(self) -> None:
+        self._records.clear()
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Write a profiler trace for the region — the ``@profilehtml`` analogue.
+
+    View with TensorBoard's profile plugin or perfetto. Usage:
+
+    >>> with trace("/tmp/dhqr_trace"):
+    ...     x = lstsq(A, b)
+    ...     sync(x)
+    """
+    with jax.profiler.trace(str(log_dir)):
+        yield

@@ -1,0 +1,52 @@
+"""Profiling subsystem tests (SURVEY.md §5: t1a/t1b/t2 timers, trace dump)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from dhqr_tpu.models.qr_model import lstsq, qr
+from dhqr_tpu.utils.profiling import PhaseTimer, phase, sync, trace
+
+
+def test_phase_timer_records_phases():
+    timer = PhaseTimer()
+    A = jnp.asarray(np.random.default_rng(0).random((64, 32)))
+    b = jnp.asarray(np.random.default_rng(1).random(64))
+    with timer.measure("factor"):
+        fact = qr(A)
+        timer.observe((fact.H, fact.alpha))
+    with timer.measure("solve"):
+        x = fact.solve(b)
+        timer.observe(x)
+    rep = timer.report()
+    assert set(rep) == {"factor", "solve"}
+    assert all(dt > 0 for dts in rep.values() for dt in dts)
+    assert timer.total("factor") == rep["factor"][0]
+    timer.reset()
+    assert timer.report() == {}
+
+
+def test_phase_nests_inside_and_outside_jit():
+    A = jnp.asarray(np.random.default_rng(2).random((48, 24)))
+    b = jnp.asarray(np.random.default_rng(3).random(48))
+    with phase("outer"):
+        x = lstsq(A, b)
+    sync(x)
+    assert x.shape == (24,)
+
+
+def test_trace_writes_profile(tmp_path):
+    log_dir = tmp_path / "trace"
+    A = jnp.asarray(np.random.default_rng(4).random((40, 20)))
+    b = jnp.asarray(np.random.default_rng(5).random(40))
+    with trace(str(log_dir)):
+        x = lstsq(A, b)
+        sync(x)
+    # jax.profiler.trace writes plugins/profile/<run>/ with at least one file
+    found = [
+        os.path.join(root, f)
+        for root, _dirs, files in os.walk(log_dir)
+        for f in files
+    ]
+    assert found, "profiler trace directory is empty"
