@@ -35,11 +35,11 @@ PRECISION = os.environ.get("DHQR_PRECISION", "highest")
 BASELINE_GFLOPS = 4800.0  # 60% of A100 cuSOLVER geqrf f32 (~8 TF/s), see above
 
 
-def _sync(x) -> float:
-    """Force completion via a scalar device->host readback; returns the scalar."""
-    import jax.numpy as jnp
+def _sync(x) -> None:
+    """Device fence via scalar readback (see dhqr_tpu.utils.profiling.sync)."""
+    from dhqr_tpu.utils.profiling import sync
 
-    return float(jnp.sum(x))
+    sync(x)
 
 
 def main() -> None:

@@ -147,11 +147,12 @@ def qr(
         if cfg.blocked:
             H, alpha = _sharded.sharded_blocked_qr(
                 A, mesh, block_size=nb, axis_name=cfg.mesh_axis,
-                precision=cfg.precision,
+                precision=cfg.precision, layout=cfg.layout,
             )
         else:
             H, alpha = _sharded.sharded_householder_qr(
-                A, mesh, axis_name=cfg.mesh_axis, precision=cfg.precision
+                A, mesh, axis_name=cfg.mesh_axis, precision=cfg.precision,
+                layout=cfg.layout,
             )
         return QRFactorization(
             H, alpha, block_size=nb, mesh=mesh, precision=cfg.precision
@@ -209,16 +210,21 @@ def lstsq(
         nloc = A.shape[1] // mesh.shape[cfg.mesh_axis]
         nb = fit_block_size(nloc, cfg.block_size)
         if not cfg.blocked:
+            # store_nb=nb + store-layout chaining: factor and solve share one
+            # storage order, avoiding cross-device column permutes in between.
             H, alpha = sharded_householder_qr(
-                A, mesh, axis_name=cfg.mesh_axis, precision=cfg.precision
+                A, mesh, axis_name=cfg.mesh_axis, precision=cfg.precision,
+                layout=cfg.layout, store_nb=nb, _store_layout_output=True,
             )
             return sharded_solve(
                 H, alpha, b, mesh,
                 block_size=nb, axis_name=cfg.mesh_axis, precision=cfg.precision,
+                layout=cfg.layout, _H_in_store_layout=True,
             )
         return sharded_lstsq(
             A, b, mesh,
             block_size=nb, axis_name=cfg.mesh_axis, precision=cfg.precision,
+            layout=cfg.layout,
         )
     return _lstsq_impl(
         A, b, cfg.block_size, cfg.blocked, cfg.precision, cfg.use_pallas

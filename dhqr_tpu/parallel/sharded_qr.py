@@ -45,18 +45,62 @@ from dhqr_tpu.ops.householder import (
 from dhqr_tpu.parallel.mesh import DEFAULT_AXIS, column_sharding
 
 
-def _unblocked_shard_body(Al, *, n: int, axis: str, precision: str = DEFAULT_PRECISION):
-    """Per-device body: Al is the local (m, nloc) column block."""
+def _local_gidx(p, n: int, nloc: int, nb: int, layout: str):
+    """Global (natural) column index of each local column — the traced
+    generalization of ``LocalColumnBlock``'s Δj offset arithmetic (src:34).
+
+    "block": device p holds the contiguous columns [p*nloc, (p+1)*nloc).
+    "cyclic": device p holds nb-wide column blocks {kb : kb % P == p},
+    stored consecutively (the layout :func:`cyclic_store_columns` produces).
+    """
+    P = n // nloc
+    c = lax.iota(jnp.int32, nloc)
+    if layout == "block":
+        return p * nloc + c
+    if layout == "cyclic":
+        return ((c // nb) * P + p) * nb + c % nb
+    raise ValueError(f"layout must be 'block' or 'cyclic', got {layout!r}")
+
+
+def _panel_owner(k: int, n: int, nloc: int, nb: int, layout: str):
+    """(owner device, local column offset) of the nb-wide panel at column k.
+
+    Static Python ints — panel offsets are unrolled, so placement is free.
+    """
+    P = n // nloc
+    if layout == "block":
+        owner = k // nloc
+        return owner, k - owner * nloc
+    kb = k // nb
+    return kb % P, (kb // P) * nb
+
+
+def _unblocked_shard_body(
+    Al, *, n: int, axis: str,
+    precision: str = DEFAULT_PRECISION, layout: str = "block", store_nb: int = 1,
+):
+    """Per-device body: Al is the local (m, nloc) column block.
+
+    ``store_nb`` is the cyclic store's block width — 1 by default, but set
+    to the *solve* panel width when the factorization feeds straight into
+    ``sharded_solve`` so both stages share one storage order.
+    """
     m, nloc = Al.shape
     p = lax.axis_index(axis)
+    P = n // nloc
     delta_j = p * nloc  # global column offset — LocalColumnBlock.Δj (src:34)
     rows = lax.iota(jnp.int32, m)
-    gidx = delta_j + lax.iota(jnp.int32, nloc)  # global indices of local cols
+    gidx = _local_gidx(p, n, nloc, store_nb, layout)  # natural idx of local cols
 
     def step(j, carry):
         Al, alpha = carry
-        jl = jnp.clip(j - delta_j, 0, nloc - 1)
-        mine = (j >= delta_j) & (j < delta_j + nloc)
+        if layout == "cyclic":
+            kb = j // store_nb  # owning block, round-robin over devices
+            jl = (kb // P) * store_nb + j % store_nb
+            mine = (kb % P) == p
+        else:
+            jl = jnp.clip(j - delta_j, 0, nloc - 1)
+            mine = (j >= delta_j) & (j < delta_j + nloc)
         col_local = lax.dynamic_slice_in_dim(Al, jl, 1, axis=1)[:, 0]
         # Broadcast = all-reduce of a one-hot contribution (reference's
         # per-column Hj serialization to every worker, src:138-143).
@@ -77,17 +121,19 @@ def _unblocked_shard_body(Al, *, n: int, axis: str, precision: str = DEFAULT_PRE
     return lax.fori_loop(0, n, step, (Al, alpha0))
 
 
-def _blocked_shard_body(Al, *, n: int, nb: int, axis: str, precision: str = DEFAULT_PRECISION):
+def _blocked_shard_body(
+    Al, *, n: int, nb: int, axis: str,
+    precision: str = DEFAULT_PRECISION, layout: str = "block",
+):
     """Per-device body for the compact-WY engine; python loop over panels."""
     m, nloc = Al.shape
     p = lax.axis_index(axis)
-    gidx_base = p * nloc + lax.iota(jnp.int32, nloc)
+    gidx_base = _local_gidx(p, n, nloc, nb, layout)
     alpha = jnp.zeros((n,), dtype=Al.dtype)
 
     for k in range(0, n, nb):
         b = min(nb, n - k)
-        owner = k // nloc           # static — panels never straddle blocks
-        kl = k - owner * nloc       # static local offset within owner's block
+        owner, kl = _panel_owner(k, n, nloc, nb, layout)  # static placement
         mine = p == owner
         # Every device factors its own (m-k, b) slice; the psum keeps the
         # owner's result. SPMD-friendly redundant compute beats a branch.
@@ -112,8 +158,13 @@ def _blocked_shard_body(Al, *, n: int, nb: int, axis: str, precision: str = DEFA
 
 
 @lru_cache(maxsize=None)
-def _build_unblocked(mesh: Mesh, axis_name: str, n: int, precision: str):
-    body = partial(_unblocked_shard_body, n=n, axis=axis_name, precision=precision)
+def _build_unblocked(
+    mesh: Mesh, axis_name: str, n: int, precision: str, layout: str, store_nb: int
+):
+    body = partial(
+        _unblocked_shard_body,
+        n=n, axis=axis_name, precision=precision, layout=layout, store_nb=store_nb,
+    )
     return jax.jit(
         shard_map(
             body,
@@ -126,8 +177,13 @@ def _build_unblocked(mesh: Mesh, axis_name: str, n: int, precision: str):
 
 
 @lru_cache(maxsize=None)
-def _build_blocked(mesh: Mesh, axis_name: str, n: int, nb: int, precision: str):
-    body = partial(_blocked_shard_body, n=n, nb=nb, axis=axis_name, precision=precision)
+def _build_blocked(
+    mesh: Mesh, axis_name: str, n: int, nb: int, precision: str, layout: str
+):
+    body = partial(
+        _blocked_shard_body,
+        n=n, nb=nb, axis=axis_name, precision=precision, layout=layout,
+    )
     return jax.jit(
         shard_map(
             body,
@@ -139,11 +195,32 @@ def _build_blocked(mesh: Mesh, axis_name: str, n: int, nb: int, precision: str):
     )
 
 
+def _to_store_layout(A, n, nproc, nb, layout):
+    """Permute natural columns into the layout's storage order (no-op for block)."""
+    if layout == "block":
+        return A
+    from dhqr_tpu.parallel.layout import cyclic_store_columns
+
+    return jnp.take(A, jnp.asarray(cyclic_store_columns(n, nproc, nb)), axis=1)
+
+
+def _to_natural_layout(H, n, nproc, nb, layout):
+    """Inverse of :func:`_to_store_layout` on the factored output."""
+    if layout == "block":
+        return H
+    from dhqr_tpu.parallel.layout import natural_store_positions
+
+    return jnp.take(H, jnp.asarray(natural_store_positions(n, nproc, nb)), axis=1)
+
+
 def sharded_householder_qr(
     A: jax.Array,
     mesh: Mesh,
     axis_name: str = DEFAULT_AXIS,
     precision: str = DEFAULT_PRECISION,
+    layout: str = "block",
+    store_nb: int = 1,
+    _store_layout_output: bool = False,
 ):
     """Unblocked distributed QR: ``(H, alpha)`` with H column-sharded.
 
@@ -151,12 +228,27 @@ def sharded_householder_qr(
     ``householder!(A::DArray, α)`` control flow (src:115-120) without any
     host round-trips. ``alpha`` is returned replicated (the reference keeps
     it in a ``SharedArray``, src:302).
+
+    ``layout="cyclic"`` distributes columns round-robin so every device owns
+    live columns until the sweep ends — the load-balancing role of the
+    reference's uneven sqrt-split blocks (test/runtests.jl:36-38). H is
+    returned in natural column order unless ``_store_layout_output``
+    (``store_nb`` sets the cyclic store's block width so a downstream solve
+    can share the storage order — see ``lstsq``'s unblocked mesh path).
     """
     m, n = A.shape
     nproc = mesh.shape[axis_name]
-    _check_divisibility(m, n, nproc, None)
+    _check_divisibility(m, n, nproc, None, layout)
+    if layout == "cyclic" and (n // nproc) % store_nb != 0:
+        raise ValueError(
+            f"store_nb={store_nb} must divide the local width {n // nproc}"
+        )
+    A = _to_store_layout(A, n, nproc, store_nb, layout)
     A = jax.device_put(A, column_sharding(mesh, axis_name))
-    return _build_unblocked(mesh, axis_name, n, precision)(A)
+    H, alpha = _build_unblocked(mesh, axis_name, n, precision, layout, store_nb)(A)
+    if not _store_layout_output:
+        H = _to_natural_layout(H, n, nproc, store_nb, layout)
+    return H, alpha
 
 
 def sharded_blocked_qr(
@@ -165,25 +257,40 @@ def sharded_blocked_qr(
     block_size: int = 128,
     axis_name: str = DEFAULT_AXIS,
     precision: str = DEFAULT_PRECISION,
+    layout: str = "block",
+    _store_layout_output: bool = False,
 ):
     """Compact-WY distributed QR: one psum per panel, GEMM trailing updates.
 
     The MXU path at scale — SURVEY.md §7 stage 3 layered over stage 2.
+    ``layout="cyclic"`` assigns nb-wide panels to devices round-robin (see
+    :func:`sharded_householder_qr`); ``_store_layout_output`` keeps H in the
+    internal storage order (used by ``sharded_lstsq`` to chain directly into
+    the solve without two cross-device column permutes).
     """
     m, n = A.shape
     nproc = mesh.shape[axis_name]
     nb = min(int(block_size), n // nproc)
-    _check_divisibility(m, n, nproc, nb)
+    _check_divisibility(m, n, nproc, nb, layout)
+    A = _to_store_layout(A, n, nproc, nb, layout)
     A = jax.device_put(A, column_sharding(mesh, axis_name))
-    return _build_blocked(mesh, axis_name, n, nb, precision)(A)
+    H, alpha = _build_blocked(mesh, axis_name, n, nb, precision, layout)(A)
+    if not _store_layout_output:
+        H = _to_natural_layout(H, n, nproc, nb, layout)
+    return H, alpha
 
 
-def _check_divisibility(m, n, nproc, nb):
+def _check_divisibility(m, n, nproc, nb, layout="block"):
     if m < n:
         raise ValueError(f"requires m >= n, got {(m, n)}")
     if n % nproc != 0:
         raise ValueError(f"n={n} must be divisible by mesh size {nproc}")
     nloc = n // nproc
+    if layout == "cyclic" and nb is not None and nloc % nb != 0:
+        raise ValueError(
+            f"cyclic layout needs the local width {nloc} divisible by the "
+            f"panel width {nb} (i.e. n % (nb * P) == 0)"
+        )
     if nb is not None and nloc % nb != 0 and nb < nloc:
         raise ValueError(
             f"panel width {nb} must divide local block width {nloc} "

@@ -34,8 +34,13 @@ from dhqr_tpu.ops.householder import DEFAULT_PRECISION
 from dhqr_tpu.parallel.mesh import DEFAULT_AXIS, column_sharding, replicated_sharding
 
 
-def _apply_qt_shard_body(Hl, b, *, n: int, nb: int, axis: str, precision: str = DEFAULT_PRECISION):
+def _apply_qt_shard_body(
+    Hl, b, *, n: int, nb: int, axis: str,
+    precision: str = DEFAULT_PRECISION, layout: str = "block",
+):
     """b <- Q^H b, panel by panel; Hl is the local (m, nloc) block."""
+    from dhqr_tpu.parallel.sharded_qr import _panel_owner
+
     m, nloc = Hl.shape
     p = lax.axis_index(axis)
     vec = b.ndim == 1
@@ -43,8 +48,7 @@ def _apply_qt_shard_body(Hl, b, *, n: int, nb: int, axis: str, precision: str = 
 
     for k in range(0, n, nb):
         bsz = min(nb, n - k)
-        owner = k // nloc
-        kl = k - owner * nloc
+        owner, kl = _panel_owner(k, n, nloc, nb, layout)
         mine = p == owner
         # Broadcast the owner's panel reflectors (rows k:m) — the psum
         # equivalent of stage 1's per-worker visit (src:227-229).
@@ -56,7 +60,10 @@ def _apply_qt_shard_body(Hl, b, *, n: int, nb: int, axis: str, precision: str = 
     return B[:, 0] if vec else B
 
 
-def _backsub_shard_body(Hl, alpha, c, *, n: int, nb: int, axis: str, precision: str = DEFAULT_PRECISION):
+def _backsub_shard_body(
+    Hl, alpha, c, *, n: int, nb: int, axis: str,
+    precision: str = DEFAULT_PRECISION, layout: str = "block",
+):
     """Solve R x = c[:n]; R packed in (Hl strict upper, alpha). Returns x.
 
     Right-to-left panel sweep replacing the reference's n fetch rounds
@@ -64,6 +71,8 @@ def _backsub_shard_body(Hl, alpha, c, *, n: int, nb: int, axis: str, precision: 
     its columns' update to all earlier rows; both ride one psum. ``c`` may
     be (m,) or (m, k).
     """
+    from dhqr_tpu.parallel.sharded_qr import _panel_owner
+
     m, nloc = Hl.shape
     p = lax.axis_index(axis)
     rows_n = lax.iota(jnp.int32, n)[:, None]
@@ -73,8 +82,7 @@ def _backsub_shard_body(Hl, alpha, c, *, n: int, nb: int, axis: str, precision: 
 
     for k in reversed(range(0, n, nb)):
         bsz = min(nb, n - k)
-        owner = k // nloc
-        kl = k - owner * nloc
+        owner, kl = _panel_owner(k, n, nloc, nb, layout)
         mine = p == owner
         # Owner's diagonal block: strict upper from H, diagonal from alpha
         # (the reference's R packing, src:244-254).
@@ -97,13 +105,16 @@ def _backsub_shard_body(Hl, alpha, c, *, n: int, nb: int, axis: str, precision: 
 
 
 @lru_cache(maxsize=None)
-def _build_solve(mesh: Mesh, axis_name: str, n: int, nb: int, precision: str):
+def _build_solve(
+    mesh: Mesh, axis_name: str, n: int, nb: int, precision: str, layout: str
+):
     def full(Hl, alpha, b):
         cb = _apply_qt_shard_body(
-            Hl, b, n=n, nb=nb, axis=axis_name, precision=precision
+            Hl, b, n=n, nb=nb, axis=axis_name, precision=precision, layout=layout
         )
         return _backsub_shard_body(
-            Hl, alpha, cb, n=n, nb=nb, axis=axis_name, precision=precision
+            Hl, alpha, cb,
+            n=n, nb=nb, axis=axis_name, precision=precision, layout=layout,
         )
 
     return jax.jit(
@@ -125,22 +136,32 @@ def sharded_solve(
     block_size: int = 128,
     axis_name: str = DEFAULT_AXIS,
     precision: str = DEFAULT_PRECISION,
+    layout: str = "block",
+    _H_in_store_layout: bool = False,
 ) -> jax.Array:
     """x = argmin ||A x - b|| from the sharded packed factorization.
 
     The reference's ``solve_householder!`` orchestration (src:284-294) as one
     compiled program: Q^H apply then panel back-substitution, b replicated.
+    ``H`` is taken in natural column order unless ``_H_in_store_layout`` says
+    it already sits in the layout's storage order (the ``sharded_lstsq``
+    fast path); x is always returned in natural order.
     """
-    from dhqr_tpu.parallel.sharded_qr import _check_divisibility
+    from dhqr_tpu.parallel.sharded_qr import (
+        _check_divisibility,
+        _to_store_layout,
+    )
 
     m, n = H.shape
     nproc = mesh.shape[axis_name]
     nb = min(int(block_size), n // nproc)
-    _check_divisibility(m, n, nproc, nb)
+    _check_divisibility(m, n, nproc, nb, layout)
+    if not _H_in_store_layout:
+        H = _to_store_layout(H, n, nproc, nb, layout)
     H = jax.device_put(H, column_sharding(mesh, axis_name))
     alpha = jax.device_put(alpha, replicated_sharding(mesh))
     b = jax.device_put(b, replicated_sharding(mesh))
-    return _build_solve(mesh, axis_name, n, nb, precision)(H, alpha, b)
+    return _build_solve(mesh, axis_name, n, nb, precision, layout)(H, alpha, b)
 
 
 def sharded_lstsq(
@@ -150,17 +171,22 @@ def sharded_lstsq(
     block_size: int = 128,
     axis_name: str = DEFAULT_AXIS,
     precision: str = DEFAULT_PRECISION,
+    layout: str = "block",
 ) -> jax.Array:
     """One-shot distributed least squares: factor + solve on the mesh.
 
     The distributed equivalent of ``qr!(A) \\ b`` (reference runtests.jl:77-78).
+    With ``layout="cyclic"`` the factorization stays in storage order between
+    the factor and solve stages — no cross-device column permute in between.
     """
     from dhqr_tpu.parallel.sharded_qr import sharded_blocked_qr
 
     H, alpha = sharded_blocked_qr(
-        A, mesh, block_size=block_size, axis_name=axis_name, precision=precision
+        A, mesh, block_size=block_size, axis_name=axis_name, precision=precision,
+        layout=layout, _store_layout_output=True,
     )
     return sharded_solve(
         H, alpha, b, mesh,
         block_size=block_size, axis_name=axis_name, precision=precision,
+        layout=layout, _H_in_store_layout=True,
     )

@@ -23,9 +23,15 @@ class DHQRConfig:
         equivalent of the reference's Distributed.jl worker dimension.
       blocked: use the compact-WY engine (True) or the unblocked
         reference-parity engine (False).
-      use_pallas: route the panel factorization through the fused Pallas
-        kernel where shapes allow ("auto"), always ("always"), or never
-        ("never").
+      use_pallas: panel-factorization kernel choice — "always" forces the
+        fused Pallas VMEM kernel (float32, panel must fit VMEM; runs the
+        interpreter off-TPU), "never" the XLA path. "auto" currently also
+        resolves to the XLA path until the kernel's backward error is
+        validated on hardware (see ops/blocked._resolve_pallas).
+      layout: distributed column layout — "block" (contiguous blocks, the
+        reference's DArray layout, runtests.jl:71) or "cyclic" (round-robin
+        nb-wide blocks; the load-balanced layout standing in for the
+        reference's uneven sqrt-splits, runtests.jl:36-38).
       precision: matmul precision for the accuracy-critical contractions —
         "highest" (full f32 passes on the MXU; required for the < 1e-5
         backward-error target in Float32), "float32", or "default" (fast
@@ -39,6 +45,7 @@ class DHQRConfig:
     blocked: bool = True
     use_pallas: str = "auto"
     precision: str = "highest"
+    layout: str = "block"
 
     @staticmethod
     def from_env(**overrides) -> "DHQRConfig":
@@ -56,5 +63,7 @@ class DHQRConfig:
             env["use_pallas"] = os.environ["DHQR_USE_PALLAS"]
         if "DHQR_PRECISION" in os.environ:
             env["precision"] = os.environ["DHQR_PRECISION"]
+        if "DHQR_LAYOUT" in os.environ:
+            env["layout"] = os.environ["DHQR_LAYOUT"]
         env.update(overrides)
         return DHQRConfig(**env)

@@ -37,16 +37,18 @@ def phase(name: str) -> Iterator[None]:
 
 
 def sync(tree) -> None:
-    """Barrier on device work by reading back one scalar per output pytree.
+    """Barrier on device work by reading back one scalar per pytree leaf.
 
     ``jax.block_until_ready`` returns early under asynchronous remote-TPU
     dispatch, so a value-dependent host readback is the only trustworthy
     fence — the same reason the reference puts ``fetch`` after ``@spawnat``
-    (reference src:117).
+    (reference src:117). Every leaf is read back: leaves may come from
+    independent dispatches (or devices), so no single readback orders them
+    all.
     """
-    leaves = [x for x in jax.tree_util.tree_leaves(tree) if hasattr(x, "dtype")]
-    for leaf in leaves[-1:]:  # one readback suffices: it orders the stream
-        jnp.sum(leaf).item()
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "dtype"):
+            jnp.sum(leaf).item()
 
 
 class PhaseTimer:
@@ -64,22 +66,22 @@ class PhaseTimer:
 
     def __init__(self) -> None:
         self._records: List[Tuple[str, float]] = []
-        self._pending = None
+        self._pending: list = []
 
     def observe(self, tree) -> None:
-        """Register outputs for the end-of-phase device fence."""
-        self._pending = tree
+        """Register outputs for the end-of-phase device fence (accumulates)."""
+        self._pending.append(tree)
 
     @contextlib.contextmanager
     def measure(self, name: str) -> Iterator[None]:
-        self._pending = None
+        self._pending = []
         t0 = time.perf_counter()
         with phase(name):
             yield
-            if self._pending is not None:
+            if self._pending:
                 sync(self._pending)
         self._records.append((name, time.perf_counter() - t0))
-        self._pending = None
+        self._pending = []
 
     def report(self) -> Dict[str, List[float]]:
         out: Dict[str, List[float]] = {}

@@ -60,3 +60,40 @@ def test_column_mesh_and_shardings():
 def test_column_mesh_too_many_devices():
     with pytest.raises(ValueError):
         column_mesh(1000)
+
+
+class TestCyclicStore:
+    """Cyclic storage permutation: SURVEY.md §2's load-balanced layout."""
+
+    def test_roundtrip(self):
+        from dhqr_tpu.parallel.layout import (
+            cyclic_store_columns,
+            natural_store_positions,
+        )
+        import numpy as np
+
+        n, P, nb = 48, 4, 4
+        store = cyclic_store_columns(n, P, nb)
+        pos = natural_store_positions(n, P, nb)
+        assert sorted(store) == list(range(n))
+        np.testing.assert_array_equal(store[pos], np.arange(n))
+
+    def test_round_robin_ownership(self):
+        from dhqr_tpu.parallel.layout import cyclic_store_columns
+
+        n, P, nb = 32, 4, 2
+        store = cyclic_store_columns(n, P, nb)
+        nloc = n // P
+        for p in range(P):
+            owned = store[p * nloc : (p + 1) * nloc]
+            # device p owns exactly the nb-wide blocks kb with kb % P == p
+            blocks = sorted(set(j // nb for j in owned))
+            assert all(kb % P == p for kb in blocks)
+
+    def test_rejects_indivisible(self):
+        import pytest
+
+        from dhqr_tpu.parallel.layout import cyclic_store_columns
+
+        with pytest.raises(ValueError):
+            cyclic_store_columns(30, 4, 2)
