@@ -1,0 +1,173 @@
+"""Benchmark suite for the five BASELINE.md configs.
+
+Prints one JSON line per config:
+    {"config": k, "metric": "...", "value": V, "unit": "GFLOP/s", ...}
+
+The five configs (BASELINE.md "Targets for the new TPU framework"):
+  1. 1024x1024 Float64 dense QR, single device (CPU-reference scale)
+  2. tall-skinny 65536x256 Float32 QR, column-sharded
+  3. square 16384x16384 Float32 QR, 1-D column-cyclic
+  4. blocked compact-WY (nb=128) 32768x4096 Float32
+  5. overdetermined least-squares 131072x512 via QR + back-substitution
+
+The nominal sizes assume multi-chip pods (v4-8..v5p-32). On smaller hardware
+run with ``--scale S`` (divides m and n by S, default chosen to fit a single
+chip) or pick configs with ``--configs 1,5``. Mesh size adapts to visible
+devices; config 3 uses the cyclic layout, the others block layout.
+
+Usage:
+    python benchmarks/run.py [--configs 1,2,3,4,5] [--scale 4] [--repeats 3]
+
+The reference has no benchmarks directory at all (SURVEY.md §6); its only
+perf artifact is runtime ratio prints in the tests (runtests.jl:84-89),
+which ``python -m dhqr_tpu.harness --bench`` reproduces.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def _flops_qr(m: float, n: float) -> float:
+    return 2.0 * m * n * n - (2.0 / 3.0) * n**3
+
+
+def _flops_lstsq(m: float, n: float) -> float:
+    return _flops_qr(m, n) + 4.0 * m * n + n * n
+
+
+def _bench(fn, sync, repeats: int):
+    out = fn()
+    sync(out)  # compile + warm
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = fn()
+        sync(out)
+        times.append(time.perf_counter() - t0)
+    return min(times), out
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--configs", default="1,2,3,4,5")
+    parser.add_argument("--scale", type=int, default=None,
+                        help="divide problem dims by this (default: fit 1 chip)")
+    parser.add_argument("--repeats", type=int, default=3)
+    parser.add_argument("--block-size", type=int, default=128)
+    args = parser.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    import dhqr_tpu
+    from dhqr_tpu.ops.blocked import _apply_q_impl
+    from dhqr_tpu.ops.solve import r_matrix
+    from dhqr_tpu.parallel.mesh import column_mesh
+    from dhqr_tpu.utils.profiling import sync
+
+    platform = jax.default_backend()
+    ndev = len(jax.devices())
+    if platform == "cpu":
+        jax.config.update("jax_enable_x64", True)
+    # default scale: nominal sizes target pods; a single chip gets /4
+    scale = args.scale if args.scale is not None else (1 if ndev >= 8 else 4)
+    nb = args.block_size
+    rng = np.random.default_rng(0)
+
+    def mesh_or_none(max_devices=None):
+        usable = ndev if max_devices is None else min(ndev, max_devices)
+        return column_mesh(usable) if usable > 1 else None
+
+    def report(k, name, m, n, seconds, flops, extra=None):
+        rec = {
+            "config": k,
+            "metric": name,
+            "value": round(flops / seconds / 1e9, 2),
+            "unit": "GFLOP/s",
+            "seconds": round(seconds, 4),
+            "shape": f"{m}x{n}",
+            "platform": platform,
+            "devices": ndev,
+            "scale": scale,
+        }
+        rec.update(extra or {})
+        print(json.dumps(rec))
+
+    chosen = {int(tok) for tok in args.configs.split(",")}
+
+    if 1 in chosen:
+        # f64 runs where f64 is native; on TPU it is emulated, so report f32
+        dt = jnp.float64 if platform == "cpu" else jnp.float32
+        m = n = 1024 // (scale if platform == "cpu" else 1)
+        A = jnp.asarray(rng.random((m, n)), dtype=dt)
+        t, (H, alpha) = _bench(
+            lambda: dhqr_tpu.blocked_householder_qr(A, nb), sync, args.repeats
+        )
+        QR = _apply_q_impl(H, r_matrix(H, alpha), nb)
+        berr = float(jnp.linalg.norm(QR - A) / jnp.linalg.norm(A))
+        report(1, f"dense_qr_{jnp.dtype(dt).name}", m, n, t, _flops_qr(m, n),
+               {"backward_error": berr})
+
+    if 2 in chosen:
+        m, n = 65536 // scale, 256 // scale
+        mesh = mesh_or_none()
+        if mesh is not None and n % mesh.shape["cols"]:
+            n += mesh.shape["cols"] - n % mesh.shape["cols"]
+        A = jnp.asarray(rng.random((m, n)), dtype=jnp.float32)
+        if mesh is None:
+            fn = lambda: dhqr_tpu.blocked_householder_qr(A, min(nb, n))
+        else:
+            from dhqr_tpu.parallel.sharded_qr import sharded_blocked_qr
+            fn = lambda: sharded_blocked_qr(A, mesh, block_size=nb)
+        t, _ = _bench(fn, sync, args.repeats)
+        report(2, "tall_skinny_qr_f32", m, n, t, _flops_qr(m, n),
+               {"mesh": 1 if mesh is None else mesh.shape["cols"]})
+
+    if 3 in chosen:
+        m = n = 16384 // scale
+        mesh = mesh_or_none()
+        A = jnp.asarray(rng.random((m, n)), dtype=jnp.float32)
+        if mesh is None:
+            fn = lambda: dhqr_tpu.blocked_householder_qr(A, nb)
+            layout = "single"
+        else:
+            from dhqr_tpu.parallel.sharded_qr import sharded_blocked_qr
+            fn = lambda: sharded_blocked_qr(A, mesh, block_size=nb, layout="cyclic")
+            layout = "cyclic"
+        t, _ = _bench(fn, sync, args.repeats)
+        report(3, "square_qr_f32", m, n, t, _flops_qr(m, n), {"layout": layout})
+
+    if 4 in chosen:
+        m, n = 32768 // scale, 4096 // scale
+        A = jnp.asarray(rng.random((m, n)), dtype=jnp.float32)
+        t, _ = _bench(
+            lambda: dhqr_tpu.blocked_householder_qr(A, nb), sync, args.repeats
+        )
+        report(4, "blocked_wy_qr_f32", m, n, t, _flops_qr(m, n),
+               {"block_size": nb})
+
+    if 5 in chosen:
+        m, n = 131072 // scale, 512 // scale
+        mesh = mesh_or_none()
+        if mesh is not None and n % mesh.shape["cols"]:
+            n += mesh.shape["cols"] - n % mesh.shape["cols"]
+        A = jnp.asarray(rng.random((m, n)), dtype=jnp.float32)
+        b = jnp.asarray(rng.random(m), dtype=jnp.float32)
+        fn = lambda: dhqr_tpu.lstsq(A, b, mesh=mesh, block_size=nb)
+        t, x = _bench(fn, sync, args.repeats)
+        res = float(jnp.linalg.norm(A.T @ (A @ x - b)))
+        report(5, "overdetermined_lstsq_f32", m, n, t, _flops_lstsq(m, n),
+               {"normal_eq_residual": res,
+                "mesh": 1 if mesh is None else mesh.shape["cols"]})
+
+
+if __name__ == "__main__":
+    main()

@@ -47,6 +47,8 @@ class QRFactorization:
         solves run the distributed engines (the DArray tier of reference
         src:115-120, selected here by placement rather than array type).
       precision: matmul precision used when applying Q/Q^H in solves.
+      layout: distributed column layout used for mesh solves ("block" or
+        "cyclic"); H itself is always stored in natural column order.
     """
 
     H: jax.Array
@@ -54,15 +56,21 @@ class QRFactorization:
     block_size: int = _blocked.DEFAULT_BLOCK_SIZE
     mesh: object = None
     precision: str = _hh.DEFAULT_PRECISION
+    layout: str = "block"
 
     # -- pytree plumbing ---------------------------------------------------
     def tree_flatten(self):
-        return (self.H, self.alpha), (self.block_size, self.mesh, self.precision)
+        return (self.H, self.alpha), (
+            self.block_size, self.mesh, self.precision, self.layout,
+        )
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
         H, alpha = leaves
-        return cls(H, alpha, block_size=aux[0], mesh=aux[1], precision=aux[2])
+        return cls(
+            H, alpha,
+            block_size=aux[0], mesh=aux[1], precision=aux[2], layout=aux[3],
+        )
 
     # -- derived quantities ------------------------------------------------
     @property
@@ -98,6 +106,7 @@ class QRFactorization:
             return sharded_solve(
                 self.H, self.alpha, b, self.mesh,
                 block_size=self.block_size, precision=self.precision,
+                layout=self.layout,
             )
         c = _blocked.blocked_apply_qt(
             self.H, self.alpha, b, self.block_size, precision=self.precision
@@ -155,7 +164,8 @@ def qr(
                 layout=cfg.layout,
             )
         return QRFactorization(
-            H, alpha, block_size=nb, mesh=mesh, precision=cfg.precision
+            H, alpha, block_size=nb, mesh=mesh, precision=cfg.precision,
+            layout=cfg.layout,
         )
     if cfg.blocked:
         H, alpha = _blocked.blocked_householder_qr(

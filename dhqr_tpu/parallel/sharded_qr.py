@@ -239,7 +239,9 @@ def sharded_householder_qr(
     m, n = A.shape
     nproc = mesh.shape[axis_name]
     _check_divisibility(m, n, nproc, None, layout)
-    if layout == "cyclic" and (n // nproc) % store_nb != 0:
+    if layout == "block":
+        store_nb = 1  # unused by the block layout; normalize the cache key
+    elif (n // nproc) % store_nb != 0:
         raise ValueError(
             f"store_nb={store_nb} must divide the local width {n // nproc}"
         )
@@ -286,11 +288,6 @@ def _check_divisibility(m, n, nproc, nb, layout="block"):
     if n % nproc != 0:
         raise ValueError(f"n={n} must be divisible by mesh size {nproc}")
     nloc = n // nproc
-    if layout == "cyclic" and nb is not None and nloc % nb != 0:
-        raise ValueError(
-            f"cyclic layout needs the local width {nloc} divisible by the "
-            f"panel width {nb} (i.e. n % (nb * P) == 0)"
-        )
     if nb is not None and nloc % nb != 0 and nb < nloc:
         raise ValueError(
             f"panel width {nb} must divide local block width {nloc} "

@@ -42,13 +42,15 @@ def sync(tree) -> None:
     ``jax.block_until_ready`` returns early under asynchronous remote-TPU
     dispatch, so a value-dependent host readback is the only trustworthy
     fence — the same reason the reference puts ``fetch`` after ``@spawnat``
-    (reference src:117). Every leaf is read back: leaves may come from
-    independent dispatches (or devices), so no single readback orders them
-    all.
+    (reference src:117). Leaves may come from independent dispatches, so the
+    fence must depend on ALL of them — but one round-trip suffices: a single
+    scalar that data-depends on every leaf.
     """
-    for leaf in jax.tree_util.tree_leaves(tree):
-        if hasattr(leaf, "dtype"):
-            jnp.sum(leaf).item()
+    leaves = [x for x in jax.tree_util.tree_leaves(tree) if hasattr(x, "dtype")]
+    if not leaves:
+        return
+    scalars = [jnp.sum(leaf).real.astype(jnp.float32) for leaf in leaves]
+    jnp.stack(scalars).sum().item()
 
 
 class PhaseTimer:

@@ -1,0 +1,23 @@
+"""CLI harness smoke test (SURVEY.md §4: the runtests.jl analogue)."""
+
+import subprocess
+import sys
+
+
+def test_harness_cli_runs_and_passes():
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "dhqr_tpu.harness", "2",
+            "--sizes", "44x40", "--dtypes", "float64", "--bench",
+        ],
+        capture_output=True, text=True, timeout=600,
+        env={
+            "PATH": "/usr/bin:/bin:/usr/local/bin",
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": "/root/repo",
+            "HOME": "/root",
+        },
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ok  44x40" in proc.stdout
+    assert "slowdown vs LAPACK" in proc.stdout
