@@ -5,7 +5,7 @@ Prints one JSON line per config:
 
 The five configs (BASELINE.md "Targets for the new TPU framework"):
   1. 1024x1024 Float64 dense QR, single device (CPU-reference scale)
-  2. tall-skinny 65536x256 Float32 QR, column-sharded
+  2. tall-skinny 65536x256 Float32 lstsq via TSQR, row-sharded
   3. square 16384x16384 Float32 QR, 1-D column-cyclic
   4. blocked compact-WY (nb=128) 32768x4096 Float32
   5. overdetermined least-squares 131072x512 via QR + back-substitution
@@ -117,19 +117,25 @@ def main(argv=None) -> None:
                {"backward_error": berr})
 
     if 2 in chosen:
+        # tall-skinny: TSQR (row-parallel, one all-gather) — the regime where
+        # the column layout cannot scale (see dhqr_tpu/parallel/sharded_tsqr.py)
         m, n = 65536 // scale, 256 // scale
-        mesh = mesh_or_none()
-        if mesh is not None and n % mesh.shape["cols"]:
-            n += mesh.shape["cols"] - n % mesh.shape["cols"]
         A = jnp.asarray(rng.random((m, n)), dtype=jnp.float32)
-        if mesh is None:
-            fn = lambda: dhqr_tpu.blocked_householder_qr(A, min(nb, n))
+        b = jnp.asarray(rng.random(m), dtype=jnp.float32)
+        if ndev > 1 and m % ndev == 0 and m // ndev >= n:
+            from dhqr_tpu.parallel.sharded_tsqr import row_mesh, sharded_tsqr_lstsq
+            rmesh = row_mesh(ndev)
+            fn = lambda: sharded_tsqr_lstsq(A, b, rmesh, block_size=nb)
+            meshsz = ndev
         else:
-            from dhqr_tpu.parallel.sharded_qr import sharded_blocked_qr
-            fn = lambda: sharded_blocked_qr(A, mesh, block_size=nb)
+            blocks = max(1, min(8, m // max(n, 1)))
+            while blocks > 1 and m % blocks:  # tsqr needs m divisible by blocks
+                blocks -= 1
+            fn = lambda: dhqr_tpu.tsqr_lstsq(A, b, n_blocks=blocks, block_size=nb)
+            meshsz = 1
         t, _ = _bench(fn, sync, args.repeats)
-        report(2, "tall_skinny_qr_f32", m, n, t, _flops_qr(m, n),
-               {"mesh": 1 if mesh is None else mesh.shape["cols"]})
+        report(2, "tall_skinny_tsqr_lstsq_f32", m, n, t, _flops_lstsq(m, n),
+               {"mesh": meshsz})
 
     if 3 in chosen:
         m = n = 16384 // scale

@@ -34,6 +34,8 @@ from dhqr_tpu.models.qr_model import (
 from dhqr_tpu.ops.householder import alphafactor, householder_qr
 from dhqr_tpu.ops.blocked import blocked_householder_qr
 from dhqr_tpu.ops.solve import apply_q, apply_qt, back_substitute, solve_least_squares
+from dhqr_tpu.ops.differentiable import lstsq_diff
+from dhqr_tpu.ops.tsqr import tsqr_lstsq, tsqr_r
 from dhqr_tpu.utils.config import DHQRConfig
 
 __version__ = "0.1.0"
@@ -49,6 +51,9 @@ __all__ = [
     "apply_q",
     "back_substitute",
     "solve_least_squares",
+    "tsqr_lstsq",
+    "tsqr_r",
+    "lstsq_diff",
     "alphafactor",
     "DHQRConfig",
     "__version__",

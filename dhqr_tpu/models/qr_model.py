@@ -189,13 +189,16 @@ def solve(fact: QRFactorization, b: jax.Array) -> jax.Array:
 @partial(jax.jit, static_argnames=("block_size", "blocked", "precision", "use_pallas"))
 def _lstsq_impl(A, b, block_size, blocked, precision, use_pallas):
     if blocked:
-        H, alpha = _blocked.blocked_householder_qr(
-            A, block_size, precision=precision, use_pallas=use_pallas
+        from dhqr_tpu.ops.differentiable import lstsq_diff
+
+        pallas, interp = _blocked._resolve_pallas(
+            use_pallas, A.shape[0], min(block_size, A.shape[1]), A.dtype
         )
-        c = _blocked.blocked_apply_qt(H, alpha, b, block_size, precision=precision)
-    else:
-        H, alpha = _hh.householder_qr(A, precision=precision)
-        c = _solve.apply_qt(H, alpha, b, precision=precision)
+        # custom-VJP core: identical forward, closed-form O(1)-memory
+        # gradients — jax.grad works through the public lstsq
+        return lstsq_diff(A, b, block_size, precision, pallas, interp)
+    H, alpha = _hh.householder_qr(A, precision=precision)
+    c = _solve.apply_qt(H, alpha, b, precision=precision)
     return _solve.back_substitute(H, alpha, c)
 
 
@@ -211,6 +214,8 @@ def lstsq(
     With ``mesh=`` the whole pipeline runs distributed (the reference's
     ``DHQR.qr!(A3) \\ b`` DArray path, runtests.jl:77-78).
     """
+    if A.shape[0] < A.shape[1]:
+        raise ValueError(f"lstsq requires m >= n, got {A.shape}")
     cfg = dataclasses.replace(config or DHQRConfig(), **overrides)
     if mesh is not None:
         from dhqr_tpu.parallel.layout import fit_block_size

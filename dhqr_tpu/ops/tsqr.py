@@ -1,0 +1,121 @@
+"""TSQR — communication-avoiding QR for tall-skinny matrices.
+
+The reference never partitions rows (its hard invariant, reference
+src/DistributedHouseholderQR.jl:33): a 65536 x 256 least-squares problem on
+its column layout puts at most 256 columns across workers and leaves the
+long dimension serial. TSQR is the TPU-right algorithm for m >> n and goes
+*beyond* the reference's capability set deliberately (SURVEY.md §6 lists
+tall-skinny 65536x256 as a target config):
+
+    leaf stage:    split rows into blocks; QR each block independently
+                   (perfectly parallel, each an MXU-dense blocked QR);
+    combine stage: stack the per-block R factors (pn x n, tiny) and QR once.
+
+For least squares the orthogonal factors never materialize: each stage also
+carries c = Q^H b, so ``x = R^{-1} c[:n]`` drops out of the tree — the same
+"never form Q" discipline as the reference's solve path (src:215-294).
+
+This module is the single-device engine (row blocks looped in one program);
+``dhqr_tpu.parallel.sharded_tsqr`` runs the leaves on a row-sharded mesh
+with one small all-gather as the combine.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from dhqr_tpu.ops.blocked import (
+    DEFAULT_BLOCK_SIZE,
+    _apply_qt_impl,
+    _blocked_qr_impl,
+)
+from dhqr_tpu.ops.householder import DEFAULT_PRECISION
+from dhqr_tpu.ops.solve import back_substitute, r_matrix
+
+
+def _leaf_factor(Ai, bi, nb, precision):
+    """One row block: packed QR + Q^H b, reduced to the (n, n) / (n,) heads."""
+    n = Ai.shape[1]
+    H, alpha = _blocked_qr_impl(Ai, nb, precision=precision)
+    R = r_matrix(H, alpha)
+    c = _apply_qt_impl(H, bi, nb, precision=precision)[:n]
+    return R, c
+
+
+@partial(jax.jit, static_argnames=("n_blocks", "block_size", "precision"))
+def _tsqr_lstsq_impl(A, b, n_blocks, block_size, precision):
+    m, n = A.shape
+    rows = m // n_blocks
+    nb = min(block_size, n)
+    # Leaves: vmapped over row blocks — XLA batches the block QRs.
+    Ab = A.reshape(n_blocks, rows, n)
+    bb = b.reshape(n_blocks, rows)
+    Rs, cs = jax.vmap(lambda Ai, bi: _leaf_factor(Ai, bi, nb, precision))(Ab, bb)
+    # Combine: one QR of the stacked R factors (n_blocks*n x n — tiny).
+    Rstack = Rs.reshape(n_blocks * n, n)
+    cstack = cs.reshape(n_blocks * n)
+    H2, alpha2 = _blocked_qr_impl(Rstack, nb, precision=precision)
+    c2 = _apply_qt_impl(H2, cstack, nb, precision=precision)
+    return back_substitute(H2, alpha2, c2)
+
+
+def tsqr_lstsq(
+    A: jax.Array,
+    b: jax.Array,
+    n_blocks: int = 8,
+    block_size: int = DEFAULT_BLOCK_SIZE,
+    precision: str = DEFAULT_PRECISION,
+) -> jax.Array:
+    """Least squares via TSQR: ``x = argmin ||A x - b||`` for m >> n.
+
+    Requires m divisible by ``n_blocks`` with each block still tall
+    (m / n_blocks >= n). Unconditionally stable (Householder at both
+    levels), unlike semi-normal-equation shortcuts.
+    """
+    m, n = A.shape
+    _check_tsqr_shape(m, n, n_blocks)
+    return _tsqr_lstsq_impl(A, b, int(n_blocks), int(block_size), precision)
+
+
+@partial(jax.jit, static_argnames=("n_blocks", "block_size", "precision"))
+def _tsqr_r_impl(A, n_blocks, block_size, precision):
+    m, n = A.shape
+    rows = m // n_blocks
+    nb = min(block_size, n)
+    Ab = A.reshape(n_blocks, rows, n)
+    Rs = jax.vmap(
+        lambda Ai: r_matrix(*_blocked_qr_impl(Ai, nb, precision=precision))
+    )(Ab)
+    H2, alpha2 = _blocked_qr_impl(Rs.reshape(n_blocks * n, n), nb,
+                                  precision=precision)
+    return r_matrix(H2, alpha2)
+
+
+def tsqr_r(
+    A: jax.Array,
+    n_blocks: int = 8,
+    block_size: int = DEFAULT_BLOCK_SIZE,
+    precision: str = DEFAULT_PRECISION,
+) -> jax.Array:
+    """The n x n triangular factor of A via TSQR (R up to row signs).
+
+    Note: Householder QR fixes R's diagonal signs by the alpha rule
+    (src:8-9), so R here may differ from another QR's R by a diagonal +-1
+    factor — ``R^H R = A^H A`` holds regardless.
+    """
+    m, n = A.shape
+    _check_tsqr_shape(m, n, n_blocks)
+    return _tsqr_r_impl(A, int(n_blocks), int(block_size), precision)
+
+
+def _check_tsqr_shape(m: int, n: int, n_blocks: int) -> None:
+    if m % n_blocks != 0:
+        raise ValueError(f"m={m} must be divisible by n_blocks={n_blocks}")
+    if m // n_blocks < n:
+        raise ValueError(
+            f"row blocks must stay tall: m/n_blocks = {m // n_blocks} < n = {n}; "
+            f"use fewer blocks"
+        )

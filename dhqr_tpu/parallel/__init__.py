@@ -17,6 +17,7 @@ from dhqr_tpu.parallel.layout import (
 from dhqr_tpu.parallel.mesh import column_mesh, column_sharding, replicated_sharding
 from dhqr_tpu.parallel.sharded_qr import sharded_blocked_qr, sharded_householder_qr
 from dhqr_tpu.parallel.sharded_solve import sharded_lstsq, sharded_solve
+from dhqr_tpu.parallel.sharded_tsqr import row_mesh, sharded_tsqr_lstsq
 
 __all__ = [
     "ColumnBlock",
@@ -30,4 +31,6 @@ __all__ = [
     "sharded_blocked_qr",
     "sharded_solve",
     "sharded_lstsq",
+    "row_mesh",
+    "sharded_tsqr_lstsq",
 ]

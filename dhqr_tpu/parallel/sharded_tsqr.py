@@ -1,0 +1,102 @@
+"""Mesh-sharded TSQR: row-partitioned tall-skinny QR / least squares.
+
+The distributed form of :mod:`dhqr_tpu.ops.tsqr`: rows sharded over a 1-D
+mesh axis, each device factors its local row block independently (zero
+communication), then the (P*n x n) stack of R heads — tiny — is
+all-gathered and the combine QR runs replicated on every device. Exactly
+one collective for the whole factorization, versus one psum per panel in
+the column-sharded engine: this is the communication-optimal regime for
+m >> n.
+
+This deliberately relaxes the reference's rows-never-partitioned invariant
+(reference src/DistributedHouseholderQR.jl:33) — its column layout cannot
+scale a 65536 x 256 problem (SURVEY.md §6 config 2), a row layout can.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dhqr_tpu.ops.blocked import _apply_qt_impl, _blocked_qr_impl
+from dhqr_tpu.ops.householder import DEFAULT_PRECISION
+from dhqr_tpu.ops.solve import back_substitute, r_matrix
+
+ROW_AXIS = "rows"
+
+
+def row_mesh(
+    n_devices: Optional[int] = None,
+    axis_name: str = ROW_AXIS,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """1-D device mesh over the row axis (the TSQR worker pool)."""
+    from dhqr_tpu.parallel.mesh import column_mesh
+
+    return column_mesh(n_devices, axis_name=axis_name, devices=devices)
+
+
+def _tsqr_shard_body(Al, bl, *, n: int, nb: int, axis: str, precision: str):
+    """Per-device: local QR + Q^H b, then replicated combine of the R heads."""
+    H, alpha = _blocked_qr_impl(Al, nb, precision=precision)
+    R = r_matrix(H, alpha)                                   # (n, n) head
+    c = _apply_qt_impl(H, bl, nb, precision=precision)[:n]   # (n,) head
+    # ONE collective: gather every device's heads (P*n rows — tiny traffic).
+    Rstack = lax.all_gather(R, axis).reshape(-1, n)
+    cstack = lax.all_gather(c, axis).reshape(-1)
+    # Combine stage, replicated on every device (cheaper than a second
+    # collective to scatter the result — same trade as the reference making
+    # alpha a SharedArray, src:302).
+    H2, alpha2 = _blocked_qr_impl(Rstack, nb, precision=precision)
+    c2 = _apply_qt_impl(H2, cstack, nb, precision=precision)
+    x = back_substitute(H2, alpha2, c2)
+    return x
+
+
+@lru_cache(maxsize=None)
+def _build_tsqr(mesh: Mesh, axis_name: str, n: int, nb: int, precision: str):
+    body = partial(
+        _tsqr_shard_body, n=n, nb=nb, axis=axis_name, precision=precision
+    )
+    return jax.jit(
+        shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(axis_name, None), P(axis_name)),
+            out_specs=P(),
+            check_vma=False,  # x is replicated by construction (all_gather)
+        )
+    )
+
+
+def sharded_tsqr_lstsq(
+    A: jax.Array,
+    b: jax.Array,
+    mesh: Mesh,
+    block_size: int = 128,
+    axis_name: str = ROW_AXIS,
+    precision: str = DEFAULT_PRECISION,
+) -> jax.Array:
+    """Distributed tall-skinny least squares: rows sharded, one all-gather.
+
+    Requires m divisible by the mesh size with each local block tall
+    (m/P >= n). Returns x replicated.
+    """
+    m, n = A.shape
+    nproc = mesh.shape[axis_name]
+    if m % nproc != 0:
+        raise ValueError(f"m={m} must be divisible by mesh size {nproc}")
+    if m // nproc < n:
+        raise ValueError(
+            f"local row blocks must stay tall: m/P = {m // nproc} < n = {n}"
+        )
+    nb = min(int(block_size), n)
+    A = jax.device_put(A, NamedSharding(mesh, P(axis_name, None)))
+    b = jax.device_put(b, NamedSharding(mesh, P(axis_name)))
+    return _build_tsqr(mesh, axis_name, n, nb, precision)(A, b)

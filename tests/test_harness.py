@@ -1,7 +1,11 @@
 """CLI harness smoke test (SURVEY.md §4: the runtests.jl analogue)."""
 
+import os
 import subprocess
 import sys
+from pathlib import Path
+
+REPO_ROOT = str(Path(__file__).resolve().parent.parent)
 
 
 def test_harness_cli_runs_and_passes():
@@ -14,8 +18,8 @@ def test_harness_cli_runs_and_passes():
         env={
             "PATH": "/usr/bin:/bin:/usr/local/bin",
             "JAX_PLATFORMS": "cpu",
-            "PYTHONPATH": "/root/repo",
-            "HOME": "/root",
+            "PYTHONPATH": REPO_ROOT,
+            "HOME": os.environ.get("HOME", "/tmp"),
         },
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
