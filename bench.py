@@ -26,6 +26,8 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 N = int(os.environ.get("DHQR_BENCH_N", "4096"))
@@ -40,6 +42,50 @@ def _sync(x) -> None:
     from dhqr_tpu.utils.profiling import sync
 
     sync(x)
+
+
+def _supervise() -> int:
+    """Run the bench in a child; on hang/failure, retry CPU-only.
+
+    The remote-TPU claim can wedge, in which case first backend use blocks
+    forever inside native code (no Python signal delivery) and the driver
+    would record nothing. The supervisor never imports jax itself, so it can
+    always kill the child and rerun it CPU-only — ONE JSON line is printed
+    either way (marked with its actual platform).
+    """
+    timeout = int(os.environ.get("DHQR_BENCH_INIT_TIMEOUT", "600"))
+    env = dict(os.environ, DHQR_BENCH_SUPERVISED="1")
+
+    def run(env):
+        # stdout is captured so exactly one JSON line ever reaches the
+        # caller, no matter how many attempts ran or how they died.
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                timeout=timeout, env=env, capture_output=True, text=True,
+            )
+        except subprocess.TimeoutExpired:
+            return None
+        if proc.returncode != 0:
+            return None
+        line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else None
+        try:
+            json.loads(line)
+        except (TypeError, ValueError):
+            return None
+        return line
+
+    line = run(env)
+    if line is None:
+        env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
+                    "PALLAS_AXON_POOL_IPS": ""})
+        line = run(env)
+    if line is None:
+        line = json.dumps({"metric": f"qr_gflops_per_chip_f32_{N}x{N}",
+                           "value": 0.0, "unit": "GFLOP/s", "vs_baseline": 0.0,
+                           "error": "bench failed on both tpu and cpu"})
+    print(line)
+    return 0
 
 
 def main() -> None:
@@ -94,4 +140,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("DHQR_BENCH_SUPERVISED"):
+        main()
+    else:
+        sys.exit(_supervise())

@@ -45,6 +45,13 @@ def _leaf_factor(Ai, bi, nb, precision):
     return R, c
 
 
+def _combine_solve(Rstack, cstack, nb, precision):
+    """Combine stage: QR the stacked heads, then solve R x = (Q^H c)[:n]."""
+    H2, alpha2 = _blocked_qr_impl(Rstack, nb, precision=precision)
+    c2 = _apply_qt_impl(H2, cstack, nb, precision=precision)
+    return back_substitute(H2, alpha2, c2)
+
+
 @partial(jax.jit, static_argnames=("n_blocks", "block_size", "precision"))
 def _tsqr_lstsq_impl(A, b, n_blocks, block_size, precision):
     m, n = A.shape
@@ -57,9 +64,7 @@ def _tsqr_lstsq_impl(A, b, n_blocks, block_size, precision):
     # Combine: one QR of the stacked R factors (n_blocks*n x n — tiny).
     Rstack = Rs.reshape(n_blocks * n, n)
     cstack = cs.reshape(n_blocks * n)
-    H2, alpha2 = _blocked_qr_impl(Rstack, nb, precision=precision)
-    c2 = _apply_qt_impl(H2, cstack, nb, precision=precision)
-    return back_substitute(H2, alpha2, c2)
+    return _combine_solve(Rstack, cstack, nb, precision)
 
 
 def tsqr_lstsq(

@@ -18,6 +18,12 @@ from dhqr_tpu.parallel.mesh import column_mesh, column_sharding, replicated_shar
 from dhqr_tpu.parallel.sharded_qr import sharded_blocked_qr, sharded_householder_qr
 from dhqr_tpu.parallel.sharded_solve import sharded_lstsq, sharded_solve
 from dhqr_tpu.parallel.sharded_tsqr import row_mesh, sharded_tsqr_lstsq
+from dhqr_tpu.parallel.multihost import (
+    global_column_mesh,
+    global_row_mesh,
+    initialize,
+    process_info,
+)
 
 __all__ = [
     "ColumnBlock",
@@ -33,4 +39,8 @@ __all__ = [
     "sharded_lstsq",
     "row_mesh",
     "sharded_tsqr_lstsq",
+    "initialize",
+    "global_column_mesh",
+    "global_row_mesh",
+    "process_info",
 ]

@@ -1,0 +1,91 @@
+"""Multi-host distribution: meshes spanning hosts over ICI + DCN.
+
+The reference's "cluster" is ``addprocs(np)`` worker processes on one
+machine (reference test/runtests.jl:9) — its Distributed.jl backend could
+reach real remote workers over TCP, with every reflector broadcast paying a
+host round-trip (src:141-143). The TPU framework's multi-host story is the
+JAX runtime's: one python process per host, ``jax.distributed.initialize``
+to form the global runtime, and a mesh over ``jax.devices()`` (ALL hosts'
+devices). The engines in this package need nothing further — ``shard_map``
+programs compile once and the runtime routes collectives over ICI within a
+slice and DCN across slices.
+
+Guidance for mesh construction (the scaling-relevant choice):
+
+* the column axis carries one psum per panel — O(n/nb) small collectives —
+  so it should ride ICI: keep a column mesh within a slice;
+* TSQR's single all-gather is DCN-tolerant — its row axis can span hosts
+  with negligible cost, which is exactly the regime (m >> n) where
+  multi-host capacity matters most.
+
+Usage (same script on every host):
+
+    from dhqr_tpu.parallel.multihost import initialize, global_column_mesh
+    initialize(coordinator_address="10.0.0.1:1234",
+               num_processes=4, process_id=HOST_ID)
+    mesh = global_column_mesh()
+    x = dhqr_tpu.lstsq(A, b, mesh=mesh)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from dhqr_tpu.parallel.mesh import DEFAULT_AXIS, column_mesh
+from dhqr_tpu.parallel.sharded_tsqr import ROW_AXIS, row_mesh
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    **kwargs,
+) -> None:
+    """Join the global JAX runtime (no-op when already initialized).
+
+    Thin wrapper over ``jax.distributed.initialize`` so framework users have
+    one import surface; on managed TPU pods all arguments are discovered
+    from the environment and may be omitted. Outside a managed environment,
+    calling with no arguments is a single-process no-op (the same script
+    then runs standalone — the reference's np=1 degenerate mode).
+    """
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            **kwargs,
+        )
+    except RuntimeError as e:
+        if "already initialized" not in str(e).lower():
+            raise
+    except ValueError:
+        if (coordinator_address is not None or num_processes is not None
+                or process_id is not None or kwargs):
+            raise  # explicit multi-process request that failed — surface it
+        # no coordinator anywhere and nothing requested: single-process mode
+
+
+def global_column_mesh(axis_name: str = DEFAULT_AXIS):
+    """Column mesh over every device of every host (ICI+DCN collectives)."""
+    return column_mesh(axis_name=axis_name, devices=jax.devices())
+
+
+def global_row_mesh(axis_name: str = ROW_AXIS):
+    """Row mesh over every device of every host — the TSQR axis, whose one
+    all-gather tolerates DCN latency."""
+    return row_mesh(axis_name=axis_name, devices=jax.devices())
+
+
+def process_info() -> dict:
+    """Topology summary for logs — the analogue of the reference printing
+    its worker/thread layout at startup (runtests.jl:10, 28)."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+        "backend": jax.default_backend(),
+    }

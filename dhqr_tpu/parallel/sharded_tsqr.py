@@ -19,14 +19,12 @@ from functools import lru_cache, partial
 from typing import Optional, Sequence
 
 import jax
-import jax.numpy as jnp
 from jax import lax
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dhqr_tpu.ops.blocked import _apply_qt_impl, _blocked_qr_impl
 from dhqr_tpu.ops.householder import DEFAULT_PRECISION
-from dhqr_tpu.ops.solve import back_substitute, r_matrix
+from dhqr_tpu.ops.tsqr import _combine_solve, _leaf_factor
 
 ROW_AXIS = "rows"
 
@@ -43,20 +41,19 @@ def row_mesh(
 
 
 def _tsqr_shard_body(Al, bl, *, n: int, nb: int, axis: str, precision: str):
-    """Per-device: local QR + Q^H b, then replicated combine of the R heads."""
-    H, alpha = _blocked_qr_impl(Al, nb, precision=precision)
-    R = r_matrix(H, alpha)                                   # (n, n) head
-    c = _apply_qt_impl(H, bl, nb, precision=precision)[:n]   # (n,) head
+    """Per-device: local QR + Q^H b, then replicated combine of the R heads.
+
+    Leaf and combine stages are shared with the single-device tree
+    (ops/tsqr) so the two paths cannot numerically diverge.
+    """
+    R, c = _leaf_factor(Al, bl, nb, precision)
     # ONE collective: gather every device's heads (P*n rows — tiny traffic).
     Rstack = lax.all_gather(R, axis).reshape(-1, n)
     cstack = lax.all_gather(c, axis).reshape(-1)
     # Combine stage, replicated on every device (cheaper than a second
     # collective to scatter the result — same trade as the reference making
     # alpha a SharedArray, src:302).
-    H2, alpha2 = _blocked_qr_impl(Rstack, nb, precision=precision)
-    c2 = _apply_qt_impl(H2, cstack, nb, precision=precision)
-    x = back_substitute(H2, alpha2, c2)
-    return x
+    return _combine_solve(Rstack, cstack, nb, precision)
 
 
 @lru_cache(maxsize=None)

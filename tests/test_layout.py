@@ -97,3 +97,39 @@ class TestCyclicStore:
 
         with pytest.raises(ValueError):
             cyclic_store_columns(30, 4, 2)
+
+
+class TestMultihost:
+    """Single-host degenerate checks of the multi-host helpers."""
+
+    def test_global_meshes_cover_all_devices(self):
+        import jax
+
+        from dhqr_tpu.parallel.multihost import (
+            global_column_mesh,
+            global_row_mesh,
+            process_info,
+        )
+
+        cmesh = global_column_mesh()
+        rmesh = global_row_mesh()
+        assert cmesh.shape["cols"] == len(jax.devices())
+        assert rmesh.shape["rows"] == len(jax.devices())
+        info = process_info()
+        assert info["process_count"] == 1
+        assert info["global_devices"] == len(jax.devices())
+
+    def test_global_mesh_runs_engines(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        import dhqr_tpu
+        from dhqr_tpu.parallel.multihost import global_column_mesh
+
+        rng = np.random.default_rng(9)
+        A = jnp.asarray(rng.random((64, 32)))
+        b = jnp.asarray(rng.random(64))
+        x = dhqr_tpu.lstsq(A, b, mesh=global_column_mesh(), block_size=4)
+        x0 = dhqr_tpu.lstsq(A, b)
+        np.testing.assert_allclose(np.asarray(x), np.asarray(x0),
+                                   rtol=1e-10, atol=1e-12)
