@@ -1,0 +1,36 @@
+"""Shared env-scrub recipe for processes that must bypass the axon TPU tunnel.
+
+The host pins every interpreter to the axon TPU plugin via a sitecustomize
+hook on PYTHONPATH; when the relay is wedged, any backend touch can hang.
+Children that must run on CPU (bench fallback, multi-chip dry run) get an
+environment with the hook's triggers removed. Kept jax-free so supervisors
+can import it without initializing any backend.
+"""
+
+from __future__ import annotations
+
+import os
+
+_REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def scrubbed_cpu_env(n_devices: int | None = None, **extra: str) -> dict:
+    """Env for a child pinned to the CPU platform, axon hook removed.
+
+    ``n_devices`` forces an n-device virtual CPU platform
+    (``--xla_force_host_platform_device_count``); any stale force flag in the
+    inherited XLA_FLAGS is dropped either way.
+    """
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO          # dhqr_tpu importable; axon_site dropped
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f for f in env.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    ]
+    if n_devices is not None:
+        flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env.update(extra)
+    return env

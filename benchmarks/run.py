@@ -140,13 +140,22 @@ def main(argv=None) -> None:
     if 3 in chosen:
         m = n = 16384 // scale
         mesh = mesh_or_none()
+        # the cyclic layout needs n % (nb * P) == 0; fall back to a single
+        # device rather than dying on an awkward device count (ADVICE r1)
+        nb3 = nb
+        if mesh is not None:
+            P = mesh.shape["cols"]
+            nb3 = min(nb, n // P)
+            if n % P or nb3 < 1 or (n // P) % nb3:
+                mesh = None
         A = jnp.asarray(rng.random((m, n)), dtype=jnp.float32)
         if mesh is None:
             fn = lambda: dhqr_tpu.blocked_householder_qr(A, nb)
             layout = "single"
         else:
             from dhqr_tpu.parallel.sharded_qr import sharded_blocked_qr
-            fn = lambda: sharded_blocked_qr(A, mesh, block_size=nb, layout="cyclic")
+            # pass the clamped width so the guard above and the engine agree
+            fn = lambda: sharded_blocked_qr(A, mesh, block_size=nb3, layout="cyclic")
             layout = "cyclic"
         t, _ = _bench(fn, sync, args.repeats)
         report(3, "square_qr_f32", m, n, t, _flops_qr(m, n), {"layout": layout})

@@ -119,11 +119,10 @@ def main(argv=None) -> int:
                 timer.observe(x)
             res = normal_equations_residual(A, np.asarray(x), b)
             ref = oracle_residual(A, b)
+            # EXACTLY the reference's acceptance rule: normal-equations
+            # residual < 8x LAPACK's (runtests.jl:62,81). No escape hatch.
             tol = TOLERANCE_FACTOR * ref
-            ok = res < tol or res < np.finfo(
-                dtype if not np.issubdtype(dtype, np.complexfloating)
-                else np.dtype(f"f{dtype.itemsize // 2}")
-            ).eps * 100
+            ok = res < tol
             status = "ok" if ok else "FAIL"
             failures += 0 if ok else 1
             print(

@@ -13,9 +13,16 @@ the reference's scaling convention makes every tau equal 1, the T factor has
 the closed form ``T = (I + triu(Y^H Y, 1))^{-1}`` — we never invert it,
 applying ``T^H`` via a unit-diagonal triangular solve instead.
 
-The panel loop is a Python loop over *static* panel offsets, so every slice
-has a static shape under ``jit`` and the trailing GEMM genuinely shrinks —
-no wasted flops, unlike the masked full-width unblocked path.
+Program size is BOUNDED regardless of n (XLA traces everything once, so an
+unrolled panel loop would grow the program — and TPU compile time — by
+O(n/nb)): when there are more than :data:`MAX_UNROLLED_PANELS` panels, the
+panel loop runs as a two-level scheme — an outer Python loop over at most
+``MAX_UNROLLED_PANELS`` statically-sliced super-blocks (each re-slices rows
+and columns, keeping the flop overhead to ~1/MAX_UNROLLED_PANELS), with a
+``lax.scan`` over uniform-shape panels inside each super-block (panel
+position passed as a traced row offset into the masked panel factorization).
+Small problems keep the fully-unrolled shrinking-slice path, which does the
+exact textbook flop count.
 """
 
 from __future__ import annotations
@@ -26,9 +33,20 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from dhqr_tpu.ops.householder import DEFAULT_PRECISION, _householder_qr_impl
+from dhqr_tpu.ops.householder import (
+    DEFAULT_PRECISION,
+    _householder_qr_impl,
+    _panel_qr_masked,
+)
 
 DEFAULT_BLOCK_SIZE = 128
+
+# Max distinct panel/super-block program regions per trace. Program size and
+# compile time scale with this constant, NOT with n; flop overhead of the
+# scanned path scales with 1/MAX_UNROLLED_PANELS (each super-block's scan
+# works on the super-block's full trailing shape instead of per-panel
+# shrinking slices).
+MAX_UNROLLED_PANELS = 8
 
 
 def wy_upper(Y: jax.Array, precision=DEFAULT_PRECISION) -> jax.Array:
@@ -70,6 +88,60 @@ def apply_block_reflector(
     return C - jnp.matmul(Y, Z, precision=precision)
 
 
+def shifted_tril(pf: jax.Array, offset) -> jax.Array:
+    """Zero entries above the shifted diagonal: keep rows >= offset + col.
+
+    Extracts the Y factor from a factored panel whose reflector for local
+    column jj starts at row ``offset + jj`` (``offset`` may be traced).
+    ``offset=0`` is ``jnp.tril``.
+    """
+    rows = lax.iota(jnp.int32, pf.shape[0])[:, None]
+    cols = lax.iota(jnp.int32, pf.shape[1])[None, :]
+    return jnp.where(rows >= offset + cols, pf, jnp.zeros_like(pf))
+
+
+def _panels_schedule(n: int, nb: int) -> tuple[int, int, int]:
+    """(num_full_panels, remainder_width, panels_per_super_block)."""
+    num_full = n // nb
+    rem = n - num_full * nb
+    ppo = -(-num_full // MAX_UNROLLED_PANELS) if num_full else 1  # ceil div
+    return num_full, rem, ppo
+
+
+def _scan_panels(S, pcount, nb, precision, pallas, pallas_interpret):
+    """Factor ``pcount`` uniform nb-wide panels of super-block S by scan.
+
+    S is the (ms, ns) trailing submatrix whose top-left element is the
+    super-block's first diagonal entry; panel q lives at rows/cols q*nb.
+    Each iteration factors one panel (masked, traced row offset) and applies
+    its compact-WY transform full-width, masked to columns right of the
+    panel. One scan body total — program size O(1) in pcount.
+    """
+    ms, ns = S.shape
+
+    def body(S, q):
+        c = q * nb
+        panel = lax.dynamic_slice(S, (jnp.int32(0), c), (ms, nb))
+        if pallas:
+            from dhqr_tpu.ops.pallas_panel import _panel_qr_pallas_impl
+
+            pf, alpha_k = _panel_qr_pallas_impl(
+                panel, c, interpret=pallas_interpret
+            )
+        else:
+            pf, alpha_k = _panel_qr_masked(panel, c, precision=precision)
+        S = lax.dynamic_update_slice(S, pf, (jnp.int32(0), c))
+        with jax.named_scope("trailing_update"):
+            Y = shifted_tril(pf, c)
+            C_new = apply_block_reflector_h(Y, S, precision)
+            cmask = lax.iota(jnp.int32, ns) >= c + nb
+            S = jnp.where(cmask[None, :], C_new, S)
+        return S, alpha_k
+
+    S, alphas = lax.scan(body, S, jnp.arange(pcount, dtype=jnp.int32))
+    return S, alphas.reshape(pcount * nb)
+
+
 @partial(
     jax.jit, static_argnames=("block_size", "precision", "pallas", "pallas_interpret")
 )
@@ -79,26 +151,61 @@ def _blocked_qr_impl(
     from dhqr_tpu.ops.pallas_panel import _panel_qr_pallas_impl, pallas_panel_supported
 
     m, n = A.shape
-    nb = block_size
+    nb = min(block_size, n)
+    num_full, rem, ppo = _panels_schedule(n, nb)
+
+    if num_full + (1 if rem else 0) <= MAX_UNROLLED_PANELS:
+        # Fully-unrolled shrinking-slice path: exact flops, small program.
+        H = A
+        alpha = jnp.zeros((n,), dtype=A.dtype)
+        for k in range(0, n, nb):
+            b = min(nb, n - k)
+            # phase names = the reference's t1a (panel math) / t1b (trailing
+            # update) timers (src:126-146), visible in XLA/perfetto traces.
+            with jax.named_scope("panel_factor"):
+                panel = lax.slice(H, (k, k), (m, k + b))
+                if pallas and pallas_panel_supported(m - k, b, A.dtype):
+                    pf, alpha_k = _panel_qr_pallas_impl(
+                        panel, 0, interpret=pallas_interpret
+                    )
+                else:
+                    pf, alpha_k = _householder_qr_impl(panel, precision=precision)
+                H = H.at[k:, k : k + b].set(pf)
+                alpha = alpha.at[k : k + b].set(alpha_k)
+            if k + b < n:
+                with jax.named_scope("trailing_update"):
+                    Y = jnp.tril(pf)  # reflectors incl. diagonal; R masked off
+                    C = lax.slice(H, (k, k + b), (m, n))
+                    H = H.at[k:, k + b :].set(
+                        apply_block_reflector_h(Y, C, precision)
+                    )
+        return H, alpha
+
+    # Two-level path: outer Python loop over <= MAX_UNROLLED_PANELS
+    # super-blocks (static row/col shrinkage), inner scan over uniform
+    # panels. The scan's trailing update spans ALL columns right of the
+    # panel — including later super-blocks — so no outer-level update pass
+    # is needed; the outer loop exists purely to re-slice shapes.
     H = A
     alpha = jnp.zeros((n,), dtype=A.dtype)
-    for k in range(0, n, nb):
-        b = min(nb, n - k)
-        # phase names = the reference's t1a (panel math) / t1b (trailing
-        # update) timers (src:126-146), visible in XLA/perfetto traces.
+    for ob in range(0, num_full, ppo):
+        pcount = min(ppo, num_full - ob)
+        K = ob * nb
+        S = lax.slice(H, (K, K), (m, n))
+        blk_pallas = pallas and pallas_panel_supported(m - K, nb, A.dtype)
+        S, alpha_blk = _scan_panels(
+            S, pcount, nb, precision, blk_pallas, pallas_interpret
+        )
+        H = H.at[K:, K:].set(S)
+        alpha = alpha.at[K : K + pcount * nb].set(alpha_blk)
+    if rem:
+        K = num_full * nb
         with jax.named_scope("panel_factor"):
-            panel = lax.slice(H, (k, k), (m, k + b))
-            if pallas and pallas_panel_supported(m - k, b, A.dtype):
-                pf, alpha_k = _panel_qr_pallas_impl(panel, interpret=pallas_interpret)
-            else:
-                pf, alpha_k = _householder_qr_impl(panel, precision=precision)
-            H = H.at[k:, k : k + b].set(pf)
-            alpha = alpha.at[k : k + b].set(alpha_k)
-        if k + b < n:
-            with jax.named_scope("trailing_update"):
-                Y = jnp.tril(pf)  # reflectors incl. diagonal; R part masked off
-                C = lax.slice(H, (k, k + b), (m, n))
-                H = H.at[k:, k + b :].set(apply_block_reflector_h(Y, C, precision))
+            pf, alpha_k = _householder_qr_impl(
+                lax.slice(H, (K, K), (m, n)), precision=precision
+            )
+        H = H.at[K:, K:].set(pf)
+        alpha = alpha.at[K:].set(alpha_k)
     return H, alpha
 
 
@@ -168,12 +275,27 @@ def blocked_householder_qr(
 @partial(jax.jit, static_argnames=("block_size", "precision"))
 def _apply_qt_impl(H, b, block_size, precision=DEFAULT_PRECISION):
     m, n = H.shape
-    nb = block_size
+    nb = min(block_size, n)
+    num_full, rem, _ = _panels_schedule(n, nb)
     vec = b.ndim == 1
     B = b[:, None] if vec else b
-    for k in range(0, n, nb):
-        bsz = min(nb, n - k)
-        Y = jnp.tril(lax.slice(H, (k, k), (m, k + bsz)))
+    if num_full + (1 if rem else 0) <= MAX_UNROLLED_PANELS:
+        for k in range(0, n, nb):
+            bsz = min(nb, n - k)
+            Y = jnp.tril(lax.slice(H, (k, k), (m, k + bsz)))
+            B = B.at[k:].set(apply_block_reflector_h(Y, B[k:], precision))
+        return B[:, 0] if vec else B
+
+    def body(B, q):
+        k = q * nb
+        Y = shifted_tril(lax.dynamic_slice(H, (jnp.int32(0), k), (m, nb)), k)
+        # Y is zero above row k, so only rows k: change — no slicing needed.
+        return apply_block_reflector_h(Y, B, precision), None
+
+    B, _ = lax.scan(body, B, jnp.arange(num_full, dtype=jnp.int32))
+    if rem:
+        k = num_full * nb
+        Y = jnp.tril(lax.slice(H, (k, k), (m, n)))
         B = B.at[k:].set(apply_block_reflector_h(Y, B[k:], precision))
     return B[:, 0] if vec else B
 
@@ -197,13 +319,32 @@ def blocked_apply_qt(
 @partial(jax.jit, static_argnames=("block_size", "precision"))
 def _apply_q_impl(H, b, block_size, precision=DEFAULT_PRECISION):
     m, n = H.shape
-    nb = block_size
+    nb = min(block_size, n)
+    num_full, rem, _ = _panels_schedule(n, nb)
     vec = b.ndim == 1
     B = b[:, None] if vec else b
-    for k in reversed(range(0, n, nb)):
-        bsz = min(nb, n - k)
-        Y = jnp.tril(lax.slice(H, (k, k), (m, k + bsz)))
+    if num_full + (1 if rem else 0) <= MAX_UNROLLED_PANELS:
+        for k in reversed(range(0, n, nb)):
+            bsz = min(nb, n - k)
+            Y = jnp.tril(lax.slice(H, (k, k), (m, k + bsz)))
+            B = B.at[k:].set(apply_block_reflector(Y, B[k:], precision))
+        return B[:, 0] if vec else B
+
+    # Reverse order: the remainder panel is the last factored, so Q applies
+    # it first; then the full panels from last to first.
+    if rem:
+        k = num_full * nb
+        Y = jnp.tril(lax.slice(H, (k, k), (m, n)))
         B = B.at[k:].set(apply_block_reflector(Y, B[k:], precision))
+
+    def body(B, q):
+        k = q * nb
+        Y = shifted_tril(lax.dynamic_slice(H, (jnp.int32(0), k), (m, nb)), k)
+        return apply_block_reflector(Y, B, precision), None
+
+    B, _ = lax.scan(
+        body, B, jnp.arange(num_full - 1, -1, -1, dtype=jnp.int32)
+    )
     return B[:, 0] if vec else B
 
 

@@ -1,21 +1,26 @@
-"""Differentiable least squares — custom VJP through the QR factorization.
+"""Differentiable least squares — custom derivative through the QR pipeline.
 
 The reference is a pure numerical package with no autodiff story; in a JAX
-framework ``lstsq`` should compose with ``grad``/``vmap``/``jit``. Naive
-reverse-mode through the factorization's ``fori_loop`` would checkpoint
-every panel step (O(n) copies of the matrix); instead we register the
-closed-form VJP of the full-rank least-squares solution
+framework ``lstsq`` should compose with ``grad``/``jacfwd``/``vmap``/``jit``.
+Naive autodiff through the factorization's loops would checkpoint every
+panel step (O(n) copies of the matrix); instead we register the closed-form
+differential of the full-rank least-squares solution
 
-    x(A, b) = argmin ||A x - b||,     dx = A+ (db - dA x) + (A^H A)^{-1} dA^H r
+    x(A, b) = argmin ||A x - b||
+    dx = A+ (db - dA x) + (A^H A)^{-1} dA^H r,   r = b - A x,  A+ = R^{-1} Q^H
 
-with r = b - A x and A+ = R^{-1} Q^H, giving cotangents
+as a ``jax.custom_jvp`` rule. The rule is *linear in the tangents* (dA, db)
+and built only from transposable primitives (GEMMs with primal constants,
+triangular solves against R, the compact-WY Q^H apply), so JAX derives
+reverse-mode by transposition — one rule serves ``jax.jvp``/``jacfwd`` AND
+``jax.grad``/``jacrev``/``jax.vjp``. (Round 1 used a ``custom_vjp``, which
+silently removed forward-mode; its closed-form cotangents
 
-    b_bar = Q R^{-H} x_bar
-    A_bar = -b_bar x^H + r w^H,    w = R^{-1} R^{-H} x_bar
+    b_bar = Q R^{-H} x_bar;  A_bar = -b_bar x^H + r w^H,  w = R^{-1} R^{-H} x_bar
 
-— everything computed from the packed factors (H, alpha) of the forward
-pass: two triangular solves with R and one compact-WY Q application. No
-normal-equations matrix is ever formed.
+are exactly what transposing this JVP produces.) Everything is computed from
+the packed factors (H, alpha) of the forward pass; no normal-equations
+matrix is ever formed.
 """
 
 from __future__ import annotations
@@ -28,7 +33,6 @@ from jax import lax
 
 from dhqr_tpu.ops.blocked import (
     DEFAULT_BLOCK_SIZE,
-    _apply_q_impl,
     _apply_qt_impl,
     _blocked_qr_impl,
 )
@@ -36,16 +40,16 @@ from dhqr_tpu.ops.householder import DEFAULT_PRECISION
 from dhqr_tpu.ops.solve import back_substitute, r_matrix
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+@partial(jax.custom_jvp, nondiff_argnums=(2, 3, 4, 5))
 def lstsq_diff(
     A, b, block_size=DEFAULT_BLOCK_SIZE, precision=DEFAULT_PRECISION,
     pallas=False, pallas_interpret=False,
 ):
-    """``x = argmin ||A x - b||`` with an O(1)-memory reverse pass.
+    """``x = argmin ||A x - b||`` with closed-form O(1)-memory derivatives.
 
     Forward = the blocked engine pipeline (factor, Q^H b, back-substitute);
-    backward = the closed-form least-squares VJP above. ``b`` may be (m,) or
-    (m, k).
+    derivatives = the closed-form least-squares differential above, in both
+    forward and reverse mode. ``b`` may be (m,) or (m, k).
     """
     x, _ = _lstsq_fwd(A, b, block_size, precision, pallas, pallas_interpret)
     return x
@@ -61,35 +65,30 @@ def _lstsq_fwd(A, b, block_size, precision, pallas=False, pallas_interpret=False
     return x, (A, b, H, alpha, x)
 
 
-def _lstsq_bwd(block_size, precision, pallas, pallas_interpret, residuals, x_bar):
-    del pallas, pallas_interpret  # forward-only choices
-    A, b, H, alpha, x = residuals
+@lstsq_diff.defjvp
+def _lstsq_jvp(block_size, precision, pallas, pallas_interpret, primals, tangents):
+    A, b = primals
+    dA, db = tangents
+    x, (_, _, H, alpha, _) = _lstsq_fwd(
+        A, b, block_size, precision, pallas, pallas_interpret
+    )
     m, n = A.shape
-    R = r_matrix(H, alpha)
-    vec = x_bar.ndim == 1
-    # JAX's cotangent convention for non-holomorphic functions: the incoming
-    # cotangent is conjugated relative to the mathematical adjoint, and the
-    # outgoing cotangents must be conjugated back (no-ops for real dtypes).
-    x_bar = jnp.conj(x_bar)
-    Xb = x_bar[:, None] if vec else x_bar
+    vec = x.ndim == 1
     X = x[:, None] if vec else x
     B = b[:, None] if vec else b
-    # z = R^{-H} x_bar  (solve R^H z = x_bar)
-    z = lax.linalg.triangular_solve(
-        R, Xb, left_side=True, lower=False, transpose_a=True, conjugate_a=True
+    dB = db[:, None] if vec else db
+    R = r_matrix(H, alpha)
+    # dx1 = A+ (db - dA x): Q^H through the compact-WY apply, then R^{-1}.
+    U = dB - jnp.matmul(dA, X, precision=precision)
+    dx1 = back_substitute(
+        H, alpha, _apply_qt_impl(H, U, block_size, precision=precision)
     )
-    # b_bar = Q [z; 0]
-    z_full = jnp.concatenate([z, jnp.zeros((m - n, z.shape[1]), z.dtype)])
-    b_bar = _apply_q_impl(H, z_full, block_size, precision=precision)
-    # w = R^{-1} z
-    w = lax.linalg.triangular_solve(R, z, left_side=True, lower=False)
+    # dx2 = (A^H A)^{-1} dA^H r via two triangular solves with R.
     r = B - jnp.matmul(A, X, precision=precision)
-    A_bar = -jnp.matmul(b_bar, jnp.conj(X.T), precision=precision) + jnp.matmul(
-        r, jnp.conj(w.T), precision=precision
+    Z = jnp.matmul(jnp.conj(dA.T), r, precision=precision)
+    W = lax.linalg.triangular_solve(
+        R, Z, left_side=True, lower=False, transpose_a=True, conjugate_a=True
     )
-    A_bar = jnp.conj(A_bar)
-    b_bar = jnp.conj(b_bar)
-    return A_bar, b_bar[:, 0] if vec else b_bar
-
-
-lstsq_diff.defvjp(_lstsq_fwd, _lstsq_bwd)
+    dx2 = lax.linalg.triangular_solve(R, W, left_side=True, lower=False)
+    dX = dx1 + dx2
+    return x, (dX[:, 0] if vec else dX)

@@ -87,38 +87,53 @@ def householder_reflector(col: jax.Array, j: jax.Array):
     return v, alpha_j
 
 
-def _qr_step(j: jax.Array, carry, precision=DEFAULT_PRECISION):
-    """One column step: reflector + whole-matrix trailing update.
+def _panel_step(jj: jax.Array, carry, offset, precision=DEFAULT_PRECISION):
+    """One column step on a panel: reflector + whole-panel trailing update.
 
-    The trailing update ``A[:, j+1:] -= v (v^H A[:, j+1:])`` is expressed
-    full-width with a column mask so shapes stay static under ``jit``; the
-    GEMV + rank-1 pair is what XLA fuses onto the MXU/VPU. This replaces the
-    reference's broadcast + per-column hot loop (src:141-143, 198-213).
+    ``jj`` is the local column index within the panel; the reflector's
+    diagonal sits at row ``offset + jj`` (``offset`` may be traced — the
+    blocked engine's scan passes the panel's position within its
+    super-block). The trailing update ``P[:, jj+1:] -= v (v^H P[:, jj+1:])``
+    is expressed full-width with a column mask so shapes stay static under
+    ``jit``; the GEMV + rank-1 pair is what XLA fuses onto the MXU/VPU. This
+    replaces the reference's broadcast + per-column hot loop (src:141-143,
+    198-213).
     """
-    H, alpha = carry
-    m, n = H.shape
-    col = lax.dynamic_slice_in_dim(H, j, 1, axis=1)[:, 0]
+    P, alpha = carry
+    m, n = P.shape
+    j = offset + jj  # row of the diagonal entry
+    col = lax.dynamic_slice_in_dim(P, jj, 1, axis=1)[:, 0]
     v, alpha_j = householder_reflector(col, j)
     rows = lax.iota(jnp.int32, m)
-    # Column j now stores the reflector in rows j:m; rows < j keep R entries.
+    # Column jj now stores the reflector in rows j:m; rows < j keep R entries.
     newcol = jnp.where(rows >= j, v, col)
-    H = lax.dynamic_update_slice_in_dim(H, newcol[:, None], j, axis=1)
-    alpha = lax.dynamic_update_slice_in_dim(alpha, alpha_j[None], j, axis=0)
-    # Trailing update on columns > j (masked; v is already zero in rows < j).
+    P = lax.dynamic_update_slice_in_dim(P, newcol[:, None], jj, axis=1)
+    alpha = lax.dynamic_update_slice_in_dim(alpha, alpha_j[None], jj, axis=0)
+    # Trailing update on local columns > jj (masked; v is zero in rows < j).
     # (n,) partial dots — reference's partialdot (src:42-59)
-    w = jnp.matmul(jnp.conj(v), H, precision=precision)
-    cmask = lax.iota(jnp.int32, n) > j
+    w = jnp.matmul(jnp.conj(v), P, precision=precision)
+    cmask = lax.iota(jnp.int32, n) > jj
     w = jnp.where(cmask, w, jnp.zeros_like(w))
-    H = H - v[:, None] * w[None, :]  # reference's hotloop! axpy (src:150-196)
-    return H, alpha
+    P = P - v[:, None] * w[None, :]  # reference's hotloop! axpy (src:150-196)
+    return P, alpha
+
+
+def _panel_qr_masked(panel, offset, precision=DEFAULT_PRECISION):
+    """Masked panel QR: reflector for local column jj starts at row offset+jj.
+
+    ``offset`` may be a traced scalar; rows above the (shifted) diagonal are
+    preserved — they hold R entries of columns factored by earlier panels.
+    With ``offset=0`` this IS the unblocked engine on the whole matrix.
+    """
+    nb = panel.shape[1]
+    alpha = jnp.zeros((nb,), dtype=panel.dtype)
+    step = partial(_panel_step, offset=offset, precision=precision)
+    return lax.fori_loop(0, nb, step, (panel, alpha))
 
 
 @partial(jax.jit, static_argnames=("precision",))
 def _householder_qr_impl(A, precision=DEFAULT_PRECISION):
-    n = A.shape[1]
-    alpha = jnp.zeros((n,), dtype=A.dtype)
-    step = partial(_qr_step, precision=precision)
-    return lax.fori_loop(0, n, step, (A, alpha))
+    return _panel_qr_masked(A, 0, precision=precision)
 
 
 def householder_qr(A: jax.Array, precision: str = DEFAULT_PRECISION):

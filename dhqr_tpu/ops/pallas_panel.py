@@ -55,12 +55,19 @@ def pallas_panel_supported(m: int, nb: int, dtype) -> bool:
     return 2 * m * nb * 4 <= _VMEM_PANEL_BUDGET
 
 
-def _panel_kernel(at_ref, out_ref, alpha_ref, *, nb: int, m: int):
-    """Factor the transposed panel At (nb, m) in place; alpha out is (nb, 1)."""
-    lane = lax.broadcasted_iota(jnp.int32, (1, m), 1)  # (1, m) global row index
+def _panel_kernel(off_ref, at_ref, out_ref, alpha_ref, *, nb: int, m: int):
+    """Factor the transposed panel At (nb, m) in place; alpha out is (nb, 1).
 
-    def step(j, at):
-        row = jax.lax.dynamic_slice_in_dim(at, j, 1, axis=0)  # (1, m)
+    ``off_ref`` (SMEM scalar) is the panel's row offset: the reflector for
+    local column j starts at row ``off + j``. Rows above it hold R entries
+    of earlier panels and are preserved. Offset 0 = standalone panel.
+    """
+    lane = lax.broadcasted_iota(jnp.int32, (1, m), 1)  # (1, m) panel row index
+    off = off_ref[0]
+
+    def step(jloc, at):
+        j = off + jloc  # diagonal row of this reflector
+        row = jax.lax.dynamic_slice_in_dim(at, jloc, 1, axis=0)  # (1, m)
         rmask = lane >= j
         rowm = jnp.where(rmask, row, 0.0)
         s = jnp.sqrt(jnp.sum(rowm * rowm))
@@ -79,25 +86,26 @@ def _panel_kernel(at_ref, out_ref, alpha_ref, *, nb: int, m: int):
             precision=jax.lax.Precision.HIGHEST,
         )  # (nb, 1)
         row_ids = lax.broadcasted_iota(jnp.int32, (nb, 1), 0)
-        W = jnp.where(row_ids > j, W, 0.0)  # update only trailing columns
+        W = jnp.where(row_ids > jloc, W, 0.0)  # update only trailing columns
         at = at - W * v  # rank-1: the reference hotloop! over all jj (src:150-160)
-        # Store the reflector into row j (replaces the old column content).
+        # Store the reflector into row jloc (replaces the old column content).
         at = jax.lax.dynamic_update_slice_in_dim(
-            at, jnp.where(rmask, v, row), j, axis=0
+            at, jnp.where(rmask, v, row), jloc, axis=0
         )
-        alpha_ref[j, 0] = alpha_j
+        alpha_ref[jloc, 0] = alpha_j
         return at
 
     out_ref[:, :] = lax.fori_loop(0, nb, step, at_ref[:, :])
 
 
 @partial(jax.jit, static_argnames=("interpret",))
-def _panel_qr_pallas_impl(panel, interpret=False):
+def _panel_qr_pallas_impl(panel, offset, interpret=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     m, nb = panel.shape
     at = panel.T  # (nb, m): column j -> sublane row j
+    off = jnp.asarray(offset, dtype=jnp.int32).reshape((1,))
     kernel = partial(_panel_kernel, nb=nb, m=m)
     out, alpha = pl.pallas_call(
         kernel,
@@ -105,13 +113,16 @@ def _panel_qr_pallas_impl(panel, interpret=False):
             jax.ShapeDtypeStruct((nb, m), panel.dtype),
             jax.ShapeDtypeStruct((nb, 1), panel.dtype),
         ),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
         out_specs=(
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
         ),
         interpret=interpret,
-    )(at)
+    )(off, at)
     return out.T, alpha[:, 0]
 
 
@@ -128,4 +139,4 @@ def panel_qr_pallas(panel: jax.Array, interpret: bool = False):
         raise ValueError(f"panel_qr_pallas requires m >= nb, got {panel.shape}")
     if panel.dtype != jnp.float32:
         raise ValueError(f"panel_qr_pallas is float32-only, got {panel.dtype}")
-    return _panel_qr_pallas_impl(panel, interpret=interpret)
+    return _panel_qr_pallas_impl(panel, 0, interpret=interpret)

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from dhqr_tpu.ops.householder import DEFAULT_PRECISION
+from dhqr_tpu.ops.summation import accurate_vdot
 
 
 def _reflector_column(H: jax.Array, j: jax.Array) -> jax.Array:
@@ -37,17 +38,24 @@ def apply_qt(
     Per step: ``s = v_j^H b; b -= v_j s`` — the reference's
     ``partialdot`` + batched axpy (src:215-224), with the ragged ``j:m``
     range replaced by the structural zeros of the masked reflector.
-    ``b`` may be a vector (m,) or a block of right-hand sides (m, k).
+    For a single right-hand side the dot runs through the compensated
+    pairwise tree (:func:`dhqr_tpu.ops.summation.accurate_vdot`) — the L0
+    accuracy tier in the same position the reference uses ``partialdot``
+    (src:218); a block of right-hand sides (m, k) uses one GEMV per step.
     """
     del alpha  # R's diagonal is not needed to apply Q^H (parity with src:215)
     n = H.shape[1]
     vec = b.ndim == 1
     B = b[:, None] if vec else b
+    single = B.shape[1] == 1
 
     def step(j, B):
         v = _reflector_column(H, j)
         # conj(v)·b per rhs, reference partialdot (src:51-59)
-        s = jnp.matmul(jnp.conj(v), B, precision=precision)
+        if single:
+            s = accurate_vdot(v, B[:, 0])[None]
+        else:
+            s = jnp.matmul(jnp.conj(v), B, precision=precision)
         return B - v[:, None] * s[None, :]
 
     out = lax.fori_loop(0, n, step, B)

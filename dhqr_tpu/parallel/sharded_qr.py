@@ -36,10 +36,15 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from dhqr_tpu.ops.blocked import apply_block_reflector_h
+from dhqr_tpu.ops.blocked import (
+    MAX_UNROLLED_PANELS,
+    apply_block_reflector_h,
+    shifted_tril,
+)
 from dhqr_tpu.ops.householder import (
     DEFAULT_PRECISION,
     _householder_qr_impl,
+    _panel_qr_masked,
     householder_reflector,
 )
 from dhqr_tpu.parallel.mesh import DEFAULT_AXIS, column_sharding
@@ -73,6 +78,21 @@ def _panel_owner(k: int, n: int, nloc: int, nb: int, layout: str):
         return owner, k - owner * nloc
     kb = k // nb
     return kb % P, (kb // P) * nb
+
+
+def _panel_owner_traced(kb, P: int, nloc: int, nb: int, layout: str):
+    """Traced twin of :func:`_panel_owner` for scanned panel loops.
+
+    ``kb`` is the (traced) panel index; returns traced (owner, local col
+    offset) — the same arithmetic with only static divisors.
+    """
+    if layout == "block":
+        k = kb * nb
+        owner = k // nloc
+        return owner, k - owner * nloc
+    if layout == "cyclic":
+        return kb % P, (kb // P) * nb
+    raise ValueError(f"layout must be 'block' or 'cyclic', got {layout!r}")
 
 
 def _unblocked_shard_body(
@@ -125,35 +145,78 @@ def _blocked_shard_body(
     Al, *, n: int, nb: int, axis: str,
     precision: str = DEFAULT_PRECISION, layout: str = "block",
 ):
-    """Per-device body for the compact-WY engine; python loop over panels."""
+    """Per-device body for the compact-WY engine.
+
+    Program size is bounded the same way as the single-device engine
+    (ops/blocked.py): few panels -> fully-unrolled shrinking slices; many
+    panels -> outer Python loop over <= MAX_UNROLLED_PANELS statically
+    row-sliced super-blocks with a ``lax.scan`` over uniform panels inside
+    (one psum per panel either way — the reference's per-column broadcast,
+    src:141-143, batched nb columns at a time).
+    """
     m, nloc = Al.shape
     p = lax.axis_index(axis)
+    nproc = n // nloc
     gidx_base = _local_gidx(p, n, nloc, nb, layout)
     alpha = jnp.zeros((n,), dtype=Al.dtype)
+    num_panels = n // nb  # nb | nloc and n = nproc * nloc (checked by callers)
 
-    for k in range(0, n, nb):
-        b = min(nb, n - k)
-        owner, kl = _panel_owner(k, n, nloc, nb, layout)  # static placement
-        mine = p == owner
-        # Every device factors its own (m-k, b) slice; the psum keeps the
-        # owner's result. SPMD-friendly redundant compute beats a branch.
-        panel = lax.slice(Al, (k, kl), (m, kl + b))
-        pf, alpha_k = _householder_qr_impl(panel, precision=precision)
-        zero = jnp.zeros_like(pf)
-        pf = lax.psum(jnp.where(mine, pf, zero), axis)
-        alpha_k = lax.psum(jnp.where(mine, alpha_k, jnp.zeros_like(alpha_k)), axis)
-        alpha = alpha.at[k : k + b].set(alpha_k)
-        # Owner writes the factored panel back into its block.
-        Al_upd = Al.at[k:, kl : kl + b].set(pf)
-        Al = jnp.where(mine, Al_upd, Al)
-        # Replicated trailing transform: C <- (I - Y T^H Y^H) C on local
-        # columns right of the panel (masked), rows k:m.
-        Y = jnp.tril(pf)  # (m-k, b); zeros above row k handled by slicing
-        C = lax.slice(Al, (k, 0), (m, nloc))
-        C_new = apply_block_reflector_h(Y, C, precision)
-        cmask = (gidx_base >= k + b)[None, :]
-        Al = Al.at[k:, :].set(jnp.where(cmask, C_new, C))
+    if num_panels <= MAX_UNROLLED_PANELS:
+        for k in range(0, n, nb):
+            b = min(nb, n - k)
+            owner, kl = _panel_owner(k, n, nloc, nb, layout)  # static placement
+            mine = p == owner
+            # Every device factors its own (m-k, b) slice; the psum keeps the
+            # owner's result. SPMD-friendly redundant compute beats a branch.
+            panel = lax.slice(Al, (k, kl), (m, kl + b))
+            pf, alpha_k = _householder_qr_impl(panel, precision=precision)
+            zero = jnp.zeros_like(pf)
+            pf = lax.psum(jnp.where(mine, pf, zero), axis)
+            alpha_k = lax.psum(
+                jnp.where(mine, alpha_k, jnp.zeros_like(alpha_k)), axis
+            )
+            alpha = alpha.at[k : k + b].set(alpha_k)
+            # Owner writes the factored panel back into its block.
+            Al_upd = Al.at[k:, kl : kl + b].set(pf)
+            Al = jnp.where(mine, Al_upd, Al)
+            # Replicated trailing transform: C <- (I - Y T^H Y^H) C on local
+            # columns right of the panel (masked), rows k:m.
+            Y = jnp.tril(pf)  # (m-k, b); zeros above row k handled by slicing
+            C = lax.slice(Al, (k, 0), (m, nloc))
+            C_new = apply_block_reflector_h(Y, C, precision)
+            cmask = (gidx_base >= k + b)[None, :]
+            Al = Al.at[k:, :].set(jnp.where(cmask, C_new, C))
+        return Al, alpha
 
+    ppo = -(-num_panels // MAX_UNROLLED_PANELS)  # panels per super-block
+    for ob in range(0, num_panels, ppo):
+        pcount = min(ppo, num_panels - ob)
+        K = ob * nb
+        Sl = lax.slice(Al, (K, 0), (m, nloc))  # rows K:, all local columns
+
+        def body(Sl, q, ob=ob, ms=m - K, K=K):
+            kb = ob + q              # global panel index (traced)
+            k = kb * nb              # global start column
+            c = k - K                # row offset within the super-block
+            owner, kl = _panel_owner_traced(kb, nproc, nloc, nb, layout)
+            mine = p == owner
+            panel = lax.dynamic_slice(Sl, (jnp.int32(0), kl), (ms, nb))
+            pf, alpha_k = _panel_qr_masked(panel, c, precision=precision)
+            pf = lax.psum(jnp.where(mine, pf, jnp.zeros_like(pf)), axis)
+            alpha_k = lax.psum(
+                jnp.where(mine, alpha_k, jnp.zeros_like(alpha_k)), axis
+            )
+            Sl_upd = lax.dynamic_update_slice(Sl, pf, (jnp.int32(0), kl))
+            Sl = jnp.where(mine, Sl_upd, Sl)
+            Y = shifted_tril(pf, c)
+            C_new = apply_block_reflector_h(Y, Sl, precision)
+            cmask = (gidx_base >= k + nb)[None, :]
+            Sl = jnp.where(cmask, C_new, Sl)
+            return Sl, alpha_k
+
+        Sl, alpha_blk = lax.scan(body, Sl, jnp.arange(pcount, dtype=jnp.int32))
+        Al = Al.at[K:, :].set(Sl)
+        alpha = alpha.at[K : K + pcount * nb].set(alpha_blk.reshape(pcount * nb))
     return Al, alpha
 
 

@@ -29,7 +29,11 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from dhqr_tpu.ops.blocked import apply_block_reflector_h
+from dhqr_tpu.ops.blocked import (
+    MAX_UNROLLED_PANELS,
+    apply_block_reflector_h,
+    shifted_tril,
+)
 from dhqr_tpu.ops.householder import DEFAULT_PRECISION
 from dhqr_tpu.parallel.mesh import DEFAULT_AXIS, column_sharding, replicated_sharding
 
@@ -38,25 +42,43 @@ def _apply_qt_shard_body(
     Hl, b, *, n: int, nb: int, axis: str,
     precision: str = DEFAULT_PRECISION, layout: str = "block",
 ):
-    """b <- Q^H b, panel by panel; Hl is the local (m, nloc) block."""
-    from dhqr_tpu.parallel.sharded_qr import _panel_owner
+    """b <- Q^H b, panel by panel; Hl is the local (m, nloc) block.
+
+    Per panel, the owner's reflectors are broadcast with one psum — the
+    equivalent of stage 1's per-worker visit (src:227-229). Many panels run
+    as one ``lax.scan`` (bounded program size, uniform full-height panels
+    whose structural zeros above row k make the unsliced update exact).
+    """
+    from dhqr_tpu.parallel.sharded_qr import _panel_owner, _panel_owner_traced
 
     m, nloc = Hl.shape
+    nproc = n // nloc
     p = lax.axis_index(axis)
     vec = b.ndim == 1
     B = b[:, None] if vec else b
+    num_panels = n // nb  # nb | nloc | n in the sharded path (checked)
 
-    for k in range(0, n, nb):
-        bsz = min(nb, n - k)
-        owner, kl = _panel_owner(k, n, nloc, nb, layout)
+    if num_panels <= MAX_UNROLLED_PANELS:
+        for k in range(0, n, nb):
+            bsz = min(nb, n - k)
+            owner, kl = _panel_owner(k, n, nloc, nb, layout)
+            mine = p == owner
+            panel = jnp.tril(lax.slice(Hl, (k, kl), (m, kl + bsz)))
+            panel = lax.psum(jnp.where(mine, panel, jnp.zeros_like(panel)), axis)
+            tail = lax.slice(B, (k, 0), B.shape)
+            B = B.at[k:, :].set(apply_block_reflector_h(panel, tail, precision))
+        return B[:, 0] if vec else B
+
+    def body(B, kb):
+        k = kb * nb
+        owner, kl = _panel_owner_traced(kb, nproc, nloc, nb, layout)
         mine = p == owner
-        # Broadcast the owner's panel reflectors (rows k:m) — the psum
-        # equivalent of stage 1's per-worker visit (src:227-229).
-        panel = jnp.tril(lax.slice(Hl, (k, kl), (m, kl + bsz)))
-        panel = lax.psum(jnp.where(mine, panel, jnp.zeros_like(panel)), axis)
-        tail = lax.slice(B, (k, 0), B.shape)
-        B = B.at[k:, :].set(apply_block_reflector_h(panel, tail, precision))
+        Y = shifted_tril(lax.dynamic_slice(Hl, (jnp.int32(0), kl), (m, nb)), k)
+        Y = lax.psum(jnp.where(mine, Y, jnp.zeros_like(Y)), axis)
+        # Y is zero above row k, so only rows k: change — no slicing needed.
+        return apply_block_reflector_h(Y, B, precision), None
 
+    B, _ = lax.scan(body, B, jnp.arange(num_panels, dtype=jnp.int32))
     return B[:, 0] if vec else B
 
 
@@ -71,36 +93,71 @@ def _backsub_shard_body(
     its columns' update to all earlier rows; both ride one psum. ``c`` may
     be (m,) or (m, k).
     """
-    from dhqr_tpu.parallel.sharded_qr import _panel_owner
+    from dhqr_tpu.parallel.sharded_qr import _panel_owner, _panel_owner_traced
 
     m, nloc = Hl.shape
+    nproc = n // nloc
     p = lax.axis_index(axis)
     rows_n = lax.iota(jnp.int32, n)[:, None]
     vec = c.ndim == 1
     C = (c[:, None] if vec else c)[:n]
     x = jnp.zeros_like(C)
+    num_panels = n // nb  # nb | nloc | n in the sharded path (checked)
 
-    for k in reversed(range(0, n, nb)):
-        bsz = min(nb, n - k)
-        owner, kl = _panel_owner(k, n, nloc, nb, layout)
+    if num_panels <= MAX_UNROLLED_PANELS:
+        for k in reversed(range(0, n, nb)):
+            bsz = min(nb, n - k)
+            owner, kl = _panel_owner(k, n, nloc, nb, layout)
+            mine = p == owner
+            # Owner's diagonal block: strict upper from H, diagonal from
+            # alpha (the reference's R packing, src:244-254).
+            blk = lax.slice(Hl, (k, kl), (k + bsz, kl + bsz))
+            Rpp = jnp.triu(blk, k=1) + jnp.diag(
+                lax.dynamic_slice_in_dim(alpha, k, bsz)
+            )
+            xp = lax.linalg.triangular_solve(
+                Rpp, C[k : k + bsz], left_side=True, lower=False
+            )  # (bsz, nrhs)
+            # Owner's columns' contribution to earlier rows: R[0:k, panel]@xp.
+            above = (
+                lax.slice(Hl, (0, kl), (k, kl + bsz))
+                if k
+                else jnp.zeros((0, bsz), Hl.dtype)
+            )
+            delta = jnp.matmul(above, xp, precision=precision)  # (k, nrhs)
+            packed = jnp.concatenate(
+                [delta, xp, jnp.zeros((n - k - bsz, xp.shape[1]), C.dtype)]
+            )
+            packed = lax.psum(jnp.where(mine, packed, jnp.zeros_like(packed)), axis)
+            x = jnp.where((rows_n >= k) & (rows_n < k + bsz), packed, x)
+            C = jnp.where(rows_n < k, C - packed, C)
+        return x[:, 0] if vec else x
+
+    def body(carry, kb):
+        x, C = carry
+        k = kb * nb
+        owner, kl = _panel_owner_traced(kb, nproc, nloc, nb, layout)
         mine = p == owner
-        # Owner's diagonal block: strict upper from H, diagonal from alpha
-        # (the reference's R packing, src:244-254).
-        blk = lax.slice(Hl, (k, kl), (k + bsz, kl + bsz))
-        Rpp = jnp.triu(blk, k=1) + jnp.diag(lax.dynamic_slice_in_dim(alpha, k, bsz))
-        xp = lax.linalg.triangular_solve(
-            Rpp, C[k : k + bsz], left_side=True, lower=False
-        )  # (bsz, nrhs)
-        # Owner's columns' contribution to earlier rows: R[0:k, panel] @ xp.
-        above = lax.slice(Hl, (0, kl), (k, kl + bsz)) if k else jnp.zeros((0, bsz), Hl.dtype)
-        delta = jnp.matmul(above, xp, precision=precision)  # (k, nrhs)
-        packed = jnp.concatenate(
-            [delta, xp, jnp.zeros((n - k - bsz, xp.shape[1]), C.dtype)]
-        )
+        # Owner's full column strip, R rows only (n x nb, uniform shape).
+        strip = lax.dynamic_slice(Hl, (jnp.int32(0), kl), (n, nb))
+        blk = lax.dynamic_slice(strip, (k, jnp.int32(0)), (nb, nb))
+        Rpp = jnp.triu(blk, k=1) + jnp.diag(lax.dynamic_slice_in_dim(alpha, k, nb))
+        Ck = lax.dynamic_slice(C, (k, jnp.int32(0)), (nb, C.shape[1]))
+        xp = lax.linalg.triangular_solve(Rpp, Ck, left_side=True, lower=False)
+        # R[0:k, panel] @ xp with the strip masked to rows < k (rows >= k+nb
+        # hold reflector entries, not R; rows in the panel are the diagonal
+        # block already solved above).
+        above = jnp.where(rows_n < k, strip, jnp.zeros_like(strip))
+        delta = jnp.matmul(above, xp, precision=precision)  # (n, nrhs)
+        packed = lax.dynamic_update_slice(delta, xp, (k, jnp.int32(0)))
         packed = lax.psum(jnp.where(mine, packed, jnp.zeros_like(packed)), axis)
-        x = jnp.where((rows_n >= k) & (rows_n < k + bsz), packed, x)
+        x = jnp.where((rows_n >= k) & (rows_n < k + nb), packed, x)
         C = jnp.where(rows_n < k, C - packed, C)
+        return (x, C), None
 
+    (x, C), _ = lax.scan(
+        body, (x, C), jnp.arange(num_panels - 1, -1, -1, dtype=jnp.int32)
+    )
     return x[:, 0] if vec else x
 
 

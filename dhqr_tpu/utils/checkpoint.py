@@ -24,13 +24,19 @@ import numpy as np
 
 
 def save_factorization(path: str | os.PathLike, fact) -> None:
-    """Serialize a :class:`~dhqr_tpu.models.qr_model.QRFactorization` to .npz."""
+    """Serialize a :class:`~dhqr_tpu.models.qr_model.QRFactorization` to .npz.
+
+    All static fields ride along (block_size, precision, layout) — H is
+    stored in natural column order, so the layout is pure metadata, but a
+    cyclic-layout factorization must reload as one.
+    """
     np.savez(
         path,
         H=np.asarray(fact.H),
         alpha=np.asarray(fact.alpha),
         block_size=np.asarray(fact.block_size, dtype=np.int64),
         precision=np.asarray(str(fact.precision)),
+        layout=np.asarray(str(fact.layout)),
     )
 
 
@@ -48,6 +54,9 @@ def load_factorization(path: str | os.PathLike, mesh=None, axis_name: str = "col
         alpha = jnp.asarray(z["alpha"])
         block_size = int(z["block_size"])
         precision = str(z["precision"])
+        # Older round-1 checkpoints predate the layout field; default matches
+        # QRFactorization's default.
+        layout = str(z["layout"]) if "layout" in z.files else "block"
     if mesh is not None:
         from dhqr_tpu.parallel.layout import fit_block_size
         from dhqr_tpu.parallel.mesh import column_sharding, replicated_sharding
@@ -56,5 +65,6 @@ def load_factorization(path: str | os.PathLike, mesh=None, axis_name: str = "col
         alpha = jax.device_put(alpha, replicated_sharding(mesh))
         block_size = fit_block_size(H.shape[1] // mesh.shape[axis_name], block_size)
     return QRFactorization(
-        H, alpha, block_size=block_size, mesh=mesh, precision=precision
+        H, alpha, block_size=block_size, mesh=mesh, precision=precision,
+        layout=layout,
     )

@@ -78,12 +78,17 @@ class PhaseTimer:
     def measure(self, name: str) -> Iterator[None]:
         self._pending = []
         t0 = time.perf_counter()
-        with phase(name):
-            yield
-            if self._pending:
-                sync(self._pending)
-        self._records.append((name, time.perf_counter() - t0))
-        self._pending = []
+        try:
+            with phase(name):
+                yield
+                if self._pending:
+                    sync(self._pending)
+            self._records.append((name, time.perf_counter() - t0))
+        finally:
+            # Exception safety: never leave stale array refs behind — a later
+            # measure() must not fence on arrays from a failed phase. The
+            # failed phase records nothing (its timing would be meaningless).
+            self._pending = []
 
     def report(self) -> Dict[str, List[float]]:
         out: Dict[str, List[float]] = {}

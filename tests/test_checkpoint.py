@@ -31,6 +31,38 @@ def test_save_load_roundtrip(tmp_path, dtype):
     assert res < TOLERANCE_FACTOR * oracle_residual(A, b)
 
 
+def test_cyclic_layout_roundtrip(tmp_path):
+    """A cyclic-layout factorization reloads as one (layout is persisted)
+    and still solves correctly on a mesh — VERDICT r1 item 8."""
+    mesh = column_mesh(4)
+    A, b = random_problem(96, 64, np.float64, seed=13)
+    fact = qr(jnp.asarray(A), mesh=mesh, block_size=8, layout="cyclic")
+    assert fact.layout == "cyclic"
+    x0 = np.asarray(fact.solve(jnp.asarray(b)))
+    path = tmp_path / "fact_cyclic.npz"
+    save_factorization(path, fact)
+    re = load_factorization(path, mesh=mesh)
+    assert re.layout == "cyclic"
+    x1 = np.asarray(re.solve(jnp.asarray(b)))
+    np.testing.assert_allclose(x1, x0, rtol=1e-10, atol=1e-12)
+
+
+def test_load_pre_layout_checkpoint_defaults_to_block(tmp_path):
+    """Round-1 checkpoints (no layout field) load with layout='block'."""
+    A, _ = random_problem(32, 16, np.float64, seed=14)
+    fact = qr(jnp.asarray(A), block_size=8)
+    path = tmp_path / "old.npz"
+    np.savez(
+        path,
+        H=np.asarray(fact.H),
+        alpha=np.asarray(fact.alpha),
+        block_size=np.asarray(fact.block_size, dtype=np.int64),
+        precision=np.asarray(str(fact.precision)),
+    )
+    re = load_factorization(path)
+    assert re.layout == "block"
+
+
 def test_reload_onto_mesh_resumes_distributed(tmp_path):
     """Checkpoint single-device, resume sharded — topology-portable resume."""
     A, b = random_problem(96, 64, np.float64, seed=12)

@@ -48,6 +48,34 @@ def test_sharded_blocked_matches_serial(mesh, dtype):
     np.testing.assert_allclose(np.asarray(a1), np.asarray(a0), rtol=1e-9, atol=1e-12)
 
 
+@pytest.mark.parametrize("layout", ["block", "cyclic"])
+def test_sharded_scan_path_matches_serial(mesh, layout):
+    """>MAX_UNROLLED_PANELS panels routes the sharded engine through its
+    scanned super-block path (bounded program size) — must still match the
+    single-device blocked engine to rounding, in both layouts."""
+    from dhqr_tpu.ops.blocked import MAX_UNROLLED_PANELS
+
+    A, _ = random_problem(160, 128, np.float64, seed=44)
+    assert 128 // 8 > MAX_UNROLLED_PANELS
+    H0, a0 = blocked_householder_qr(jnp.asarray(A), block_size=8)
+    H1, a1 = sharded_blocked_qr(jnp.asarray(A), mesh, block_size=8, layout=layout)
+    np.testing.assert_allclose(np.asarray(H1), np.asarray(H0), rtol=1e-9, atol=1e-11)
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(a0), rtol=1e-9, atol=1e-11)
+
+
+@pytest.mark.parametrize("layout", ["block", "cyclic"])
+def test_sharded_scan_solve_matches_serial(mesh, layout):
+    """Scan-path distributed solve (apply-Q^H + back-sub) matches serial."""
+    import dhqr_tpu
+
+    A, b = random_problem(160, 128, np.float64, seed=45)
+    x_serial = np.asarray(dhqr_tpu.lstsq(jnp.asarray(A), jnp.asarray(b), block_size=8))
+    x_shard = np.asarray(
+        sharded_lstsq(jnp.asarray(A), jnp.asarray(b), mesh, block_size=8, layout=layout)
+    )
+    np.testing.assert_allclose(x_shard, x_serial, rtol=1e-8, atol=1e-10)
+
+
 def test_sharded_output_shardings(mesh):
     """H comes back column-sharded, alpha replicated (SharedArray analogue)."""
     A, _ = random_problem(64, 32, np.float64, seed=33)

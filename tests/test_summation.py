@@ -1,0 +1,103 @@
+"""L0 unit tests: compensated reductions vs stdlib oracles (SURVEY.md §4).
+
+The reference unit-tests its dot micro-kernel against the stdlib oracle for
+every length and start offset (reference test/partialdot.jl:11-22). Same
+protocol here for the L0 tier (ops/summation.py): lengths 1..20, every
+offset, real and complex, against numpy/math.fsum high-precision oracles —
+plus an adversarial cancellation case the plain dtype-precision sum fails.
+"""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dhqr_tpu.ops.summation import (
+    accurate_norm,
+    accurate_sumsq,
+    accurate_vdot,
+    tree_sum,
+)
+
+
+def _mask_from(x, start):
+    """Zero entries before ``start`` — the masked spelling of a[start:]."""
+    return np.where(np.arange(len(x)) >= start, x, 0)
+
+
+@pytest.mark.parametrize("n", range(1, 21))
+def test_tree_sum_matches_fsum(n):
+    rng = np.random.default_rng(100 + n)
+    x = rng.standard_normal(n)
+    got = float(tree_sum(jnp.asarray(x)))
+    want = math.fsum(x)
+    assert got == pytest.approx(want, rel=1e-15, abs=1e-300)
+
+
+@pytest.mark.parametrize("n", range(1, 21))
+def test_vdot_every_offset_real(n):
+    """partialdot(a, b, i:N) ≈ dot(a[i:], b[i:]) for every i — the
+    reference's unit-test protocol (test/partialdot.jl:11-22)."""
+    rng = np.random.default_rng(200 + n)
+    a = rng.standard_normal(n)
+    b = rng.standard_normal(n)
+    for start in range(n):
+        am = _mask_from(a, start)
+        got = float(accurate_vdot(jnp.asarray(am), jnp.asarray(b)))
+        want = np.dot(a[start:], b[start:])
+        assert got == pytest.approx(want, rel=1e-13, abs=1e-14)
+
+
+@pytest.mark.parametrize("n", range(1, 21))
+def test_vdot_every_offset_complex(n):
+    """Complex conjugating dot — ``conj(a)·b`` like the reference's complex
+    partialdot (src:51-59) and numpy's vdot."""
+    rng = np.random.default_rng(300 + n)
+    a = rng.standard_normal(n) + 1j * rng.standard_normal(n)
+    b = rng.standard_normal(n) + 1j * rng.standard_normal(n)
+    for start in range(n):
+        am = _mask_from(a, start)
+        got = complex(accurate_vdot(jnp.asarray(am), jnp.asarray(b)))
+        want = np.vdot(a[start:], b[start:])
+        assert got == pytest.approx(want, rel=1e-13, abs=1e-14)
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 7, 16, 33, 1000])
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_sumsq_and_norm(n, dtype):
+    rng = np.random.default_rng(400 + n)
+    x = rng.standard_normal(n).astype(dtype)
+    if np.issubdtype(dtype, np.complexfloating):
+        x = x + 1j * rng.standard_normal(n)
+    want = math.fsum(np.abs(x) ** 2)
+    assert float(accurate_sumsq(jnp.asarray(x))) == pytest.approx(want, rel=1e-14)
+    assert float(accurate_norm(jnp.asarray(x))) == pytest.approx(
+        math.sqrt(want), rel=1e-14
+    )
+
+
+def test_tree_sum_beats_plain_sum_on_cancellation():
+    """Adversarial f32 case: plain reduce-sum loses everything to
+    cancellation; the compensated tree keeps the exact result."""
+    # pairs (big, tiny) summing to n_pairs in exact arithmetic, with the
+    # big terms cancelling: fl32 naive left-to-right or pairwise sums lose
+    # the tiny terms entirely.
+    big = np.float32(1e8)
+    x = np.array([big, 1.0, -big, 1.0] * 64, dtype=np.float32)
+    exact = 128.0
+    got_tree = float(tree_sum(jnp.asarray(x)))
+    got_plain = float(jnp.sum(jnp.asarray(x)))
+    assert got_tree == exact
+    assert got_plain != exact  # documents why the tree exists
+
+
+def test_vdot_zero_length_masked():
+    """Fully-masked input (empty range) sums to zero, like dot(a[n:], ...)."""
+    a = np.zeros(5)
+    b = np.ones(5)
+    assert float(accurate_vdot(jnp.asarray(a), jnp.asarray(b))) == 0.0
+
+
+def test_tree_sum_empty():
+    assert float(tree_sum(jnp.zeros((0,)))) == 0.0
