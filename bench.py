@@ -73,36 +73,66 @@ def _scrubbed_cpu_env() -> dict:
     return scrubbed_cpu_env(DHQR_BENCH_SUPERVISED="1")
 
 
-def _run_child(env: dict, timeout: int) -> dict:
-    """Run the bench child; return attempt record (json line or failure info)."""
-    proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__)],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-    )
-    killed = False
-    try:
-        out, err = proc.communicate(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        # Graceful first: SIGTERM + grace. SIGKILL only if that fails, and
-        # record it — a hard kill mid-claim can wedge the axon relay.
-        proc.send_signal(signal.SIGTERM)
+def _parse_last_json(out: str):
+    for line in reversed(out.strip().splitlines()):
         try:
-            out, err = proc.communicate(timeout=20)
+            return json.loads(line)
+        except ValueError:
+            continue
+    return None
+
+
+def _run_child(env: dict, timeout: int) -> dict:
+    """Run the bench child; return attempt record (json line or failure info).
+
+    Child stdout/stderr go to temp files, not pipes: on a timeout the
+    partial output survives, so a child that measured the headline number
+    but hung in a later stage (e.g. the backward-error extra compile) still
+    yields its result — the child prints the metric line as soon as it
+    exists (see ``main``), and the supervisor takes the LAST parseable
+    JSON line either way.
+    """
+    import tempfile
+
+    with tempfile.TemporaryFile("w+") as fout, \
+            tempfile.TemporaryFile("w+") as ferr:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, stdout=fout, stderr=ferr, text=True,
+        )
+        killed = timed_out = False
+        try:
+            proc.wait(timeout=timeout)
         except subprocess.TimeoutExpired:
-            proc.kill()
-            killed = True
-            out, err = proc.communicate()
-        return {"ok": False, "why": "timeout", "sigkill_escalated": killed,
-                "last_stage": _last_stage(err), "stderr_tail": err[-2000:]}
-    if proc.returncode != 0:
-        return {"ok": False, "why": f"rc={proc.returncode}",
-                "last_stage": _last_stage(err), "stderr_tail": err[-2000:]}
-    line = out.strip().splitlines()[-1] if out.strip() else None
-    try:
-        return {"ok": True, "result": json.loads(line)}
-    except (TypeError, ValueError):
-        return {"ok": False, "why": "no json on stdout",
-                "last_stage": _last_stage(err), "stderr_tail": err[-2000:]}
+            # Graceful first: SIGTERM + grace (the child converts it to
+            # sys.exit so the PJRT client shuts down and releases its
+            # claim). SIGKILL only if that fails, and record it — a hard
+            # kill mid-claim can wedge the axon relay.
+            timed_out = True
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                killed = True
+                proc.wait()
+        fout.seek(0)
+        out = fout.read()
+        ferr.seek(0)
+        err = ferr.read()
+    result = _parse_last_json(out)
+    if result is not None:
+        if timed_out or proc.returncode != 0:
+            result["child_incomplete"] = (
+                "timeout" if timed_out else f"rc={proc.returncode}"
+            )
+            result["last_stage"] = _last_stage(err)
+            result["sigkill_escalated"] = killed
+        return {"ok": True, "result": result}
+    why = ("timeout" if timed_out else
+           f"rc={proc.returncode}" if proc.returncode else "no json on stdout")
+    return {"ok": False, "why": why, "sigkill_escalated": killed,
+            "last_stage": _last_stage(err), "stderr_tail": err[-2000:]}
 
 
 def _supervise() -> int:
@@ -129,10 +159,24 @@ def _supervise() -> int:
 
 
 def main() -> None:
+    # Convert SIGTERM into a normal interpreter exit so the PJRT client's
+    # destructor runs and the TPU claim is released — dying inside a
+    # blocking recv wedges the relay for every later process.
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(3))
+
     _stage("import_jax")
     import jax
     import jax.numpy as jnp
     import numpy as np
+
+    # Persistent compilation cache: the remote compile leg is the slowest
+    # and most fragile stage; a warm cache skips it entirely on re-runs.
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(_REPO, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
 
     from dhqr_tpu.ops.blocked import _apply_q_impl, _blocked_qr_impl
     from dhqr_tpu.ops.solve import r_matrix
@@ -169,16 +213,6 @@ def main() -> None:
     flops = 2.0 * m * n * n - (2.0 / 3.0) * n**3
     gflops = flops / t / 1e9
 
-    # backward-error check ||QR - A|| / ||A|| on a smaller problem (forming
-    # Q R at bench size would dwarf the factorization itself).
-    _stage("backward_error")
-    small = 1024
-    As = jnp.asarray(rng.random((small, small)), dtype=jnp.float32)
-    Hs, als = _blocked_qr_impl(As, BLOCK, precision=PRECISION)
-    QRs = _apply_q_impl(Hs, r_matrix(Hs, als), BLOCK, precision=PRECISION)
-    berr = float(jnp.linalg.norm(QRs - As) / jnp.linalg.norm(As))
-    _stage("done")
-
     result = {
         "metric": f"qr_gflops_per_chip_f32_{N}x{N}",
         "value": round(gflops, 2),
@@ -189,8 +223,23 @@ def main() -> None:
         "compile_seconds": round(compile_s, 2),
         "block_size": BLOCK,
         "precision": PRECISION,
-        "backward_error_1024": berr,
     }
+    # Emit the headline number NOW — the backward-error stage below needs a
+    # second compile, and if that hangs the supervisor can still recover
+    # this line from the child's captured stdout.
+    print(json.dumps(result), flush=True)
+
+    # backward-error check ||QR - A|| / ||A|| on a smaller problem (forming
+    # Q R at bench size would dwarf the factorization itself).
+    _stage("backward_error")
+    small = 1024
+    As = jnp.asarray(rng.random((small, small)), dtype=jnp.float32)
+    Hs, als = _blocked_qr_impl(As, BLOCK, precision=PRECISION)
+    QRs = _apply_q_impl(Hs, r_matrix(Hs, als), BLOCK, precision=PRECISION)
+    result["backward_error_1024"] = float(
+        jnp.linalg.norm(QRs - As) / jnp.linalg.norm(As)
+    )
+    _stage("done")
     print(json.dumps(result))
 
 

@@ -1,0 +1,116 @@
+"""Device-scaling curve for the sharded engines (VERDICT r1 item 4).
+
+Runs the distributed compact-WY QR (and optionally the full least-squares
+pipeline) at a fixed problem size over meshes of 1, 2, 4, ... devices and
+prints one JSON line per point plus a summary speedup table. On a machine
+without a multi-chip TPU this exercises the virtual CPU mesh
+(``--xla_force_host_platform_device_count``), where XLA executes the SPMD
+partitions on host threads — real parallel execution and real collective
+costs (through shared memory), the same proof-shape as the reference's
+``addprocs(np)`` local cluster benchmarks (reference test/runtests.jl:84-89).
+
+Interpreting the curve: per panel, every device factors an (m-k) x nb panel
+redundantly (wall-clock-free in SPMD — all devices would otherwise idle
+waiting on the owner) and one psum moves the panel, which every device needs
+for its trailing update anyway. The scalable term is the trailing update,
+whose per-device width shrinks as nloc = n/P. Efficiency is therefore
+bounded by (trailing flops)/(total flops) — Amdahl on the panel tier.
+
+Usage:
+    python benchmarks/scaling.py [--n 1024] [--m 1024] [--nb 64]
+                                 [--devices 1,2,4,8] [--repeats 3]
+                                 [--layout cyclic] [--lstsq]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--n", type=int, default=1024)
+    parser.add_argument("--m", type=int, default=None, help="rows (default n)")
+    parser.add_argument("--nb", type=int, default=64)
+    parser.add_argument("--devices", default="1,2,4,8")
+    parser.add_argument("--repeats", type=int, default=3)
+    parser.add_argument("--layout", default="cyclic", choices=["block", "cyclic"])
+    parser.add_argument("--lstsq", action="store_true",
+                        help="time factor+solve instead of factor only")
+    args = parser.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dhqr_tpu.ops.blocked import _blocked_qr_impl
+    from dhqr_tpu.parallel.mesh import column_mesh
+    from dhqr_tpu.parallel.sharded_qr import sharded_blocked_qr
+    from dhqr_tpu.parallel.sharded_solve import sharded_lstsq
+    from dhqr_tpu.utils.profiling import sync
+
+    m = args.m or args.n
+    n, nb = args.n, args.nb
+    counts = [int(t) for t in args.devices.split(",")]
+    ndev = len(jax.devices())
+    rng = np.random.default_rng(0)
+    A = jnp.asarray(rng.random((m, n)), dtype=jnp.float32)
+    b = jnp.asarray(rng.random(m), dtype=jnp.float32)
+    flops = 2.0 * m * n * n - (2.0 / 3.0) * n**3
+
+    def bench(fn):
+        out = fn()
+        sync(out)
+        times = []
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            out = fn()
+            sync(out)
+            times.append(time.perf_counter() - t0)
+        return min(times)
+
+    results = {}
+    for P in counts:
+        if P > ndev:
+            print(json.dumps({"devices": P, "skipped": f"only {ndev} visible"}))
+            continue
+        if P == 1:
+            fn = lambda: _blocked_qr_impl(A, nb)
+            if args.lstsq:
+                import dhqr_tpu
+                fn = lambda: dhqr_tpu.lstsq(A, b, block_size=nb)
+        else:
+            mesh = column_mesh(P)
+            if n % P or (n // P) % nb:
+                print(json.dumps(
+                    {"devices": P, "skipped": f"n={n} not divisible by P*nb"}))
+                continue
+            if args.lstsq:
+                fn = lambda: sharded_lstsq(A, b, mesh, block_size=nb,
+                                           layout=args.layout)
+            else:
+                fn = lambda: sharded_blocked_qr(A, mesh, block_size=nb,
+                                                layout=args.layout)
+        t = bench(fn)
+        results[P] = t
+        print(json.dumps({
+            "metric": "sharded_lstsq" if args.lstsq else "sharded_blocked_qr",
+            "devices": P, "layout": args.layout if P > 1 else "single",
+            "shape": f"{m}x{n}", "block_size": nb,
+            "seconds": round(t, 4),
+            "gflops": round(flops / t / 1e9, 2),
+            "speedup_vs_1": round(results.get(1, t) / t, 3) if 1 in results else None,
+            "platform": jax.default_backend(),
+        }))
+
+
+if __name__ == "__main__":
+    main()

@@ -43,7 +43,9 @@ import jax.numpy as jnp
 from jax import lax
 
 # VMEM working-set budget for the transposed panel (bytes). The chip has
-# ~16 MiB per core; leave headroom for the output copy and scratch.
+# ~16 MiB per core; the kernel factors the panel IN PLACE (the input block
+# is aliased to the output, see ``input_output_aliases`` below) so only one
+# panel copy plus the per-step reflector/dot scratch is resident.
 _VMEM_PANEL_BUDGET = 12 * 1024 * 1024
 
 
@@ -51,23 +53,37 @@ def pallas_panel_supported(m: int, nb: int, dtype) -> bool:
     """True when the fused kernel can factor an (m, nb) f32 panel in VMEM."""
     if jnp.dtype(dtype) != jnp.float32:
         return False
-    # input block + output block both resident
-    return 2 * m * nb * 4 <= _VMEM_PANEL_BUDGET
+    # The panel is factored in place (input aliased to output), but the
+    # step body still materializes panel-sized intermediates (the W*v
+    # outer product and the updated panel value) unless Mosaic fuses the
+    # chain — so budget TWO resident panel copies until the single-copy
+    # limit is validated on hardware.
+    return 2 * m * nb * 4 + 4 * m * 4 <= _VMEM_PANEL_BUDGET
 
 
 def _panel_kernel(off_ref, at_ref, out_ref, alpha_ref, *, nb: int, m: int):
-    """Factor the transposed panel At (nb, m) in place; alpha out is (nb, 1).
+    """Factor the transposed panel At (nb, m) IN PLACE; alpha out is (nb, 1).
 
     ``off_ref`` (SMEM scalar) is the panel's row offset: the reflector for
     local column j starts at row ``off + j``. Rows above it hold R entries
     of earlier panels and are preserved. Offset 0 = standalone panel.
+
+    ``at_ref`` is aliased to ``out_ref`` (``input_output_aliases`` in the
+    ``pallas_call``), and the column loop mutates ``out_ref`` directly
+    rather than carrying the panel as a loop value — the HBM in/out
+    buffers are shared; step temporaries may still hold a second panel
+    copy in VMEM (see :func:`pallas_panel_supported`).
     """
     lane = lax.broadcasted_iota(jnp.int32, (1, m), 1)  # (1, m) panel row index
     off = off_ref[0]
+    out_ref[:, :] = at_ref[:, :]  # no-op when aliased
 
-    def step(jloc, at):
+    def step(jloc, _):
+        from jax.experimental import pallas as pl
+
         j = off + jloc  # diagonal row of this reflector
-        row = jax.lax.dynamic_slice_in_dim(at, jloc, 1, axis=0)  # (1, m)
+        at = out_ref[:, :]
+        row = out_ref[pl.dslice(jloc, 1), :]  # (1, m)
         rmask = lane >= j
         rowm = jnp.where(rmask, row, 0.0)
         s = jnp.sqrt(jnp.sum(rowm * rowm))
@@ -87,15 +103,14 @@ def _panel_kernel(off_ref, at_ref, out_ref, alpha_ref, *, nb: int, m: int):
         )  # (nb, 1)
         row_ids = lax.broadcasted_iota(jnp.int32, (nb, 1), 0)
         W = jnp.where(row_ids > jloc, W, 0.0)  # update only trailing columns
-        at = at - W * v  # rank-1: the reference hotloop! over all jj (src:150-160)
-        # Store the reflector into row jloc (replaces the old column content).
-        at = jax.lax.dynamic_update_slice_in_dim(
-            at, jnp.where(rmask, v, row), jloc, axis=0
-        )
+        # rank-1: the reference hotloop! over all jj (src:150-160), then the
+        # reflector overwrites row jloc (the old column content).
+        out_ref[:, :] = at - W * v
+        out_ref[pl.dslice(jloc, 1), :] = jnp.where(rmask, v, row)
         alpha_ref[jloc, 0] = alpha_j
-        return at
+        return 0
 
-    out_ref[:, :] = lax.fori_loop(0, nb, step, at_ref[:, :])
+    lax.fori_loop(0, nb, step, 0)
 
 
 @partial(jax.jit, static_argnames=("interpret",))
@@ -121,6 +136,7 @@ def _panel_qr_pallas_impl(panel, offset, interpret=False):
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
         ),
+        input_output_aliases={1: 0},  # factor the panel in place
         interpret=interpret,
     )(off, at)
     return out.T, alpha[:, 0]

@@ -161,6 +161,17 @@ def _blocked_shard_body(
     alpha = jnp.zeros((n,), dtype=Al.dtype)
     num_panels = n // nb  # nb | nloc and n = nproc * nloc (checked by callers)
 
+    # Static local-column shrinkage ("drop"): with the cyclic layout, by the
+    # time panel kb starts, every device's first kb // nproc stored blocks
+    # are fully factored (device p's stored block l holds global panel
+    # l*nproc + p, done iff l*nproc + p < kb, and l < kb // nproc implies
+    # that for every p) — so they can be sliced off the trailing update
+    # statically instead of masked, cutting the dead flops the masking
+    # would otherwise spend. The block layout has no p-independent done
+    # prefix (low-p devices simply go idle — that is why cyclic exists).
+    def _done_cols(kb: int) -> int:
+        return (kb // nproc) * nb if layout == "cyclic" else 0
+
     if num_panels <= MAX_UNROLLED_PANELS:
         for k in range(0, n, nb):
             b = min(nb, n - k)
@@ -181,24 +192,27 @@ def _blocked_shard_body(
             Al = jnp.where(mine, Al_upd, Al)
             # Replicated trailing transform: C <- (I - Y T^H Y^H) C on local
             # columns right of the panel (masked), rows k:m.
+            drop = _done_cols(k // nb)
             Y = jnp.tril(pf)  # (m-k, b); zeros above row k handled by slicing
-            C = lax.slice(Al, (k, 0), (m, nloc))
+            C = lax.slice(Al, (k, drop), (m, nloc))
             C_new = apply_block_reflector_h(Y, C, precision)
-            cmask = (gidx_base >= k + b)[None, :]
-            Al = Al.at[k:, :].set(jnp.where(cmask, C_new, C))
+            cmask = (gidx_base[drop:] >= k + b)[None, :]
+            Al = Al.at[k:, drop:].set(jnp.where(cmask, C_new, C))
         return Al, alpha
 
     ppo = -(-num_panels // MAX_UNROLLED_PANELS)  # panels per super-block
     for ob in range(0, num_panels, ppo):
         pcount = min(ppo, num_panels - ob)
         K = ob * nb
-        Sl = lax.slice(Al, (K, 0), (m, nloc))  # rows K:, all local columns
+        drop = _done_cols(ob)  # static: columns done before this super-block
+        Sl = lax.slice(Al, (K, drop), (m, nloc))  # rows K:, live local columns
 
-        def body(Sl, q, ob=ob, ms=m - K, K=K):
+        def body(Sl, q, ob=ob, ms=m - K, K=K, drop=drop):
             kb = ob + q              # global panel index (traced)
             k = kb * nb              # global start column
             c = k - K                # row offset within the super-block
             owner, kl = _panel_owner_traced(kb, nproc, nloc, nb, layout)
+            kl = kl - drop           # local offset within the live slice
             mine = p == owner
             panel = lax.dynamic_slice(Sl, (jnp.int32(0), kl), (ms, nb))
             pf, alpha_k = _panel_qr_masked(panel, c, precision=precision)
@@ -210,12 +224,12 @@ def _blocked_shard_body(
             Sl = jnp.where(mine, Sl_upd, Sl)
             Y = shifted_tril(pf, c)
             C_new = apply_block_reflector_h(Y, Sl, precision)
-            cmask = (gidx_base >= k + nb)[None, :]
+            cmask = (gidx_base[drop:] >= k + nb)[None, :]
             Sl = jnp.where(cmask, C_new, Sl)
             return Sl, alpha_k
 
         Sl, alpha_blk = lax.scan(body, Sl, jnp.arange(pcount, dtype=jnp.int32))
-        Al = Al.at[K:, :].set(Sl)
+        Al = Al.at[K:, drop:].set(Sl)
         alpha = alpha.at[K : K + pcount * nb].set(alpha_blk.reshape(pcount * nb))
     return Al, alpha
 

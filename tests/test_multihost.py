@@ -99,10 +99,13 @@ def test_two_process_distributed_lstsq(tmp_path):
             out, err = p.communicate(timeout=300)
             outs.append((p.returncode, out, err))
     except subprocess.TimeoutExpired:
+        tails = []
         for p in procs:
             p.kill()
-        pytest.fail("multi-process run timed out: " + repr(
-            [(p.returncode,) for p in procs]))
+            out, err = p.communicate()
+            tails.append(f"rc={p.returncode}\nstdout:{out[-1000:]}\n"
+                         f"stderr:{err[-2000:]}")
+        pytest.fail("multi-process run timed out:\n" + "\n---\n".join(tails))
 
     for rc, out, err in outs:
         assert rc == 0, f"worker failed (rc={rc})\nstdout:{out}\nstderr:{err[-3000:]}"
