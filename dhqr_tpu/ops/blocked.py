@@ -237,7 +237,7 @@ def _resolve_pallas(mode: str, m: int, nb: int, dtype) -> tuple[bool, bool]:
         if not supported:
             raise ValueError(
                 f"use_pallas='always' but an ({m}, {nb}) {jnp.dtype(dtype).name} "
-                "panel is unsupported (float32-only, must fit VMEM)"
+                "panel is unsupported (float32/complex64 only, must fit VMEM)"
             )
         return True, not on_tpu
     if mode == "auto":

@@ -29,9 +29,18 @@ column norm is a plain f32 sum of squares, NOT the compensated tree of
 per column, which is why the kernel stays opt-in (``use_pallas="always"``)
 until its backward error is validated on hardware.
 
-Float32 only (TPU-native dtype; f64 stays on the XLA path, complex is
-unsupported by Mosaic), and the panel must fit in VMEM — callers gate via
-:func:`pallas_panel_supported`.
+Float32 and complex64. Mosaic has no complex dtype, so the complex64
+kernel runs PLANAR arithmetic — separate real/imaginary (nb, m) f32 planes,
+the TPU-level analogue of the reference's reinterpret-to-Float64-lanes
+trick in its hand-SIMD ComplexF64 ``hotloop!`` (src:162-196): each complex
+partial dot becomes four real contractions
+
+    Wr =  Ar vr + Ai vi        (re of conj(v) . x)
+    Wi =  Ai vr - Ar vi        (im of conj(v) . x)
+
+and the rank-1 update two real outer-product pairs. Float64/complex128 stay
+on the XLA path (TPU f64 is emulated anyway). The panel must fit in VMEM —
+callers gate via :func:`pallas_panel_supported`.
 """
 
 from __future__ import annotations
@@ -50,15 +59,24 @@ _VMEM_PANEL_BUDGET = 12 * 1024 * 1024
 
 
 def pallas_panel_supported(m: int, nb: int, dtype) -> bool:
-    """True when the fused kernel can factor an (m, nb) f32 panel in VMEM."""
-    if jnp.dtype(dtype) != jnp.float32:
+    """True when the fused kernel can factor an (m, nb) panel in VMEM.
+
+    Supported dtypes: float32 (direct) and complex64 (planar re/im — two
+    f32 planes, so twice the resident bytes).
+    """
+    dt = jnp.dtype(dtype)
+    if dt == jnp.float32:
+        planes = 1
+    elif dt == jnp.complex64:
+        planes = 2
+    else:
         return False
     # The panel is factored in place (input aliased to output), but the
     # step body still materializes panel-sized intermediates (the W*v
     # outer product and the updated panel value) unless Mosaic fuses the
     # chain — so budget TWO resident panel copies until the single-copy
     # limit is validated on hardware.
-    return 2 * m * nb * 4 + 4 * m * 4 <= _VMEM_PANEL_BUDGET
+    return planes * (2 * m * nb * 4 + 4 * m * 4) <= _VMEM_PANEL_BUDGET
 
 
 def _panel_kernel(off_ref, at_ref, out_ref, alpha_ref, *, nb: int, m: int):
@@ -113,14 +131,103 @@ def _panel_kernel(off_ref, at_ref, out_ref, alpha_ref, *, nb: int, m: int):
     lax.fori_loop(0, nb, step, 0)
 
 
+def _panel_kernel_c64(off_ref, ar_ref, ai_ref, or_ref, oi_ref,
+                      alr_ref, ali_ref, *, nb: int, m: int):
+    """Complex64 twin of :func:`_panel_kernel`, planar re/im f32 planes.
+
+    The reference ships its complex fast kernel ACTIVE in the hot path
+    (src:174-196, 4-wide f64 lanes with shuffle/sign vectors); here the
+    complex algebra is spelled as real plane arithmetic so the VPU/MXU see
+    only f32: conj(v).x = (vr.xr + vi.xi) + i(vr.xi - vi.xr), and the
+    rank-1 update  x -= W v  splits into two real outer-product pairs.
+    """
+    from jax.experimental import pallas as pl
+
+    lane = lax.broadcasted_iota(jnp.int32, (1, m), 1)
+    off = off_ref[0]
+    or_ref[:, :] = ar_ref[:, :]  # no-ops when aliased
+    oi_ref[:, :] = ai_ref[:, :]
+
+    def _dot(a, b):  # (nb, m) x (1, m) -> (nb, 1), contraction over m
+        return jax.lax.dot_general(
+            a, b, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+
+    def step(jloc, _):
+        j = off + jloc
+        atr = or_ref[:, :]
+        ati = oi_ref[:, :]
+        rowr = or_ref[pl.dslice(jloc, 1), :]
+        rowi = oi_ref[pl.dslice(jloc, 1), :]
+        rmask = lane >= j
+        rowmr = jnp.where(rmask, rowr, 0.0)
+        rowmi = jnp.where(rmask, rowi, 0.0)
+        s = jnp.sqrt(jnp.sum(rowmr * rowmr + rowmi * rowmi))
+        ar_jj = jnp.sum(jnp.where(lane == j, rowr, 0.0))
+        ai_jj = jnp.sum(jnp.where(lane == j, rowi, 0.0))
+        mag = jnp.sqrt(ar_jj * ar_jj + ai_jj * ai_jj)
+        # alpha = s * (-a/|a|), with the reference's zero-pivot guard -> -1
+        # (alphafactor, src:8-9 / ops/householder.py).
+        inv = jnp.where(mag > 0, 1.0 / jnp.where(mag > 0, mag, 1.0), 0.0)
+        alr = s * jnp.where(mag > 0, -ar_jj * inv, -1.0)
+        ali = s * jnp.where(mag > 0, -ai_jj * inv, 0.0)
+        denom = s * (s + mag)
+        f = jnp.where(denom > 0, 1.0 / jnp.sqrt(jnp.where(denom > 0, denom, 1.0)), 0.0)
+        ej = (lane == j).astype(jnp.float32)
+        vr = (rowmr - alr * ej) * f
+        vi = (rowmi - ali * ej) * f
+        # W[jj] = conj(v) . At[jj, :]  (four real contractions)
+        Wr = _dot(atr, vr) + _dot(ati, vi)
+        Wi = _dot(ati, vr) - _dot(atr, vi)
+        row_ids = lax.broadcasted_iota(jnp.int32, (nb, 1), 0)
+        trail = row_ids > jloc
+        Wr = jnp.where(trail, Wr, 0.0)
+        Wi = jnp.where(trail, Wi, 0.0)
+        # x -= W v  (complex rank-1; the reference's SIMD hotloop!, src:174-196)
+        or_ref[:, :] = atr - (Wr * vr - Wi * vi)
+        oi_ref[:, :] = ati - (Wr * vi + Wi * vr)
+        or_ref[pl.dslice(jloc, 1), :] = jnp.where(rmask, vr, rowr)
+        oi_ref[pl.dslice(jloc, 1), :] = jnp.where(rmask, vi, rowi)
+        alr_ref[jloc, 0] = alr
+        ali_ref[jloc, 0] = ali
+        return 0
+
+    lax.fori_loop(0, nb, step, 0)
+
+
 @partial(jax.jit, static_argnames=("interpret",))
 def _panel_qr_pallas_impl(panel, offset, interpret=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     m, nb = panel.shape
-    at = panel.T  # (nb, m): column j -> sublane row j
     off = jnp.asarray(offset, dtype=jnp.int32).reshape((1,))
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+
+    if panel.dtype == jnp.complex64:
+        atr = jnp.real(panel).T  # (nb, m) planes: column j -> sublane row j
+        ati = jnp.imag(panel).T
+        kernel = partial(_panel_kernel_c64, nb=nb, m=m)
+        outr, outi, alr, ali = pl.pallas_call(
+            kernel,
+            out_shape=(
+                jax.ShapeDtypeStruct((nb, m), jnp.float32),
+                jax.ShapeDtypeStruct((nb, m), jnp.float32),
+                jax.ShapeDtypeStruct((nb, 1), jnp.float32),
+                jax.ShapeDtypeStruct((nb, 1), jnp.float32),
+            ),
+            in_specs=[smem, vmem, vmem],
+            out_specs=(vmem, vmem, vmem, vmem),
+            input_output_aliases={1: 0, 2: 1},  # planes factored in place
+            interpret=interpret,
+        )(off, atr, ati)
+        out = jax.lax.complex(outr.T, outi.T)
+        return out, jax.lax.complex(alr[:, 0], ali[:, 0])
+
+    at = panel.T  # (nb, m): column j -> sublane row j
     kernel = partial(_panel_kernel, nb=nb, m=m)
     out, alpha = pl.pallas_call(
         kernel,
@@ -128,14 +235,8 @@ def _panel_qr_pallas_impl(panel, offset, interpret=False):
             jax.ShapeDtypeStruct((nb, m), panel.dtype),
             jax.ShapeDtypeStruct((nb, 1), panel.dtype),
         ),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-        ],
-        out_specs=(
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-        ),
+        in_specs=[smem, vmem],
+        out_specs=(vmem, vmem),
         input_output_aliases={1: 0},  # factor the panel in place
         interpret=interpret,
     )(off, at)
@@ -143,7 +244,7 @@ def _panel_qr_pallas_impl(panel, offset, interpret=False):
 
 
 def panel_qr_pallas(panel: jax.Array, interpret: bool = False):
-    """Factor an (m, nb) Float32 panel with the fused VMEM kernel.
+    """Factor an (m, nb) float32/complex64 panel with the fused VMEM kernel.
 
     Returns ``(pf, alpha)`` in the same packed storage as
     :func:`dhqr_tpu.ops.householder.householder_qr`. ``interpret=True`` runs
@@ -153,6 +254,8 @@ def panel_qr_pallas(panel: jax.Array, interpret: bool = False):
     m, nb = panel.shape
     if m < nb:
         raise ValueError(f"panel_qr_pallas requires m >= nb, got {panel.shape}")
-    if panel.dtype != jnp.float32:
-        raise ValueError(f"panel_qr_pallas is float32-only, got {panel.dtype}")
+    if panel.dtype not in (jnp.float32, jnp.complex64):
+        raise ValueError(
+            f"panel_qr_pallas supports float32/complex64, got {panel.dtype}"
+        )
     return _panel_qr_pallas_impl(panel, 0, interpret=interpret)
