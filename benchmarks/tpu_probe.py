@@ -1,0 +1,191 @@
+"""Staged TPU bring-up + perf probe (run directly on the pinned axon platform).
+
+Runs an escalating sequence of stages — device query, tiny matmul, growing
+QR sizes, Pallas panel validation, precision comparison — logging a
+timestamped line before and after each stage to stderr AND to the file
+named by ``DHQR_PROBE_LOG`` (default /tmp/tpu_probe.log), so a hang is
+attributable to an exact stage even if the process is later killed.
+
+Safety on the fragile axon relay (see VERDICT r1):
+
+* every stage runs under a watchdog thread; on expiry the probe logs the
+  stage and exits immediately (``os._exit``) rather than being externally
+  SIGKILLed later with no diagnostics;
+* the persistent compilation cache is enabled, so a stage that succeeded
+  once never recompiles on a re-run;
+* stages are ordered smallest-first, and each stage's success is logged
+  before the next begins — re-runs can skip completed work with --from.
+
+Usage: python benchmarks/tpu_probe.py [--from STAGE] [--to STAGE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+LOG = os.environ.get("DHQR_PROBE_LOG", "/tmp/tpu_probe.log")
+
+
+def log(msg: str) -> None:
+    line = f"[{time.strftime('%H:%M:%S')}] {msg}"
+    print(line, file=sys.stderr, flush=True)
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+
+
+class Watchdog:
+    """os._exit(4) if the stage outlives its deadline (a hung PJRT call
+    cannot be interrupted by signals — the GIL-released C call never
+    returns to the eval loop, so a thread + hard exit is the only out)."""
+
+    def __init__(self, stage: str, seconds: float):
+        self.stage, self.seconds = stage, seconds
+        self._done = threading.Event()
+
+    def _fire(self):
+        if not self._done.wait(self.seconds):
+            log(f"WATCHDOG: stage '{self.stage}' exceeded {self.seconds}s — exiting")
+            os._exit(4)
+
+    def __enter__(self):
+        self._t = threading.Thread(target=self._fire, daemon=True)
+        self._t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._done.set()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--from", dest="from_stage", default=None)
+    parser.add_argument("--to", dest="to_stage", default=None)
+    parser.add_argument("--repeats", type=int, default=3)
+    args = parser.parse_args()
+
+    stages = []
+
+    def stage(name, seconds=420):
+        def deco(fn):
+            stages.append((name, seconds, fn))
+            return fn
+        return deco
+
+    log(f"probe start pid={os.getpid()}")
+
+    with Watchdog("import_jax", 180):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(_REPO, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    log("import ok")
+
+    from dhqr_tpu.ops.blocked import _apply_q_impl, _blocked_qr_impl
+    from dhqr_tpu.ops.solve import r_matrix
+    from dhqr_tpu.utils.profiling import sync
+
+    rng = np.random.default_rng(0)
+
+    @stage("devices", 240)
+    def _devices():
+        d = jax.devices()[0]
+        return {"platform": d.platform, "device": str(d)}
+
+    @stage("tiny_matmul", 420)
+    def _tiny():
+        x = jnp.ones((128, 128), dtype=jnp.float32)
+        y = x @ x
+        return {"ok": float(y[0, 0])}
+
+    def qr_stage(N, nb, precision="highest", pallas=False):
+        A = jnp.asarray(rng.random((N, N)), dtype=jnp.float32)
+        sync(A)
+        t0 = time.perf_counter()
+        c = _blocked_qr_impl.lower(
+            A, nb, precision=precision, pallas=pallas
+        ).compile()
+        tc = time.perf_counter() - t0
+        H, al = c(A)
+        sync(al)
+        times = []
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            H, al = c(A)
+            sync(al)
+            times.append(time.perf_counter() - t0)
+        t = min(times)
+        fl = 2.0 * N * N * N - (2.0 / 3.0) * N ** 3
+        rec = {"N": N, "nb": nb, "precision": precision, "pallas": pallas,
+               "compile_s": round(tc, 1), "run_s": round(t, 4),
+               "gflops": round(fl / t / 1e9, 1)}
+        if N <= 2048:  # backward error: QR - A via explicit Q application
+            R = r_matrix(H, al)
+            Rp = jnp.zeros_like(A).at[: R.shape[0]].set(R)
+            QR = _apply_q_impl(H, Rp, nb, precision=precision)
+            rec["backward_error"] = float(
+                jnp.linalg.norm(QR - A) / jnp.linalg.norm(A))
+        return rec
+
+    @stage("qr_256", 480)
+    def _qr256():
+        return qr_stage(256, 64)
+
+    @stage("qr_1024", 480)
+    def _qr1024():
+        return qr_stage(1024, 128)
+
+    @stage("qr_1024_pallas", 480)
+    def _qr1024p():
+        return qr_stage(1024, 128, pallas=True)
+
+    @stage("qr_4096", 560)
+    def _qr4096():
+        return qr_stage(4096, 128)
+
+    @stage("qr_4096_pallas", 560)
+    def _qr4096p():
+        return qr_stage(4096, 128, pallas=True)
+
+    @stage("qr_1024_high", 480)
+    def _qr1024h():
+        # 3-pass bf16 (Precision.HIGH) vs 6-pass HIGHEST: 2x MXU throughput
+        # if the backward error holds under 1e-5. NB "float32" is a JAX
+        # alias for HIGHEST, not HIGH — use "high".
+        return qr_stage(1024, 128, precision="high")
+
+    @stage("qr_4096_high", 560)
+    def _qr4096h():
+        return qr_stage(4096, 128, precision="high")
+
+    @stage("qr_8192", 580)
+    def _qr8192():
+        return qr_stage(8192, 128)
+
+    names = [n for n, _, _ in stages]
+    lo = names.index(args.from_stage) if args.from_stage else 0
+    hi = names.index(args.to_stage) + 1 if args.to_stage else len(stages)
+    for name, seconds, fn in stages[lo:hi]:
+        log(f"stage {name} start")
+        with Watchdog(name, seconds):
+            try:
+                rec = fn()
+            except Exception as e:  # log and continue to next stage
+                log(f"stage {name} FAILED: {type(e).__name__}: {e}")
+                continue
+        log(f"stage {name} ok {json.dumps(rec)}")
+    log("probe done")
+
+
+if __name__ == "__main__":
+    main()
