@@ -50,8 +50,11 @@ BLOCK = int(os.environ.get("DHQR_BENCH_BLOCK", "128"))
 REPEATS = int(os.environ.get("DHQR_BENCH_REPEATS", "3"))
 PRECISION = os.environ.get("DHQR_PRECISION", "highest")
 BASELINE_GFLOPS = 4800.0  # 60% of A100 cuSOLVER geqrf f32 (~8 TF/s), see above
-TPU_TIMEOUT = int(os.environ.get("DHQR_BENCH_TPU_TIMEOUT", "480"))
-CPU_TIMEOUT = int(os.environ.get("DHQR_BENCH_CPU_TIMEOUT", "420"))
+# The driver's whole-bench window is ~600 s: the TPU attempt plus the CPU
+# fallback (plus SIGTERM grace) must BOTH fit inside it, or a hung TPU
+# attempt starves the fallback and the round records nothing.
+TPU_TIMEOUT = int(os.environ.get("DHQR_BENCH_TPU_TIMEOUT", "330"))
+CPU_TIMEOUT = int(os.environ.get("DHQR_BENCH_CPU_TIMEOUT", "150"))
 _REPO = os.path.dirname(os.path.abspath(__file__))
 
 
