@@ -36,9 +36,10 @@ from dhqr_tpu.ops.blocked import blocked_householder_qr
 from dhqr_tpu.ops.solve import apply_q, apply_qt, back_substitute, solve_least_squares
 from dhqr_tpu.ops.differentiable import lstsq_diff
 from dhqr_tpu.ops.tsqr import tsqr_lstsq, tsqr_r
+from dhqr_tpu.ops.cholqr import cholesky_qr2, cholesky_qr_lstsq
 from dhqr_tpu.utils.config import DHQRConfig
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 __all__ = [
     "QRFactorization",
@@ -53,6 +54,8 @@ __all__ = [
     "solve_least_squares",
     "tsqr_lstsq",
     "tsqr_r",
+    "cholesky_qr2",
+    "cholesky_qr_lstsq",
     "lstsq_diff",
     "alphafactor",
     "DHQRConfig",

@@ -1,0 +1,127 @@
+"""CholeskyQR2 — the all-GEMM tall-skinny QR fast path (beyond the reference).
+
+The reference factors strictly by Householder reflections (reference
+src/DistributedHouseholderQR.jl:122-213). On TPU the throughput-optimal QR
+for m >> n is CholeskyQR2 (Fukaya et al., "CholeskyQR2: a simple and
+communication-avoiding algorithm"; used at pod scale in "Large Scale
+Distributed Linear Algebra With Tensor Processing Units",
+arxiv 2112.09017): every flop is a GEMM / rank-k update on the MXU, the
+only non-GEMM work is an n x n Cholesky, and the distributed form needs ONE
+psum per pass.
+
+    G  = A^H A                (syrk — MXU)
+    R1 = chol(G)^H            (upper)
+    Q1 = A R1^{-1}            (triangular solve, n x n against m rows)
+    ... repeat on Q1 ...      (second pass restores orthogonality)
+    R  = R2 R1
+
+One pass loses orthogonality as cond(A)^2 * eps; the second pass repairs it
+to O(eps) PROVIDED the first Cholesky succeeds, which needs roughly
+cond(A) < 1/sqrt(eps) (~3e3 in f32, ~7e7 in f64). A Fukaya-style diagonal
+shift keeps the first factorization positive-definite near that edge
+(shifted CholeskyQR3 degenerates to our 2-pass form when the shift is 0).
+Outside that regime use the Householder engines or TSQR — this module
+checks and reports rather than silently degrading.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dhqr_tpu.ops.householder import DEFAULT_PRECISION, _real_dtype
+
+
+def _chol_upper(G: jax.Array, shift: bool) -> jax.Array:
+    """Upper-triangular R with R^H R = G (+ optional stabilizing shift).
+
+    The shift follows Fukaya et al.'s shifted CholeskyQR: a multiple of
+    eps * trace(G) added to the diagonal, large enough to keep the
+    factorization positive-definite for cond(A) up to ~1/sqrt(eps) while
+    perturbing R by O(eps * ||A||^2) — repaired by the second pass.
+    """
+    n = G.shape[0]
+    if shift:
+        rdtype = _real_dtype(G.dtype)
+        eps = jnp.finfo(rdtype).eps
+        s = 11.0 * (n + 16) * eps * jnp.real(jnp.trace(G)) / n
+        G = G + s * jnp.eye(n, dtype=G.dtype)
+    L = lax.linalg.cholesky(G)  # lower
+    return jnp.conj(L.T)
+
+
+def _one_pass(A, precision, shift):
+    G = jnp.matmul(jnp.conj(A.T), A, precision=precision)
+    R = _chol_upper(G, shift)
+    # Q = A R^{-1}  <=>  solve x R = A for x (right-hand triangular solve)
+    Q = lax.linalg.triangular_solve(R, A, left_side=False, lower=False)
+    return Q, R
+
+
+@partial(jax.jit, static_argnames=("precision", "shift"))
+def _cholesky_qr2_impl(A, precision, shift):
+    # shift=False: plain CholeskyQR2 — fails LOUDLY (NaN) outside its
+    # conditioning window. shift=True: shifted CholeskyQR3 — the shifted
+    # first pass widens the window but leaves Q1 only O(eps*cond)
+    # orthogonal, so a THIRD pass is required to restore O(eps) (Fukaya et
+    # al.; a shifted two-pass form would return finite-but-wrong factors).
+    Q, R = _one_pass(A, precision, shift)
+    Q, R2 = _one_pass(Q, precision, False)
+    R = jnp.matmul(R2, R, precision=precision)
+    if shift:
+        Q, R3 = _one_pass(Q, precision, False)
+        R = jnp.matmul(R3, R, precision=precision)
+    return Q, R
+
+
+def cholesky_qr2(
+    A: jax.Array,
+    precision: str = DEFAULT_PRECISION,
+    shift: bool = False,
+):
+    """Thin QR of a tall matrix via Cholesky passes: ``A = Q R``.
+
+    Returns explicit ``(Q, R)`` with Q (m, n) orthonormal and R (n, n)
+    upper-triangular (diagonal real-positive — note this differs from the
+    Householder engines, whose R diagonal carries the alpha sign rule;
+    ``R^H R == A^H A`` either way). All flops are GEMMs: this is the MXU
+    throughput ceiling for m >> n.
+
+    ``shift=False`` (default) is CholeskyQR2: applicable while
+    cond(A) < ~1/sqrt(eps) (~3e3 in f32, ~7e7 in f64); outside that window
+    the first Cholesky is non-PD and the result is NaN — a LOUD failure to
+    catch with ``jnp.isfinite`` and reroute to the Householder engines or
+    :func:`dhqr_tpu.ops.tsqr.tsqr_lstsq`. ``shift=True`` is shifted
+    CholeskyQR3 (three passes, ~1.5x the flops): the stabilizing shift
+    widens the window toward cond(A) ~ 1/eps and the extra pass restores
+    O(eps) orthogonality that the shift alone would forfeit.
+    """
+    m, n = A.shape
+    if m < n:
+        raise ValueError(f"cholesky_qr2 requires m >= n, got {A.shape}")
+    return _cholesky_qr2_impl(A, precision, bool(shift))
+
+
+@partial(jax.jit, static_argnames=("precision", "shift"))
+def _cholqr_lstsq_impl(A, b, precision, shift):
+    Q, R = _cholesky_qr2_impl(A, precision, shift)
+    vec = b.ndim == 1
+    B = b[:, None] if vec else b
+    C = jnp.matmul(jnp.conj(Q.T), B, precision=precision)
+    x = lax.linalg.triangular_solve(R, C, left_side=True, lower=False)
+    return x[:, 0] if vec else x
+
+
+def cholesky_qr_lstsq(
+    A: jax.Array,
+    b: jax.Array,
+    precision: str = DEFAULT_PRECISION,
+    shift: bool = False,
+) -> jax.Array:
+    """Least squares via CholeskyQR2 — the all-GEMM fast path for m >> n."""
+    if A.shape[0] < A.shape[1]:
+        raise ValueError(f"lstsq requires m >= n, got {A.shape}")
+    return _cholqr_lstsq_impl(A, b, precision, bool(shift))

@@ -18,6 +18,7 @@ from dhqr_tpu.parallel.mesh import column_mesh, column_sharding, replicated_shar
 from dhqr_tpu.parallel.sharded_qr import sharded_blocked_qr, sharded_householder_qr
 from dhqr_tpu.parallel.sharded_solve import sharded_lstsq, sharded_solve
 from dhqr_tpu.parallel.sharded_tsqr import row_mesh, sharded_tsqr_lstsq
+from dhqr_tpu.parallel.sharded_cholqr import sharded_cholqr_lstsq
 from dhqr_tpu.parallel.multihost import (
     global_column_mesh,
     global_row_mesh,
@@ -39,6 +40,7 @@ __all__ = [
     "sharded_lstsq",
     "row_mesh",
     "sharded_tsqr_lstsq",
+    "sharded_cholqr_lstsq",
     "initialize",
     "global_column_mesh",
     "global_row_mesh",

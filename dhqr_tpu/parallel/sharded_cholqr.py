@@ -1,0 +1,91 @@
+"""Row-sharded CholeskyQR2 least squares — one psum per pass.
+
+The distributed form of :mod:`dhqr_tpu.ops.cholqr`: rows sharded over the
+TSQR axis; each Gram matrix is a local syrk plus ONE ``psum`` of an n x n
+block, the Cholesky + triangular work runs replicated (tiny), and the
+Q-updates stay local. Two psums + one more for Q^H b (three with the shifted three-pass form)
+— O(n^2) words per device regardless of m, the communication-optimal
+regime for m >> n,
+and every local flop a GEMM on the MXU (see ops/cholqr.py for the
+conditioning window; this is the pod-scale recipe of arxiv 2112.09017).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dhqr_tpu.ops.cholqr import _chol_upper
+from dhqr_tpu.ops.householder import DEFAULT_PRECISION
+from dhqr_tpu.parallel.sharded_tsqr import ROW_AXIS
+
+
+def _cholqr_shard_body(Al, bl, *, axis: str, precision: str, shift: bool):
+    """Per-device rows of A; returns x replicated."""
+
+    def one_pass(Al, do_shift):
+        G = lax.psum(jnp.matmul(jnp.conj(Al.T), Al, precision=precision), axis)
+        R = _chol_upper(G, do_shift)  # replicated (deterministic on psum result)
+        Ql = lax.linalg.triangular_solve(R, Al, left_side=False, lower=False)
+        return Ql, R
+
+    # shift=False: CholeskyQR2 (loud NaN outside the window); shift=True:
+    # shifted CholeskyQR3 — third pass restores orthogonality (ops/cholqr.py).
+    Ql, R = one_pass(Al, shift)
+    Ql, R2 = one_pass(Ql, False)
+    R = jnp.matmul(R2, R, precision=precision)
+    if shift:
+        Ql, R3 = one_pass(Ql, False)
+        R = jnp.matmul(R3, R, precision=precision)
+    vec = bl.ndim == 1
+    Bl = bl[:, None] if vec else bl
+    C = lax.psum(jnp.matmul(jnp.conj(Ql.T), Bl, precision=precision), axis)
+    x = lax.linalg.triangular_solve(R, C, left_side=True, lower=False)
+    return x[:, 0] if vec else x
+
+
+@lru_cache(maxsize=None)
+def _build_cholqr(mesh: Mesh, axis_name: str, precision: str, shift: bool):
+    body = partial(
+        _cholqr_shard_body, axis=axis_name, precision=precision, shift=shift
+    )
+    return jax.jit(
+        shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(axis_name, None), P(axis_name)),
+            out_specs=P(),
+            check_vma=False,  # x is replicated by construction (psum inputs)
+        )
+    )
+
+
+def sharded_cholqr_lstsq(
+    A: jax.Array,
+    b: jax.Array,
+    mesh: Mesh,
+    axis_name: str = ROW_AXIS,
+    precision: str = DEFAULT_PRECISION,
+    shift: bool = False,
+) -> jax.Array:
+    """Distributed least squares via CholeskyQR2: rows sharded, three psums
+    (four with ``shift=True``, the shifted-CholeskyQR3 wide-window form).
+
+    Requires m divisible by the mesh size. Returns x replicated. Same
+    conditioning window as :func:`dhqr_tpu.ops.cholqr.cholesky_qr2` —
+    prefer :func:`sharded_tsqr_lstsq` for ill-conditioned problems.
+    """
+    m, n = A.shape
+    if m < n:
+        raise ValueError(f"lstsq requires m >= n, got {A.shape}")
+    nproc = mesh.shape[axis_name]
+    if m % nproc != 0:
+        raise ValueError(f"m={m} must be divisible by mesh size {nproc}")
+    A = jax.device_put(A, NamedSharding(mesh, P(axis_name, None)))
+    b = jax.device_put(b, NamedSharding(mesh, P(axis_name)))
+    return _build_cholqr(mesh, axis_name, precision, bool(shift))(A, b)

@@ -1,0 +1,95 @@
+"""CholeskyQR2 engines vs oracles (single-device and row-sharded)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dhqr_tpu.ops.cholqr import cholesky_qr2, cholesky_qr_lstsq
+from dhqr_tpu.utils.testing import (
+    TOLERANCE_FACTOR,
+    normal_equations_residual,
+    oracle_residual,
+    random_problem,
+)
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_cholqr2_orthonormal_and_reconstructs(dtype):
+    rng = np.random.default_rng(0)
+    A = rng.standard_normal((200, 40))
+    if np.issubdtype(dtype, np.complexfloating):
+        A = A + 1j * rng.standard_normal((200, 40))
+    Aj = jnp.asarray(A.astype(dtype))
+    Q, R = cholesky_qr2(Aj)
+    eye = np.asarray(jnp.conj(Q.T) @ Q)
+    np.testing.assert_allclose(eye, np.eye(40), atol=1e-13)
+    np.testing.assert_allclose(np.asarray(Q @ R), A.astype(dtype), atol=1e-12)
+    # R upper-triangular with real positive diagonal (Cholesky convention)
+    Rn = np.asarray(R)
+    assert np.allclose(Rn, np.triu(Rn))
+    assert np.all(np.real(np.diag(Rn)) > 0)
+
+
+def test_cholqr_lstsq_matches_oracle():
+    A, b = random_problem(500, 64, np.float64, seed=1)
+    x = cholesky_qr_lstsq(jnp.asarray(A), jnp.asarray(b))
+    res = normal_equations_residual(A, np.asarray(x), b)
+    assert res < TOLERANCE_FACTOR * oracle_residual(A, b)
+
+
+def test_cholqr_multi_rhs():
+    A, _ = random_problem(300, 32, np.float64, seed=2)
+    B = np.random.default_rng(3).standard_normal((300, 5))
+    X = cholesky_qr_lstsq(jnp.asarray(A), jnp.asarray(B))
+    X0 = np.linalg.lstsq(A, B, rcond=None)[0]
+    np.testing.assert_allclose(np.asarray(X), X0, atol=1e-9)
+
+
+def test_cholqr_ill_conditioned_yields_nan_not_garbage():
+    """Outside the cond window the factorization must fail loudly (NaN),
+    not return a silently wrong Q — callers then fall back to Householder."""
+    rng = np.random.default_rng(4)
+    U, _ = np.linalg.qr(rng.standard_normal((100, 20)))
+    V, _ = np.linalg.qr(rng.standard_normal((20, 20)))
+    s = np.logspace(0, -12, 20)  # cond 1e12 >> 1/sqrt(eps_f64)
+    A = (U * s) @ V.T
+    Q, R = cholesky_qr2(jnp.asarray(A))
+    assert not bool(jnp.all(jnp.isfinite(Q)))
+
+
+def test_sharded_cholqr_matches_single_device():
+    from dhqr_tpu.parallel import sharded_cholqr_lstsq
+    from dhqr_tpu.parallel.sharded_tsqr import row_mesh
+
+    A, b = random_problem(512, 48, np.float64, seed=5)
+    mesh = row_mesh(8)
+    x = sharded_cholqr_lstsq(jnp.asarray(A), jnp.asarray(b), mesh)
+    x1 = cholesky_qr_lstsq(jnp.asarray(A), jnp.asarray(b))
+    np.testing.assert_allclose(np.asarray(x), np.asarray(x1), atol=1e-10)
+    res = normal_equations_residual(A, np.asarray(x), b)
+    assert res < TOLERANCE_FACTOR * oracle_residual(A, b)
+
+
+def test_sharded_cholqr_f32():
+    from dhqr_tpu.parallel import sharded_cholqr_lstsq
+    from dhqr_tpu.parallel.sharded_tsqr import row_mesh
+
+    A, b = random_problem(1024, 64, np.float32, seed=6)
+    mesh = row_mesh(4)
+    x = sharded_cholqr_lstsq(jnp.asarray(A), jnp.asarray(b), mesh)
+    res = normal_equations_residual(A, np.asarray(x), b)
+    assert res < TOLERANCE_FACTOR * max(oracle_residual(A, b), 1e-4)
+
+
+def test_shifted_cholqr3_wide_window():
+    """shift=True (shifted CholeskyQR3): three passes keep O(eps)
+    orthogonality at conditioning far beyond the CQR2 window."""
+    rng = np.random.default_rng(7)
+    U, _ = np.linalg.qr(rng.standard_normal((200, 24)))
+    V, _ = np.linalg.qr(rng.standard_normal((24, 24)))
+    s = np.logspace(0, -10, 24)  # cond 1e10 >> 1/sqrt(eps_f64)
+    A = (U * s) @ V.T
+    Q, R = cholesky_qr2(jnp.asarray(A), shift=True)
+    eye = np.asarray(jnp.conj(Q.T) @ Q)
+    assert np.linalg.norm(eye - np.eye(24)) < 1e-12
+    np.testing.assert_allclose(np.asarray(Q @ R), A, atol=1e-12)
