@@ -31,6 +31,8 @@ from dhqr_tpu.ops import householder as _hh
 from dhqr_tpu.ops import solve as _solve
 from dhqr_tpu.utils.config import DHQRConfig
 
+LSTSQ_ENGINES = ("householder", "tsqr", "cholqr2", "cholqr3")
+
 
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
@@ -142,6 +144,16 @@ def qr(
     >>> fact = qr(A, mesh=column_mesh(8))  # distributed: the DArray tier
     """
     cfg = dataclasses.replace(config or DHQRConfig(), **overrides)
+    if cfg.engine != "householder":
+        if cfg.engine not in LSTSQ_ENGINES:
+            raise ValueError(
+                f"unknown engine {cfg.engine!r}: expected one of {LSTSQ_ENGINES}"
+            )
+        raise ValueError(
+            f"qr() supports only engine='householder' (got {cfg.engine!r}): "
+            "the factorization object stores packed reflectors; the "
+            "tsqr/cholqr engines are lstsq-only fast paths"
+        )
     if mesh is not None:
         if donate:
             raise ValueError(
@@ -186,6 +198,72 @@ def solve(fact: QRFactorization, b: jax.Array) -> jax.Array:
     return fact.solve(b)
 
 
+def _lstsq_alt_engine(A, b, cfg: DHQRConfig, mesh):
+    """Route ``lstsq`` to the non-Householder engine families.
+
+    "tsqr": row-parallel communication-avoiding tree (m >> n); on a mesh
+    the rows ride the mesh axis (one all-gather). "cholqr2"/"cholqr3":
+    all-GEMM Cholesky passes (see ops/cholqr.py for the conditioning
+    windows); on a mesh, one n x n psum per pass. These engines return x
+    only — ``qr()`` stays Householder-packed by design.
+
+    Both families shard ROWS over the mesh axis — ``cfg.mesh_axis`` when
+    the mesh has an axis of that name, else the sole axis of a 1-D mesh —
+    unlike the Householder mesh path, which shards columns.
+    """
+    axis = None
+    if mesh is not None:
+        from dhqr_tpu.parallel.sharded_tsqr import ROW_AXIS
+
+        default_axis = DHQRConfig().mesh_axis  # "cols" — the COLUMN name
+        if len(mesh.shape) == 1:
+            axis = next(iter(mesh.shape))
+        elif cfg.mesh_axis != default_axis and cfg.mesh_axis in mesh.shape:
+            axis = cfg.mesh_axis  # explicit user choice
+        elif ROW_AXIS in mesh.shape:
+            axis = ROW_AXIS
+        else:
+            # A defaulted "cols" on a multi-axis mesh is NOT taken as the
+            # row axis — sharding rows over the column-sharding name while
+            # silently replicating over the rest would waste the pod.
+            raise ValueError(
+                f"ambiguous row axis on mesh axes {tuple(mesh.shape)} for "
+                f"engine={cfg.engine!r}: pass mesh_axis= to pick one"
+            )
+    if cfg.engine == "tsqr":
+        from dhqr_tpu.ops.tsqr import tsqr_lstsq
+
+        if mesh is not None:
+            from dhqr_tpu.parallel.sharded_tsqr import sharded_tsqr_lstsq
+
+            return sharded_tsqr_lstsq(
+                A, b, mesh, block_size=cfg.block_size,
+                axis_name=axis, precision=cfg.precision,
+            )
+        n_blocks = max(1, min(8, A.shape[0] // max(A.shape[1], 1)))
+        while n_blocks > 1 and A.shape[0] % n_blocks:
+            n_blocks -= 1
+        return tsqr_lstsq(
+            A, b, n_blocks=n_blocks, block_size=cfg.block_size,
+            precision=cfg.precision,
+        )
+    if cfg.engine in ("cholqr2", "cholqr3"):
+        shift = cfg.engine == "cholqr3"
+        if mesh is not None:
+            from dhqr_tpu.parallel.sharded_cholqr import sharded_cholqr_lstsq
+
+            return sharded_cholqr_lstsq(
+                A, b, mesh, axis_name=axis,
+                precision=cfg.precision, shift=shift,
+            )
+        from dhqr_tpu.ops.cholqr import cholesky_qr_lstsq
+
+        return cholesky_qr_lstsq(A, b, precision=cfg.precision, shift=shift)
+    raise ValueError(
+        f"unknown engine {cfg.engine!r}: expected one of {LSTSQ_ENGINES}"
+    )
+
+
 @partial(jax.jit, static_argnames=("block_size", "blocked", "precision", "use_pallas"))
 def _lstsq_impl(A, b, block_size, blocked, precision, use_pallas):
     if blocked:
@@ -217,6 +295,8 @@ def lstsq(
     if A.shape[0] < A.shape[1]:
         raise ValueError(f"lstsq requires m >= n, got {A.shape}")
     cfg = dataclasses.replace(config or DHQRConfig(), **overrides)
+    if cfg.engine != "householder":
+        return _lstsq_alt_engine(A, b, cfg, mesh)
     if mesh is not None:
         from dhqr_tpu.parallel.layout import fit_block_size
         from dhqr_tpu.parallel.sharded_qr import sharded_householder_qr

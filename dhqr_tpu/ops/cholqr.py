@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from dhqr_tpu.ops.householder import DEFAULT_PRECISION, _real_dtype
+from dhqr_tpu.ops.solve import as_matrix_rhs
 
 
 def _chol_upper(G: jax.Array, shift: bool) -> jax.Array:
@@ -53,28 +54,39 @@ def _chol_upper(G: jax.Array, shift: bool) -> jax.Array:
     return jnp.conj(L.T)
 
 
-def _one_pass(A, precision, shift):
-    G = jnp.matmul(jnp.conj(A.T), A, precision=precision)
-    R = _chol_upper(G, shift)
-    # Q = A R^{-1}  <=>  solve x R = A for x (right-hand triangular solve)
-    Q = lax.linalg.triangular_solve(R, A, left_side=False, lower=False)
+def _cholqr_passes(A, gram, precision, shift):
+    """Shared pass driver: (Q, R) from repeated Gram/Cholesky passes.
+
+    ``gram(X)`` returns X^H X — a local syrk on one device, syrk + psum in
+    the row-sharded form (parallel/sharded_cholqr.py); everything else is
+    identical between the two so they cannot numerically diverge.
+
+    shift=False: plain CholeskyQR2 — fails LOUDLY (NaN) outside its
+    conditioning window. shift=True: shifted CholeskyQR3 — the shifted
+    first pass widens the window but leaves Q1 only O(eps*cond)
+    orthogonal, so a THIRD pass is required to restore O(eps) (Fukaya et
+    al.; a shifted two-pass form would return finite-but-wrong factors).
+    """
+
+    def one_pass(X, do_shift):
+        R = _chol_upper(gram(X), do_shift)
+        # Q = X R^{-1}  <=>  solve q R = X for q (right-hand tri solve)
+        Q = lax.linalg.triangular_solve(R, X, left_side=False, lower=False)
+        return Q, R
+
+    Q, R = one_pass(A, shift)
+    Q, R2 = one_pass(Q, False)
+    R = jnp.matmul(R2, R, precision=precision)
+    if shift:
+        Q, R3 = one_pass(Q, False)
+        R = jnp.matmul(R3, R, precision=precision)
     return Q, R
 
 
 @partial(jax.jit, static_argnames=("precision", "shift"))
 def _cholesky_qr2_impl(A, precision, shift):
-    # shift=False: plain CholeskyQR2 — fails LOUDLY (NaN) outside its
-    # conditioning window. shift=True: shifted CholeskyQR3 — the shifted
-    # first pass widens the window but leaves Q1 only O(eps*cond)
-    # orthogonal, so a THIRD pass is required to restore O(eps) (Fukaya et
-    # al.; a shifted two-pass form would return finite-but-wrong factors).
-    Q, R = _one_pass(A, precision, shift)
-    Q, R2 = _one_pass(Q, precision, False)
-    R = jnp.matmul(R2, R, precision=precision)
-    if shift:
-        Q, R3 = _one_pass(Q, precision, False)
-        R = jnp.matmul(R3, R, precision=precision)
-    return Q, R
+    gram = lambda X: jnp.matmul(jnp.conj(X.T), X, precision=precision)
+    return _cholqr_passes(A, gram, precision, shift)
 
 
 def cholesky_qr2(
@@ -108,11 +120,9 @@ def cholesky_qr2(
 @partial(jax.jit, static_argnames=("precision", "shift"))
 def _cholqr_lstsq_impl(A, b, precision, shift):
     Q, R = _cholesky_qr2_impl(A, precision, shift)
-    vec = b.ndim == 1
-    B = b[:, None] if vec else b
+    B, restore = as_matrix_rhs(b)
     C = jnp.matmul(jnp.conj(Q.T), B, precision=precision)
-    x = lax.linalg.triangular_solve(R, C, left_side=True, lower=False)
-    return x[:, 0] if vec else x
+    return restore(lax.linalg.triangular_solve(R, C, left_side=True, lower=False))
 
 
 def cholesky_qr_lstsq(

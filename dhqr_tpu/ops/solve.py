@@ -29,6 +29,15 @@ def _reflector_column(H: jax.Array, j: jax.Array) -> jax.Array:
     return jnp.where(lax.iota(jnp.int32, m) >= j, col, jnp.zeros_like(col))
 
 
+def as_matrix_rhs(b):
+    """(B, restore): view a vector RHS as an (m, 1) block and a function
+    restoring the original rank — the one shared spelling of the
+    vector/multi-RHS adapter used across the solve/TSQR/CholQR engines."""
+    if b.ndim == 1:
+        return b[:, None], lambda x: x[:, 0]
+    return b, lambda x: x
+
+
 @partial(jax.jit, static_argnames=("precision",))
 def apply_qt(
     H: jax.Array, alpha: jax.Array, b: jax.Array, precision: str = DEFAULT_PRECISION

@@ -33,11 +33,11 @@ from dhqr_tpu.ops.blocked import (
     _blocked_qr_impl,
 )
 from dhqr_tpu.ops.householder import DEFAULT_PRECISION
-from dhqr_tpu.ops.solve import back_substitute, r_matrix
+from dhqr_tpu.ops.solve import as_matrix_rhs, back_substitute, r_matrix
 
 
 def _leaf_factor(Ai, bi, nb, precision):
-    """One row block: packed QR + Q^H b, reduced to the (n, n) / (n,) heads."""
+    """One row block: packed QR + Q^H b, reduced to the (n, n) / (n, k) heads."""
     n = Ai.shape[1]
     H, alpha = _blocked_qr_impl(Ai, nb, precision=precision)
     R = r_matrix(H, alpha)
@@ -57,14 +57,16 @@ def _tsqr_lstsq_impl(A, b, n_blocks, block_size, precision):
     m, n = A.shape
     rows = m // n_blocks
     nb = min(block_size, n)
+    B, restore = as_matrix_rhs(b)
+    k = B.shape[1]
     # Leaves: vmapped over row blocks — XLA batches the block QRs.
     Ab = A.reshape(n_blocks, rows, n)
-    bb = b.reshape(n_blocks, rows)
+    bb = B.reshape(n_blocks, rows, k)
     Rs, cs = jax.vmap(lambda Ai, bi: _leaf_factor(Ai, bi, nb, precision))(Ab, bb)
     # Combine: one QR of the stacked R factors (n_blocks*n x n — tiny).
     Rstack = Rs.reshape(n_blocks * n, n)
-    cstack = cs.reshape(n_blocks * n)
-    return _combine_solve(Rstack, cstack, nb, precision)
+    cstack = cs.reshape(n_blocks * n, k)
+    return restore(_combine_solve(Rstack, cstack, nb, precision))
 
 
 def tsqr_lstsq(
@@ -76,6 +78,7 @@ def tsqr_lstsq(
 ) -> jax.Array:
     """Least squares via TSQR: ``x = argmin ||A x - b||`` for m >> n.
 
+    ``b`` may be a vector (m,) or a block of right-hand sides (m, k).
     Requires m divisible by ``n_blocks`` with each block still tall
     (m / n_blocks >= n). Unconditionally stable (Householder at both
     levels), unlike semi-normal-equation shortcuts.

@@ -3,11 +3,11 @@
 The distributed form of :mod:`dhqr_tpu.ops.cholqr`: rows sharded over the
 TSQR axis; each Gram matrix is a local syrk plus ONE ``psum`` of an n x n
 block, the Cholesky + triangular work runs replicated (tiny), and the
-Q-updates stay local. Two psums + one more for Q^H b (three with the shifted three-pass form)
-— O(n^2) words per device regardless of m, the communication-optimal
-regime for m >> n,
-and every local flop a GEMM on the MXU (see ops/cholqr.py for the
-conditioning window; this is the pod-scale recipe of arxiv 2112.09017).
+Q-updates stay local. Three psums total (one per Gram pass plus one for
+Q^H b; four in the shifted three-pass form) of O(n^2) words per device
+regardless of m — the communication-optimal regime for m >> n, every
+local flop a GEMM on the MXU (see ops/cholqr.py for the conditioning
+window; this is the pod-scale recipe of arxiv 2112.09017).
 """
 
 from __future__ import annotations
@@ -20,33 +20,26 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dhqr_tpu.ops.cholqr import _chol_upper
+from dhqr_tpu.ops.cholqr import _cholqr_passes
+from dhqr_tpu.ops.solve import as_matrix_rhs
 from dhqr_tpu.ops.householder import DEFAULT_PRECISION
 from dhqr_tpu.parallel.sharded_tsqr import ROW_AXIS
 
 
 def _cholqr_shard_body(Al, bl, *, axis: str, precision: str, shift: bool):
-    """Per-device rows of A; returns x replicated."""
+    """Per-device rows of A; returns x replicated.
 
-    def one_pass(Al, do_shift):
-        G = lax.psum(jnp.matmul(jnp.conj(Al.T), Al, precision=precision), axis)
-        R = _chol_upper(G, do_shift)  # replicated (deterministic on psum result)
-        Ql = lax.linalg.triangular_solve(R, Al, left_side=False, lower=False)
-        return Ql, R
-
-    # shift=False: CholeskyQR2 (loud NaN outside the window); shift=True:
-    # shifted CholeskyQR3 — third pass restores orthogonality (ops/cholqr.py).
-    Ql, R = one_pass(Al, shift)
-    Ql, R2 = one_pass(Ql, False)
-    R = jnp.matmul(R2, R, precision=precision)
-    if shift:
-        Ql, R3 = one_pass(Ql, False)
-        R = jnp.matmul(R3, R, precision=precision)
-    vec = bl.ndim == 1
-    Bl = bl[:, None] if vec else bl
+    Pass structure is :func:`dhqr_tpu.ops.cholqr._cholqr_passes` — shared
+    with the single-device engine — with the Gram matrix reduced by one
+    psum per pass (replicated, so the Cholesky is deterministic everywhere).
+    """
+    gram = lambda X: lax.psum(
+        jnp.matmul(jnp.conj(X.T), X, precision=precision), axis
+    )
+    Ql, R = _cholqr_passes(Al, gram, precision, shift)
+    Bl, restore = as_matrix_rhs(bl)
     C = lax.psum(jnp.matmul(jnp.conj(Ql.T), Bl, precision=precision), axis)
-    x = lax.linalg.triangular_solve(R, C, left_side=True, lower=False)
-    return x[:, 0] if vec else x
+    return restore(lax.linalg.triangular_solve(R, C, left_side=True, lower=False))
 
 
 @lru_cache(maxsize=None)

@@ -24,6 +24,7 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dhqr_tpu.ops.householder import DEFAULT_PRECISION
+from dhqr_tpu.ops.solve import as_matrix_rhs
 from dhqr_tpu.ops.tsqr import _combine_solve, _leaf_factor
 
 ROW_AXIS = "rows"
@@ -46,14 +47,15 @@ def _tsqr_shard_body(Al, bl, *, n: int, nb: int, axis: str, precision: str):
     Leaf and combine stages are shared with the single-device tree
     (ops/tsqr) so the two paths cannot numerically diverge.
     """
-    R, c = _leaf_factor(Al, bl, nb, precision)
+    Bl, restore = as_matrix_rhs(bl)
+    R, c = _leaf_factor(Al, Bl, nb, precision)
     # ONE collective: gather every device's heads (P*n rows — tiny traffic).
     Rstack = lax.all_gather(R, axis).reshape(-1, n)
-    cstack = lax.all_gather(c, axis).reshape(-1)
+    cstack = lax.all_gather(c, axis).reshape(-1, c.shape[1])
     # Combine stage, replicated on every device (cheaper than a second
     # collective to scatter the result — same trade as the reference making
     # alpha a SharedArray, src:302).
-    return _combine_solve(Rstack, cstack, nb, precision)
+    return restore(_combine_solve(Rstack, cstack, nb, precision))
 
 
 @lru_cache(maxsize=None)

@@ -38,6 +38,12 @@ class DHQRConfig:
         bf16 passes, ~1e-4 relative error; the speed tier). The TPU
         equivalent of the reference's import-time BLAS configuration
         (reference src:6) — but per-call, not global state.
+      engine: least-squares algorithm family — "householder" (the
+        reference-parity path; the only engine ``qr()`` supports, since the
+        factorization object stores packed reflectors), "tsqr"
+        (communication-avoiding row-parallel tree for m >> n), "cholqr2" /
+        "cholqr3" (all-GEMM Cholesky passes; cholqr3 is the shifted
+        wide-window form — see ops/cholqr.py for conditioning windows).
     """
 
     block_size: int = 128
@@ -46,6 +52,7 @@ class DHQRConfig:
     use_pallas: str = "auto"
     precision: str = "highest"
     layout: str = "block"
+    engine: str = "householder"
 
     @staticmethod
     def from_env(**overrides) -> "DHQRConfig":
@@ -65,5 +72,7 @@ class DHQRConfig:
             env["precision"] = os.environ["DHQR_PRECISION"]
         if "DHQR_LAYOUT" in os.environ:
             env["layout"] = os.environ["DHQR_LAYOUT"]
+        if "DHQR_ENGINE" in os.environ:
+            env["engine"] = os.environ["DHQR_ENGINE"]
         env.update(overrides)
         return DHQRConfig(**env)

@@ -91,3 +91,69 @@ def test_version_and_exports():
     assert dhqr_tpu.__version__
     for name in dhqr_tpu.__all__:
         assert hasattr(dhqr_tpu, name), name
+
+
+@pytest.mark.parametrize("engine", ["tsqr", "cholqr2", "cholqr3"])
+def test_lstsq_engine_routing(engine):
+    """cfg.engine routes lstsq to the TSQR / CholeskyQR fast paths."""
+    from dhqr_tpu.utils.testing import (
+        TOLERANCE_FACTOR, normal_equations_residual, oracle_residual,
+        random_problem,
+    )
+
+    A, b = random_problem(256, 32, np.float64, seed=11)
+    x = dhqr_tpu.lstsq(jnp.asarray(A), jnp.asarray(b), engine=engine)
+    res = normal_equations_residual(A, np.asarray(x), b)
+    assert res < TOLERANCE_FACTOR * oracle_residual(A, b)
+
+
+def test_lstsq_engine_routing_mesh():
+    from dhqr_tpu.parallel.sharded_tsqr import row_mesh
+    from dhqr_tpu.utils.testing import (
+        TOLERANCE_FACTOR, normal_equations_residual, oracle_residual,
+        random_problem,
+    )
+
+    A, b = random_problem(512, 32, np.float64, seed=12)
+    mesh = row_mesh(4)
+    for engine in ("tsqr", "cholqr2", "cholqr3"):
+        x = dhqr_tpu.lstsq(jnp.asarray(A), jnp.asarray(b), mesh=mesh,
+                           engine=engine)
+        res = normal_equations_residual(A, np.asarray(x), b)
+        assert res < TOLERANCE_FACTOR * oracle_residual(A, b)
+
+
+def test_lstsq_unknown_engine_raises():
+    A = jnp.zeros((8, 4))
+    b = jnp.zeros(8)
+    with pytest.raises(ValueError, match="unknown engine"):
+        dhqr_tpu.lstsq(A, b, engine="qrcp")
+
+
+def test_qr_rejects_lstsq_only_and_unknown_engines():
+    A = jnp.zeros((8, 4))
+    with pytest.raises(ValueError, match="lstsq-only"):
+        qr(A, engine="cholqr2")
+    with pytest.raises(ValueError, match="unknown engine"):
+        qr(A, engine="qrcp")
+
+
+def test_lstsq_row_engine_multi_axis_mesh():
+    """Row engines on a 2-axis mesh: prefer the 'rows' axis; a defaulted
+    'cols' name is never silently taken as the row axis."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("replica", "rows"))
+    A, b = (np.random.default_rng(13).standard_normal((64, 8)),
+            np.random.default_rng(14).standard_normal(64))
+    x = dhqr_tpu.lstsq(jnp.asarray(A), jnp.asarray(b), mesh=mesh, engine="cholqr2")
+    x0 = np.linalg.lstsq(A, b, rcond=None)[0]
+    np.testing.assert_allclose(np.asarray(x), x0, atol=1e-8)
+    mesh2 = Mesh(devs, ("replica", "cols"))
+    with pytest.raises(ValueError, match="ambiguous row axis"):
+        dhqr_tpu.lstsq(jnp.asarray(A), jnp.asarray(b), mesh=mesh2, engine="cholqr2")

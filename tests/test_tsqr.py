@@ -75,3 +75,21 @@ def test_sharded_tsqr_validation():
         sharded_tsqr_lstsq(jnp.zeros((100, 4)), jnp.zeros(100), mesh)  # 100 % 8
     with pytest.raises(ValueError):
         sharded_tsqr_lstsq(jnp.zeros((64, 16)), jnp.zeros(64), mesh)  # 8 < 16
+
+
+def test_tsqr_multi_rhs():
+    """(m, k) right-hand-side block through both the single-device tree
+    and the row-sharded form."""
+    import numpy as np
+
+    import dhqr_tpu
+    from dhqr_tpu.parallel.sharded_tsqr import row_mesh, sharded_tsqr_lstsq
+
+    rng = np.random.default_rng(21)
+    A = rng.standard_normal((256, 16))
+    B = rng.standard_normal((256, 3))
+    X0 = np.linalg.lstsq(A, B, rcond=None)[0]
+    X = dhqr_tpu.tsqr_lstsq(jnp.asarray(A), jnp.asarray(B), n_blocks=4)
+    np.testing.assert_allclose(np.asarray(X), X0, atol=1e-9)
+    Xs = sharded_tsqr_lstsq(jnp.asarray(A), jnp.asarray(B), row_mesh(4))
+    np.testing.assert_allclose(np.asarray(Xs), X0, atol=1e-9)
