@@ -57,6 +57,12 @@ def main(argv=None) -> None:
                         help="divide problem dims by this (default: fit 1 chip)")
     parser.add_argument("--repeats", type=int, default=3)
     parser.add_argument("--block-size", type=int, default=128)
+    parser.add_argument(
+        "--engine", default=None,
+        choices=["tsqr", "cholqr2", "cholqr3"],
+        help="override the lstsq engine for configs 2 and 5 "
+        "(default: config 2 uses tsqr, config 5 householder)",
+    )
     args = parser.parse_args(argv)
 
     import jax
@@ -122,19 +128,18 @@ def main(argv=None) -> None:
         m, n = 65536 // scale, 256 // scale
         A = jnp.asarray(rng.random((m, n)), dtype=jnp.float32)
         b = jnp.asarray(rng.random(m), dtype=jnp.float32)
-        if ndev > 1 and m % ndev == 0 and m // ndev >= n:
-            from dhqr_tpu.parallel.sharded_tsqr import row_mesh, sharded_tsqr_lstsq
+        eng2 = args.engine or "tsqr"
+        if ndev > 1 and m % ndev == 0 and (eng2 != "tsqr" or m // ndev >= n):
+            from dhqr_tpu.parallel.sharded_tsqr import row_mesh
             rmesh = row_mesh(ndev)
-            fn = lambda: sharded_tsqr_lstsq(A, b, rmesh, block_size=nb)
+            fn = lambda: dhqr_tpu.lstsq(A, b, mesh=rmesh, engine=eng2,
+                                        block_size=nb)
             meshsz = ndev
         else:
-            blocks = max(1, min(8, m // max(n, 1)))
-            while blocks > 1 and m % blocks:  # tsqr needs m divisible by blocks
-                blocks -= 1
-            fn = lambda: dhqr_tpu.tsqr_lstsq(A, b, n_blocks=blocks, block_size=nb)
+            fn = lambda: dhqr_tpu.lstsq(A, b, engine=eng2, block_size=nb)
             meshsz = 1
         t, _ = _bench(fn, sync, args.repeats)
-        report(2, "tall_skinny_tsqr_lstsq_f32", m, n, t, _flops_lstsq(m, n),
+        report(2, f"tall_skinny_{eng2}_lstsq_f32", m, n, t, _flops_lstsq(m, n),
                {"mesh": meshsz})
 
     if 3 in chosen:
@@ -176,12 +181,21 @@ def main(argv=None) -> None:
             n += mesh.shape["cols"] - n % mesh.shape["cols"]
         A = jnp.asarray(rng.random((m, n)), dtype=jnp.float32)
         b = jnp.asarray(rng.random(m), dtype=jnp.float32)
-        fn = lambda: dhqr_tpu.lstsq(A, b, mesh=mesh, block_size=nb)
+        if args.engine:
+            rmesh5 = mesh
+            if rmesh5 is not None and m % rmesh5.shape["cols"]:
+                rmesh5 = None  # row engines need m divisible instead
+            fn = lambda: dhqr_tpu.lstsq(A, b, mesh=rmesh5, engine=args.engine,
+                                        block_size=nb)
+        else:
+            fn = lambda: dhqr_tpu.lstsq(A, b, mesh=mesh, block_size=nb)
         t, x = _bench(fn, sync, args.repeats)
         res = float(jnp.linalg.norm(A.T @ (A @ x - b)))
+        eff_mesh = rmesh5 if args.engine else mesh
         report(5, "overdetermined_lstsq_f32", m, n, t, _flops_lstsq(m, n),
                {"normal_eq_residual": res,
-                "mesh": 1 if mesh is None else mesh.shape["cols"]})
+                "engine": args.engine or "householder",
+                "mesh": 1 if eff_mesh is None else eff_mesh.shape["cols"]})
 
 
 if __name__ == "__main__":

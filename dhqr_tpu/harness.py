@@ -51,6 +51,12 @@ def main(argv=None) -> int:
         help="comma-separated dtypes (reference: Float64, ComplexF64)",
     )
     parser.add_argument("--layout", default="block", choices=["block", "cyclic"])
+    parser.add_argument(
+        "--engine", default="householder",
+        choices=["householder", "tsqr", "cholqr2", "cholqr3"],
+        help="least-squares engine family (tsqr/cholqr shard ROWS; their "
+        "mesh uses the same device count)",
+    )
     parser.add_argument("--block-size", type=int, default=128)
     parser.add_argument(
         "--profile-dir", default=None,
@@ -92,8 +98,11 @@ def main(argv=None) -> int:
 
     ndev = min(args.n_devices, len(jax.devices()))
     mesh = column_mesh(ndev) if ndev > 1 else None
+    row_engine = args.engine != "householder"
+    lkw = {} if row_engine else {"layout": args.layout}
     print(f"# devices: {len(jax.devices())} ({jax.default_backend()}), "
-          f"mesh size: {ndev}, layout: {args.layout}")
+          f"mesh size: {ndev}, engine: {args.engine}"
+          + ("" if row_engine else f", layout: {args.layout}"))
 
     failures = 0
     for dtype_name in args.dtypes.split(","):
@@ -104,17 +113,25 @@ def main(argv=None) -> int:
             print(f"# skip {dtype_name} on TPU (f64/c128 are emulated)")
             continue
         for m, n in _parse_sizes(args.sizes):
-            # pad n so every device gets an equal block (mesh constraint)
-            if mesh is not None and n % ndev:
+            # pad n so every device gets an equal block (mesh constraint);
+            # row engines need m divisible (and local blocks tall) instead
+            if mesh is not None and args.engine == "householder" and n % ndev:
                 n += ndev - n % ndev
                 m = max(m, n)
+            if mesh is not None and args.engine != "householder" and m % ndev:
+                m += ndev - m % ndev
+            size_mesh = mesh
+            if (mesh is not None and args.engine == "tsqr"
+                    and m // ndev < n):  # local row blocks must stay tall
+                print(f"# {m}x{n}: m/P < n, tsqr runs single-device")
+                size_mesh = None
             A, b = random_problem(m, n, dtype, seed=0)
             Aj, bj = jnp.asarray(A), jnp.asarray(b)
             timer = PhaseTimer()
             with timer.measure("factor+solve"):
                 x = dhqr_tpu.lstsq(
-                    Aj, bj, mesh=mesh,
-                    layout=args.layout, block_size=args.block_size,
+                    Aj, bj, mesh=size_mesh, engine=args.engine,
+                    block_size=args.block_size, **lkw,
                 )
                 timer.observe(x)
             res = normal_equations_residual(A, np.asarray(x), b)
@@ -139,8 +156,8 @@ def main(argv=None) -> int:
                 # XLA compilation, which the reference has no analogue of
                 with timer.measure("warm"):
                     x = dhqr_tpu.lstsq(
-                        Aj, bj, mesh=mesh,
-                        layout=args.layout, block_size=args.block_size,
+                        Aj, bj, mesh=size_mesh, engine=args.engine,
+                        block_size=args.block_size, **lkw,
                     )
                     timer.observe(x)
                 t_ours = timer.total("warm")

@@ -162,17 +162,19 @@ def qr(
             )
         from dhqr_tpu.parallel import sharded_qr as _sharded
         from dhqr_tpu.parallel.layout import fit_block_size
+        from dhqr_tpu.parallel.mesh import DEFAULT_AXIS
 
-        nloc = A.shape[1] // mesh.shape[cfg.mesh_axis]
+        col_axis = cfg.mesh_axis or DEFAULT_AXIS
+        nloc = A.shape[1] // mesh.shape[col_axis]
         nb = fit_block_size(nloc, cfg.block_size)
         if cfg.blocked:
             H, alpha = _sharded.sharded_blocked_qr(
-                A, mesh, block_size=nb, axis_name=cfg.mesh_axis,
+                A, mesh, block_size=nb, axis_name=col_axis,
                 precision=cfg.precision, layout=cfg.layout,
             )
         else:
             H, alpha = _sharded.sharded_householder_qr(
-                A, mesh, axis_name=cfg.mesh_axis, precision=cfg.precision,
+                A, mesh, axis_name=col_axis, precision=cfg.precision,
                 layout=cfg.layout,
             )
         return QRFactorization(
@@ -207,25 +209,35 @@ def _lstsq_alt_engine(A, b, cfg: DHQRConfig, mesh):
     windows); on a mesh, one n x n psum per pass. These engines return x
     only — ``qr()`` stays Householder-packed by design.
 
-    Both families shard ROWS over the mesh axis — ``cfg.mesh_axis`` when
-    the mesh has an axis of that name, else the sole axis of a 1-D mesh —
-    unlike the Householder mesh path, which shards columns.
+    Both families shard ROWS over the mesh axis — an explicitly-passed
+    ``mesh_axis``, else the sole axis of a 1-D mesh, else an axis named
+    "rows" — unlike the Householder mesh path, which shards columns.
     """
+    if cfg.layout != "block" or cfg.use_pallas != "auto":
+        raise ValueError(
+            f"layout/use_pallas apply only to the householder engines; "
+            f"engine={cfg.engine!r} shards rows (layout={cfg.layout!r}, "
+            f"use_pallas={cfg.use_pallas!r})"
+        )
     axis = None
     if mesh is not None:
         from dhqr_tpu.parallel.sharded_tsqr import ROW_AXIS
 
-        default_axis = DHQRConfig().mesh_axis  # "cols" — the COLUMN name
-        if len(mesh.shape) == 1:
+        if cfg.mesh_axis is not None:  # explicit user choice
+            if cfg.mesh_axis not in mesh.shape:
+                raise ValueError(
+                    f"mesh axes {tuple(mesh.shape)} do not include "
+                    f"mesh_axis={cfg.mesh_axis!r}"
+                )
+            axis = cfg.mesh_axis
+        elif len(mesh.shape) == 1:
             axis = next(iter(mesh.shape))
-        elif cfg.mesh_axis != default_axis and cfg.mesh_axis in mesh.shape:
-            axis = cfg.mesh_axis  # explicit user choice
         elif ROW_AXIS in mesh.shape:
             axis = ROW_AXIS
         else:
-            # A defaulted "cols" on a multi-axis mesh is NOT taken as the
-            # row axis — sharding rows over the column-sharding name while
-            # silently replicating over the rest would waste the pod.
+            # Never guess among multiple axes — sharding rows over a
+            # column-sharding name while silently replicating over the
+            # rest would waste the pod.
             raise ValueError(
                 f"ambiguous row axis on mesh axes {tuple(mesh.shape)} for "
                 f"engine={cfg.engine!r}: pass mesh_axis= to pick one"
@@ -299,26 +311,28 @@ def lstsq(
         return _lstsq_alt_engine(A, b, cfg, mesh)
     if mesh is not None:
         from dhqr_tpu.parallel.layout import fit_block_size
+        from dhqr_tpu.parallel.mesh import DEFAULT_AXIS
         from dhqr_tpu.parallel.sharded_qr import sharded_householder_qr
         from dhqr_tpu.parallel.sharded_solve import sharded_lstsq, sharded_solve
 
-        nloc = A.shape[1] // mesh.shape[cfg.mesh_axis]
+        col_axis = cfg.mesh_axis or DEFAULT_AXIS
+        nloc = A.shape[1] // mesh.shape[col_axis]
         nb = fit_block_size(nloc, cfg.block_size)
         if not cfg.blocked:
             # store_nb=nb + store-layout chaining: factor and solve share one
             # storage order, avoiding cross-device column permutes in between.
             H, alpha = sharded_householder_qr(
-                A, mesh, axis_name=cfg.mesh_axis, precision=cfg.precision,
+                A, mesh, axis_name=col_axis, precision=cfg.precision,
                 layout=cfg.layout, store_nb=nb, _store_layout_output=True,
             )
             return sharded_solve(
                 H, alpha, b, mesh,
-                block_size=nb, axis_name=cfg.mesh_axis, precision=cfg.precision,
+                block_size=nb, axis_name=col_axis, precision=cfg.precision,
                 layout=cfg.layout, _H_in_store_layout=True,
             )
         return sharded_lstsq(
             A, b, mesh,
-            block_size=nb, axis_name=cfg.mesh_axis, precision=cfg.precision,
+            block_size=nb, axis_name=col_axis, precision=cfg.precision,
             layout=cfg.layout,
         )
     return _lstsq_impl(

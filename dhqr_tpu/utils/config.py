@@ -19,8 +19,11 @@ class DHQRConfig:
     Attributes:
       block_size: compact-WY panel width nb (MXU-friendly multiple of 128
         where possible; the engine handles ragged final panels).
-      mesh_axis: name of the mesh axis columns are sharded over — the TPU
-        equivalent of the reference's Distributed.jl worker dimension.
+      mesh_axis: name of the mesh axis to shard over — columns for the
+        householder engines ("cols" when unset), rows for the tsqr/cholqr
+        families. None (the default) means "not explicitly chosen": the
+        engines then use their conventional axis name, and the row engines
+        refuse to guess on a multi-axis mesh.
       blocked: use the compact-WY engine (True) or the unblocked
         reference-parity engine (False).
       use_pallas: panel-factorization kernel choice — "always" forces the
@@ -47,7 +50,7 @@ class DHQRConfig:
     """
 
     block_size: int = 128
-    mesh_axis: str = "cols"
+    mesh_axis: "str | None" = None
     blocked: bool = True
     use_pallas: str = "auto"
     precision: str = "highest"
