@@ -60,6 +60,13 @@ def run(n_devices: int) -> None:
     assert bool(jnp.all(jnp.isfinite(x))), "non-finite x (tsqr)"
     print("dryrun: sharded_tsqr_lstsq ok", flush=True)
 
+    from dhqr_tpu.parallel.sharded_cholqr import sharded_cholqr_lstsq
+
+    x = sharded_cholqr_lstsq(At, bt, rmesh)
+    assert x.shape == (nt,)
+    assert bool(jnp.all(jnp.isfinite(x))), "non-finite x (cholqr)"
+    print("dryrun: sharded_cholqr_lstsq ok", flush=True)
+
 
 if __name__ == "__main__":
     run(int(sys.argv[1]) if len(sys.argv) > 1 else 8)
