@@ -49,6 +49,12 @@ N = int(os.environ.get("DHQR_BENCH_N", "4096"))
 BLOCK = int(os.environ.get("DHQR_BENCH_BLOCK", "128"))
 REPEATS = int(os.environ.get("DHQR_BENCH_REPEATS", "3"))
 PRECISION = os.environ.get("DHQR_PRECISION", "highest")
+# Plain-XLA-reduce column norms: measured backward error matches the
+# compensated tree to ~3% (7.3e-7 vs 7.5e-7 at 1024^2 f32, target 1e-5)
+# while cutting panel-loop op count; the JSON records the mode + the
+# actual backward error either way. Library default stays "accurate" —
+# the bench passes this as an explicit engine parameter.
+NORM = os.environ.get("DHQR_NORM", "fast")
 BASELINE_GFLOPS = 4800.0  # 60% of A100 cuSOLVER geqrf f32 (~8 TF/s), see above
 # The driver's whole-bench window is ~600 s: the TPU attempt plus the CPU
 # fallback (plus SIGTERM grace) must BOTH fit inside it, or a hung TPU
@@ -197,7 +203,9 @@ def main() -> None:
 
     _stage("compile")
     t0 = time.perf_counter()
-    compiled = _blocked_qr_impl.lower(A, BLOCK, precision=PRECISION).compile()
+    compiled = _blocked_qr_impl.lower(
+        A, BLOCK, precision=PRECISION, norm=NORM
+    ).compile()
     compile_s = time.perf_counter() - t0
 
     _stage("warmup")
@@ -226,6 +234,7 @@ def main() -> None:
         "compile_seconds": round(compile_s, 2),
         "block_size": BLOCK,
         "precision": PRECISION,
+        "norm": NORM,
     }
     # Emit the headline number NOW — the backward-error stage below needs a
     # second compile, and if that hangs the supervisor can still recover
@@ -237,7 +246,7 @@ def main() -> None:
     _stage("backward_error")
     small = 1024
     As = jnp.asarray(rng.random((small, small)), dtype=jnp.float32)
-    Hs, als = _blocked_qr_impl(As, BLOCK, precision=PRECISION)
+    Hs, als = _blocked_qr_impl(As, BLOCK, precision=PRECISION, norm=NORM)
     QRs = _apply_q_impl(Hs, r_matrix(Hs, als), BLOCK, precision=PRECISION)
     result["backward_error_1024"] = float(
         jnp.linalg.norm(QRs - As) / jnp.linalg.norm(As)

@@ -170,12 +170,12 @@ def qr(
         if cfg.blocked:
             H, alpha = _sharded.sharded_blocked_qr(
                 A, mesh, block_size=nb, axis_name=col_axis,
-                precision=cfg.precision, layout=cfg.layout,
+                precision=cfg.precision, layout=cfg.layout, norm=cfg.norm,
             )
         else:
             H, alpha = _sharded.sharded_householder_qr(
                 A, mesh, axis_name=col_axis, precision=cfg.precision,
-                layout=cfg.layout,
+                layout=cfg.layout, norm=cfg.norm,
             )
         return QRFactorization(
             H, alpha, block_size=nb, mesh=mesh, precision=cfg.precision,
@@ -184,12 +184,12 @@ def qr(
     if cfg.blocked:
         H, alpha = _blocked.blocked_householder_qr(
             A, cfg.block_size, donate=donate, precision=cfg.precision,
-            use_pallas=cfg.use_pallas,
+            use_pallas=cfg.use_pallas, norm=cfg.norm,
         )
     else:
         if donate:
             raise ValueError("donate=True is only supported on the blocked path")
-        H, alpha = _hh.householder_qr(A, precision=cfg.precision)
+        H, alpha = _hh.householder_qr(A, precision=cfg.precision, norm=cfg.norm)
     return QRFactorization(
         H, alpha, block_size=cfg.block_size, precision=cfg.precision
     )
@@ -276,18 +276,20 @@ def _lstsq_alt_engine(A, b, cfg: DHQRConfig, mesh):
     )
 
 
-@partial(jax.jit, static_argnames=("block_size", "blocked", "precision", "use_pallas"))
-def _lstsq_impl(A, b, block_size, blocked, precision, use_pallas):
+@partial(jax.jit, static_argnames=(
+    "block_size", "blocked", "precision", "use_pallas", "norm"))
+def _lstsq_impl(A, b, block_size, blocked, precision, use_pallas,
+                norm="accurate"):
     if blocked:
         from dhqr_tpu.ops.differentiable import lstsq_diff
 
         pallas, interp = _blocked._resolve_pallas(
             use_pallas, A.shape[0], min(block_size, A.shape[1]), A.dtype
         )
-        # custom-VJP core: identical forward, closed-form O(1)-memory
+        # custom-JVP core: identical forward, closed-form O(1)-memory
         # gradients — jax.grad works through the public lstsq
-        return lstsq_diff(A, b, block_size, precision, pallas, interp)
-    H, alpha = _hh.householder_qr(A, precision=precision)
+        return lstsq_diff(A, b, block_size, precision, pallas, interp, norm)
+    H, alpha = _hh.householder_qr(A, precision=precision, norm=norm)
     c = _solve.apply_qt(H, alpha, b, precision=precision)
     return _solve.back_substitute(H, alpha, c)
 
@@ -307,6 +309,10 @@ def lstsq(
     if A.shape[0] < A.shape[1]:
         raise ValueError(f"lstsq requires m >= n, got {A.shape}")
     cfg = dataclasses.replace(config or DHQRConfig(), **overrides)
+    if cfg.norm not in ("accurate", "fast"):
+        raise ValueError(
+            f"norm must be 'accurate' or 'fast', got {cfg.norm!r}"
+        )
     if cfg.engine != "householder":
         return _lstsq_alt_engine(A, b, cfg, mesh)
     if mesh is not None:
@@ -324,6 +330,7 @@ def lstsq(
             H, alpha = sharded_householder_qr(
                 A, mesh, axis_name=col_axis, precision=cfg.precision,
                 layout=cfg.layout, store_nb=nb, _store_layout_output=True,
+                norm=cfg.norm,
             )
             return sharded_solve(
                 H, alpha, b, mesh,
@@ -333,8 +340,9 @@ def lstsq(
         return sharded_lstsq(
             A, b, mesh,
             block_size=nb, axis_name=col_axis, precision=cfg.precision,
-            layout=cfg.layout,
+            layout=cfg.layout, norm=cfg.norm,
         )
     return _lstsq_impl(
-        A, b, cfg.block_size, cfg.blocked, cfg.precision, cfg.use_pallas
+        A, b, cfg.block_size, cfg.blocked, cfg.precision, cfg.use_pallas,
+        norm=cfg.norm,
     )

@@ -108,7 +108,8 @@ def _panels_schedule(n: int, nb: int) -> tuple[int, int, int]:
     return num_full, rem, ppo
 
 
-def _scan_panels(S, pcount, nb, precision, pallas, pallas_interpret):
+def _scan_panels(S, pcount, nb, precision, pallas, pallas_interpret,
+                 norm="accurate"):
     """Factor ``pcount`` uniform nb-wide panels of super-block S by scan.
 
     S is the (ms, ns) trailing submatrix whose top-left element is the
@@ -129,7 +130,8 @@ def _scan_panels(S, pcount, nb, precision, pallas, pallas_interpret):
                 panel, c, interpret=pallas_interpret
             )
         else:
-            pf, alpha_k = _panel_qr_masked(panel, c, precision=precision)
+            pf, alpha_k = _panel_qr_masked(panel, c, precision=precision,
+                                           norm=norm)
         S = lax.dynamic_update_slice(S, pf, (jnp.int32(0), c))
         with jax.named_scope("trailing_update"):
             Y = shifted_tril(pf, c)
@@ -143,10 +145,12 @@ def _scan_panels(S, pcount, nb, precision, pallas, pallas_interpret):
 
 
 @partial(
-    jax.jit, static_argnames=("block_size", "precision", "pallas", "pallas_interpret")
+    jax.jit,
+    static_argnames=("block_size", "precision", "pallas", "pallas_interpret", "norm"),
 )
 def _blocked_qr_impl(
-    A, block_size, precision=DEFAULT_PRECISION, pallas=False, pallas_interpret=False
+    A, block_size, precision=DEFAULT_PRECISION, pallas=False,
+    pallas_interpret=False, norm="accurate",
 ):
     from dhqr_tpu.ops.pallas_panel import _panel_qr_pallas_impl, pallas_panel_supported
 
@@ -169,7 +173,9 @@ def _blocked_qr_impl(
                         panel, 0, interpret=pallas_interpret
                     )
                 else:
-                    pf, alpha_k = _householder_qr_impl(panel, precision=precision)
+                    pf, alpha_k = _householder_qr_impl(
+                        panel, precision=precision, norm=norm
+                    )
                 H = H.at[k:, k : k + b].set(pf)
                 alpha = alpha.at[k : k + b].set(alpha_k)
             if k + b < n:
@@ -194,7 +200,7 @@ def _blocked_qr_impl(
         S = lax.slice(H, (K, K), (m, n))
         blk_pallas = pallas and pallas_panel_supported(m - K, nb, A.dtype)
         S, alpha_blk = _scan_panels(
-            S, pcount, nb, precision, blk_pallas, pallas_interpret
+            S, pcount, nb, precision, blk_pallas, pallas_interpret, norm=norm
         )
         H = H.at[K:, K:].set(S)
         alpha = alpha.at[K : K + pcount * nb].set(alpha_blk)
@@ -202,7 +208,7 @@ def _blocked_qr_impl(
         K = num_full * nb
         with jax.named_scope("panel_factor"):
             pf, alpha_k = _householder_qr_impl(
-                lax.slice(H, (K, K), (m, n)), precision=precision
+                lax.slice(H, (K, K), (m, n)), precision=precision, norm=norm
             )
         H = H.at[K:, K:].set(pf)
         alpha = alpha.at[K:].set(alpha_k)
@@ -211,7 +217,7 @@ def _blocked_qr_impl(
 
 _blocked_qr_impl_donate = partial(
     jax.jit,
-    static_argnames=("block_size", "precision", "pallas", "pallas_interpret"),
+    static_argnames=("block_size", "precision", "pallas", "pallas_interpret", "norm"),
     donate_argnums=(0,),
 )(_blocked_qr_impl.__wrapped__)
 
@@ -251,6 +257,7 @@ def blocked_householder_qr(
     donate: bool = False,
     precision: str = DEFAULT_PRECISION,
     use_pallas: str = "auto",
+    norm: str = "accurate",
 ):
     """Factor ``A`` (m x n, m >= n): returns ``(H, alpha)`` in packed storage.
 
@@ -259,6 +266,10 @@ def blocked_householder_qr(
     alpha — reference src:122-148, 296-309), but organized panel-wise so the
     trailing update runs on the MXU.
 
+    ``norm`` selects the column-norm accumulation on the XLA panel path
+    (ops/summation.sumsq); panels taken by the Pallas kernel use the
+    kernel's own in-VMEM plain-sum accumulation regardless.
+
     With ``donate=True`` the input buffer is donated to XLA — the functional
     spelling of the reference's in-place ``householder!`` (src:113), halving
     peak memory; the caller's array is invalidated, so it is opt-in.
@@ -266,10 +277,13 @@ def blocked_householder_qr(
     m, n = A.shape
     if m < n:
         raise ValueError(f"blocked_householder_qr requires m >= n, got {A.shape}")
+    if norm not in ("accurate", "fast"):
+        raise ValueError(f"norm must be 'accurate' or 'fast', got {norm!r}")
     nb = int(block_size)
     pallas, interpret = _resolve_pallas(use_pallas, m, min(nb, n), A.dtype)
     impl = _blocked_qr_impl_donate if donate else _blocked_qr_impl
-    return impl(A, nb, precision=precision, pallas=pallas, pallas_interpret=interpret)
+    return impl(A, nb, precision=precision, pallas=pallas,
+                pallas_interpret=interpret, norm=norm)
 
 
 @partial(jax.jit, static_argnames=("block_size", "precision"))

@@ -40,10 +40,10 @@ from dhqr_tpu.ops.householder import DEFAULT_PRECISION
 from dhqr_tpu.ops.solve import back_substitute, r_matrix
 
 
-@partial(jax.custom_jvp, nondiff_argnums=(2, 3, 4, 5))
+@partial(jax.custom_jvp, nondiff_argnums=(2, 3, 4, 5, 6))
 def lstsq_diff(
     A, b, block_size=DEFAULT_BLOCK_SIZE, precision=DEFAULT_PRECISION,
-    pallas=False, pallas_interpret=False,
+    pallas=False, pallas_interpret=False, norm="accurate",
 ):
     """``x = argmin ||A x - b||`` with closed-form O(1)-memory derivatives.
 
@@ -51,14 +51,16 @@ def lstsq_diff(
     derivatives = the closed-form least-squares differential above, in both
     forward and reverse mode. ``b`` may be (m,) or (m, k).
     """
-    x, _ = _lstsq_fwd(A, b, block_size, precision, pallas, pallas_interpret)
+    x, _ = _lstsq_fwd(A, b, block_size, precision, pallas, pallas_interpret,
+                      norm)
     return x
 
 
-def _lstsq_fwd(A, b, block_size, precision, pallas=False, pallas_interpret=False):
+def _lstsq_fwd(A, b, block_size, precision, pallas=False,
+               pallas_interpret=False, norm="accurate"):
     H, alpha = _blocked_qr_impl(
         A, block_size, precision=precision,
-        pallas=pallas, pallas_interpret=pallas_interpret,
+        pallas=pallas, pallas_interpret=pallas_interpret, norm=norm,
     )
     c = _apply_qt_impl(H, b, block_size, precision=precision)
     x = back_substitute(H, alpha, c)
@@ -66,11 +68,12 @@ def _lstsq_fwd(A, b, block_size, precision, pallas=False, pallas_interpret=False
 
 
 @lstsq_diff.defjvp
-def _lstsq_jvp(block_size, precision, pallas, pallas_interpret, primals, tangents):
+def _lstsq_jvp(block_size, precision, pallas, pallas_interpret, norm,
+               primals, tangents):
     A, b = primals
     dA, db = tangents
     x, (_, _, H, alpha, _) = _lstsq_fwd(
-        A, b, block_size, precision, pallas, pallas_interpret
+        A, b, block_size, precision, pallas, pallas_interpret, norm
     )
     m, n = A.shape
     vec = x.ndim == 1

@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from dhqr_tpu.ops.summation import accurate_norm
+from dhqr_tpu.ops.summation import norm2
 
 # Matmul precision for the accuracy-critical contractions. TPU MXU default
 # is bf16 multiplication (~1e-4 relative error) which destroys the <1e-5
@@ -56,7 +56,7 @@ def _real_dtype(dtype) -> jnp.dtype:
         else jnp.zeros((), dtype).real.dtype
 
 
-def householder_reflector(col: jax.Array, j: jax.Array):
+def householder_reflector(col: jax.Array, j: jax.Array, norm: str = "accurate"):
     """Compute one Householder reflector from (the full m-vector of) column j.
 
     ``col`` is the whole column; rows above ``j`` are R entries belonging to
@@ -74,7 +74,7 @@ def householder_reflector(col: jax.Array, j: jax.Array):
     # s = ||A[j:m, j]||  (reference src:129). XLA's reduce-sum carries
     # O(10-100) ulps and the error is amplified by ~sqrt(m) in the trailing
     # update, so use the compensated tree reduction (see ops/summation.py).
-    s = accurate_norm(colm).astype(rdtype)
+    s = norm2(colm, norm).astype(rdtype)
     a_jj = col[j]
     alpha_j = (s.astype(dtype) * alphafactor(a_jj)).astype(dtype)
     denom = s * (s + jnp.abs(a_jj).astype(rdtype))
@@ -87,7 +87,8 @@ def householder_reflector(col: jax.Array, j: jax.Array):
     return v, alpha_j
 
 
-def _panel_step(jj: jax.Array, carry, offset, precision=DEFAULT_PRECISION):
+def _panel_step(jj: jax.Array, carry, offset, precision=DEFAULT_PRECISION,
+                norm="accurate"):
     """One column step on a panel: reflector + whole-panel trailing update.
 
     ``jj`` is the local column index within the panel; the reflector's
@@ -103,7 +104,7 @@ def _panel_step(jj: jax.Array, carry, offset, precision=DEFAULT_PRECISION):
     m, n = P.shape
     j = offset + jj  # row of the diagonal entry
     col = lax.dynamic_slice_in_dim(P, jj, 1, axis=1)[:, 0]
-    v, alpha_j = householder_reflector(col, j)
+    v, alpha_j = householder_reflector(col, j, norm)
     rows = lax.iota(jnp.int32, m)
     # Column jj now stores the reflector in rows j:m; rows < j keep R entries.
     newcol = jnp.where(rows >= j, v, col)
@@ -118,7 +119,8 @@ def _panel_step(jj: jax.Array, carry, offset, precision=DEFAULT_PRECISION):
     return P, alpha
 
 
-def _panel_qr_masked(panel, offset, precision=DEFAULT_PRECISION):
+def _panel_qr_masked(panel, offset, precision=DEFAULT_PRECISION,
+                     norm="accurate"):
     """Masked panel QR: reflector for local column jj starts at row offset+jj.
 
     ``offset`` may be a traced scalar; rows above the (shifted) diagonal are
@@ -127,16 +129,17 @@ def _panel_qr_masked(panel, offset, precision=DEFAULT_PRECISION):
     """
     nb = panel.shape[1]
     alpha = jnp.zeros((nb,), dtype=panel.dtype)
-    step = partial(_panel_step, offset=offset, precision=precision)
+    step = partial(_panel_step, offset=offset, precision=precision, norm=norm)
     return lax.fori_loop(0, nb, step, (panel, alpha))
 
 
-@partial(jax.jit, static_argnames=("precision",))
-def _householder_qr_impl(A, precision=DEFAULT_PRECISION):
-    return _panel_qr_masked(A, 0, precision=precision)
+@partial(jax.jit, static_argnames=("precision", "norm"))
+def _householder_qr_impl(A, precision=DEFAULT_PRECISION, norm="accurate"):
+    return _panel_qr_masked(A, 0, precision=precision, norm=norm)
 
 
-def householder_qr(A: jax.Array, precision: str = DEFAULT_PRECISION):
+def householder_qr(A: jax.Array, precision: str = DEFAULT_PRECISION,
+                   norm: str = "accurate"):
     """Factor ``A`` (m x n, m >= n) in place: returns ``(H, alpha)``.
 
     ``H`` holds the reflectors (rows j:m of column j, ``||v||^2 = 2``) and R's
@@ -147,4 +150,4 @@ def householder_qr(A: jax.Array, precision: str = DEFAULT_PRECISION):
     m, n = A.shape
     if m < n:
         raise ValueError(f"householder_qr requires m >= n, got {A.shape}")
-    return _householder_qr_impl(A, precision=precision)
+    return _householder_qr_impl(A, precision=precision, norm=norm)

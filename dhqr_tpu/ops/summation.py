@@ -47,18 +47,40 @@ def tree_sum(x: jax.Array) -> jax.Array:
     return x[0] + err[0]
 
 
-def accurate_sumsq(x: jax.Array) -> jax.Array:
-    """sum(|x|^2) to ~1 ulp (real result, works for real and complex x)."""
+def sumsq(x: jax.Array, mode: str = "accurate") -> jax.Array:
+    """sum(|x|^2) (real result, works for real and complex x).
+
+    ``mode="accurate"``: compensated pairwise tree, ~1 ulp. ``mode="fast"``:
+    plain XLA reduce — itself tree-shaped on TPU/CPU, so for a sum of
+    SQUARES (condition number 1, no cancellation possible) the error
+    difference is a few ulps (measured: backward error 7.3e-7 vs 7.5e-7 at
+    1024^2 f32 against a 1e-5 target) while skipping the compensation's
+    O(log m) strided-slice levels in hot panel loops.
+    """
     if jnp.issubdtype(x.dtype, jnp.complexfloating):
         y = jnp.real(x) ** 2 + jnp.imag(x) ** 2
     else:
         y = x * x
+    if mode == "fast":
+        return jnp.sum(y)
+    if mode != "accurate":
+        raise ValueError(f"norm mode must be 'accurate' or 'fast', got {mode!r}")
     return tree_sum(y)
+
+
+def accurate_sumsq(x: jax.Array) -> jax.Array:
+    """sum(|x|^2) to ~1 ulp (real result, works for real and complex x)."""
+    return sumsq(x, "accurate")
 
 
 def accurate_norm(x: jax.Array) -> jax.Array:
     """||x||_2 to ~1 ulp — the reference's ``norm(view(Hl, j:m, j))`` (src:129)."""
     return jnp.sqrt(accurate_sumsq(x))
+
+
+def norm2(x: jax.Array, mode: str = "accurate") -> jax.Array:
+    """||x||_2 with selectable accumulation (see :func:`sumsq`)."""
+    return jnp.sqrt(sumsq(x, mode))
 
 
 def accurate_vdot(a: jax.Array, b: jax.Array) -> jax.Array:

@@ -98,6 +98,7 @@ def _panel_owner_traced(kb, P: int, nloc: int, nb: int, layout: str):
 def _unblocked_shard_body(
     Al, *, n: int, axis: str,
     precision: str = DEFAULT_PRECISION, layout: str = "block", store_nb: int = 1,
+    norm: str = "accurate",
 ):
     """Per-device body: Al is the local (m, nloc) column block.
 
@@ -125,7 +126,7 @@ def _unblocked_shard_body(
         # Broadcast = all-reduce of a one-hot contribution (reference's
         # per-column Hj serialization to every worker, src:138-143).
         col = lax.psum(jnp.where(mine, col_local, jnp.zeros_like(col_local)), axis)
-        v, alpha_j = householder_reflector(col, j)
+        v, alpha_j = householder_reflector(col, j, norm)
         newcol = jnp.where(rows >= j, v, col)
         Al_upd = lax.dynamic_update_slice_in_dim(Al, newcol[:, None], jl, axis=1)
         Al = jnp.where(mine, Al_upd, Al)
@@ -144,6 +145,7 @@ def _unblocked_shard_body(
 def _blocked_shard_body(
     Al, *, n: int, nb: int, axis: str,
     precision: str = DEFAULT_PRECISION, layout: str = "block",
+    norm: str = "accurate",
 ):
     """Per-device body for the compact-WY engine.
 
@@ -180,7 +182,8 @@ def _blocked_shard_body(
             # Every device factors its own (m-k, b) slice; the psum keeps the
             # owner's result. SPMD-friendly redundant compute beats a branch.
             panel = lax.slice(Al, (k, kl), (m, kl + b))
-            pf, alpha_k = _householder_qr_impl(panel, precision=precision)
+            pf, alpha_k = _householder_qr_impl(panel, precision=precision,
+                                               norm=norm)
             zero = jnp.zeros_like(pf)
             pf = lax.psum(jnp.where(mine, pf, zero), axis)
             alpha_k = lax.psum(
@@ -215,7 +218,8 @@ def _blocked_shard_body(
             kl = kl - drop           # local offset within the live slice
             mine = p == owner
             panel = lax.dynamic_slice(Sl, (jnp.int32(0), kl), (ms, nb))
-            pf, alpha_k = _panel_qr_masked(panel, c, precision=precision)
+            pf, alpha_k = _panel_qr_masked(panel, c, precision=precision,
+                                           norm=norm)
             pf = lax.psum(jnp.where(mine, pf, jnp.zeros_like(pf)), axis)
             alpha_k = lax.psum(
                 jnp.where(mine, alpha_k, jnp.zeros_like(alpha_k)), axis
@@ -236,11 +240,13 @@ def _blocked_shard_body(
 
 @lru_cache(maxsize=None)
 def _build_unblocked(
-    mesh: Mesh, axis_name: str, n: int, precision: str, layout: str, store_nb: int
+    mesh: Mesh, axis_name: str, n: int, precision: str, layout: str,
+    store_nb: int, norm: str = "accurate",
 ):
     body = partial(
         _unblocked_shard_body,
-        n=n, axis=axis_name, precision=precision, layout=layout, store_nb=store_nb,
+        n=n, axis=axis_name, precision=precision, layout=layout,
+        store_nb=store_nb, norm=norm,
     )
     return jax.jit(
         shard_map(
@@ -255,11 +261,12 @@ def _build_unblocked(
 
 @lru_cache(maxsize=None)
 def _build_blocked(
-    mesh: Mesh, axis_name: str, n: int, nb: int, precision: str, layout: str
+    mesh: Mesh, axis_name: str, n: int, nb: int, precision: str, layout: str,
+    norm: str = "accurate",
 ):
     body = partial(
         _blocked_shard_body,
-        n=n, nb=nb, axis=axis_name, precision=precision, layout=layout,
+        n=n, nb=nb, axis=axis_name, precision=precision, layout=layout, norm=norm,
     )
     return jax.jit(
         shard_map(
@@ -298,6 +305,7 @@ def sharded_householder_qr(
     layout: str = "block",
     store_nb: int = 1,
     _store_layout_output: bool = False,
+    norm: str = "accurate",
 ):
     """Unblocked distributed QR: ``(H, alpha)`` with H column-sharded.
 
@@ -324,7 +332,9 @@ def sharded_householder_qr(
         )
     A = _to_store_layout(A, n, nproc, store_nb, layout)
     A = jax.device_put(A, column_sharding(mesh, axis_name))
-    H, alpha = _build_unblocked(mesh, axis_name, n, precision, layout, store_nb)(A)
+    H, alpha = _build_unblocked(
+        mesh, axis_name, n, precision, layout, store_nb, norm
+    )(A)
     if not _store_layout_output:
         H = _to_natural_layout(H, n, nproc, store_nb, layout)
     return H, alpha
@@ -338,6 +348,7 @@ def sharded_blocked_qr(
     precision: str = DEFAULT_PRECISION,
     layout: str = "block",
     _store_layout_output: bool = False,
+    norm: str = "accurate",
 ):
     """Compact-WY distributed QR: one psum per panel, GEMM trailing updates.
 
@@ -353,7 +364,7 @@ def sharded_blocked_qr(
     _check_divisibility(m, n, nproc, nb, layout)
     A = _to_store_layout(A, n, nproc, nb, layout)
     A = jax.device_put(A, column_sharding(mesh, axis_name))
-    H, alpha = _build_blocked(mesh, axis_name, n, nb, precision, layout)(A)
+    H, alpha = _build_blocked(mesh, axis_name, n, nb, precision, layout, norm)(A)
     if not _store_layout_output:
         H = _to_natural_layout(H, n, nproc, nb, layout)
     return H, alpha

@@ -229,6 +229,7 @@ def sharded_lstsq(
     axis_name: str = DEFAULT_AXIS,
     precision: str = DEFAULT_PRECISION,
     layout: str = "block",
+    norm: str = "accurate",
 ) -> jax.Array:
     """One-shot distributed least squares: factor + solve on the mesh.
 
@@ -240,7 +241,7 @@ def sharded_lstsq(
 
     H, alpha = sharded_blocked_qr(
         A, mesh, block_size=block_size, axis_name=axis_name, precision=precision,
-        layout=layout, _store_layout_output=True,
+        layout=layout, _store_layout_output=True, norm=norm,
     )
     return sharded_solve(
         H, alpha, b, mesh,

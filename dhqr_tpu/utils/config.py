@@ -41,6 +41,10 @@ class DHQRConfig:
         bf16 passes, ~1e-4 relative error; the speed tier). The TPU
         equivalent of the reference's import-time BLAS configuration
         (reference src:6) — but per-call, not global state.
+      norm: column-norm accumulation — "accurate" (compensated TwoSum
+        tree, ~1 ulp; the default L0 accuracy tier) or "fast" (plain XLA
+        reduce — a few ulps for sums of squares, fewer ops per panel-loop
+        column; see ops/summation.sumsq for the measured error).
       engine: least-squares algorithm family — "householder" (the
         reference-parity path; the only engine ``qr()`` supports, since the
         factorization object stores packed reflectors), "tsqr"
@@ -56,6 +60,7 @@ class DHQRConfig:
     precision: str = "highest"
     layout: str = "block"
     engine: str = "householder"
+    norm: str = "accurate"
 
     @staticmethod
     def from_env(**overrides) -> "DHQRConfig":
@@ -77,5 +82,7 @@ class DHQRConfig:
             env["layout"] = os.environ["DHQR_LAYOUT"]
         if "DHQR_ENGINE" in os.environ:
             env["engine"] = os.environ["DHQR_ENGINE"]
+        if "DHQR_NORM" in os.environ:
+            env["norm"] = os.environ["DHQR_NORM"]
         env.update(overrides)
         return DHQRConfig(**env)

@@ -101,3 +101,29 @@ def test_vdot_zero_length_masked():
 
 def test_tree_sum_empty():
     assert float(tree_sum(jnp.zeros((0,)))) == 0.0
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64, np.complex128])
+def test_sumsq_fast_mode_matches_oracle(dtype):
+    """mode="fast" (plain XLA reduce) stays a few-ulp tree for squares."""
+    from dhqr_tpu.ops.summation import norm2, sumsq
+
+    rng = np.random.default_rng(31)
+    x = rng.standard_normal(1000)
+    if np.issubdtype(dtype, np.complexfloating):
+        x = x + 1j * rng.standard_normal(1000)
+    xj = jnp.asarray(x.astype(dtype))
+    want = np.sum(np.abs(x.astype(dtype)) ** 2)
+    eps = np.finfo(np.float32 if dtype == np.float32 else np.float64).eps
+    got = float(sumsq(xj, "fast"))
+    assert abs(got - want) <= 100 * eps * want
+    assert float(norm2(xj, "fast")) == pytest.approx(np.sqrt(want), rel=50 * eps)
+    # accurate and fast agree to reduction-order rounding
+    assert float(sumsq(xj, "accurate")) == pytest.approx(got, rel=100 * eps)
+
+
+def test_sumsq_rejects_unknown_mode():
+    from dhqr_tpu.ops.summation import sumsq
+
+    with pytest.raises(ValueError, match="norm mode"):
+        sumsq(jnp.ones(4), "fats")
