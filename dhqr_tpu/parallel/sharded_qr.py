@@ -38,6 +38,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from dhqr_tpu.ops.blocked import (
     MAX_UNROLLED_PANELS,
+    _panels_schedule,
     apply_block_reflector_h,
     shifted_tril,
 )
@@ -203,7 +204,7 @@ def _blocked_shard_body(
             Al = Al.at[k:, drop:].set(jnp.where(cmask, C_new, C))
         return Al, alpha
 
-    ppo = -(-num_panels // MAX_UNROLLED_PANELS)  # panels per super-block
+    _, _, ppo = _panels_schedule(n, nb)  # panels per super-block (rem 0 here)
     for ob in range(0, num_panels, ppo):
         pcount = min(ppo, num_panels - ob)
         K = ob * nb

@@ -31,6 +31,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from dhqr_tpu.ops.blocked import (
     MAX_UNROLLED_PANELS,
+    _panels_schedule,
     apply_block_reflector_h,
     shifted_tril,
 )
@@ -46,8 +47,10 @@ def _apply_qt_shard_body(
 
     Per panel, the owner's reflectors are broadcast with one psum — the
     equivalent of stage 1's per-worker visit (src:227-229). Many panels run
-    as one ``lax.scan`` (bounded program size, uniform full-height panels
-    whose structural zeros above row k make the unsliced update exact).
+    as scans inside <= MAX_UNROLLED_PANELS statically row-sliced
+    super-blocks (bounded program size; the row shrinkage bounds the psum'd
+    panel to the super-block's rows, and structural zeros above the
+    reflector row make the within-block unsliced update exact).
     """
     from dhqr_tpu.parallel.sharded_qr import _panel_owner, _panel_owner_traced
 
@@ -69,16 +72,32 @@ def _apply_qt_shard_body(
             B = B.at[k:, :].set(apply_block_reflector_h(panel, tail, precision))
         return B[:, 0] if vec else B
 
-    def body(B, kb):
-        k = kb * nb
-        owner, kl = _panel_owner_traced(kb, nproc, nloc, nb, layout)
-        mine = p == owner
-        Y = shifted_tril(lax.dynamic_slice(Hl, (jnp.int32(0), kl), (m, nb)), k)
-        Y = lax.psum(jnp.where(mine, Y, jnp.zeros_like(Y)), axis)
-        # Y is zero above row k, so only rows k: change — no slicing needed.
-        return apply_block_reflector_h(Y, B, precision), None
+    # Super-block row shrinkage (same scheme as the factor engines): panel
+    # kb's reflectors live in rows k:m, so the psum'd panel and the updated
+    # B rows can be statically cut to the super-block's row range — without
+    # it every panel would move a full m x nb block over the mesh (m*n
+    # words total, as much as the matrix itself).
+    _, _, ppo = _panels_schedule(n, nb)  # rem is 0 on the sharded path
+    for ob in range(0, num_panels, ppo):
+        pcount = min(ppo, num_panels - ob)
+        K = ob * nb
+        ms = m - K
+        Bs = lax.slice(B, (K, 0), B.shape)  # rows K:
 
-    B, _ = lax.scan(body, B, jnp.arange(num_panels, dtype=jnp.int32))
+        def body(Bs, q, ob=ob, ms=ms, K=K):
+            kb = ob + q
+            c = kb * nb - K  # reflector start row within the super-block
+            owner, kl = _panel_owner_traced(kb, nproc, nloc, nb, layout)
+            mine = p == owner
+            Y = shifted_tril(
+                lax.dynamic_slice(Hl, (jnp.int32(K), kl), (ms, nb)), c
+            )
+            Y = lax.psum(jnp.where(mine, Y, jnp.zeros_like(Y)), axis)
+            # Y is zero above row c, so only rows c: of Bs change.
+            return apply_block_reflector_h(Y, Bs, precision), None
+
+        Bs, _ = lax.scan(body, Bs, jnp.arange(pcount, dtype=jnp.int32))
+        B = B.at[K:, :].set(Bs)
     return B[:, 0] if vec else B
 
 
@@ -133,31 +152,50 @@ def _backsub_shard_body(
             C = jnp.where(rows_n < k, C - packed, C)
         return x[:, 0] if vec else x
 
-    def body(carry, kb):
-        x, C = carry
-        k = kb * nb
-        owner, kl = _panel_owner_traced(kb, nproc, nloc, nb, layout)
-        mine = p == owner
-        # Owner's full column strip, R rows only (n x nb, uniform shape).
-        strip = lax.dynamic_slice(Hl, (jnp.int32(0), kl), (n, nb))
-        blk = lax.dynamic_slice(strip, (k, jnp.int32(0)), (nb, nb))
-        Rpp = jnp.triu(blk, k=1) + jnp.diag(lax.dynamic_slice_in_dim(alpha, k, nb))
-        Ck = lax.dynamic_slice(C, (k, jnp.int32(0)), (nb, C.shape[1]))
-        xp = lax.linalg.triangular_solve(Rpp, Ck, left_side=True, lower=False)
-        # R[0:k, panel] @ xp with the strip masked to rows < k (rows >= k+nb
-        # hold reflector entries, not R; rows in the panel are the diagonal
-        # block already solved above).
-        above = jnp.where(rows_n < k, strip, jnp.zeros_like(strip))
-        delta = jnp.matmul(above, xp, precision=precision)  # (n, nrhs)
-        packed = lax.dynamic_update_slice(delta, xp, (k, jnp.int32(0)))
-        packed = lax.psum(jnp.where(mine, packed, jnp.zeros_like(packed)), axis)
-        x = jnp.where((rows_n >= k) & (rows_n < k + nb), packed, x)
-        C = jnp.where(rows_n < k, C - packed, C)
-        return (x, C), None
+    # Right-to-left super-blocks with static row shrinkage: every panel in
+    # super-block ob touches only rows < Ke = (ob+pcount)*nb, so the packed
+    # psum per panel is Ke x nrhs instead of n x nrhs — halving the
+    # back-sub's collective traffic on average.
+    _, _, ppo = _panels_schedule(n, nb)  # rem is 0 on the sharded path
+    for ob in reversed(range(0, num_panels, ppo)):
+        pcount = min(ppo, num_panels - ob)
+        Ke = (ob + pcount) * nb
+        rows_e = lax.iota(jnp.int32, Ke)[:, None]
+        xs = lax.slice(x, (0, 0), (Ke, x.shape[1]))
+        Cs = lax.slice(C, (0, 0), (Ke, C.shape[1]))
 
-    (x, C), _ = lax.scan(
-        body, (x, C), jnp.arange(num_panels - 1, -1, -1, dtype=jnp.int32)
-    )
+        def body(carry, kb, Ke=Ke, rows_e=rows_e):
+            xs, Cs = carry
+            k = kb * nb
+            owner, kl = _panel_owner_traced(kb, nproc, nloc, nb, layout)
+            mine = p == owner
+            # Owner's column strip, rows < Ke only (R rows for this block).
+            strip = lax.dynamic_slice(Hl, (jnp.int32(0), kl), (Ke, nb))
+            blk = lax.dynamic_slice(strip, (k, jnp.int32(0)), (nb, nb))
+            Rpp = jnp.triu(blk, k=1) + jnp.diag(
+                lax.dynamic_slice_in_dim(alpha, k, nb)
+            )
+            Ck = lax.dynamic_slice(Cs, (k, jnp.int32(0)), (nb, Cs.shape[1]))
+            xp = lax.linalg.triangular_solve(Rpp, Ck, left_side=True, lower=False)
+            # R[0:k, panel] @ xp with the strip masked to rows < k (rows in
+            # [k, k+nb) are the diagonal block already solved; rows beyond
+            # hold reflector entries, not R).
+            above = jnp.where(rows_e < k, strip, jnp.zeros_like(strip))
+            delta = jnp.matmul(above, xp, precision=precision)  # (Ke, nrhs)
+            packed = lax.dynamic_update_slice(delta, xp, (k, jnp.int32(0)))
+            packed = lax.psum(
+                jnp.where(mine, packed, jnp.zeros_like(packed)), axis
+            )
+            xs = jnp.where((rows_e >= k) & (rows_e < k + nb), packed, xs)
+            Cs = jnp.where(rows_e < k, Cs - packed, Cs)
+            return (xs, Cs), None
+
+        (xs, Cs), _ = lax.scan(
+            body, (xs, Cs),
+            jnp.arange(ob + pcount - 1, ob - 1, -1, dtype=jnp.int32),
+        )
+        x = x.at[:Ke].set(xs)
+        C = C.at[:Ke].set(Cs)
     return x[:, 0] if vec else x
 
 

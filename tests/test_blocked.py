@@ -128,3 +128,26 @@ def test_blocked_q_inverts_qt():
     c = blocked_apply_qt(H, alpha, jnp.asarray(b), block_size=16)
     b_back = np.asarray(blocked_apply_q(H, alpha, c, block_size=16))
     np.testing.assert_allclose(b_back, b, rtol=1e-9, atol=1e-11)
+
+
+def test_blocked_qr_fast_norm_end_to_end():
+    """norm='fast' through the full blocked factor/solve pipeline (a silent
+    drop of the threaded parameter would leave this path untested)."""
+    import numpy as np
+
+    from dhqr_tpu.ops.blocked import _apply_qt_impl, blocked_householder_qr
+    from dhqr_tpu.ops.solve import back_substitute
+    from dhqr_tpu.utils.testing import (
+        TOLERANCE_FACTOR, normal_equations_residual, oracle_residual,
+        random_problem,
+    )
+
+    A, b = random_problem(300, 288, np.float32, seed=17)  # scan path: 18 panels
+    Aj = jnp.asarray(A)
+    H, alpha = blocked_householder_qr(Aj, 16, norm="fast")
+    x = back_substitute(H, alpha, _apply_qt_impl(H, jnp.asarray(b), 16))
+    res = normal_equations_residual(A, np.asarray(x), b)
+    assert res < TOLERANCE_FACTOR * max(oracle_residual(A, b), 1e-4)
+    # and the two modes agree to f32 rounding
+    H0, alpha0 = blocked_householder_qr(Aj, 16, norm="accurate")
+    np.testing.assert_allclose(np.asarray(H), np.asarray(H0), atol=2e-4, rtol=2e-4)
