@@ -178,6 +178,36 @@ def main() -> None:
         # so the comparison runs in-process — no second TPU claim.
         return qr_stage(4096, 128, norm="fast")
 
+    def lstsq_stage(engine, m_, n_):
+        # BASELINE config-2 shape on one chip: engine fast-path comparison.
+        import dhqr_tpu
+
+        A = jnp.asarray(rng.random((m_, n_)), dtype=jnp.float32)
+        b = jnp.asarray(rng.random(m_), dtype=jnp.float32)
+        sync(b)
+        x = dhqr_tpu.lstsq(A, b, engine=engine)
+        sync(x)
+        times = []
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            x = dhqr_tpu.lstsq(A, b, engine=engine)
+            sync(x)
+            times.append(time.perf_counter() - t0)
+        t = min(times)
+        fl = 2.0 * m_ * n_ * n_ - (2.0 / 3.0) * n_ ** 3 + 4.0 * m_ * n_
+        res = float(jnp.linalg.norm(A.T @ (A @ x - b)))
+        return {"engine": engine, "shape": f"{m_}x{n_}",
+                "run_s": round(t, 4), "gflops": round(fl / t / 1e9, 1),
+                "normal_eq_residual": res}
+
+    @stage("tall_skinny_tsqr", 560)
+    def _ts_tsqr():
+        return lstsq_stage("tsqr", 65536, 256)
+
+    @stage("tall_skinny_cholqr2", 560)
+    def _ts_cholqr():
+        return lstsq_stage("cholqr2", 65536, 256)
+
     names = [n for n, _, _ in stages]
     lo = names.index(args.from_stage) if args.from_stage else 0
     hi = names.index(args.to_stage) + 1 if args.to_stage else len(stages)

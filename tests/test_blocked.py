@@ -133,14 +133,7 @@ def test_blocked_q_inverts_qt():
 def test_blocked_qr_fast_norm_end_to_end():
     """norm='fast' through the full blocked factor/solve pipeline (a silent
     drop of the threaded parameter would leave this path untested)."""
-    import numpy as np
-
-    from dhqr_tpu.ops.blocked import _apply_qt_impl, blocked_householder_qr
-    from dhqr_tpu.ops.solve import back_substitute
-    from dhqr_tpu.utils.testing import (
-        TOLERANCE_FACTOR, normal_equations_residual, oracle_residual,
-        random_problem,
-    )
+    from dhqr_tpu.ops.blocked import _apply_qt_impl
 
     A, b = random_problem(300, 288, np.float32, seed=17)  # scan path: 18 panels
     Aj = jnp.asarray(A)
