@@ -294,6 +294,31 @@ def _lstsq_impl(A, b, block_size, blocked, precision, use_pallas,
     return _solve.back_substitute(H, alpha, c)
 
 
+@partial(jax.jit, static_argnames=("block_size", "precision", "norm"))
+def _minimum_norm_impl(A, b, block_size, precision, norm="accurate"):
+    """Underdetermined (m < n, full row rank): the minimum-norm solution.
+
+    Factor A^H = Q R (tall, the engines' home turf); then A = R^H Q^H and
+    ``x = Q R^{-H} b`` solves A x = b exactly with the smallest ||x||.
+    Beyond the reference (which is tall-only, src:33) but expected of a
+    least-squares surface; the blocked engine + compact-WY Q-apply keep it
+    on the MXU.
+    """
+    m, n = A.shape  # m < n
+    H, alpha = _blocked._blocked_qr_impl(
+        jnp.conj(A.T), block_size, precision=precision, norm=norm
+    )
+    R = _solve.r_matrix(H, alpha)  # (m, m) upper; A = R^H Q^H
+    vec = b.ndim == 1
+    B = b[:, None] if vec else b
+    Y = jax.lax.linalg.triangular_solve(
+        R, B, left_side=True, lower=False, transpose_a=True, conjugate_a=True
+    )  # R^H Y = b
+    Yp = jnp.zeros((n,) + Y.shape[1:], dtype=Y.dtype).at[:m].set(Y)
+    X = _blocked._apply_q_impl(H, Yp, block_size, precision=precision)
+    return X[:, 0] if vec else X
+
+
 def lstsq(
     A: jax.Array,
     b: jax.Array,
@@ -304,14 +329,32 @@ def lstsq(
     """One-shot least squares ``x = qr(A) \\ b`` as a single jitted program.
 
     With ``mesh=`` the whole pipeline runs distributed (the reference's
-    ``DHQR.qr!(A3) \\ b`` DArray path, runtests.jl:77-78).
+    ``DHQR.qr!(A3) \\ b`` DArray path, runtests.jl:77-78). For m < n the
+    result is the minimum-norm solution of the underdetermined system
+    (single-device householder engine only).
     """
-    if A.shape[0] < A.shape[1]:
-        raise ValueError(f"lstsq requires m >= n, got {A.shape}")
     cfg = dataclasses.replace(config or DHQRConfig(), **overrides)
     if cfg.norm not in ("accurate", "fast"):
         raise ValueError(
             f"norm must be 'accurate' or 'fast', got {cfg.norm!r}"
+        )
+    if cfg.engine not in LSTSQ_ENGINES:
+        raise ValueError(
+            f"unknown engine {cfg.engine!r}: expected one of {LSTSQ_ENGINES}"
+        )
+    if A.shape[0] < A.shape[1]:
+        if mesh is not None or cfg.engine != "householder":
+            raise ValueError(
+                f"m < n (got {A.shape}) is supported only on the "
+                "single-device householder path (minimum-norm solve)"
+            )
+        if not cfg.blocked or cfg.use_pallas != "auto":
+            raise ValueError(
+                "m < n supports only the default blocked XLA path "
+                f"(got blocked={cfg.blocked}, use_pallas={cfg.use_pallas!r})"
+            )
+        return _minimum_norm_impl(
+            A, b, cfg.block_size, cfg.precision, norm=cfg.norm
         )
     if cfg.engine != "householder":
         return _lstsq_alt_engine(A, b, cfg, mesh)

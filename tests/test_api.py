@@ -157,3 +157,40 @@ def test_lstsq_row_engine_multi_axis_mesh():
     mesh2 = Mesh(devs, ("replica", "cols"))
     with pytest.raises(ValueError, match="ambiguous row axis"):
         dhqr_tpu.lstsq(jnp.asarray(A), jnp.asarray(b), mesh=mesh2, engine="cholqr2")
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_lstsq_underdetermined_minimum_norm(dtype):
+    """m < n: lstsq returns the minimum-norm exact solution (vs numpy)."""
+    rng = np.random.default_rng(23)
+    A = rng.standard_normal((24, 64))
+    B = rng.standard_normal(24)
+    if np.issubdtype(dtype, np.complexfloating):
+        A = A + 1j * rng.standard_normal((24, 64))
+        B = B + 1j * rng.standard_normal(24)
+    A, B = A.astype(dtype), B.astype(dtype)
+    x = lstsq(jnp.asarray(A), jnp.asarray(B), block_size=16)
+    x0 = np.linalg.lstsq(A, B, rcond=None)[0]  # numpy's min-norm solution
+    np.testing.assert_allclose(np.asarray(x), x0, atol=1e-10)
+    # exact solve: residual at machine precision
+    assert np.linalg.norm(A @ np.asarray(x) - B) < 1e-10
+    # multi-RHS
+    B2 = rng.standard_normal((24, 3)).astype(dtype)
+    X = lstsq(jnp.asarray(A), jnp.asarray(B2), block_size=16)
+    X0 = np.linalg.lstsq(A, B2, rcond=None)[0]
+    np.testing.assert_allclose(np.asarray(X), X0, atol=1e-10)
+
+
+def test_lstsq_underdetermined_rejects_mesh_and_alt_engines():
+    from dhqr_tpu.parallel.mesh import column_mesh
+
+    A = jnp.zeros((4, 8))
+    b = jnp.zeros(4)
+    with pytest.raises(ValueError, match="m < n"):
+        lstsq(A, b, engine="cholqr2")
+    with pytest.raises(ValueError, match="m < n"):
+        lstsq(A, b, mesh=column_mesh(2))
+    with pytest.raises(ValueError, match="unknown engine"):
+        lstsq(A, b, engine="bogus")  # engine validation precedes m<n branch
+    with pytest.raises(ValueError, match="default blocked"):
+        lstsq(A, b, use_pallas="always")
