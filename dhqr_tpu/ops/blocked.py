@@ -45,8 +45,11 @@ DEFAULT_BLOCK_SIZE = 128
 # compile time scale with this constant, NOT with n; flop overhead of the
 # scanned path scales with 1/MAX_UNROLLED_PANELS (each super-block's scan
 # works on the super-block's full trailing shape instead of per-panel
-# shrinking slices).
-MAX_UNROLLED_PANELS = 8
+# shrinking slices). DHQR_MAX_PANELS tunes the compile-time/flop-overhead
+# trade for hardware experiments (read once at import).
+import os as _os
+
+MAX_UNROLLED_PANELS = int(_os.environ.get("DHQR_MAX_PANELS", "8"))
 
 
 def wy_upper(Y: jax.Array, precision=DEFAULT_PRECISION) -> jax.Array:

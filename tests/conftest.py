@@ -20,6 +20,22 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)  # Float64/ComplexF64 parity with reference
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Drop compiled executables after each test module.
+
+    A full-suite run keeps hundreds of XLA CPU executables alive in one
+    process; the native compiler has been observed to segfault (flaky,
+    ~1-in-6 full runs) deep into such a run while compiling yet another
+    shard_map program. Bounding the live-executable population per module
+    removes that accumulation; the cost is re-tracing shared engines at
+    module boundaries, a few seconds across the suite.
+    """
+    yield
+    jax.clear_caches()
