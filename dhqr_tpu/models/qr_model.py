@@ -171,8 +171,14 @@ def qr(
             H, alpha = _sharded.sharded_blocked_qr(
                 A, mesh, block_size=nb, axis_name=col_axis,
                 precision=cfg.precision, layout=cfg.layout, norm=cfg.norm,
+                use_pallas=cfg.use_pallas,
             )
         else:
+            if cfg.use_pallas != "auto":
+                raise ValueError(
+                    "use_pallas applies to the blocked engines only "
+                    f"(got use_pallas={cfg.use_pallas!r} with blocked=False)"
+                )
             H, alpha = _sharded.sharded_householder_qr(
                 A, mesh, axis_name=col_axis, precision=cfg.precision,
                 layout=cfg.layout, norm=cfg.norm,
@@ -289,6 +295,11 @@ def _lstsq_impl(A, b, block_size, blocked, precision, use_pallas,
         # custom-JVP core: identical forward, closed-form O(1)-memory
         # gradients — jax.grad works through the public lstsq
         return lstsq_diff(A, b, block_size, precision, pallas, interp, norm)
+    if use_pallas != "auto":
+        raise ValueError(
+            "use_pallas applies to the blocked engines only "
+            f"(got use_pallas={use_pallas!r} with blocked=False)"
+        )
     H, alpha = _hh.householder_qr(A, precision=precision, norm=norm)
     c = _solve.apply_qt(H, alpha, b, precision=precision)
     return _solve.back_substitute(H, alpha, c)
@@ -368,6 +379,11 @@ def lstsq(
         nloc = A.shape[1] // mesh.shape[col_axis]
         nb = fit_block_size(nloc, cfg.block_size)
         if not cfg.blocked:
+            if cfg.use_pallas != "auto":
+                raise ValueError(
+                    "use_pallas applies to the blocked engines only "
+                    f"(got use_pallas={cfg.use_pallas!r} with blocked=False)"
+                )
             # store_nb=nb + store-layout chaining: factor and solve share one
             # storage order, avoiding cross-device column permutes in between.
             H, alpha = sharded_householder_qr(
@@ -383,7 +399,7 @@ def lstsq(
         return sharded_lstsq(
             A, b, mesh,
             block_size=nb, axis_name=col_axis, precision=cfg.precision,
-            layout=cfg.layout, norm=cfg.norm,
+            layout=cfg.layout, norm=cfg.norm, use_pallas=cfg.use_pallas,
         )
     return _lstsq_impl(
         A, b, cfg.block_size, cfg.blocked, cfg.precision, cfg.use_pallas,

@@ -146,7 +146,7 @@ def _unblocked_shard_body(
 def _blocked_shard_body(
     Al, *, n: int, nb: int, axis: str,
     precision: str = DEFAULT_PRECISION, layout: str = "block",
-    norm: str = "accurate",
+    norm: str = "accurate", pallas: bool = False, pallas_interpret: bool = False,
 ):
     """Per-device body for the compact-WY engine.
 
@@ -182,9 +182,18 @@ def _blocked_shard_body(
             mine = p == owner
             # Every device factors its own (m-k, b) slice; the psum keeps the
             # owner's result. SPMD-friendly redundant compute beats a branch.
-            panel = lax.slice(Al, (k, kl), (m, kl + b))
-            pf, alpha_k = _householder_qr_impl(panel, precision=precision,
-                                               norm=norm)
+            panel = lax.slice(Al, (k, kl), (m, kl + b))  # rows k:, offset 0
+            # gate validated once in sharded_blocked_qr: the VMEM budget is
+            # monotone in (m, nb), so every smaller panel fits too
+            if pallas:
+                from dhqr_tpu.ops.pallas_panel import _panel_qr_pallas_impl
+
+                pf, alpha_k = _panel_qr_pallas_impl(
+                    panel, 0, interpret=pallas_interpret
+                )
+            else:
+                pf, alpha_k = _householder_qr_impl(panel, precision=precision,
+                                                   norm=norm)
             zero = jnp.zeros_like(pf)
             pf = lax.psum(jnp.where(mine, pf, zero), axis)
             alpha_k = lax.psum(
@@ -210,8 +219,7 @@ def _blocked_shard_body(
         K = ob * nb
         drop = _done_cols(ob)  # static: columns done before this super-block
         Sl = lax.slice(Al, (K, drop), (m, nloc))  # rows K:, live local columns
-
-        def body(Sl, q, ob=ob, ms=m - K, K=K, drop=drop):
+        def body(Sl, q, ob=ob, ms=m - K, K=K, drop=drop, blk_pallas=pallas):
             kb = ob + q              # global panel index (traced)
             k = kb * nb              # global start column
             c = k - K                # row offset within the super-block
@@ -219,8 +227,15 @@ def _blocked_shard_body(
             kl = kl - drop           # local offset within the live slice
             mine = p == owner
             panel = lax.dynamic_slice(Sl, (jnp.int32(0), kl), (ms, nb))
-            pf, alpha_k = _panel_qr_masked(panel, c, precision=precision,
-                                           norm=norm)
+            if blk_pallas:
+                from dhqr_tpu.ops.pallas_panel import _panel_qr_pallas_impl
+
+                pf, alpha_k = _panel_qr_pallas_impl(
+                    panel, c, interpret=pallas_interpret
+                )
+            else:
+                pf, alpha_k = _panel_qr_masked(panel, c, precision=precision,
+                                               norm=norm)
             pf = lax.psum(jnp.where(mine, pf, jnp.zeros_like(pf)), axis)
             alpha_k = lax.psum(
                 jnp.where(mine, alpha_k, jnp.zeros_like(alpha_k)), axis
@@ -263,11 +278,12 @@ def _build_unblocked(
 @lru_cache(maxsize=None)
 def _build_blocked(
     mesh: Mesh, axis_name: str, n: int, nb: int, precision: str, layout: str,
-    norm: str = "accurate",
+    norm: str = "accurate", pallas: bool = False, pallas_interpret: bool = False,
 ):
     body = partial(
         _blocked_shard_body,
-        n=n, nb=nb, axis=axis_name, precision=precision, layout=layout, norm=norm,
+        n=n, nb=nb, axis=axis_name, precision=precision, layout=layout,
+        norm=norm, pallas=pallas, pallas_interpret=pallas_interpret,
     )
     return jax.jit(
         shard_map(
@@ -350,6 +366,7 @@ def sharded_blocked_qr(
     layout: str = "block",
     _store_layout_output: bool = False,
     norm: str = "accurate",
+    use_pallas: str = "never",
 ):
     """Compact-WY distributed QR: one psum per panel, GEMM trailing updates.
 
@@ -363,9 +380,18 @@ def sharded_blocked_qr(
     nproc = mesh.shape[axis_name]
     nb = min(int(block_size), n // nproc)
     _check_divisibility(m, n, nproc, nb, layout)
+    from dhqr_tpu.ops.blocked import _resolve_pallas
+
+    pallas, _ = _resolve_pallas(use_pallas, m, nb, A.dtype)
+    # Interpret-vs-compile follows the MESH's platform, not the process
+    # default backend — a CPU mesh on a TPU-default host (the virtual-mesh
+    # test pattern) must get the interpreter, and vice versa.
+    interp = pallas and mesh.devices.flat[0].platform != "tpu"
     A = _to_store_layout(A, n, nproc, nb, layout)
     A = jax.device_put(A, column_sharding(mesh, axis_name))
-    H, alpha = _build_blocked(mesh, axis_name, n, nb, precision, layout, norm)(A)
+    H, alpha = _build_blocked(
+        mesh, axis_name, n, nb, precision, layout, norm, pallas, interp
+    )(A)
     if not _store_layout_output:
         H = _to_natural_layout(H, n, nproc, nb, layout)
     return H, alpha

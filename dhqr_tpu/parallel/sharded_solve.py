@@ -268,6 +268,7 @@ def sharded_lstsq(
     precision: str = DEFAULT_PRECISION,
     layout: str = "block",
     norm: str = "accurate",
+    use_pallas: str = "never",
 ) -> jax.Array:
     """One-shot distributed least squares: factor + solve on the mesh.
 
@@ -280,6 +281,7 @@ def sharded_lstsq(
     H, alpha = sharded_blocked_qr(
         A, mesh, block_size=block_size, axis_name=axis_name, precision=precision,
         layout=layout, _store_layout_output=True, norm=norm,
+        use_pallas=use_pallas,
     )
     return sharded_solve(
         H, alpha, b, mesh,
