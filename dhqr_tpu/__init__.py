@@ -29,6 +29,7 @@ from dhqr_tpu.models.qr_model import (
     QRFactorization,
     lstsq,
     qr,
+    qr_explicit,
     solve,
 )
 from dhqr_tpu.ops.householder import alphafactor, householder_qr
@@ -44,6 +45,7 @@ __version__ = "0.2.0"
 __all__ = [
     "QRFactorization",
     "qr",
+    "qr_explicit",
     "lstsq",
     "solve",
     "householder_qr",

@@ -206,6 +206,25 @@ def solve(fact: QRFactorization, b: jax.Array) -> jax.Array:
     return fact.solve(b)
 
 
+def qr_explicit(
+    A: jax.Array,
+    config: Optional[DHQRConfig] = None,
+    mesh=None,
+    **overrides,
+):
+    """Explicit reduced factors ``(Q, R)`` — the ``jnp.linalg.qr`` shape.
+
+    Convenience for callers migrating from ``jnp.linalg.qr(A)``: Q is
+    (m, n) with orthonormal columns, R (n, n) upper-triangular. The packed
+    form (:func:`qr`) is cheaper when you only need solves/applies — the
+    reference never forms Q at all (src:215-294). ``mesh=`` factors
+    distributed, then materializes the factors (Q formed by the
+    single-program blocked apply).
+    """
+    fact = qr(A, config=config, mesh=mesh, **overrides)
+    return fact.q_columns(), fact.r_matrix()
+
+
 def _lstsq_alt_engine(A, b, cfg: DHQRConfig, mesh):
     """Route ``lstsq`` to the non-Householder engine families.
 

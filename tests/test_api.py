@@ -194,3 +194,20 @@ def test_lstsq_underdetermined_rejects_mesh_and_alt_engines():
         lstsq(A, b, engine="bogus")  # engine validation precedes m<n branch
     with pytest.raises(ValueError, match="default blocked"):
         lstsq(A, b, use_pallas="always")
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_qr_explicit_matches_numpy_semantics(dtype):
+    """(Q, R) with orthonormal Q and Q R == A — the jnp.linalg.qr shape."""
+    rng = np.random.default_rng(41)
+    A = rng.standard_normal((60, 40))
+    if np.issubdtype(dtype, np.complexfloating):
+        A = A + 1j * rng.standard_normal((60, 40))
+    A = A.astype(dtype)
+    Q, R = dhqr_tpu.qr_explicit(jnp.asarray(A), block_size=16)
+    assert Q.shape == (60, 40) and R.shape == (40, 40)
+    np.testing.assert_allclose(np.asarray(jnp.conj(Q.T) @ Q), np.eye(40),
+                               atol=1e-13)
+    np.testing.assert_allclose(np.asarray(Q @ R), A, atol=1e-12)
+    Rn = np.asarray(R)
+    assert np.allclose(Rn, np.triu(Rn))

@@ -234,3 +234,5 @@ def test_sharded_blocked_qr_complex64():
                                 use_pallas="always")
     np.testing.assert_allclose(np.asarray(H2), np.asarray(H0), atol=1e-3,
                                rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(a2), np.asarray(a0), atol=1e-3,
+                               rtol=1e-3)
