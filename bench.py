@@ -12,11 +12,21 @@ cuSOLVER-geqrf A100 Float32 throughput; public cuSOLVER geqrf f32 numbers on
 A100 are ~8 TFLOP/s at this size, so baseline = 0.6 * 8000 = 4800 GFLOP/s
 per chip. vs_baseline = value / 4800.
 
-Supervision protocol (the axon TPU tunnel is fragile — see VERDICT.md r1):
+Supervision protocol (the axon TPU tunnel is fragile — see VERDICT.md r1/r2):
 
 * The TPU attempt runs FIRST and ONCE, in a child process with a generous
   timeout (backend init alone can take ~2 min). The child emits ``::stage``
   progress markers on stderr so a hang is attributable to an exact phase.
+* On TPU the child runs a STAGED ESCALATION — devices, tiny matmul, then
+  QR at N = 512, 2048, 4096 (then a Pallas-panel variant) — emitting a
+  complete headline-JSON line the moment each stage finishes, each line
+  superseding the last. The supervisor takes the LAST parseable line, so a
+  relay that wedges partway still yields the largest size reached ON TPU
+  instead of falling back to CPU with nothing (VERDICT r2 weak #1). Each
+  stage has its own in-child watchdog that hard-exits (a hung PJRT call
+  never returns to the eval loop; only a thread + ``os._exit`` escapes),
+  which the supervisor handles exactly like a timeout, keeping the partial
+  stdout.
 * On timeout the child gets SIGTERM and a grace period; SIGKILL only as a
   last resort, and the JSON records that it happened. (Round 1's supervisor
   SIGKILLed a mid-claim child, which wedges the relay for every subsequent
@@ -58,9 +68,13 @@ NORM = os.environ.get("DHQR_NORM", "fast")
 BASELINE_GFLOPS = 4800.0  # 60% of A100 cuSOLVER geqrf f32 (~8 TF/s), see above
 # The driver's whole-bench window is ~600 s: the TPU attempt plus the CPU
 # fallback (plus SIGTERM grace) must BOTH fit inside it, or a hung TPU
-# attempt starves the fallback and the round records nothing.
-TPU_TIMEOUT = int(os.environ.get("DHQR_BENCH_TPU_TIMEOUT", "330"))
-CPU_TIMEOUT = int(os.environ.get("DHQR_BENCH_CPU_TIMEOUT", "150"))
+# attempt starves the fallback and the round records nothing. The TPU child
+# self-watchdogs every stage (hard-exit on hang), so the external timeout
+# only binds when stages keep SUCCEEDING slowly — give the escalation room
+# to reach N=4096 on a healthy-but-slow relay; the CPU fallback is a single
+# direct measurement and fits in its smaller share.
+TPU_TIMEOUT = int(os.environ.get("DHQR_BENCH_TPU_TIMEOUT", "420"))
+CPU_TIMEOUT = int(os.environ.get("DHQR_BENCH_CPU_TIMEOUT", "120"))
 _REPO = os.path.dirname(os.path.abspath(__file__))
 
 
@@ -167,6 +181,35 @@ def _supervise() -> int:
     return 1
 
 
+class _Watchdog:
+    """os._exit(4) if a stage outlives its deadline — a hung PJRT call can't
+    be interrupted by signals (the GIL-released C call never returns to the
+    eval loop), so a timer thread + hard exit is the only way out. Partial
+    stdout survives because the supervisor captures it in a temp file. The
+    exit runs BEFORE the supervisor's own SIGTERM would, sparing the relay
+    a mid-claim external kill."""
+
+    def __init__(self, stage: str, seconds: float):
+        import threading
+
+        self._stage, self._seconds = stage, seconds
+        self._done = threading.Event()
+        self._t = threading.Thread(target=self._fire, daemon=True)
+
+    def _fire(self):
+        if not self._done.wait(self._seconds):
+            print(f"::watchdog {self._stage} exceeded {self._seconds}s",
+                  file=sys.stderr, flush=True)
+            os._exit(4)
+
+    def __enter__(self):
+        self._t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._done.set()
+
+
 def main() -> None:
     # Convert SIGTERM into a normal interpreter exit so the PJRT client's
     # destructor runs and the TPU claim is released — dying inside a
@@ -192,67 +235,120 @@ def main() -> None:
     from dhqr_tpu.utils.profiling import sync
 
     _stage("backend_init")
-    platform = jax.devices()[0].platform
-    sync(jnp.ones((8, 8)) @ jnp.ones((8, 8)))   # force full backend bring-up
+    with _Watchdog("backend_init", 150):
+        platform = jax.devices()[0].platform
+        sync(jnp.ones((8, 8)) @ jnp.ones((8, 8)))  # force full backend bring-up
     _stage(f"backend_ready_{platform}")
 
-    m = n = N
     rng = np.random.default_rng(0)
-    A = jnp.asarray(rng.random((m, n)), dtype=jnp.float32)
-    sync(A)
 
-    _stage("compile")
-    t0 = time.perf_counter()
-    compiled = _blocked_qr_impl.lower(
-        A, BLOCK, precision=PRECISION, norm=NORM
-    ).compile()
-    compile_s = time.perf_counter() - t0
+    def qr_bench(n_, pallas=False, watchdog=120, repeats=REPEATS,
+                 backward_error=False):
+        """Measure blocked QR at n_ x n_ and print a COMPLETE headline JSON
+        line for it — later (larger) stages supersede it; the supervisor
+        keeps the last parseable line (so a wedge mid-escalation still
+        records the largest size that finished)."""
+        name = f"qr_{n_}" + ("_pallas" if pallas else "")
+        _stage(name)
+        try:
+            return _qr_bench_guarded(name, n_, pallas, watchdog, repeats,
+                                     backward_error)
+        except Exception as e:  # a failed stage must not kill later stages
+            print(f"::stage_failed {name} {type(e).__name__}: {e}",
+                  file=sys.stderr, flush=True)
+            return None
 
-    _stage("warmup")
-    H, alpha = compiled(A)
-    sync(alpha)
+    def _qr_bench_guarded(name, n_, pallas, watchdog, repeats, backward_error):
+        with _Watchdog(name, watchdog):
+            A = jnp.asarray(rng.random((n_, n_)), dtype=jnp.float32)
+            sync(A)
+            t0 = time.perf_counter()
+            compiled = _blocked_qr_impl.lower(
+                A, BLOCK, precision=PRECISION, pallas=pallas, norm=NORM
+            ).compile()
+            compile_s = time.perf_counter() - t0
+            H, alpha = compiled(A)
+            sync(alpha)
+            times = []
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                H, alpha = compiled(A)
+                sync(alpha)  # alpha depends on the final panel -> QR is done
+                times.append(time.perf_counter() - t0)
+            t = min(times)
+            flops = (4.0 / 3.0) * n_**3
+            result = {
+                "metric": f"qr_gflops_per_chip_f32_{n_}x{n_}",
+                "value": round(flops / t / 1e9, 2),
+                "unit": "GFLOP/s",
+                "vs_baseline": round(flops / t / 1e9 / BASELINE_GFLOPS, 4),
+                "platform": platform,
+                "seconds": round(t, 4),
+                "compile_seconds": round(compile_s, 2),
+                "block_size": BLOCK,
+                "precision": PRECISION,
+                "norm": NORM,
+                "pallas_panels": pallas,
+            }
+            if backward_error:
+                # ||QR - A|| / ||A|| at this size (cheap at N <= 1024;
+                # square bench matrices, so R is already (n_, n_)).
+                QR = _apply_q_impl(H, r_matrix(H, alpha), BLOCK,
+                                   precision=PRECISION)
+                result[f"backward_error_{n_}"] = float(
+                    jnp.linalg.norm(QR - A) / jnp.linalg.norm(A))
+        print(json.dumps(result), flush=True)
+        return result
 
-    _stage("run")
-    times = []
-    for _ in range(REPEATS):
-        t0 = time.perf_counter()
-        H, alpha = compiled(A)
-        sync(alpha)  # alpha depends on the final panel -> whole QR is done
-        times.append(time.perf_counter() - t0)
-    t = min(times)
+    if platform != "tpu" and not os.environ.get("DHQR_BENCH_FORCE_STAGED"):
+        # CPU (scrubbed-env fallback): one direct measurement at full size —
+        # the escalation exists to survive the fragile relay, which isn't a
+        # risk here, and the supervisor's CPU window is half the TPU one.
+        r = qr_bench(N, watchdog=CPU_TIMEOUT, backward_error=False)
+        if r is None:
+            return  # stage already logged the failure; no JSON to extend
+        _stage("backward_error")
+        small = 1024
+        As = jnp.asarray(rng.random((small, small)), dtype=jnp.float32)
+        Hs, als = _blocked_qr_impl(As, BLOCK, precision=PRECISION, norm=NORM)
+        QRs = _apply_q_impl(Hs, r_matrix(Hs, als), BLOCK, precision=PRECISION)
+        r["backward_error_1024"] = float(
+            jnp.linalg.norm(QRs - As) / jnp.linalg.norm(As))
+        _stage("done")
+        print(json.dumps(r))
+        return
 
-    flops = 2.0 * m * n * n - (2.0 / 3.0) * n**3
-    gflops = flops / t / 1e9
+    # TPU: staged escalation, smallest first (VERDICT r2 next-round #1).
+    _stage("tiny_matmul")
+    with _Watchdog("tiny_matmul", 90):
+        x = jnp.ones((128, 128), dtype=jnp.float32)
+        sync(x @ x)
 
-    result = {
-        "metric": f"qr_gflops_per_chip_f32_{N}x{N}",
-        "value": round(gflops, 2),
-        "unit": "GFLOP/s",
-        "vs_baseline": round(gflops / BASELINE_GFLOPS, 4),
-        "platform": platform,
-        "seconds": round(t, 4),
-        "compile_seconds": round(compile_s, 2),
-        "block_size": BLOCK,
-        "precision": PRECISION,
-        "norm": NORM,
-    }
-    # Emit the headline number NOW — the backward-error stage below needs a
-    # second compile, and if that hangs the supervisor can still recover
-    # this line from the child's captured stdout.
-    print(json.dumps(result), flush=True)
-
-    # backward-error check ||QR - A|| / ||A|| on a smaller problem (forming
-    # Q R at bench size would dwarf the factorization itself).
-    _stage("backward_error")
-    small = 1024
-    As = jnp.asarray(rng.random((small, small)), dtype=jnp.float32)
-    Hs, als = _blocked_qr_impl(As, BLOCK, precision=PRECISION, norm=NORM)
-    QRs = _apply_q_impl(Hs, r_matrix(Hs, als), BLOCK, precision=PRECISION)
-    result["backward_error_1024"] = float(
-        jnp.linalg.norm(QRs - As) / jnp.linalg.norm(As)
-    )
+    results = [qr_bench(512, watchdog=150, backward_error=False)]
+    results.append(qr_bench(1024, watchdog=150, backward_error=True))
+    results.append(qr_bench(2048, watchdog=170))
+    results.append(qr_bench(N, watchdog=200))
+    # Pallas-kernel hardware validation (VERDICT r2 next-round #2) AFTER the
+    # headline sizes so a slow relay never starves the main number; the 1024
+    # stage records the kernel's on-hardware backward error.
+    results.append(qr_bench(1024, pallas=True, watchdog=150,
+                            backward_error=True))
+    results.append(qr_bench(N, pallas=True, watchdog=200))
+    results = [r for r in results if r is not None]
+    if not results:
+        return
+    _stage("best")
+    # Re-emit the best full-size record (XLA vs Pallas panels) so the LAST
+    # line = the headline; carry the 1024 backward errors as evidence.
+    full = [r for r in results if r["metric"].endswith(f"{N}x{N}")]
+    best = max(full or results, key=lambda r: r["value"])
+    for r in results:
+        for k, v in r.items():
+            if k.startswith("backward_error_"):
+                key = k + ("_pallas" if r.get("pallas_panels") else "")
+                best.setdefault(key, v)
     _stage("done")
-    print(json.dumps(result))
+    print(json.dumps(best))
 
 
 if __name__ == "__main__":

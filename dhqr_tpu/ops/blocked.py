@@ -229,12 +229,16 @@ def _resolve_pallas(mode: str, m: int, nb: int, dtype) -> tuple[bool, bool]:
     """Map a ``use_pallas`` config value to (enabled, interpret) for a shape.
 
     "always" forces the fused panel kernel, using the Pallas interpreter
-    off-TPU (the CPU test path); "never" disables it. "auto" currently
-    resolves to the XLA panel path: the kernel's backward error on real
-    hardware has not yet been measured against the <1e-5 target (its norm is
-    a plain f32 sum, not the compensated tree of ops/summation.py), so it
-    stays opt-in until benchmarked accurate — then "auto" flips to
-    shape-gated on-TPU use.
+    off-TPU (the CPU test path); "never" disables it. "auto" resolves to the
+    fused kernel on TPU for supported shapes (f32/c64 panels that fit VMEM)
+    — the analogue of the reference dispatching its hand-SIMD complex
+    hotloop unconditionally in the hot path (src:174-196). The kernel's
+    column norm carries the same compensated-accumulation standard as the
+    XLA engine (``pallas_panel._sumsq_compensated``), so routing is a
+    performance choice, not an accuracy trade. Off-TPU, "auto" stays on the
+    XLA path (the interpreter is a test vehicle, orders of magnitude slower).
+    ``DHQR_PALLAS_AUTO=0`` vetoes auto-routing without touching call sites
+    (an escape hatch if hardware benchmarking shows XLA panels faster).
     """
     from dhqr_tpu.ops.pallas_panel import pallas_panel_supported
 
@@ -250,7 +254,8 @@ def _resolve_pallas(mode: str, m: int, nb: int, dtype) -> tuple[bool, bool]:
             )
         return True, not on_tpu
     if mode == "auto":
-        return False, False
+        veto = _os.environ.get("DHQR_PALLAS_AUTO", "") == "0"
+        return (supported and on_tpu and not veto), False
     raise ValueError(f"use_pallas must be 'auto', 'always' or 'never', got {mode!r}")
 
 
@@ -271,7 +276,8 @@ def blocked_householder_qr(
 
     ``norm`` selects the column-norm accumulation on the XLA panel path
     (ops/summation.sumsq); panels taken by the Pallas kernel use the
-    kernel's own in-VMEM plain-sum accumulation regardless.
+    kernel's in-VMEM compensated accumulation
+    (pallas_panel._sumsq_compensated) regardless.
 
     With ``donate=True`` the input buffer is donated to XLA — the functional
     spelling of the reference's in-place ``householder!`` (src:113), halving

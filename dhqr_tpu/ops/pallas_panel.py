@@ -23,11 +23,12 @@ vectorize along the m-length lane axis. Per column j:
 
 with row masks ``i >= j`` and row masks ``jj > j`` replacing the ragged
 ranges. The reflector formulas match :func:`dhqr_tpu.ops.householder`
-(alpha sign rule src:8-9, ``f = 1/sqrt(s(s+|a_jj|))`` src:131), but the
-column norm is a plain f32 sum of squares, NOT the compensated tree of
-``ops/summation.py`` — rounding differs from the XLA engine by a few ulps
-per column, which is why the kernel stays opt-in (``use_pallas="always"``)
-until its backward error is validated on hardware.
+(alpha sign rule src:8-9, ``f = 1/sqrt(s(s+|a_jj|))`` src:131), and the
+column norm uses the same compensated-accumulation standard as the XLA
+engine's tree (``ops/summation.py``), spelled in Mosaic-legal vector ops:
+Dekker TwoProduct makes each square exact (``x*x = p + e`` with no FMA
+required, via a Veltkamp split), and a contiguous-halving TwoSum tree
+compensates the additions of the ``p`` plane (:func:`_sumsq_compensated`).
 
 Float32 and complex64. Mosaic has no complex dtype, so the complex64
 kernel runs PLANAR arithmetic — separate real/imaginary (nb, m) f32 planes,
@@ -79,6 +80,51 @@ def pallas_panel_supported(m: int, nb: int, dtype) -> bool:
     return planes * (2 * m * nb * 4 + 4 * m * 4) <= _VMEM_PANEL_BUDGET
 
 
+def _sumsq_compensated(x):
+    """Compensated sum of squares of a (1, w) f32 row — scalar f32 result.
+
+    In-VMEM counterpart of ``ops/summation.tree_sum`` over ``x*x``, built
+    from Mosaic-legal vector ops only (no strided slices, no reshapes):
+
+    * Dekker TwoProduct via a Veltkamp split (f32 constant ``2^12 + 1``)
+      makes each square exact: ``x*x == p + e`` in rounded f32 arithmetic,
+      no FMA required (overflow-safe for ``|x| < ~8e34``);
+    * the ``p`` plane is zero-padded on the lane axis to the next power of
+      two (zeros are exact under TwoSum; the pad is one (1, w) row, ~16 KB
+      at worst — noise next to the panel), so the halving tree below slices
+      ONLY at power-of-two offsets >= 128, i.e. always lane-tile-aligned,
+      for every panel height the blocked engine produces;
+    * a contiguous-halving TwoSum tree then compensates the additions of
+      the ``p`` plane down to a 128-wide slab, the per-level error folded
+      into a scalar side channel (error terms are tiny; a plain reduce of
+      them is fine — same reasoning as summation.py's ``err`` channel);
+    * the final 128-wide slab goes through the hardware lane-tree reduce,
+      whose few levels contribute ~1 ulp.
+    """
+    p = x * x
+    c = x * 4097.0
+    hi = c - (c - x)
+    lo = x - hi
+    e = ((hi * hi - p) + 2.0 * hi * lo) + lo * lo
+    err = jnp.sum(e)
+    w = p.shape[1]
+    if w >= 256:
+        w2 = 1 << (w - 1).bit_length()  # next power of two
+        if w2 != w:
+            p = jnp.pad(p, ((0, 0), (0, w2 - w)))
+            w = w2
+        while w > 128:
+            h = w // 2
+            a = p[:, :h]
+            b = p[:, h:]
+            s = a + b
+            z = s - a
+            err = err + jnp.sum((a - (s - z)) + (b - z))  # Knuth TwoSum error
+            p = s
+            w = h
+    return jnp.sum(p) + err
+
+
 def _panel_kernel(off_ref, at_ref, out_ref, alpha_ref, *, nb: int, m: int):
     """Factor the transposed panel At (nb, m) IN PLACE; alpha out is (nb, 1).
 
@@ -104,7 +150,7 @@ def _panel_kernel(off_ref, at_ref, out_ref, alpha_ref, *, nb: int, m: int):
         row = out_ref[pl.dslice(jloc, 1), :]  # (1, m)
         rmask = lane >= j
         rowm = jnp.where(rmask, row, 0.0)
-        s = jnp.sqrt(jnp.sum(rowm * rowm))
+        s = jnp.sqrt(_sumsq_compensated(rowm))
         a_jj = jnp.sum(jnp.where(lane == j, row, 0.0))
         alpha_j = jnp.where(a_jj >= 0, -s, s)  # s * alphafactor(a_jj) (src:8-9)
         denom = s * (s + jnp.abs(a_jj))
@@ -164,7 +210,7 @@ def _panel_kernel_c64(off_ref, ar_ref, ai_ref, or_ref, oi_ref,
         rmask = lane >= j
         rowmr = jnp.where(rmask, rowr, 0.0)
         rowmi = jnp.where(rmask, rowi, 0.0)
-        s = jnp.sqrt(jnp.sum(rowmr * rowmr + rowmi * rowmi))
+        s = jnp.sqrt(_sumsq_compensated(rowmr) + _sumsq_compensated(rowmi))
         ar_jj = jnp.sum(jnp.where(lane == j, rowr, 0.0))
         ai_jj = jnp.sum(jnp.where(lane == j, rowi, 0.0))
         mag = jnp.sqrt(ar_jj * ar_jj + ai_jj * ai_jj)

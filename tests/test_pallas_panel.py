@@ -82,6 +82,39 @@ def test_supported_predicate():
     assert not pallas_panel_supported(2**20, 128, jnp.float32)  # VMEM blowout
 
 
+def test_auto_routing(monkeypatch):
+    """"auto" = fused kernel on TPU for supported shapes (the reference
+    dispatches its SIMD hotloop unconditionally, src:174-176); XLA path
+    off-TPU; DHQR_PALLAS_AUTO=0 vetoes."""
+    import jax
+
+    from dhqr_tpu.ops import blocked
+
+    # Off-TPU (this test host): auto stays on the XLA path.
+    assert blocked._resolve_pallas("auto", 1024, 128, jnp.float32) == (False, False)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert blocked._resolve_pallas("auto", 1024, 128, jnp.float32) == (True, False)
+    assert blocked._resolve_pallas("auto", 1024, 128, jnp.complex64) == (True, False)
+    # Unsupported dtype/shape falls back rather than erroring (unlike "always").
+    assert blocked._resolve_pallas("auto", 1024, 128, jnp.float64) == (False, False)
+    monkeypatch.setenv("DHQR_PALLAS_AUTO", "0")
+    assert blocked._resolve_pallas("auto", 1024, 128, jnp.float32) == (False, False)
+
+
+@pytest.mark.parametrize("m", [4096, 3967, 767])
+def test_compensated_sumsq_adversarial(m):
+    """In-kernel norm accumulation matches f64 ground truth to ~1 ulp on a
+    12-decade dynamic-range column (the engine's summation.py standard).
+    Non-power-of-two / odd heights exercise the pad-to-pow2 halving tree —
+    the widths the blocked engine actually produces (m - k per panel)."""
+    rng = np.random.default_rng(3)
+    x = (rng.standard_normal((m, 8)) *
+         np.logspace(-6, 6, m)[:, None]).astype(np.float32)
+    pf, al = panel_qr_pallas(jnp.asarray(x), interpret=True)
+    s64 = np.linalg.norm(x[:, 0].astype(np.float64))
+    assert abs(abs(float(al[0])) - s64) / s64 < 5e-7  # few-ulp f32
+
+
 def test_blocked_qr_with_pallas_panels():
     """End-to-end blocked QR with fused panels passes the 8x criterion."""
     A, b = random_problem(220, 200, np.float32, seed=5)
