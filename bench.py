@@ -343,7 +343,7 @@ def main() -> None:
     full = [r for r in results if r["metric"].endswith(f"{N}x{N}")]
     best = max(full or results, key=lambda r: r["value"])
     for r in results:
-        for k, v in r.items():
+        for k, v in list(r.items()):  # list(): best may be r; setdefault mutates
             if k.startswith("backward_error_"):
                 key = k + ("_pallas" if r.get("pallas_panels") else "")
                 best.setdefault(key, v)

@@ -161,12 +161,14 @@ def qr(
                 "re-placed onto the mesh, so donation cannot honor its contract)"
             )
         from dhqr_tpu.parallel import sharded_qr as _sharded
-        from dhqr_tpu.parallel.layout import fit_block_size
+        from dhqr_tpu.parallel.layout import plan_padding
         from dhqr_tpu.parallel.mesh import DEFAULT_AXIS
 
         col_axis = cfg.mesh_axis or DEFAULT_AXIS
-        nloc = A.shape[1] // mesh.shape[col_axis]
-        nb = fit_block_size(nloc, cfg.block_size)
+        # Same planning the engines do internally (arbitrary n is padded and
+        # sliced back there) — recomputed here so the factorization object
+        # records the panel width the solve stage will reuse.
+        nb, _ = plan_padding(A.shape[1], mesh.shape[col_axis], cfg.block_size)
         if cfg.blocked:
             H, alpha = _sharded.sharded_blocked_qr(
                 A, mesh, block_size=nb, axis_name=col_axis,
@@ -389,20 +391,28 @@ def lstsq(
     if cfg.engine != "householder":
         return _lstsq_alt_engine(A, b, cfg, mesh)
     if mesh is not None:
-        from dhqr_tpu.parallel.layout import fit_block_size
+        from dhqr_tpu.parallel.layout import plan_padding
         from dhqr_tpu.parallel.mesh import DEFAULT_AXIS
-        from dhqr_tpu.parallel.sharded_qr import sharded_householder_qr
+        from dhqr_tpu.parallel.sharded_qr import (
+            _pad_cols_orthogonal,
+            sharded_householder_qr,
+        )
         from dhqr_tpu.parallel.sharded_solve import sharded_lstsq, sharded_solve
 
         col_axis = cfg.mesh_axis or DEFAULT_AXIS
-        nloc = A.shape[1] // mesh.shape[col_axis]
-        nb = fit_block_size(nloc, cfg.block_size)
         if not cfg.blocked:
             if cfg.use_pallas != "auto":
                 raise ValueError(
                     "use_pallas applies to the blocked engines only "
                     f"(got use_pallas={cfg.use_pallas!r} with blocked=False)"
                 )
+            m, n = A.shape
+            nb, n_pad = plan_padding(n, mesh.shape[col_axis], cfg.block_size)
+            if n_pad != n:
+                # Pad once so the factor->solve store-layout chaining holds
+                # (see sharded_lstsq for the blocked twin of this dance).
+                A = _pad_cols_orthogonal(A, n_pad)
+                b = jnp.pad(b, [(0, n_pad - n)] + [(0, 0)] * (b.ndim - 1))
             # store_nb=nb + store-layout chaining: factor and solve share one
             # storage order, avoiding cross-device column permutes in between.
             H, alpha = sharded_householder_qr(
@@ -410,15 +420,17 @@ def lstsq(
                 layout=cfg.layout, store_nb=nb, _store_layout_output=True,
                 norm=cfg.norm,
             )
-            return sharded_solve(
+            x = sharded_solve(
                 H, alpha, b, mesh,
                 block_size=nb, axis_name=col_axis, precision=cfg.precision,
                 layout=cfg.layout, _H_in_store_layout=True,
             )
+            return x[:n]
         return sharded_lstsq(
             A, b, mesh,
-            block_size=nb, axis_name=col_axis, precision=cfg.precision,
-            layout=cfg.layout, norm=cfg.norm, use_pallas=cfg.use_pallas,
+            block_size=cfg.block_size, axis_name=col_axis,
+            precision=cfg.precision, layout=cfg.layout, norm=cfg.norm,
+            use_pallas=cfg.use_pallas,
         )
     return _lstsq_impl(
         A, b, cfg.block_size, cfg.blocked, cfg.precision, cfg.use_pallas,

@@ -142,7 +142,7 @@ def _panel_kernel(off_ref, at_ref, out_ref, alpha_ref, *, nb: int, m: int):
     off = off_ref[0]
     out_ref[:, :] = at_ref[:, :]  # no-op when aliased
 
-    def step(jloc, _):
+    def step(jloc, acc):
         from jax.experimental import pallas as pl
 
         j = off + jloc  # diagonal row of this reflector
@@ -171,10 +171,13 @@ def _panel_kernel(off_ref, at_ref, out_ref, alpha_ref, *, nb: int, m: int):
         # reflector overwrites row jloc (the old column content).
         out_ref[:, :] = at - W * v
         out_ref[pl.dslice(jloc, 1), :] = jnp.where(rmask, v, row)
-        alpha_ref[jloc, 0] = alpha_j
-        return 0
+        # Mosaic forbids scalar stores to VMEM — alpha rides the loop carry
+        # as an (nb, 1) vector select and is stored once after the sweep.
+        return jnp.where(row_ids == jloc, alpha_j, acc)
 
-    lax.fori_loop(0, nb, step, 0)
+    alpha_ref[:, :] = lax.fori_loop(
+        0, nb, step, jnp.zeros((nb, 1), jnp.float32)
+    )
 
 
 def _panel_kernel_c64(off_ref, ar_ref, ai_ref, or_ref, oi_ref,
@@ -201,7 +204,8 @@ def _panel_kernel_c64(off_ref, ar_ref, ai_ref, or_ref, oi_ref,
             precision=jax.lax.Precision.HIGHEST,
         )
 
-    def step(jloc, _):
+    def step(jloc, acc):
+        accr, acci = acc
         j = off + jloc
         atr = or_ref[:, :]
         ati = oi_ref[:, :]
@@ -236,11 +240,13 @@ def _panel_kernel_c64(off_ref, ar_ref, ai_ref, or_ref, oi_ref,
         oi_ref[:, :] = ati - (Wr * vi + Wi * vr)
         or_ref[pl.dslice(jloc, 1), :] = jnp.where(rmask, vr, rowr)
         oi_ref[pl.dslice(jloc, 1), :] = jnp.where(rmask, vi, rowi)
-        alr_ref[jloc, 0] = alr
-        ali_ref[jloc, 0] = ali
-        return 0
+        # Scalar VMEM stores are illegal in Mosaic — alpha planes ride the
+        # loop carry as (nb, 1) vector selects, stored once after the sweep.
+        return (jnp.where(row_ids == jloc, alr, accr),
+                jnp.where(row_ids == jloc, ali, acci))
 
-    lax.fori_loop(0, nb, step, 0)
+    zero = jnp.zeros((nb, 1), jnp.float32)
+    alr_ref[:, :], ali_ref[:, :] = lax.fori_loop(0, nb, step, (zero, zero))
 
 
 @partial(jax.jit, static_argnames=("interpret",))

@@ -296,6 +296,41 @@ def _build_blocked(
     )
 
 
+def _pad_cols_orthogonal(A, n_pad: int):
+    """Extend A (m, n) to (m + k, n_pad), k = n_pad - n, as [[A, 0], [0, I_k]].
+
+    The padded columns live entirely in the padded rows, so they are exactly
+    orthogonal to the originals, and the padded factorization contains the
+    true one as its leading [:m, :n] sub-block, exactly in exact arithmetic
+    (numerically to ~ulp — padding changes reduction-tree shapes only):
+
+    * a right-looking QR's result for column j depends only on columns <= j,
+      so the leading n columns' reflectors and alpha are untouched;
+    * the original reflectors vanish on the padded rows (their columns are
+      zero there), so Q's leading n columns vanish there too, making the
+      R coupling block R[:n, n:] = Q[:, :n]^H A_pad[:, n:] exactly zero —
+      back-substitution of the padded R never mixes padded entries into
+      x[:n];
+    * the padded columns' reflectors vanish on the original rows, so
+      slicing [:m, :n] loses nothing.
+
+    This is the TPU-native answer to arbitrary problem shapes, replacing
+    the reference's *uneven* worker blocks (``columnblocks`` src:18-19;
+    sqrt-split, test/runtests.jl:36-38): XLA shardings are even by
+    construction, so the matrix is padded to the layout's divisibility and
+    the results sliced back (VERDICT r2 next-round #3).
+    """
+    m, n = A.shape
+    k = n_pad - n
+    if k == 0:
+        return A
+    top = jnp.concatenate([A, jnp.zeros((m, k), A.dtype)], axis=1)
+    bot = jnp.concatenate(
+        [jnp.zeros((k, n), A.dtype), jnp.eye(k, dtype=A.dtype)], axis=1
+    )
+    return jnp.concatenate([top, bot], axis=0)
+
+
 def _to_store_layout(A, n, nproc, nb, layout):
     """Permute natural columns into the layout's storage order (no-op for block)."""
     if layout == "block":
@@ -340,10 +375,37 @@ def sharded_householder_qr(
     """
     m, n = A.shape
     nproc = mesh.shape[axis_name]
-    _check_divisibility(m, n, nproc, None, layout)
     if layout == "block":
         store_nb = 1  # unused by the block layout; normalize the cache key
-    elif (n // nproc) % store_nb != 0:
+    # Arbitrary n: pad to the layout's divisibility (multiple of store_nb *
+    # nproc covers both constraints below), factor, slice back — exact, see
+    # :func:`_pad_cols_orthogonal`.
+    step = store_nb * nproc
+    n_pad = -(-n // step) * step
+    if n_pad != n:
+        if _store_layout_output:
+            raise ValueError(
+                f"internal store-layout chaining requires n divisible by "
+                f"{step}, got n={n}: pad the input before chaining"
+            )
+        H, alpha = sharded_householder_qr(
+            _pad_cols_orthogonal(A, n_pad), mesh, axis_name=axis_name,
+            precision=precision, layout=layout, store_nb=store_nb, norm=norm,
+        )
+        return H[:m, :n], alpha[:n]
+    if n > 512:
+        # After the padding dispatch, so awkward n warns exactly once.
+        import warnings
+
+        warnings.warn(
+            f"unblocked sharded engine runs one m-vector collective per "
+            f"column (n={n}): this is the reference-faithful slow tier (its "
+            "author's own 'this is most expensive', src:141) — use the "
+            "blocked compact-WY engine (blocked=True, the default) at scale",
+            stacklevel=2,
+        )
+    _check_divisibility(m, n, nproc, None, layout)
+    if layout != "block" and (n // nproc) % store_nb != 0:
         raise ValueError(
             f"store_nb={store_nb} must divide the local width {n // nproc}"
         )
@@ -378,7 +440,23 @@ def sharded_blocked_qr(
     """
     m, n = A.shape
     nproc = mesh.shape[axis_name]
-    nb = min(int(block_size), n // nproc)
+    from dhqr_tpu.parallel.layout import plan_padding
+
+    nb, n_pad = plan_padding(n, nproc, block_size)
+    if n_pad != n:
+        # Arbitrary n: pad to nb*P divisibility, factor, slice back — exact,
+        # see :func:`_pad_cols_orthogonal`.
+        if _store_layout_output:
+            raise ValueError(
+                f"internal store-layout chaining requires n divisible by "
+                f"nb*P = {nb * nproc}, got n={n}: pad the input before chaining"
+            )
+        H, alpha = sharded_blocked_qr(
+            _pad_cols_orthogonal(A, n_pad), mesh, block_size=nb,
+            axis_name=axis_name, precision=precision, layout=layout,
+            norm=norm, use_pallas=use_pallas,
+        )
+        return H[:m, :n], alpha[:n]
     _check_divisibility(m, n, nproc, nb, layout)
     from dhqr_tpu.ops.blocked import _resolve_pallas
 

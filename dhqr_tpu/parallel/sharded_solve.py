@@ -242,6 +242,7 @@ def sharded_solve(
     it already sits in the layout's storage order (the ``sharded_lstsq``
     fast path); x is always returned in natural order.
     """
+    from dhqr_tpu.parallel.layout import plan_padding
     from dhqr_tpu.parallel.sharded_qr import (
         _check_divisibility,
         _to_store_layout,
@@ -249,7 +250,32 @@ def sharded_solve(
 
     m, n = H.shape
     nproc = mesh.shape[axis_name]
-    nb = min(int(block_size), n // nproc)
+    nb, n_pad = plan_padding(n, nproc, block_size)
+    if n_pad != n:
+        # Arbitrary n: pad H with zero columns (v = 0 is the identity
+        # reflector under the compact-WY unit-diagonal solve) and alpha with
+        # ones (unit R diagonal). The padded R has zero coupling into the
+        # leading rows, so x[:n] is exact; zero rows are appended if the
+        # padded width exceeds m (reflectors and R ignore zero rows).
+        if _H_in_store_layout:
+            raise ValueError(
+                f"internal store-layout chaining requires n divisible by "
+                f"nb*P = {nb * nproc}, got n={n}: pad the input before chaining"
+            )
+        k = n_pad - n
+        H = jnp.concatenate([H, jnp.zeros((m, k), H.dtype)], axis=1)
+        alpha = jnp.concatenate([alpha, jnp.ones((k,), alpha.dtype)])
+        if m < n_pad:
+            H = jnp.concatenate(
+                [H, jnp.zeros((n_pad - m, n_pad), H.dtype)], axis=0
+            )
+            pad_b = [(0, n_pad - m)] + [(0, 0)] * (b.ndim - 1)
+            b = jnp.pad(b, pad_b)
+        x = sharded_solve(
+            H, alpha, b, mesh, block_size=nb, axis_name=axis_name,
+            precision=precision, layout=layout,
+        )
+        return x[:n]
     _check_divisibility(m, n, nproc, nb, layout)
     if not _H_in_store_layout:
         H = _to_store_layout(H, n, nproc, nb, layout)
@@ -275,16 +301,31 @@ def sharded_lstsq(
     The distributed equivalent of ``qr!(A) \\ b`` (reference runtests.jl:77-78).
     With ``layout="cyclic"`` the factorization stays in storage order between
     the factor and solve stages — no cross-device column permute in between.
+    Arbitrary n is padded ONCE here (the orthogonal extension, see
+    ``sharded_qr._pad_cols_orthogonal``) so the store-layout chaining between
+    the stages stays intact; x is sliced back to n.
     """
-    from dhqr_tpu.parallel.sharded_qr import sharded_blocked_qr
+    from dhqr_tpu.parallel.layout import plan_padding
+    from dhqr_tpu.parallel.sharded_qr import (
+        _pad_cols_orthogonal,
+        sharded_blocked_qr,
+    )
 
+    m, n = A.shape
+    nproc = mesh.shape[axis_name]
+    nb, n_pad = plan_padding(n, nproc, block_size)
+    if n_pad != n:
+        A = _pad_cols_orthogonal(A, n_pad)
+        pad_b = [(0, n_pad - n)] + [(0, 0)] * (b.ndim - 1)
+        b = jnp.pad(b, pad_b)  # zero rows for the appended identity rows
     H, alpha = sharded_blocked_qr(
-        A, mesh, block_size=block_size, axis_name=axis_name, precision=precision,
+        A, mesh, block_size=nb, axis_name=axis_name, precision=precision,
         layout=layout, _store_layout_output=True, norm=norm,
         use_pallas=use_pallas,
     )
-    return sharded_solve(
+    x = sharded_solve(
         H, alpha, b, mesh,
-        block_size=block_size, axis_name=axis_name, precision=precision,
+        block_size=nb, axis_name=axis_name, precision=precision,
         layout=layout, _H_in_store_layout=True,
     )
+    return x[:n]

@@ -1,0 +1,193 @@
+"""Arbitrary problem shapes on the mesh via internal padding (VERDICT r2 #3).
+
+The reference accepts ANY n with np workers through *uneven* column blocks
+(``columnblocks``, reference src/DistributedHouseholderQR.jl:18-19; the
+sqrt-split, test/runtests.jl:36-38). XLA shardings are even by construction,
+so the TPU framework pads instead: the orthogonal extension
+``[[A, 0], [0, I]]`` (``sharded_qr._pad_cols_orthogonal``) whose padded
+factorization contains the true one bit-for-bit in its leading block, and a
+zero-reflector/unit-diagonal extension on the solve side. These tests pin
+both the exactness claim and the public-API behavior for awkward n.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import dhqr_tpu
+from dhqr_tpu.models.qr_model import qr, qr_explicit
+from dhqr_tpu.ops.blocked import blocked_householder_qr
+from dhqr_tpu.parallel.layout import plan_padding
+from dhqr_tpu.parallel.mesh import column_mesh
+from dhqr_tpu.parallel.sharded_qr import (
+    _pad_cols_orthogonal,
+    sharded_blocked_qr,
+    sharded_householder_qr,
+)
+from dhqr_tpu.parallel.sharded_solve import sharded_lstsq, sharded_solve
+from dhqr_tpu.utils.testing import (
+    TOLERANCE_FACTOR,
+    normal_equations_residual,
+    oracle_residual,
+    random_problem,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return column_mesh(8)
+
+
+# ---------------------------------------------------------------- planner --
+def test_plan_padding_invariants():
+    for n in (1, 7, 100, 250, 999, 1000, 1001, 4096):
+        for P in (1, 2, 8):
+            for req in (1, 32, 128):
+                nb, n_pad = plan_padding(n, P, req)
+                assert n_pad >= n
+                assert n_pad % (nb * P) == 0
+                assert 1 <= nb <= max(req, 1)
+
+
+def test_plan_padding_divisible_needs_none():
+    # When a padding-free option exists, the planner finds it.
+    nb, n_pad = plan_padding(1024, 8, 128)
+    assert (nb, n_pad) == (128, 1024)
+    nb, n_pad = plan_padding(1000, 8, 128)
+    assert n_pad == 1000 and 1000 % (nb * 8) == 0
+
+
+def test_plan_padding_minimal_for_awkward_n():
+    # n=1001 on 8 devices: theoretical minimum is 1008 = ceil(1001/8)*8.
+    nb, n_pad = plan_padding(1001, 8, 128)
+    assert n_pad == 1008 and 1008 % (nb * 8) == 0
+
+
+# ----------------------------------------------------- exactness of padding --
+def test_padded_factorization_contains_true_one():
+    """Leading [:m, :n] of the padded factorization == factoring A alone —
+    exactly in exact arithmetic (the right-looking column-dependency
+    argument); numerically to ~1 ulp scale, since padding changes XLA
+    reduction-tree shapes (extra zero terms re-associate the same sums)."""
+    A, _ = random_problem(70, 50, np.float64, seed=7)
+    H0, a0 = blocked_householder_qr(jnp.asarray(A), block_size=8)
+    Ap = _pad_cols_orthogonal(jnp.asarray(A), 64)
+    H1, a1 = blocked_householder_qr(Ap, block_size=8)
+    np.testing.assert_allclose(np.asarray(H1)[:70, :50], np.asarray(H0),
+                               rtol=1e-13, atol=1e-14)
+    np.testing.assert_allclose(np.asarray(a1)[:50], np.asarray(a0),
+                               rtol=1e-13, atol=1e-14)
+
+
+# ------------------------------------------------------------- public paths --
+@pytest.mark.parametrize("layout", ["block", "cyclic"])
+@pytest.mark.parametrize("n", [100, 250])
+def test_lstsq_mesh_awkward_n(mesh8, layout, n):
+    """The VERDICT done-criterion: lstsq(A, b, mesh=mesh8) for n not
+    divisible by P (nor nb*P)."""
+    m = n + n // 10
+    A, b = random_problem(m, n, np.float64, seed=11 + n)
+    x = dhqr_tpu.lstsq(jnp.asarray(A), jnp.asarray(b), mesh=mesh8,
+                       layout=layout, block_size=16)
+    assert x.shape == (n,)
+    res = normal_equations_residual(A, np.asarray(x), b)
+    assert res < TOLERANCE_FACTOR * oracle_residual(A, b)
+
+
+def test_lstsq_mesh_awkward_n_multirhs(mesh8):
+    A, b = random_problem(110, 100, np.float64, seed=3)
+    B = np.stack([b, 2.0 * b], axis=1)
+    X = dhqr_tpu.lstsq(jnp.asarray(A), jnp.asarray(B), mesh=mesh8,
+                       block_size=16)
+    assert X.shape == (100, 2)
+    for j in range(2):
+        res = normal_equations_residual(A, np.asarray(X[:, j]), B[:, j])
+        assert res < TOLERANCE_FACTOR * oracle_residual(A, B[:, j])
+
+
+def test_lstsq_mesh_awkward_n_unblocked(mesh8):
+    A, b = random_problem(60, 52, np.float64, seed=5)
+    x = dhqr_tpu.lstsq(jnp.asarray(A), jnp.asarray(b), mesh=mesh8,
+                       blocked=False, block_size=8)
+    assert x.shape == (52,)
+    res = normal_equations_residual(A, np.asarray(x), b)
+    assert res < TOLERANCE_FACTOR * oracle_residual(A, b)
+
+
+def test_lstsq_mesh_square_awkward_needs_row_padding(mesh8):
+    """Square awkward n: the padded width exceeds m, so rows are extended
+    too (the [[A,0],[0,I]] extension keeps the system equivalent)."""
+    n = 101
+    A, b = random_problem(n, n, np.float64, seed=13)
+    x = dhqr_tpu.lstsq(jnp.asarray(A), jnp.asarray(b), mesh=mesh8,
+                       block_size=16)
+    assert x.shape == (n,)
+    x_ref = np.linalg.solve(A, b)
+    np.testing.assert_allclose(np.asarray(x), x_ref, rtol=1e-8, atol=1e-10)
+
+
+@pytest.mark.parametrize("layout", ["block", "cyclic"])
+def test_sharded_blocked_qr_awkward_n_matches_serial(mesh8, layout):
+    A, _ = random_problem(90, 60, np.float64, seed=23)
+    H0, a0 = blocked_householder_qr(jnp.asarray(A), block_size=8)
+    H1, a1 = sharded_blocked_qr(jnp.asarray(A), mesh8, block_size=8,
+                                layout=layout)
+    assert H1.shape == (90, 60) and a1.shape == (60,)
+    np.testing.assert_allclose(np.asarray(H1), np.asarray(H0),
+                               rtol=1e-9, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(a0),
+                               rtol=1e-9, atol=1e-12)
+
+
+def test_sharded_unblocked_qr_awkward_n_matches_serial(mesh8):
+    from dhqr_tpu.ops.householder import householder_qr
+
+    A, _ = random_problem(40, 30, np.float64, seed=29)
+    H0, a0 = householder_qr(jnp.asarray(A))
+    H1, a1 = sharded_householder_qr(jnp.asarray(A), mesh8)
+    assert H1.shape == (40, 30)
+    np.testing.assert_allclose(np.asarray(H1), np.asarray(H0),
+                               rtol=1e-9, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(a0),
+                               rtol=1e-9, atol=1e-12)
+
+
+def test_sharded_solve_awkward_n_zero_column_padding(mesh8):
+    """Direct sharded_solve on an (m, n) packed factorization with awkward
+    n: zero reflector columns + unit alpha diagonal, exact x[:n]."""
+    from dhqr_tpu.ops.solve import apply_qt, back_substitute
+
+    A, b = random_problem(66, 52, np.float64, seed=37)
+    H, alpha = blocked_householder_qr(jnp.asarray(A), block_size=8)
+    x1 = sharded_solve(H, alpha, jnp.asarray(b), mesh8, block_size=8)
+    c = apply_qt(H, alpha, jnp.asarray(b))
+    x0 = back_substitute(H, alpha, c)
+    assert x1.shape == (52,)
+    np.testing.assert_allclose(np.asarray(x1), np.asarray(x0),
+                               rtol=1e-9, atol=1e-12)
+
+
+def test_qr_mesh_awkward_n_object_roundtrip(mesh8):
+    """qr(A, mesh=...) with awkward n: natural-order (m, n) factors, and the
+    factorization object solves and materializes correctly."""
+    m, n = 77, 60
+    A, b = random_problem(m, n, np.float64, seed=41)
+    fact = qr(jnp.asarray(A), mesh=mesh8, block_size=16)
+    assert fact.H.shape == (m, n) and fact.alpha.shape == (n,)
+    x = fact.solve(jnp.asarray(b))
+    assert x.shape == (n,)
+    res = normal_equations_residual(A, np.asarray(x), b)
+    assert res < TOLERANCE_FACTOR * oracle_residual(A, b)
+    Q, R = qr_explicit(jnp.asarray(A), mesh=mesh8, block_size=16)
+    np.testing.assert_allclose(np.asarray(Q @ R), A, rtol=1e-9, atol=1e-10)
+    np.testing.assert_allclose(
+        np.asarray(Q.conj().T @ Q), np.eye(n), rtol=1e-9, atol=1e-10
+    )
+
+
+def test_unblocked_mesh_slow_tier_warns(mesh8):
+    """VERDICT r2 #7: the unblocked engine on a mesh at scale warns that the
+    blocked tier is the intended one."""
+    A, _ = random_problem(640, 600, np.float64, seed=43)
+    with pytest.warns(UserWarning, match="most expensive"):
+        sharded_householder_qr(jnp.asarray(A), mesh8)

@@ -90,7 +90,9 @@ def test_auto_routing(monkeypatch):
 
     from dhqr_tpu.ops import blocked
 
-    # Off-TPU (this test host): auto stays on the XLA path.
+    # Off-TPU: auto stays on the XLA path (pin the backend — the suite runs
+    # CPU via conftest, but don't depend on the host).
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
     assert blocked._resolve_pallas("auto", 1024, 128, jnp.float32) == (False, False)
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     assert blocked._resolve_pallas("auto", 1024, 128, jnp.float32) == (True, False)

@@ -153,10 +153,14 @@ def test_mesh_donate_rejected():
         dhqr_tpu.qr(jnp.ones((16, 8)), mesh=column_mesh(2), donate=True)
 
 
-def test_indivisible_n_rejected():
+def test_indivisible_n_padded_not_rejected():
+    """Arbitrary n is padded internally (VERDICT r2 #3), not rejected —
+    the reference's uneven-block capability (src:18-19), TPU-style.
+    Exactness is covered in tests/test_padding.py."""
     mesh = column_mesh(8)
-    with pytest.raises(ValueError):
-        sharded_blocked_qr(jnp.ones((20, 10)), mesh)
+    A = jnp.asarray(random_problem(20, 10, np.float64, seed=50)[0])
+    H, alpha = sharded_blocked_qr(A, mesh)
+    assert H.shape == (20, 10) and alpha.shape == (10,)
 
 
 def test_sharded_f32():
