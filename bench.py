@@ -41,6 +41,14 @@ Timing note: device completion is detected with a scalar host readback, NOT
 and ``block_until_ready`` returns before the computation finishes, which
 would measure dispatch latency only.
 
+Second timing note (round 3): the tunnel's dispatch+readback round trip is
+~60-90 ms — larger than the device time of a 4096^2 QR — so a single
+dispatch measures the RELAY, not the chip (round-2's 966 GFLOP/s headline
+was RTT-bound). On TPU each stage therefore times a ``lax.scan`` chain of k
+dependent factorizations (H_i feeds the next iteration) in ONE dispatch:
+device seconds = (t_chain(k) - t_single) / (k - 1). Both raw numbers are
+recorded in the JSON for transparency.
+
 The reference publishes no absolute numbers (BASELINE.md) — its benchmark
 harness prints runtime ratios vs LAPACK at test time without recording them
 (reference test/runtests.jl:84-89).
@@ -243,28 +251,36 @@ def main() -> None:
     rng = np.random.default_rng(0)
 
     def qr_bench(n_, pallas=False, watchdog=120, repeats=REPEATS,
-                 backward_error=False):
+                 backward_error=False, chain=0, nb=None, panel="loop"):
         """Measure blocked QR at n_ x n_ and print a COMPLETE headline JSON
         line for it — later (larger) stages supersede it; the supervisor
         keeps the last parseable line (so a wedge mid-escalation still
-        records the largest size that finished)."""
-        name = f"qr_{n_}" + ("_pallas" if pallas else "")
+        records the largest size that finished). ``chain=k`` times a k-long
+        in-jit scan of dependent factorizations to cancel the tunnel RTT
+        (see module docstring); 0 = single-dispatch timing (CPU fallback)."""
+        name = f"qr_{n_}" + ("_pallas" if pallas else "") + \
+            (f"_nb{nb}" if nb else "") + \
+            ("_recursive" if panel == "recursive" else "")
         _stage(name)
         try:
             return _qr_bench_guarded(name, n_, pallas, watchdog, repeats,
-                                     backward_error)
+                                     backward_error, chain, nb or BLOCK, panel)
         except Exception as e:  # a failed stage must not kill later stages
             print(f"::stage_failed {name} {type(e).__name__}: {e}",
                   file=sys.stderr, flush=True)
             return None
 
-    def _qr_bench_guarded(name, n_, pallas, watchdog, repeats, backward_error):
+    def _qr_bench_guarded(name, n_, pallas, watchdog, repeats, backward_error,
+                          chain, nb, panel):
+        from jax import lax
+
         with _Watchdog(name, watchdog):
             A = jnp.asarray(rng.random((n_, n_)), dtype=jnp.float32)
             sync(A)
             t0 = time.perf_counter()
             compiled = _blocked_qr_impl.lower(
-                A, BLOCK, precision=PRECISION, pallas=pallas, norm=NORM
+                A, nb, precision=PRECISION, pallas=pallas, norm=NORM,
+                panel_impl=panel,
             ).compile()
             compile_s = time.perf_counter() - t0
             H, alpha = compiled(A)
@@ -275,7 +291,42 @@ def main() -> None:
                 H, alpha = compiled(A)
                 sync(alpha)  # alpha depends on the final panel -> QR is done
                 times.append(time.perf_counter() - t0)
-            t = min(times)
+            t_single = min(times)
+            t = t_single
+            t_chain = None
+            chain_unreliable = False
+            if chain and chain > 1:
+                def chained(A):
+                    def body(C, _):
+                        Hc, ac = _blocked_qr_impl(
+                            C, nb, precision=PRECISION, pallas=pallas,
+                            norm=NORM, panel_impl=panel)
+                        return Hc, ac[0]
+                    Hc, s = lax.scan(body, A, None, length=chain)
+                    return Hc, s
+                t0 = time.perf_counter()
+                cchain = jax.jit(chained).lower(A).compile()
+                compile_s += time.perf_counter() - t0
+                Hc, s = cchain(A)
+                sync(s)
+                times = []
+                for _ in range(repeats):
+                    t0 = time.perf_counter()
+                    Hc, s = cchain(A)
+                    sync(s)
+                    times.append(time.perf_counter() - t0)
+                t_chain = min(times)
+                # k dependent QRs in one dispatch: per-iteration device time
+                # with the RTT (present once in both measurements) cancelled.
+                # Noise guard: RTT jitter can exceed the device work at small
+                # N — a delta that isn't meaningfully positive would divide
+                # into an absurd headline, so fall back to the (RTT-bound,
+                # conservative) single-dispatch time and say so.
+                delta = (t_chain - t_single) / (chain - 1)
+                if t_chain > t_single * 1.05 and delta > 0:
+                    t = delta
+                else:
+                    chain_unreliable = True
             flops = (4.0 / 3.0) * n_**3
             result = {
                 "metric": f"qr_gflops_per_chip_f32_{n_}x{n_}",
@@ -284,16 +335,23 @@ def main() -> None:
                 "vs_baseline": round(flops / t / 1e9 / BASELINE_GFLOPS, 4),
                 "platform": platform,
                 "seconds": round(t, 4),
+                "seconds_single_dispatch": round(t_single, 4),
                 "compile_seconds": round(compile_s, 2),
-                "block_size": BLOCK,
+                "block_size": nb,
                 "precision": PRECISION,
                 "norm": NORM,
                 "pallas_panels": pallas,
+                "panel_impl": panel,
             }
+            if t_chain is not None:
+                result["seconds_chain"] = round(t_chain, 4)
+                result["chain_length"] = chain
+                if chain_unreliable:
+                    result["chain_unreliable"] = True
             if backward_error:
                 # ||QR - A|| / ||A|| at this size (cheap at N <= 1024;
                 # square bench matrices, so R is already (n_, n_)).
-                QR = _apply_q_impl(H, r_matrix(H, alpha), BLOCK,
+                QR = _apply_q_impl(H, r_matrix(H, alpha), nb,
                                    precision=PRECISION)
                 result[f"backward_error_{n_}"] = float(
                     jnp.linalg.norm(QR - A) / jnp.linalg.norm(A))
@@ -324,16 +382,23 @@ def main() -> None:
         x = jnp.ones((128, 128), dtype=jnp.float32)
         sync(x @ x)
 
-    results = [qr_bench(512, watchdog=150, backward_error=False)]
-    results.append(qr_bench(1024, watchdog=150, backward_error=True))
-    results.append(qr_bench(2048, watchdog=170))
-    results.append(qr_bench(N, watchdog=200))
+    results = [qr_bench(512, watchdog=150, chain=9, backward_error=False)]
+    results.append(qr_bench(1024, watchdog=150, chain=5, backward_error=True))
+    results.append(qr_bench(2048, watchdog=170, chain=5))
+    results.append(qr_bench(N, watchdog=240, chain=3))
+    # nb=256 halves the panel count; round-3 tuning showed it ahead of 128
+    # at 4096 — bench both, the best-record pass keeps the winner.
+    results.append(qr_bench(N, watchdog=240, chain=3, nb=256))
+    # Recursive (geqrt3) panel interior: panel work as compact-WY GEMMs —
+    # candidate to displace the loop panel at large nb.
+    results.append(qr_bench(N, watchdog=240, chain=3, nb=256,
+                            panel="recursive"))
     # Pallas-kernel hardware validation (VERDICT r2 next-round #2) AFTER the
     # headline sizes so a slow relay never starves the main number; the 1024
     # stage records the kernel's on-hardware backward error.
-    results.append(qr_bench(1024, pallas=True, watchdog=150,
+    results.append(qr_bench(1024, pallas=True, watchdog=150, chain=5,
                             backward_error=True))
-    results.append(qr_bench(N, pallas=True, watchdog=200))
+    results.append(qr_bench(N, pallas=True, watchdog=240, chain=3))
     results = [r for r in results if r is not None]
     if not results:
         return

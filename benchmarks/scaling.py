@@ -48,6 +48,11 @@ def main(argv=None) -> None:
     args = parser.parse_args(argv)
 
     import jax
+
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        # Decisive CPU override — env vars lose to sitecustomize-pinned
+        # remote TPU plugins (see tests/conftest.py).
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np
 

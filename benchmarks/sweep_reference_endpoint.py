@@ -1,0 +1,123 @@
+"""Reference-endpoint sweep artifact: 4400x4000, Float64 + ComplexF128.
+
+The reference's integration sweep tops out at m x n = 4400 x 4000 (m = 1.1 n)
+over {Float64, ComplexF64} (reference test/runtests.jl:42-43), checked with
+the 8x normal-equations criterion (runtests.jl:62,81) and timed against
+LAPACK (runtests.jl:84-89). This script reproduces that endpoint on the
+distributed tier — 8-device mesh (virtual CPU mesh off-TPU, the reference's
+local fake cluster) — asserts the same criterion, and writes the result to
+``benchmarks/results/sweep_4400x4000.json`` so the numbers are an artifact,
+not prose (VERDICT r2 next-round #6). ``pytest -m slow
+tests/test_reference_endpoint.py`` runs the same sweep through pytest.
+
+Usage:  python benchmarks/sweep_reference_endpoint.py [--devices 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_sweep(n_devices: int = 8, sizes=((4400, 4000),),
+              dtypes=("float64", "complex128")) -> dict:
+    """Run the endpoint sweep; returns the artifact dict (asserts 8x)."""
+    sys.path.insert(0, _REPO)
+    import jax
+
+    # The host may pin a remote TPU platform via a sitecustomize hook that
+    # wins over env vars; jax.config.update is the reliable override
+    # (tests/conftest.py has the full story).
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    if jax.default_backend() != "tpu":
+        jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    import numpy as np
+
+    import dhqr_tpu
+    from dhqr_tpu.parallel.mesh import column_mesh
+    from dhqr_tpu.utils.profiling import sync
+    from dhqr_tpu.utils.testing import (
+        TOLERANCE_FACTOR,
+        lapack_lstsq,
+        normal_equations_residual,
+        oracle_residual,
+        random_problem,
+    )
+
+    ndev = min(n_devices, len(jax.devices()))
+    mesh = column_mesh(ndev)
+    artifact = {
+        "sweep": "reference endpoint (test/runtests.jl:42-43)",
+        "platform": jax.default_backend(),
+        "mesh_devices": ndev,
+        "criterion": "normal-equations residual < 8x LAPACK (runtests.jl:62,81)",
+        "cases": [],
+    }
+    for m, n in sizes:
+        for dtype_name in dtypes:
+            dtype = np.dtype(dtype_name)
+            A, b = random_problem(m, n, dtype, seed=0)
+            Aj, bj = jnp.asarray(A), jnp.asarray(b)
+            # warm = compile; the reference has no compile stage to time
+            t0 = time.perf_counter()
+            x = dhqr_tpu.lstsq(Aj, bj, mesh=mesh)
+            sync(x)
+            t_cold = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            x = dhqr_tpu.lstsq(Aj, bj, mesh=mesh)
+            sync(x)
+            t_warm = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            lapack_lstsq(A, b)
+            t_lapack = time.perf_counter() - t0
+            res = normal_equations_residual(A, np.asarray(x), b)
+            ref = oracle_residual(A, b)
+            ok = bool(res < TOLERANCE_FACTOR * ref)
+            case = {
+                "m": m, "n": n, "dtype": dtype_name,
+                "residual": res, "lapack_residual": ref,
+                "tolerance": TOLERANCE_FACTOR * ref, "pass": ok,
+                "seconds_warm": round(t_warm, 3),
+                "seconds_cold_incl_compile": round(t_cold, 3),
+                "lapack_seconds": round(t_lapack, 3),
+                "slowdown_vs_lapack_warm": round(t_warm / max(t_lapack, 1e-9), 2),
+            }
+            artifact["cases"].append(case)
+            print(json.dumps(case), flush=True)
+            assert ok, f"8x criterion FAILED for {m}x{n} {dtype_name}"
+    return artifact
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--devices", type=int, default=8)
+    parser.add_argument(
+        "--out", default=os.path.join(_REPO, "benchmarks", "results",
+                                      "sweep_4400x4000.json"))
+    args = parser.parse_args(argv)
+
+    if "tpu" not in os.environ.get("JAX_PLATFORMS", "").lower():
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={args.devices}"
+            ).strip()
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    artifact = run_sweep(args.devices)
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=2)
+    print(f"# artifact written to {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -49,6 +49,14 @@ def run(n_devices: int) -> None:
         assert bool(jnp.all(jnp.isfinite(x))), f"non-finite x ({layout})"
         print(f"dryrun: sharded_lstsq layout={layout} ok", flush=True)
 
+    # Awkward n (not divisible by the mesh): the internal orthogonal-
+    # extension padding must compile and run on the mesh too.
+    n_awk = n - 3
+    x = sharded_lstsq(A[:, :n_awk], b, cmesh, block_size=block_size)
+    assert x.shape == (n_awk,)
+    assert bool(jnp.all(jnp.isfinite(x))), "non-finite x (awkward n)"
+    print(f"dryrun: sharded_lstsq awkward n={n_awk} ok", flush=True)
+
     # TSQR wants a genuinely tall problem: local row blocks must stay tall
     nt = 8
     mt = 2 * nt * n_devices

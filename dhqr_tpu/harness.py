@@ -70,8 +70,9 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     # Decide the platform before first backend use: a real TPU if one is
-    # visible, else a virtual CPU mesh of the requested size.
-    if "tpu" not in os.environ.get("JAX_PLATFORMS", "").lower():
+    # explicitly requested, else a virtual CPU mesh of the requested size.
+    force_cpu = "tpu" not in os.environ.get("JAX_PLATFORMS", "").lower()
+    if force_cpu:
         flags = os.environ.get("XLA_FLAGS", "")
         if "host_platform_device_count" not in flags:
             os.environ["XLA_FLAGS"] = (
@@ -81,6 +82,25 @@ def main(argv=None) -> int:
     import jax
     import jax.numpy as jnp
     import numpy as np
+
+    if force_cpu:
+        # Env vars are not enough on hosts whose sitecustomize pins a remote
+        # TPU plugin (a wedged relay then hangs the first backend touch);
+        # jax.config.update is the decisive override (see tests/conftest.py).
+        jax.config.update("jax_platforms", "cpu")
+
+    # Persistent compile cache — shard_map programs dominate harness
+    # wall-clock on first runs; warm runs skip them (same dir as the test
+    # suite and bench.py, keyed by backend+flags).
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), ".jax_cache"),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
 
     if jax.default_backend() == "cpu":
         jax.config.update("jax_enable_x64", True)
@@ -113,11 +133,9 @@ def main(argv=None) -> int:
             print(f"# skip {dtype_name} on TPU (f64/c128 are emulated)")
             continue
         for m, n in _parse_sizes(args.sizes):
-            # pad n so every device gets an equal block (mesh constraint);
-            # row engines need m divisible (and local blocks tall) instead
-            if mesh is not None and args.engine == "householder" and n % ndev:
-                n += ndev - n % ndev
-                m = max(m, n)
+            # The householder mesh engines pad arbitrary n internally
+            # (parallel/sharded_qr._pad_cols_orthogonal) — sizes run as
+            # given. Row engines still need m divisible (local blocks tall).
             if mesh is not None and args.engine != "householder" and m % ndev:
                 m += ndev - m % ndev
             size_mesh = mesh

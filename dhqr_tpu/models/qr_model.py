@@ -154,6 +154,11 @@ def qr(
             "the factorization object stores packed reflectors; the "
             "tsqr/cholqr engines are lstsq-only fast paths"
         )
+    if cfg.panel_impl != "loop" and (mesh is not None or not cfg.blocked):
+        raise ValueError(
+            f"panel_impl={cfg.panel_impl!r} is supported on the "
+            "single-device blocked path only (mesh=None, blocked=True)"
+        )
     if mesh is not None:
         if donate:
             raise ValueError(
@@ -193,6 +198,7 @@ def qr(
         H, alpha = _blocked.blocked_householder_qr(
             A, cfg.block_size, donate=donate, precision=cfg.precision,
             use_pallas=cfg.use_pallas, norm=cfg.norm,
+            panel_impl=cfg.panel_impl,
         )
     else:
         if donate:
@@ -369,6 +375,12 @@ def lstsq(
     if cfg.norm not in ("accurate", "fast"):
         raise ValueError(
             f"norm must be 'accurate' or 'fast', got {cfg.norm!r}"
+        )
+    if cfg.panel_impl != "loop":
+        raise ValueError(
+            f"panel_impl={cfg.panel_impl!r} is a qr()/factor-time knob; "
+            "lstsq runs the loop panel (factor with qr(panel_impl=...) and "
+            "solve on the factorization instead)"
         )
     if cfg.engine not in LSTSQ_ENGINES:
         raise ValueError(

@@ -111,8 +111,24 @@ def _panels_schedule(n: int, nb: int) -> tuple[int, int, int]:
     return num_full, rem, ppo
 
 
+def _panel_factor(panel, offset, precision, norm, panel_impl):
+    """Panel-interior engine selector: "loop" = the masked fori_loop
+    (reference-shaped numerics, one GEMV + rank-1 per column); "recursive" =
+    geqrt3-style divide and conquer (panel interior on the MXU, see
+    ops/householder._panel_qr_recursive)."""
+    from dhqr_tpu.ops.householder import _panel_qr_masked, _panel_qr_recursive
+
+    if panel_impl == "recursive":
+        return _panel_qr_recursive(panel, offset, precision=precision,
+                                   norm=norm)
+    if panel_impl == "loop":
+        return _panel_qr_masked(panel, offset, precision=precision, norm=norm)
+    raise ValueError(
+        f"panel_impl must be 'loop' or 'recursive', got {panel_impl!r}")
+
+
 def _scan_panels(S, pcount, nb, precision, pallas, pallas_interpret,
-                 norm="accurate"):
+                 norm="accurate", panel_impl="loop"):
     """Factor ``pcount`` uniform nb-wide panels of super-block S by scan.
 
     S is the (ms, ns) trailing submatrix whose top-left element is the
@@ -133,8 +149,7 @@ def _scan_panels(S, pcount, nb, precision, pallas, pallas_interpret,
                 panel, c, interpret=pallas_interpret
             )
         else:
-            pf, alpha_k = _panel_qr_masked(panel, c, precision=precision,
-                                           norm=norm)
+            pf, alpha_k = _panel_factor(panel, c, precision, norm, panel_impl)
         S = lax.dynamic_update_slice(S, pf, (jnp.int32(0), c))
         with jax.named_scope("trailing_update"):
             Y = shifted_tril(pf, c)
@@ -149,11 +164,12 @@ def _scan_panels(S, pcount, nb, precision, pallas, pallas_interpret,
 
 @partial(
     jax.jit,
-    static_argnames=("block_size", "precision", "pallas", "pallas_interpret", "norm"),
+    static_argnames=("block_size", "precision", "pallas", "pallas_interpret",
+                     "norm", "panel_impl"),
 )
 def _blocked_qr_impl(
     A, block_size, precision=DEFAULT_PRECISION, pallas=False,
-    pallas_interpret=False, norm="accurate",
+    pallas_interpret=False, norm="accurate", panel_impl="loop",
 ):
     from dhqr_tpu.ops.pallas_panel import _panel_qr_pallas_impl, pallas_panel_supported
 
@@ -176,9 +192,8 @@ def _blocked_qr_impl(
                         panel, 0, interpret=pallas_interpret
                     )
                 else:
-                    pf, alpha_k = _householder_qr_impl(
-                        panel, precision=precision, norm=norm
-                    )
+                    pf, alpha_k = _panel_factor(panel, 0, precision, norm,
+                                                panel_impl)
                 H = H.at[k:, k : k + b].set(pf)
                 alpha = alpha.at[k : k + b].set(alpha_k)
             if k + b < n:
@@ -203,15 +218,16 @@ def _blocked_qr_impl(
         S = lax.slice(H, (K, K), (m, n))
         blk_pallas = pallas and pallas_panel_supported(m - K, nb, A.dtype)
         S, alpha_blk = _scan_panels(
-            S, pcount, nb, precision, blk_pallas, pallas_interpret, norm=norm
+            S, pcount, nb, precision, blk_pallas, pallas_interpret, norm=norm,
+            panel_impl=panel_impl,
         )
         H = H.at[K:, K:].set(S)
         alpha = alpha.at[K : K + pcount * nb].set(alpha_blk)
     if rem:
         K = num_full * nb
         with jax.named_scope("panel_factor"):
-            pf, alpha_k = _householder_qr_impl(
-                lax.slice(H, (K, K), (m, n)), precision=precision, norm=norm
+            pf, alpha_k = _panel_factor(
+                lax.slice(H, (K, K), (m, n)), 0, precision, norm, panel_impl
             )
         H = H.at[K:, K:].set(pf)
         alpha = alpha.at[K:].set(alpha_k)
@@ -223,6 +239,29 @@ _blocked_qr_impl_donate = partial(
     static_argnames=("block_size", "precision", "pallas", "pallas_interpret", "norm"),
     donate_argnums=(0,),
 )(_blocked_qr_impl.__wrapped__)
+
+
+from functools import lru_cache as _lru_cache
+
+
+@_lru_cache(maxsize=None)
+def _pallas_lowers_on_this_backend(dtype_name: str) -> bool:
+    """One-time probe: does the fused panel kernel actually COMPILE here?
+
+    Interpret-mode tests cannot catch Mosaic lowering rejections (round 3
+    found one on real hardware that every CPU test had passed), so "auto"
+    verifies lowering once per process with a tiny panel before routing any
+    real work through the kernel; on failure auto degrades to the XLA path
+    instead of crashing the caller. "always" still raises, by design.
+    """
+    try:
+        from dhqr_tpu.ops.pallas_panel import _panel_qr_pallas_impl
+
+        probe = jnp.zeros((128, 8), dtype=jnp.dtype(dtype_name))
+        _panel_qr_pallas_impl.lower(probe, 0, interpret=False).compile()
+        return True
+    except Exception:
+        return False
 
 
 def _resolve_pallas(mode: str, m: int, nb: int, dtype) -> tuple[bool, bool]:
@@ -255,7 +294,11 @@ def _resolve_pallas(mode: str, m: int, nb: int, dtype) -> tuple[bool, bool]:
         return True, not on_tpu
     if mode == "auto":
         veto = _os.environ.get("DHQR_PALLAS_AUTO", "") == "0"
-        return (supported and on_tpu and not veto), False
+        enabled = supported and on_tpu and not veto
+        if enabled and not _pallas_lowers_on_this_backend(
+                jnp.dtype(dtype).name):
+            enabled = False  # Mosaic rejected the kernel here — XLA path
+        return enabled, False
     raise ValueError(f"use_pallas must be 'auto', 'always' or 'never', got {mode!r}")
 
 
@@ -266,6 +309,7 @@ def blocked_householder_qr(
     precision: str = DEFAULT_PRECISION,
     use_pallas: str = "auto",
     norm: str = "accurate",
+    panel_impl: str = "loop",
 ):
     """Factor ``A`` (m x n, m >= n): returns ``(H, alpha)`` in packed storage.
 
@@ -292,7 +336,7 @@ def blocked_householder_qr(
     pallas, interpret = _resolve_pallas(use_pallas, m, min(nb, n), A.dtype)
     impl = _blocked_qr_impl_donate if donate else _blocked_qr_impl
     return impl(A, nb, precision=precision, pallas=pallas,
-                pallas_interpret=interpret, norm=norm)
+                pallas_interpret=interpret, norm=norm, panel_impl=panel_impl)
 
 
 @partial(jax.jit, static_argnames=("block_size", "precision"))

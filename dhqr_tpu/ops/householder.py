@@ -133,6 +133,41 @@ def _panel_qr_masked(panel, offset, precision=DEFAULT_PRECISION,
     return lax.fori_loop(0, nb, step, (panel, alpha))
 
 
+RECURSIVE_BASE_WIDTH = 32
+
+
+def _panel_qr_recursive(panel, offset, precision=DEFAULT_PRECISION,
+                        norm="accurate", base=RECURSIVE_BASE_WIDTH):
+    """Divide-and-conquer panel QR (the LAPACK geqrt3 recursion, TPU-style).
+
+    Left half by recursion; the left reflectors applied to the right half as
+    ONE compact-WY transform (two GEMMs + a small triangular solve — MXU
+    work); right half by recursion at row offset ``offset + h``. Identical
+    packed output and reflector numerics to :func:`_panel_qr_masked`; what
+    changes is the *shape* of the trailing work inside the panel — per-column
+    GEMV + rank-1 pairs survive only below ``base`` width, everything above
+    becomes GEMMs. The reference's equivalent region is its per-column
+    broadcast + hotloop chain (src:141-143, 198-213), which is memory-bound
+    by construction; this is the panel-interior analogue of SURVEY.md §7
+    stage 3. ``offset`` may be traced (the blocked engine's scan path).
+    """
+    m, b = panel.shape
+    if b <= base:
+        return _panel_qr_masked(panel, offset, precision=precision, norm=norm)
+    from dhqr_tpu.ops.blocked import apply_block_reflector_h, shifted_tril
+
+    h = b // 2
+    left = lax.slice_in_dim(panel, 0, h, axis=1)
+    right = lax.slice_in_dim(panel, h, b, axis=1)
+    left_f, alpha_l = _panel_qr_recursive(left, offset, precision, norm, base)
+    Y = shifted_tril(left_f, offset)
+    right = apply_block_reflector_h(Y, right, precision)
+    right_f, alpha_r = _panel_qr_recursive(right, offset + h, precision, norm,
+                                           base)
+    return (jnp.concatenate([left_f, right_f], axis=1),
+            jnp.concatenate([alpha_l, alpha_r]))
+
+
 @partial(jax.jit, static_argnames=("precision", "norm"))
 def _householder_qr_impl(A, precision=DEFAULT_PRECISION, norm="accurate"):
     return _panel_qr_masked(A, 0, precision=precision, norm=norm)

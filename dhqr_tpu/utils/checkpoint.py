@@ -58,12 +58,18 @@ def load_factorization(path: str | os.PathLike, mesh=None, axis_name: str = "col
         # QRFactorization's default.
         layout = str(z["layout"]) if "layout" in z.files else "block"
     if mesh is not None:
-        from dhqr_tpu.parallel.layout import fit_block_size
+        from dhqr_tpu.parallel.layout import plan_padding
         from dhqr_tpu.parallel.mesh import column_sharding, replicated_sharding
 
-        H = jax.device_put(H, column_sharding(mesh, axis_name))
+        nproc = mesh.shape[axis_name]
+        # Same planning the solve engines do (arbitrary n is padded there);
+        # the recorded block_size is re-planned so object and engines agree.
+        block_size, n_pad = plan_padding(H.shape[1], nproc, block_size)
+        if n_pad == H.shape[1]:
+            H = jax.device_put(H, column_sharding(mesh, axis_name))
+        # Awkward n cannot shard evenly as-is — leave H on the default
+        # placement; sharded_solve pads and re-places it per call.
         alpha = jax.device_put(alpha, replicated_sharding(mesh))
-        block_size = fit_block_size(H.shape[1] // mesh.shape[axis_name], block_size)
     return QRFactorization(
         H, alpha, block_size=block_size, mesh=mesh, precision=precision,
         layout=layout,

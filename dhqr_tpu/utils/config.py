@@ -27,10 +27,11 @@ class DHQRConfig:
       blocked: use the compact-WY engine (True) or the unblocked
         reference-parity engine (False).
       use_pallas: panel-factorization kernel choice — "always" forces the
-        fused Pallas VMEM kernel (float32, panel must fit VMEM; runs the
-        interpreter off-TPU), "never" the XLA path. "auto" currently also
-        resolves to the XLA path until the kernel's backward error is
-        validated on hardware (see ops/blocked._resolve_pallas).
+        fused Pallas VMEM kernel (float32/complex64, panel must fit VMEM;
+        runs the interpreter off-TPU), "never" the XLA path. "auto" routes
+        supported panels through the kernel on TPU after a one-time probe
+        confirms it lowers there (Mosaic rejections degrade to XLA instead
+        of crashing; see ops/blocked._resolve_pallas).
       layout: distributed column layout — "block" (contiguous blocks, the
         reference's DArray layout, runtests.jl:71) or "cyclic" (round-robin
         nb-wide blocks; the load-balanced layout standing in for the
@@ -51,6 +52,12 @@ class DHQRConfig:
         (communication-avoiding row-parallel tree for m >> n), "cholqr2" /
         "cholqr3" (all-GEMM Cholesky passes; cholqr3 is the shifted
         wide-window form — see ops/cholqr.py for conditioning windows).
+      panel_impl: panel-interior algorithm on the XLA path — "loop" (one
+        masked GEMV + rank-1 per column, the reference-shaped numerics) or
+        "recursive" (geqrt3-style divide and conquer: the panel interior
+        becomes compact-WY GEMMs above a small base width — see
+        ops/householder._panel_qr_recursive). Ignored where the Pallas
+        kernel takes the panel.
     """
 
     block_size: int = 128
@@ -61,6 +68,7 @@ class DHQRConfig:
     layout: str = "block"
     engine: str = "householder"
     norm: str = "accurate"
+    panel_impl: str = "loop"
 
     @staticmethod
     def from_env(**overrides) -> "DHQRConfig":
@@ -84,5 +92,7 @@ class DHQRConfig:
             env["engine"] = os.environ["DHQR_ENGINE"]
         if "DHQR_NORM" in os.environ:
             env["norm"] = os.environ["DHQR_NORM"]
+        if "DHQR_PANEL_IMPL" in os.environ:
+            env["panel_impl"] = os.environ["DHQR_PANEL_IMPL"]
         env.update(overrides)
         return DHQRConfig(**env)

@@ -75,3 +75,19 @@ def test_reload_onto_mesh_resumes_distributed(tmp_path):
     assert re.mesh is mesh
     x1 = np.asarray(re.solve(jnp.asarray(b)))
     np.testing.assert_allclose(x1, x0, rtol=1e-10, atol=1e-12)
+
+
+def test_reload_awkward_n_onto_mesh(tmp_path):
+    """Round-3 regression: an awkward-n factorization (padded internally at
+    factor time, natural (m, n) in the checkpoint) must reload onto a mesh —
+    H stays on default placement (sharded_solve pads and places per call)."""
+    A, b = random_problem(70, 60, np.float64, seed=13)
+    mesh = column_mesh(8)
+    fact = qr(jnp.asarray(A), mesh=mesh, block_size=16)
+    x0 = np.asarray(fact.solve(jnp.asarray(b)))
+    path = tmp_path / "fact_awkward.npz"
+    save_factorization(path, fact)
+    re = load_factorization(path, mesh=mesh)
+    assert re.mesh is mesh and re.H.shape == (70, 60)
+    x1 = np.asarray(re.solve(jnp.asarray(b)))
+    np.testing.assert_allclose(x1, x0, rtol=1e-10, atol=1e-12)

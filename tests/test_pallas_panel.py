@@ -95,12 +95,40 @@ def test_auto_routing(monkeypatch):
     monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
     assert blocked._resolve_pallas("auto", 1024, 128, jnp.float32) == (False, False)
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    # The lowering probe would compile for real on actual TPU; pin it here
+    # (the degrade-on-failure half is tested separately below).
+    monkeypatch.setattr(blocked, "_pallas_lowers_on_this_backend",
+                        lambda dt: True)
     assert blocked._resolve_pallas("auto", 1024, 128, jnp.float32) == (True, False)
     assert blocked._resolve_pallas("auto", 1024, 128, jnp.complex64) == (True, False)
     # Unsupported dtype/shape falls back rather than erroring (unlike "always").
     assert blocked._resolve_pallas("auto", 1024, 128, jnp.float64) == (False, False)
     monkeypatch.setenv("DHQR_PALLAS_AUTO", "0")
     assert blocked._resolve_pallas("auto", 1024, 128, jnp.float32) == (False, False)
+
+
+def test_auto_degrades_when_lowering_fails(monkeypatch):
+    """Mosaic rejecting the kernel (seen on round-3 hardware) must degrade
+    "auto" to the XLA path, not crash the caller; "always" still raises
+    upstream of this check by design."""
+    import jax
+
+    from dhqr_tpu.ops import blocked
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(blocked, "_pallas_lowers_on_this_backend",
+                        lambda dt: False)
+    assert blocked._resolve_pallas("auto", 1024, 128, jnp.float32) == (False, False)
+
+
+def test_lowering_probe_is_honest_on_cpu():
+    """The probe itself: on the CPU backend, non-interpret pallas_call does
+    not lower — the cached probe must report False (and not raise)."""
+    from dhqr_tpu.ops import blocked
+
+    blocked._pallas_lowers_on_this_backend.cache_clear()
+    assert blocked._pallas_lowers_on_this_backend("float32") is False
+    blocked._pallas_lowers_on_this_backend.cache_clear()
 
 
 @pytest.mark.parametrize("m", [4096, 3967, 767])

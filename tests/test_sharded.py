@@ -153,6 +153,27 @@ def test_mesh_donate_rejected():
         dhqr_tpu.qr(jnp.ones((16, 8)), mesh=column_mesh(2), donate=True)
 
 
+@pytest.mark.parametrize("layout", ["block", "cyclic"])
+def test_distributed_q_materialization(mesh, layout):
+    """VERDICT r2 #5: qr_explicit(mesh=...) / q_columns() on a sharded
+    factorization — orthonormality and QR ≈ A on the device mesh, both
+    layouts (Q formed by the blocked apply over the sharded H via GSPMD)."""
+    import dhqr_tpu
+
+    m, n = 96, 64
+    A, _ = random_problem(m, n, np.float64, seed=51)
+    fact = dhqr_tpu.qr(jnp.asarray(A), mesh=mesh, block_size=8, layout=layout)
+    Q = np.asarray(fact.q_columns())
+    R = np.asarray(fact.r_matrix())
+    assert Q.shape == (m, n) and R.shape == (n, n)
+    np.testing.assert_allclose(Q @ R, A, rtol=1e-9, atol=1e-10)
+    np.testing.assert_allclose(Q.conj().T @ Q, np.eye(n), rtol=1e-9, atol=1e-10)
+    Q2, R2 = dhqr_tpu.qr_explicit(jnp.asarray(A), mesh=mesh, block_size=8,
+                                  layout=layout)
+    np.testing.assert_allclose(np.asarray(Q2), Q, rtol=1e-12, atol=1e-13)
+    np.testing.assert_allclose(np.asarray(R2), R, rtol=1e-12, atol=1e-13)
+
+
 def test_indivisible_n_padded_not_rejected():
     """Arbitrary n is padded internally (VERDICT r2 #3), not rejected —
     the reference's uneven-block capability (src:18-19), TPU-style.
