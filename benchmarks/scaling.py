@@ -49,10 +49,15 @@ def main(argv=None) -> None:
 
     import jax
 
-    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
-        # Decisive CPU override — env vars lose to sitecustomize-pinned
-        # remote TPU plugins (see tests/conftest.py).
-        jax.config.update("jax_platforms", "cpu")
+    from dhqr_tpu.utils.platform import (
+        cpu_requested,
+        enable_compile_cache,
+        force_cpu_platform,
+    )
+
+    if cpu_requested():
+        force_cpu_platform()
+    enable_compile_cache()
     import jax.numpy as jnp
     import numpy as np
 

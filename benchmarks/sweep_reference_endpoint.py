@@ -30,11 +30,15 @@ def run_sweep(n_devices: int = 8, sizes=((4400, 4000),),
     sys.path.insert(0, _REPO)
     import jax
 
-    # The host may pin a remote TPU platform via a sitecustomize hook that
-    # wins over env vars; jax.config.update is the reliable override
-    # (tests/conftest.py has the full story).
-    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
-        jax.config.update("jax_platforms", "cpu")
+    from dhqr_tpu.utils.platform import (
+        cpu_requested,
+        enable_compile_cache,
+        force_cpu_platform,
+    )
+
+    if cpu_requested():
+        force_cpu_platform()
+    enable_compile_cache()
     if jax.default_backend() != "tpu":
         jax.config.update("jax_enable_x64", True)
     import jax.numpy as jnp

@@ -59,6 +59,10 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--block-size", type=int, default=128)
     parser.add_argument(
+        "--panel-impl", default="loop", choices=["loop", "recursive"],
+        help="panel-interior algorithm for the blocked householder engines",
+    )
+    parser.add_argument(
         "--profile-dir", default=None,
         help="write a jax.profiler trace here (the @profilehtml analogue)",
     )
@@ -83,24 +87,11 @@ def main(argv=None) -> int:
     import jax.numpy as jnp
     import numpy as np
 
-    if force_cpu:
-        # Env vars are not enough on hosts whose sitecustomize pins a remote
-        # TPU plugin (a wedged relay then hangs the first backend touch);
-        # jax.config.update is the decisive override (see tests/conftest.py).
-        jax.config.update("jax_platforms", "cpu")
+    from dhqr_tpu.utils.platform import enable_compile_cache, force_cpu_platform
 
-    # Persistent compile cache — shard_map programs dominate harness
-    # wall-clock on first runs; warm runs skip them (same dir as the test
-    # suite and bench.py, keyed by backend+flags).
-    try:
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.path.join(os.path.dirname(os.path.dirname(
-                os.path.abspath(__file__))), ".jax_cache"),
-        )
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    except Exception:
-        pass
+    if force_cpu:
+        force_cpu_platform()
+    enable_compile_cache()
 
     if jax.default_backend() == "cpu":
         jax.config.update("jax_enable_x64", True)
@@ -119,7 +110,8 @@ def main(argv=None) -> int:
     ndev = min(args.n_devices, len(jax.devices()))
     mesh = column_mesh(ndev) if ndev > 1 else None
     row_engine = args.engine != "householder"
-    lkw = {} if row_engine else {"layout": args.layout}
+    lkw = {} if row_engine else {"layout": args.layout,
+                                 "panel_impl": args.panel_impl}
     print(f"# devices: {len(jax.devices())} ({jax.default_backend()}), "
           f"mesh size: {ndev}, engine: {args.engine}"
           + ("" if row_engine else f", layout: {args.layout}"))

@@ -34,6 +34,19 @@ from dhqr_tpu.utils.config import DHQRConfig
 LSTSQ_ENGINES = ("householder", "tsqr", "cholqr2", "cholqr3")
 
 
+def _check_panel_impl(cfg: DHQRConfig) -> None:
+    """Shared panel_impl validation for qr() and lstsq()."""
+    if cfg.panel_impl not in ("loop", "recursive"):
+        raise ValueError(
+            f"panel_impl must be 'loop' or 'recursive', got {cfg.panel_impl!r}"
+        )
+    if cfg.panel_impl != "loop" and not cfg.blocked:
+        raise ValueError(
+            "panel_impl applies to the blocked engines only "
+            f"(got panel_impl={cfg.panel_impl!r} with blocked=False)"
+        )
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class QRFactorization:
@@ -154,11 +167,7 @@ def qr(
             "the factorization object stores packed reflectors; the "
             "tsqr/cholqr engines are lstsq-only fast paths"
         )
-    if cfg.panel_impl != "loop" and (mesh is not None or not cfg.blocked):
-        raise ValueError(
-            f"panel_impl={cfg.panel_impl!r} is supported on the "
-            "single-device blocked path only (mesh=None, blocked=True)"
-        )
+    _check_panel_impl(cfg)
     if mesh is not None:
         if donate:
             raise ValueError(
@@ -178,7 +187,7 @@ def qr(
             H, alpha = _sharded.sharded_blocked_qr(
                 A, mesh, block_size=nb, axis_name=col_axis,
                 precision=cfg.precision, layout=cfg.layout, norm=cfg.norm,
-                use_pallas=cfg.use_pallas,
+                use_pallas=cfg.use_pallas, panel_impl=cfg.panel_impl,
             )
         else:
             if cfg.use_pallas != "auto":
@@ -310,9 +319,9 @@ def _lstsq_alt_engine(A, b, cfg: DHQRConfig, mesh):
 
 
 @partial(jax.jit, static_argnames=(
-    "block_size", "blocked", "precision", "use_pallas", "norm"))
+    "block_size", "blocked", "precision", "use_pallas", "norm", "panel_impl"))
 def _lstsq_impl(A, b, block_size, blocked, precision, use_pallas,
-                norm="accurate"):
+                norm="accurate", panel_impl="loop"):
     if blocked:
         from dhqr_tpu.ops.differentiable import lstsq_diff
 
@@ -321,7 +330,8 @@ def _lstsq_impl(A, b, block_size, blocked, precision, use_pallas,
         )
         # custom-JVP core: identical forward, closed-form O(1)-memory
         # gradients — jax.grad works through the public lstsq
-        return lstsq_diff(A, b, block_size, precision, pallas, interp, norm)
+        return lstsq_diff(A, b, block_size, precision, pallas, interp, norm,
+                          panel_impl)
     if use_pallas != "auto":
         raise ValueError(
             "use_pallas applies to the blocked engines only "
@@ -376,12 +386,7 @@ def lstsq(
         raise ValueError(
             f"norm must be 'accurate' or 'fast', got {cfg.norm!r}"
         )
-    if cfg.panel_impl != "loop":
-        raise ValueError(
-            f"panel_impl={cfg.panel_impl!r} is a qr()/factor-time knob; "
-            "lstsq runs the loop panel (factor with qr(panel_impl=...) and "
-            "solve on the factorization instead)"
-        )
+    _check_panel_impl(cfg)
     if cfg.engine not in LSTSQ_ENGINES:
         raise ValueError(
             f"unknown engine {cfg.engine!r}: expected one of {LSTSQ_ENGINES}"
@@ -442,9 +447,9 @@ def lstsq(
             A, b, mesh,
             block_size=cfg.block_size, axis_name=col_axis,
             precision=cfg.precision, layout=cfg.layout, norm=cfg.norm,
-            use_pallas=cfg.use_pallas,
+            use_pallas=cfg.use_pallas, panel_impl=cfg.panel_impl,
         )
     return _lstsq_impl(
         A, b, cfg.block_size, cfg.blocked, cfg.precision, cfg.use_pallas,
-        norm=cfg.norm,
+        norm=cfg.norm, panel_impl=cfg.panel_impl,
     )

@@ -40,10 +40,11 @@ from dhqr_tpu.ops.householder import DEFAULT_PRECISION
 from dhqr_tpu.ops.solve import back_substitute, r_matrix
 
 
-@partial(jax.custom_jvp, nondiff_argnums=(2, 3, 4, 5, 6))
+@partial(jax.custom_jvp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
 def lstsq_diff(
     A, b, block_size=DEFAULT_BLOCK_SIZE, precision=DEFAULT_PRECISION,
     pallas=False, pallas_interpret=False, norm="accurate",
+    panel_impl="loop",
 ):
     """``x = argmin ||A x - b||`` with closed-form O(1)-memory derivatives.
 
@@ -52,15 +53,16 @@ def lstsq_diff(
     forward and reverse mode. ``b`` may be (m,) or (m, k).
     """
     x, _ = _lstsq_fwd(A, b, block_size, precision, pallas, pallas_interpret,
-                      norm)
+                      norm, panel_impl)
     return x
 
 
 def _lstsq_fwd(A, b, block_size, precision, pallas=False,
-               pallas_interpret=False, norm="accurate"):
+               pallas_interpret=False, norm="accurate", panel_impl="loop"):
     H, alpha = _blocked_qr_impl(
         A, block_size, precision=precision,
         pallas=pallas, pallas_interpret=pallas_interpret, norm=norm,
+        panel_impl=panel_impl,
     )
     c = _apply_qt_impl(H, b, block_size, precision=precision)
     x = back_substitute(H, alpha, c)
@@ -69,11 +71,12 @@ def _lstsq_fwd(A, b, block_size, precision, pallas=False,
 
 @lstsq_diff.defjvp
 def _lstsq_jvp(block_size, precision, pallas, pallas_interpret, norm,
-               primals, tangents):
+               panel_impl, primals, tangents):
     A, b = primals
     dA, db = tangents
     x, (_, _, H, alpha, _) = _lstsq_fwd(
-        A, b, block_size, precision, pallas, pallas_interpret, norm
+        A, b, block_size, precision, pallas, pallas_interpret, norm,
+        panel_impl
     )
     m, n = A.shape
     vec = x.ndim == 1

@@ -147,6 +147,7 @@ def _blocked_shard_body(
     Al, *, n: int, nb: int, axis: str,
     precision: str = DEFAULT_PRECISION, layout: str = "block",
     norm: str = "accurate", pallas: bool = False, pallas_interpret: bool = False,
+    panel_impl: str = "loop",
 ):
     """Per-device body for the compact-WY engine.
 
@@ -192,8 +193,10 @@ def _blocked_shard_body(
                     panel, 0, interpret=pallas_interpret
                 )
             else:
-                pf, alpha_k = _householder_qr_impl(panel, precision=precision,
-                                                   norm=norm)
+                from dhqr_tpu.ops.blocked import _panel_factor
+
+                pf, alpha_k = _panel_factor(panel, 0, precision, norm,
+                                            panel_impl)
             zero = jnp.zeros_like(pf)
             pf = lax.psum(jnp.where(mine, pf, zero), axis)
             alpha_k = lax.psum(
@@ -234,8 +237,10 @@ def _blocked_shard_body(
                     panel, c, interpret=pallas_interpret
                 )
             else:
-                pf, alpha_k = _panel_qr_masked(panel, c, precision=precision,
-                                               norm=norm)
+                from dhqr_tpu.ops.blocked import _panel_factor
+
+                pf, alpha_k = _panel_factor(panel, c, precision, norm,
+                                            panel_impl)
             pf = lax.psum(jnp.where(mine, pf, jnp.zeros_like(pf)), axis)
             alpha_k = lax.psum(
                 jnp.where(mine, alpha_k, jnp.zeros_like(alpha_k)), axis
@@ -279,11 +284,13 @@ def _build_unblocked(
 def _build_blocked(
     mesh: Mesh, axis_name: str, n: int, nb: int, precision: str, layout: str,
     norm: str = "accurate", pallas: bool = False, pallas_interpret: bool = False,
+    panel_impl: str = "loop",
 ):
     body = partial(
         _blocked_shard_body,
         n=n, nb=nb, axis=axis_name, precision=precision, layout=layout,
         norm=norm, pallas=pallas, pallas_interpret=pallas_interpret,
+        panel_impl=panel_impl,
     )
     return jax.jit(
         shard_map(
@@ -427,6 +434,7 @@ def sharded_blocked_qr(
     _store_layout_output: bool = False,
     norm: str = "accurate",
     use_pallas: str = "never",
+    panel_impl: str = "loop",
 ):
     """Compact-WY distributed QR: one psum per panel, GEMM trailing updates.
 
@@ -452,7 +460,7 @@ def sharded_blocked_qr(
         H, alpha = sharded_blocked_qr(
             _pad_cols_orthogonal(A, n_pad), mesh, block_size=nb,
             axis_name=axis_name, precision=precision, layout=layout,
-            norm=norm, use_pallas=use_pallas,
+            norm=norm, use_pallas=use_pallas, panel_impl=panel_impl,
         )
         return H[:m, :n], alpha[:n]
     _check_divisibility(m, n, nproc, nb, layout)
@@ -466,7 +474,8 @@ def sharded_blocked_qr(
     A = _to_store_layout(A, n, nproc, nb, layout)
     A = jax.device_put(A, column_sharding(mesh, axis_name))
     H, alpha = _build_blocked(
-        mesh, axis_name, n, nb, precision, layout, norm, pallas, interp
+        mesh, axis_name, n, nb, precision, layout, norm, pallas, interp,
+        panel_impl,
     )(A)
     if not _store_layout_output:
         H = _to_natural_layout(H, n, nproc, nb, layout)

@@ -295,6 +295,7 @@ def sharded_lstsq(
     layout: str = "block",
     norm: str = "accurate",
     use_pallas: str = "never",
+    panel_impl: str = "loop",
 ) -> jax.Array:
     """One-shot distributed least squares: factor + solve on the mesh.
 
@@ -321,7 +322,7 @@ def sharded_lstsq(
     H, alpha = sharded_blocked_qr(
         A, mesh, block_size=nb, axis_name=axis_name, precision=precision,
         layout=layout, _store_layout_output=True, norm=norm,
-        use_pallas=use_pallas,
+        use_pallas=use_pallas, panel_impl=panel_impl,
     )
     x = sharded_solve(
         H, alpha, b, mesh,

@@ -1,0 +1,45 @@
+"""Backend selection + compile-cache helpers shared by every entry point.
+
+Two host quirks live here so they are written down exactly once:
+
+* Some hosts pin a remote TPU plugin through a ``sitecustomize`` hook that
+  runs at interpreter start; ``JAX_PLATFORMS=cpu`` in the environment then
+  LOSES, and if the remote relay is wedged the first backend touch hangs.
+  ``jax.config.update("jax_platforms", "cpu")`` after import is the
+  decisive override (tests/conftest.py has the full story).
+* XLA compiles of shard_map programs dominate first-run wall clock; a
+  persistent compilation cache shared by the test suite, the harness, and
+  the benches (keyed by backend+flags, so CPU and TPU entries coexist)
+  makes warm runs skip them.
+"""
+
+from __future__ import annotations
+
+import os
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def cpu_requested() -> bool:
+    """True when the environment asks for the CPU backend explicitly."""
+    return os.environ.get("JAX_PLATFORMS", "").lower() == "cpu"
+
+
+def force_cpu_platform() -> None:
+    """Decisively select the CPU backend (wins over sitecustomize pins)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def enable_compile_cache(cache_dir: str | None = None,
+                         min_compile_secs: float = 0.5) -> None:
+    """Turn on the shared persistent compilation cache (idempotent)."""
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        cache_dir or os.path.join(_REPO, ".jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      min_compile_secs)

@@ -27,13 +27,10 @@ jax.config.update("jax_enable_x64", True)  # Float64/ComplexF64 parity with refe
 
 # Persistent compilation cache: the suite's wall-clock is dominated by XLA
 # compiles of shard_map programs (~10-25 s each); with a warm cache a full
-# run skips nearly all of them. Keyed by backend+flags, so the CPU test
-# cache and bench.py's TPU cache coexist in one directory.
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.path.join(os.path.dirname(__file__), "..", ".jax_cache"),
-)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# run skips nearly all of them (shared helper — same dir as harness/bench).
+from dhqr_tpu.utils.platform import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
 
 
 @pytest.fixture(autouse=True, scope="module")

@@ -72,13 +72,50 @@ def test_qr_api_recursive_panels_solves():
     assert res < TOLERANCE_FACTOR * oracle_residual(A, b)
 
 
-def test_recursive_rejected_off_single_device_blocked():
-    from dhqr_tpu.parallel.mesh import column_mesh
-
+def test_recursive_unblocked_rejected_and_bad_value():
     A = jnp.ones((16, 8))
-    with pytest.raises(ValueError, match="single-device blocked"):
-        dhqr_tpu.qr(A, mesh=column_mesh(2), panel_impl="recursive")
-    with pytest.raises(ValueError, match="single-device blocked"):
+    with pytest.raises(ValueError, match="blocked engines only"):
         dhqr_tpu.qr(A, blocked=False, panel_impl="recursive")
-    with pytest.raises(ValueError, match="factor-time knob"):
-        dhqr_tpu.lstsq(A, jnp.ones(16), panel_impl="recursive")
+    with pytest.raises(ValueError, match="panel_impl"):
+        dhqr_tpu.qr(A, panel_impl="typo")
+
+
+def test_lstsq_recursive_panels():
+    """panel_impl rides the full differentiable lstsq pipeline."""
+    A, b = random_problem(132, 120, np.float64, seed=65)
+    x0 = np.asarray(dhqr_tpu.lstsq(jnp.asarray(A), jnp.asarray(b),
+                                   block_size=32))
+    x1 = np.asarray(dhqr_tpu.lstsq(jnp.asarray(A), jnp.asarray(b),
+                                   block_size=32, panel_impl="recursive"))
+    np.testing.assert_allclose(x1, x0, rtol=1e-9, atol=1e-11)
+
+
+def test_lstsq_recursive_grad_works():
+    import jax
+
+    A, b = random_problem(24, 16, np.float64, seed=66)
+
+    def loss(Aj):
+        x = dhqr_tpu.lstsq(Aj, jnp.asarray(b), block_size=8,
+                           panel_impl="recursive")
+        return jnp.sum(x * x)
+
+    g = jax.grad(loss)(jnp.asarray(A))
+    assert g.shape == A.shape and bool(jnp.all(jnp.isfinite(g)))
+
+
+def test_sharded_recursive_panels_match():
+    """Recursive panel interior inside the shard_map engines, both layouts."""
+    from dhqr_tpu.parallel.mesh import column_mesh
+    from dhqr_tpu.parallel.sharded_qr import sharded_blocked_qr
+
+    mesh = column_mesh(8)
+    A, _ = random_problem(96, 64, np.float64, seed=67)
+    H0, a0 = blocked_householder_qr(jnp.asarray(A), block_size=8)
+    for layout in ("block", "cyclic"):
+        H1, a1 = sharded_blocked_qr(jnp.asarray(A), mesh, block_size=8,
+                                    layout=layout, panel_impl="recursive")
+        np.testing.assert_allclose(np.asarray(H1), np.asarray(H0),
+                                   rtol=1e-9, atol=1e-11)
+        np.testing.assert_allclose(np.asarray(a1), np.asarray(a0),
+                                   rtol=1e-9, atol=1e-11)
