@@ -367,7 +367,8 @@ def main() -> None:
     if platform != "tpu" and not os.environ.get("DHQR_BENCH_FORCE_STAGED"):
         # CPU (scrubbed-env fallback): one direct measurement at full size —
         # the escalation exists to survive the fragile relay, which isn't a
-        # risk here, and the supervisor's CPU window is half the TPU one.
+        # risk here, and the supervisor grants the CPU child only a ~90 s
+        # window (CPU_TIMEOUT) after the TPU attempt's 470 s.
         r = qr_bench(N, watchdog=CPU_TIMEOUT, backward_error=False,
                      panel=PANEL_IMPL)
         if r is None:
@@ -407,12 +408,15 @@ def main() -> None:
 
     def _best_record():
         """Best full-size record (falling back to any size), annotated with
-        every backward-error datum collected so far."""
+        every backward-error datum collected so far. Returns a FRESH dict —
+        stage records are never mutated, so repeated calls cannot re-suffix
+        previously copied keys (a copied plain backward_error living inside
+        a pallas record must not become fake _pallas evidence)."""
         full = [r for r in results if r["metric"].endswith(f"{N}x{N}")]
-        best = max(full or results, key=lambda r: r["value"])
+        best = dict(max(full or results, key=lambda r: r["value"]))
         for r in results:
-            for k, v in list(r.items()):  # list(): best may be r (mutation)
-                if k.startswith("backward_error_"):
+            for k, v in r.items():
+                if k.startswith("backward_error_") and not k.endswith("_pallas"):
                     key = k + ("_pallas" if r.get("pallas_panels") else "")
                     best.setdefault(key, v)
         return best

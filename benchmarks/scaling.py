@@ -45,6 +45,10 @@ def main(argv=None) -> None:
     parser.add_argument("--layout", default="cyclic", choices=["block", "cyclic"])
     parser.add_argument("--lstsq", action="store_true",
                         help="time factor+solve instead of factor only")
+    parser.add_argument("--panel-impl", default="loop",
+                        choices=["loop", "recursive"],
+                        help="panel-interior engine (the replicated panel is "
+                        "the curve's Amdahl term — see module docstring)")
     args = parser.parse_args(argv)
 
     import jax
@@ -93,10 +97,11 @@ def main(argv=None) -> None:
             print(json.dumps({"devices": P, "skipped": f"only {ndev} visible"}))
             continue
         if P == 1:
-            fn = lambda: _blocked_qr_impl(A, nb)
+            fn = lambda: _blocked_qr_impl(A, nb, panel_impl=args.panel_impl)
             if args.lstsq:
                 import dhqr_tpu
-                fn = lambda: dhqr_tpu.lstsq(A, b, block_size=nb)
+                fn = lambda: dhqr_tpu.lstsq(A, b, block_size=nb,
+                                            panel_impl=args.panel_impl)
         else:
             mesh = column_mesh(P)
             if n % P or (n // P) % nb:
@@ -105,16 +110,19 @@ def main(argv=None) -> None:
                 continue
             if args.lstsq:
                 fn = lambda: sharded_lstsq(A, b, mesh, block_size=nb,
-                                           layout=args.layout)
+                                           layout=args.layout,
+                                           panel_impl=args.panel_impl)
             else:
                 fn = lambda: sharded_blocked_qr(A, mesh, block_size=nb,
-                                                layout=args.layout)
+                                                layout=args.layout,
+                                                panel_impl=args.panel_impl)
         t = bench(fn)
         results[P] = t
         print(json.dumps({
             "metric": "sharded_lstsq" if args.lstsq else "sharded_blocked_qr",
             "devices": P, "layout": args.layout if P > 1 else "single",
             "shape": f"{m}x{n}", "block_size": nb,
+            "panel_impl": args.panel_impl,
             "seconds": round(t, 4),
             "gflops": round(flops / t / 1e9, 2),
             "speedup_vs_1": round(results.get(1, t) / t, 3) if 1 in results else None,
