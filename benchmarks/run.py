@@ -65,6 +65,11 @@ def main(argv=None) -> None:
     )
     args = parser.parse_args(argv)
 
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
     import jax
 
     from dhqr_tpu.utils.platform import (
@@ -79,10 +84,6 @@ def main(argv=None) -> None:
     import jax.numpy as jnp
     import numpy as np
 
-    import sys
-    from pathlib import Path
-
-    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
     import dhqr_tpu
     from dhqr_tpu.ops.blocked import _apply_q_impl
     from dhqr_tpu.ops.solve import r_matrix

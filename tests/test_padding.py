@@ -191,3 +191,30 @@ def test_unblocked_mesh_slow_tier_warns(mesh8):
     A, _ = random_problem(640, 600, np.float64, seed=43)
     with pytest.warns(UserWarning, match="most expensive"):
         sharded_householder_qr(jnp.asarray(A), mesh8)
+
+
+def test_plan_padding_brute_force_minimality():
+    """The planner's padded width equals the brute-force minimum over all
+    admissible panel widths, for a grid of (n, P, request)."""
+    for n in (1, 3, 17, 100, 255, 1000, 1001):
+        for P in (1, 2, 3, 8):
+            for req in (1, 7, 32, 128):
+                nb, n_pad = plan_padding(n, P, req)
+                lo = min(max(req, 1), -(-n // P))
+                brute = min(-(-n // (w * P)) * w * P
+                            for w in range(1, lo + 1))
+                assert n_pad == brute, (n, P, req, nb, n_pad, brute)
+
+
+def test_mesh_solve_awkward_n_multirhs(mesh8):
+    """fact.solve with an (m, k) right-hand-side block on an awkward-n
+    sharded factorization (padding handles the extra RHS dimension)."""
+    m, n = 66, 52
+    A, b = random_problem(m, n, np.float64, seed=71)
+    B = np.stack([b, -0.5 * b], axis=1)
+    fact = qr(jnp.asarray(A), mesh=mesh8, block_size=16)
+    X = fact.solve(jnp.asarray(B))
+    assert X.shape == (n, 2)
+    for j in range(2):
+        res = normal_equations_residual(A, np.asarray(X[:, j]), B[:, j])
+        assert res < TOLERANCE_FACTOR * oracle_residual(A, B[:, j])
