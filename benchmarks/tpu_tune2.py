@@ -1,0 +1,155 @@
+"""Round-3 session-2 TPU tuning probe (single chip, watchdogged stages).
+
+Questions this answers, each as one JSONL line on stdout:
+
+1. ``geqrf_backward_error_1024`` — does the platform's own
+   ``lax.linalg.geqrf`` (quoted as a comparison datum in README/bench)
+   meet the < 1e-5 backward-error target our engine is held to? If not,
+   its higher GFLOP/s is not an apples-to-apples ceiling.
+2. ``qr_4096_nb256_pallas`` under the ambient ``DHQR_MAX_PANELS`` — run
+   once with 8 (default) and once with 16 to price the two-level scan's
+   masked-flop overhead against program size (ops/blocked.py docstring).
+3. ``qr_8192_nb256_pallas`` — nb=256 at m=8192 exceeds the kernel's VMEM
+   gate for the tallest super-blocks, so the engine mixes XLA panels
+   (early super-blocks) with Pallas panels (later, shorter ones); is the
+   mix ahead of the all-Pallas nb=128 9,970 GFLOP/s?
+4. ``qr_16384_nb128_pallas`` — the BASELINE.md north-star size on one
+   chip (the target itself is v4-32); chain=3 suffices because device
+   time (~0.5-1 s) dwarfs the ~60-90 ms tunnel RTT.
+
+Run ONE instance at a time (the axon relay allows a single TPU process).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _stage(name: str) -> None:
+    print(f"::stage {name} t={time.time():.1f}", file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(3))
+    from bench import _Watchdog  # same hard-exit escape for hung PJRT calls
+
+    _stage("import")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(os.path.dirname(os.path.dirname(
+                          os.path.abspath(__file__))), ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    from dhqr_tpu.ops.blocked import MAX_UNROLLED_PANELS, _blocked_qr_impl
+    from dhqr_tpu.utils.profiling import sync
+
+    _stage("backend_init")
+    with _Watchdog("backend_init", 150):
+        platform = jax.devices()[0].platform
+        sync(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    _stage(f"backend_ready_{platform}")
+    rng = np.random.default_rng(0)
+
+    def emit(rec):
+        rec["platform"] = platform
+        print(json.dumps(rec), flush=True)
+
+    # 1. geqrf accuracy at 1024 (its GFLOP/s datum already exists).
+    if os.environ.get("TUNE_GEQRF", "1") == "1":
+        _stage("geqrf_accuracy")
+        try:
+            with _Watchdog("geqrf_accuracy", 120):
+                from jax._src.lax.linalg import geqrf, householder_product
+
+                A = jnp.asarray(rng.random((1024, 1024)), jnp.float32)
+
+                @jax.jit
+                def backward_err(A):
+                    packed, taus = geqrf(A)
+                    Q = householder_product(packed, taus)
+                    R = jnp.triu(packed)
+                    return jnp.linalg.norm(Q @ R - A) / jnp.linalg.norm(A)
+
+                e = float(backward_err(A))
+                emit({"metric": "geqrf_backward_error_1024", "value": e,
+                      "meets_1e-5": e < 1e-5})
+        except Exception as ex:
+            print(f"::stage_failed geqrf {type(ex).__name__}: {ex}",
+                  file=sys.stderr, flush=True)
+
+    def chain_time(n, nb, chain, watchdog, pallas=True, repeats=3):
+        name = f"qr_{n}_nb{nb}" + ("_pallas" if pallas else "")
+        _stage(name)
+        try:
+            with _Watchdog(name, watchdog):
+                A = jnp.asarray(rng.random((n, n)), jnp.float32)
+                sync(A)
+                kw = dict(precision="highest", pallas=pallas, norm="fast",
+                          panel_impl="loop")
+                t0 = time.perf_counter()
+                single = _blocked_qr_impl.lower(A, nb, **kw).compile()
+                H, al = single(A)
+                sync(al)
+
+                def chained(A):
+                    def body(C, _):
+                        Hc, ac = _blocked_qr_impl(C, nb, **kw)
+                        return Hc, ac[0]
+                    return lax.scan(body, A, None, length=chain)
+
+                ck = jax.jit(chained).lower(A).compile()
+                compile_s = time.perf_counter() - t0
+                Hc, s = ck(A)
+                sync(s)
+
+                def tmin(f, out):
+                    ts = []
+                    for _ in range(repeats):
+                        t0 = time.perf_counter()
+                        r = f(A)
+                        sync(r[1] if out else r[1])
+                        ts.append(time.perf_counter() - t0)
+                    return min(ts)
+
+                t1 = tmin(single, False)
+                tk = tmin(ck, True)
+                t = (tk - t1) / (chain - 1)
+                unreliable = not (tk > t1 * 1.05 and t > 0)
+                if unreliable:
+                    t = t1
+                flops = (4.0 / 3.0) * n**3
+                emit({"metric": f"qr_gflops_per_chip_f32_{n}x{n}",
+                      "value": round(flops / t / 1e9, 2), "unit": "GFLOP/s",
+                      "seconds": round(t, 4), "block_size": nb,
+                      "pallas_panels": pallas, "chain_length": chain,
+                      "seconds_single_dispatch": round(t1, 4),
+                      "seconds_chain": round(tk, 4),
+                      "compile_seconds": round(compile_s, 2),
+                      "max_unrolled_panels": MAX_UNROLLED_PANELS,
+                      "chain_unreliable": unreliable})
+        except Exception as ex:
+            print(f"::stage_failed {name} {type(ex).__name__}: {ex}",
+                  file=sys.stderr, flush=True)
+
+    stages = os.environ.get("TUNE_STAGES", "4096,8192,16384").split(",")
+    if "4096" in stages:
+        chain_time(4096, 256, 25, 360)
+    if "8192" in stages:
+        chain_time(8192, 256, 5, 420)
+    if "16384" in stages:
+        chain_time(16384, 128, 3, 540, repeats=2)
+    _stage("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -168,6 +168,16 @@ def qr(
             "tsqr/cholqr engines are lstsq-only fast paths"
         )
     _check_panel_impl(cfg)
+    # Resolve the auto panel width once, up front: the factorization object
+    # must record a concrete nb (its solves reuse it), and the mesh planner
+    # needs an int. None = backend/shape auto (ops/blocked.auto_block_size);
+    # the mesh tier keeps the 128 default (the kernel's VMEM gate applies
+    # per-shard there, and padding planning is nb-coupled).
+    if cfg.block_size is None:
+        bs = (_blocked.auto_block_size(A.shape[0], A.dtype, cfg.use_pallas)
+              if mesh is None and cfg.blocked
+              else _blocked.DEFAULT_BLOCK_SIZE)
+        cfg = dataclasses.replace(cfg, block_size=bs)
     if mesh is not None:
         if donate:
             raise ValueError(
@@ -391,6 +401,18 @@ def lstsq(
         raise ValueError(
             f"unknown engine {cfg.engine!r}: expected one of {LSTSQ_ENGINES}"
         )
+    if cfg.block_size is None:
+        # Same resolution rule as qr(): auto width only where the Pallas
+        # kernel can actually take the panels — the single-device blocked
+        # householder path with m >= n (the m < n minimum-norm path factors
+        # A^H with the kernel unset, so it keeps the 128 default, as do the
+        # mesh and alt-engine tiers).
+        if (mesh is None and cfg.engine == "householder" and cfg.blocked
+                and A.shape[0] >= A.shape[1]):
+            bs = _blocked.auto_block_size(A.shape[0], A.dtype, cfg.use_pallas)
+        else:
+            bs = _blocked.DEFAULT_BLOCK_SIZE
+        cfg = dataclasses.replace(cfg, block_size=bs)
     if A.shape[0] < A.shape[1]:
         if mesh is not None or cfg.engine != "householder":
             raise ValueError(

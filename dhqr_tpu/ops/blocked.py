@@ -302,9 +302,33 @@ def _resolve_pallas(mode: str, m: int, nb: int, dtype) -> tuple[bool, bool]:
     raise ValueError(f"use_pallas must be 'auto', 'always' or 'never', got {mode!r}")
 
 
+def auto_block_size(m: int, dtype, use_pallas: str = "auto") -> int:
+    """Panel width when the caller leaves ``block_size`` unset.
+
+    Round-3 hardware sweep (benchmarks/results/tpu_r3_longchain_stages.jsonl
+    + tpu_r3_tune2.jsonl): with the fused Pallas panel kernel, nb=256 beat
+    nb=128 at 4096^2 (7.5-10.3 vs 7.5 TFLOP/s across runs) — fewer, larger
+    trailing GEMMs — but only where the kernel's VMEM gate admits the
+    TALLEST panel at width 256 (m <= ~6k for f32); above that the mixed
+    XLA/Pallas nb=256 schedule measured slower than all-Pallas nb=128
+    (8.8 vs 10.0 TFLOP/s at 8192^2). Off-TPU (or with the kernel vetoed)
+    the panel loop is latency-bound either way: stay at 128.
+    """
+    if use_pallas == "never":
+        return DEFAULT_BLOCK_SIZE
+    try:
+        # The one routing predicate (_resolve_pallas) decides — duplicating
+        # its supported/on-TPU/veto/lowering-probe logic here would let the
+        # two sites drift.
+        enabled, interpret = _resolve_pallas(use_pallas, m, 256, dtype)
+    except ValueError:  # "always" but a 256-wide panel is unsupported here
+        return DEFAULT_BLOCK_SIZE
+    return 256 if enabled and not interpret else DEFAULT_BLOCK_SIZE
+
+
 def blocked_householder_qr(
     A: jax.Array,
-    block_size: int = DEFAULT_BLOCK_SIZE,
+    block_size: "int | None" = None,
     donate: bool = False,
     precision: str = DEFAULT_PRECISION,
     use_pallas: str = "auto",
@@ -317,6 +341,10 @@ def blocked_householder_qr(
     ||v||^2 = 2 below/on the diagonal, R strict-upper in H, R diagonal in
     alpha — reference src:122-148, 296-309), but organized panel-wise so the
     trailing update runs on the MXU.
+
+    ``block_size=None`` (the default) auto-selects the panel width for the
+    backend and shape (:func:`auto_block_size`): 256 on TPU where the
+    Pallas kernel admits 256-wide panels, else 128.
 
     ``norm`` selects the column-norm accumulation on the XLA panel path
     (ops/summation.sumsq); panels taken by the Pallas kernel use the
@@ -332,7 +360,8 @@ def blocked_householder_qr(
         raise ValueError(f"blocked_householder_qr requires m >= n, got {A.shape}")
     if norm not in ("accurate", "fast"):
         raise ValueError(f"norm must be 'accurate' or 'fast', got {norm!r}")
-    nb = int(block_size)
+    nb = auto_block_size(m, A.dtype, use_pallas) if block_size is None \
+        else int(block_size)
     pallas, interpret = _resolve_pallas(use_pallas, m, min(nb, n), A.dtype)
     impl = _blocked_qr_impl_donate if donate else _blocked_qr_impl
     return impl(A, nb, precision=precision, pallas=pallas,

@@ -18,7 +18,10 @@ class DHQRConfig:
 
     Attributes:
       block_size: compact-WY panel width nb (MXU-friendly multiple of 128
-        where possible; the engine handles ragged final panels).
+        where possible; the engine handles ragged final panels). None (the
+        default) auto-selects per backend and shape — 256 on TPU where the
+        fused Pallas panel kernel admits 256-wide panels (the measured
+        round-3 optimum), 128 otherwise; see ops/blocked.auto_block_size.
       mesh_axis: name of the mesh axis to shard over — columns for the
         householder engines ("cols" when unset), rows for the tsqr/cholqr
         families. None (the default) means "not explicitly chosen": the
@@ -60,7 +63,7 @@ class DHQRConfig:
         kernel takes the panel.
     """
 
-    block_size: int = 128
+    block_size: "int | None" = None
     mesh_axis: "str | None" = None
     blocked: bool = True
     use_pallas: str = "auto"

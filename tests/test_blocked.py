@@ -144,3 +144,46 @@ def test_blocked_qr_fast_norm_end_to_end():
     # and the two modes agree to f32 rounding
     H0, alpha0 = blocked_householder_qr(Aj, 16, norm="accurate")
     np.testing.assert_allclose(np.asarray(H), np.asarray(H0), atol=2e-4, rtol=2e-4)
+
+
+def test_auto_block_size_rules(monkeypatch):
+    """None block_size resolves per backend: 128 off-TPU; on TPU 256 only
+    where the Pallas VMEM gate admits a 256-wide tallest panel and the
+    kernel path is not vetoed (measured optimum, round-3 hardware sweep)."""
+    from dhqr_tpu.ops import blocked as B
+
+    # this suite runs on CPU -> always the 128 default
+    assert B.auto_block_size(4096, jnp.float32) == B.DEFAULT_BLOCK_SIZE
+
+    monkeypatch.setattr(B.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(B, "_pallas_lowers_on_this_backend", lambda _: True)
+    assert B.auto_block_size(4096, jnp.float32) == 256
+    # VMEM gate: a 16384-tall 256-wide f32 panel does not fit
+    assert B.auto_block_size(16384, jnp.float32) == 128
+    # f64 unsupported by the kernel -> 128
+    assert B.auto_block_size(4096, jnp.float64) == 128
+    # explicit veto of the kernel path -> 128
+    assert B.auto_block_size(4096, jnp.float32, use_pallas="never") == 128
+    monkeypatch.setenv("DHQR_PALLAS_AUTO", "0")
+    assert B.auto_block_size(4096, jnp.float32) == 128
+    # "always" ignores the env veto (same semantics as _resolve_pallas)...
+    assert B.auto_block_size(4096, jnp.float32, use_pallas="always") == 256
+    # ...but falls back where a 256-wide panel is unsupported rather than
+    # propagating _resolve_pallas's "always" ValueError
+    assert B.auto_block_size(16384, jnp.float32, use_pallas="always") == 128
+
+
+def test_default_block_size_none_end_to_end():
+    """qr()/lstsq() with the config default (block_size=None) resolve to a
+    concrete width and factor correctly; the factorization records it."""
+    from dhqr_tpu import lstsq, qr
+    from dhqr_tpu.ops.blocked import DEFAULT_BLOCK_SIZE
+
+    A, b = random_problem(120, 90, np.float64, seed=21)
+    fact = qr(jnp.asarray(A))
+    assert fact.block_size == DEFAULT_BLOCK_SIZE  # CPU resolution
+    x = np.asarray(fact.solve(jnp.asarray(b)))
+    res = normal_equations_residual(A, x, b)
+    assert res < TOLERANCE_FACTOR * max(oracle_residual(A, b), 1e-12)
+    x2 = np.asarray(lstsq(jnp.asarray(A), jnp.asarray(b)))
+    np.testing.assert_allclose(x2, x, rtol=1e-10, atol=1e-12)
