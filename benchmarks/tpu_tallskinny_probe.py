@@ -1,0 +1,240 @@
+"""Round-3 TPU probe: tall-skinny engines on hardware (BASELINE configs 2/5).
+
+First hardware datum for the TSQR and CholeskyQR2 engine families at the
+BASELINE.md config-2 shape (65536 x 256 f32) and the config-5 shape
+(131072 x 512 lstsq), single chip. Device time per factorization is ~2-20 ms
+— far below the axon tunnel's 60-90 ms RTT — so every stage is chain-timed
+(k dependent iterations in one dispatch, (t_k - t_1)/(k - 1), same protocol
+as bench.py).
+
+Chaining trick: CholeskyQR2 feeds its own orthonormal Q as the next
+iteration's input (cond(Q) = 1, stays in the engine's window). TSQR returns
+only R, so the chain multiplies A by a data-dependent 1.0
+(``where(isfinite(R[0,0]), 1, 0)``) that XLA cannot constant-fold away.
+
+GFLOP/s is reported against the STANDARD dense-QR flop model
+2mn^2 - (2/3)n^3 ("useful flops" — what a Householder factorization of the
+same shape would cost), so numbers are comparable across engines even
+though CholeskyQR2's actual executed flops (~4mn^2 + Q materialization)
+and TSQR's (leaf QRs + combine) differ. The model is recorded per line.
+
+Run ONE instance at a time (the axon relay allows a single TPU process).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _stage(name: str) -> None:
+    print(f"::stage {name} t={time.time():.1f}", file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(3))
+    from bench import _Watchdog
+
+    _stage("import")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(_REPO, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    from dhqr_tpu.ops.cholqr import _cholesky_qr2_impl, _cholqr_lstsq_impl
+    from dhqr_tpu.ops.tsqr import _tsqr_lstsq_impl, _tsqr_r_impl
+    from dhqr_tpu.utils.profiling import sync
+
+    _stage("backend_init")
+    with _Watchdog("backend_init", 150):
+        dev = jax.devices()[0]
+        platform = dev.platform
+        kind = getattr(dev, "device_kind", "?")
+        sync(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    _stage(f"backend_ready_{platform}")
+    rng = np.random.default_rng(0)
+
+    def emit(rec):
+        rec["platform"] = platform
+        rec["device_kind"] = kind
+        print(json.dumps(rec), flush=True)
+
+    def measure(name, make_single, make_chain, chain, flops, watchdog,
+                repeats=3, extra=None):
+        _stage(name)
+        try:
+            with _Watchdog(name, watchdog):
+                t0 = time.perf_counter()
+                f1 = make_single()
+                fk = make_chain()
+                compile_s = time.perf_counter() - t0
+
+                def tmin(f):
+                    s = f()
+                    sync(s)
+                    ts = []
+                    for _ in range(repeats):
+                        t0 = time.perf_counter()
+                        s = f()
+                        sync(s)
+                        ts.append(time.perf_counter() - t0)
+                    return min(ts)
+
+                t1, tk = tmin(f1), tmin(fk)
+                t = (tk - t1) / (chain - 1)
+                unreliable = not (tk > t1 * 1.05 and t > 0)
+                if unreliable:
+                    t = t1
+                rec = {"metric": name, "value": round(flops / t / 1e9, 2),
+                       "unit": "GFLOP/s",
+                       "flop_model": "2mn^2-(2/3)n^3 (dense-QR-equivalent)",
+                       "seconds": round(t, 5), "chain_length": chain,
+                       "seconds_single_dispatch": round(t1, 4),
+                       "seconds_chain": round(tk, 4),
+                       "compile_seconds": round(compile_s, 2),
+                       "chain_unreliable": unreliable}
+                if extra:
+                    rec.update(extra)
+                emit(rec)
+        except Exception as ex:
+            emit({"metric": name, "ok": False,
+                  "error": f"{type(ex).__name__}: {ex}"[:500]})
+
+    PREC = "highest"
+
+    def qr_flops(m, n):
+        return 2.0 * m * n * n - (2.0 / 3.0) * n**3
+
+    # ---- config 2 shape: 65536 x 256 f32, factor-only ----
+    m, n = 65536, 256
+    A = jnp.asarray(rng.random((m, n)), jnp.float32)
+    sync(A)
+
+    def cholqr_single():
+        f = jax.jit(lambda A: _cholesky_qr2_impl(A, PREC, False)[1]) \
+            .lower(A).compile()
+        return lambda: f(A)[0, 0]
+
+    def cholqr_chain(k):
+        def chained(A):
+            def body(C, _):
+                Q, R = _cholesky_qr2_impl(C, PREC, False)
+                return Q, R[0, 0]
+            _, s = lax.scan(body, A, None, length=k)
+            return s[-1]
+        f = jax.jit(chained).lower(A).compile()
+        return lambda: f(A)
+
+    measure(f"cholqr2_f32_{m}x{n}",
+            cholqr_single, lambda: cholqr_chain(50), 50, qr_flops(m, n), 360,
+            extra={"engine": "cholqr2", "note": "chain feeds Q back as A"})
+
+    def tsqr_single(nblk):
+        f = jax.jit(lambda A: _tsqr_r_impl(A, nblk, 128, PREC)[0, 0]) \
+            .lower(A).compile()
+        return lambda: f(A)
+
+    def tsqr_chain(nblk, k):
+        def chained(A):
+            def body(C, _):
+                R = _tsqr_r_impl(C, nblk, 128, PREC)
+                keep = jnp.where(jnp.isfinite(R[0, 0]), jnp.float32(1.0),
+                                 jnp.float32(0.0))
+                return C * keep, R[0, 0]
+            _, s = lax.scan(body, A, None, length=k)
+            return s[-1]
+        f = jax.jit(chained).lower(A).compile()
+        return lambda: f(A)
+
+    for nblk in (8, 32):
+        measure(f"tsqr_r_f32_{m}x{n}_blocks{nblk}",
+                lambda nblk=nblk: tsqr_single(nblk),
+                lambda nblk=nblk: tsqr_chain(nblk, 25), 25,
+                qr_flops(m, n), 420,
+                extra={"engine": "tsqr", "n_blocks": nblk})
+
+    # ---- config 5 shape: 131072 x 512 overdetermined lstsq ----
+    m2, n2 = 131072, 512
+    A2 = jnp.asarray(rng.random((m2, n2)), jnp.float32)
+    b2 = jnp.asarray(rng.random((m2,)), jnp.float32)
+    sync(A2)
+    sync(b2)
+
+    def chol_lstsq_chain(k):
+        def chained(A, b):
+            def body(bc, _):
+                x = _cholqr_lstsq_impl(A, bc, PREC, False)
+                # feed x's magnitude back into b: data dependency without
+                # shape games (b stays (m,))
+                keep = jnp.where(jnp.isfinite(x[0]), jnp.float32(1.0),
+                                 jnp.float32(0.0))
+                return bc * keep, x[0]
+            _, s = lax.scan(body, b, None, length=k)
+            return s[-1]
+        f = jax.jit(chained).lower(A2, b2).compile()
+        return lambda: f(A2, b2)
+
+    def chol_lstsq_single():
+        f = jax.jit(lambda A, b: _cholqr_lstsq_impl(A, b, PREC, False)[0]) \
+            .lower(A2, b2).compile()
+        return lambda: f(A2, b2)
+
+    measure(f"cholqr_lstsq_f32_{m2}x{n2}",
+            chol_lstsq_single, lambda: chol_lstsq_chain(25), 25,
+            qr_flops(m2, n2) + 2.0 * m2 * n2, 480,
+            extra={"engine": "cholqr2", "config": "BASELINE-5 shape"})
+
+    def tsqr_lstsq_chain(k, nblk=16):
+        def chained(A, b):
+            def body(bc, _):
+                x = _tsqr_lstsq_impl(A, bc, nblk, 128, PREC)
+                keep = jnp.where(jnp.isfinite(x[0]), jnp.float32(1.0),
+                                 jnp.float32(0.0))
+                return bc * keep, x[0]
+            _, s = lax.scan(body, b, None, length=k)
+            return s[-1]
+        f = jax.jit(chained).lower(A2, b2).compile()
+        return lambda: f(A2, b2)
+
+    def tsqr_lstsq_single(nblk=16):
+        f = jax.jit(lambda A, b: _tsqr_lstsq_impl(A, b, nblk, 128, PREC)[0]) \
+            .lower(A2, b2).compile()
+        return lambda: f(A2, b2)
+
+    measure(f"tsqr_lstsq_f32_{m2}x{n2}",
+            tsqr_lstsq_single, lambda: tsqr_lstsq_chain(25), 25,
+            qr_flops(m2, n2) + 2.0 * m2 * n2, 480,
+            extra={"engine": "tsqr", "n_blocks": 16,
+                   "config": "BASELINE-5 shape"})
+
+    # Accuracy datum at config-2 shape: CholeskyQR2 orthogonality + residual.
+    _stage("cholqr_accuracy")
+    try:
+        with _Watchdog("cholqr_accuracy", 240):
+            Q, R = _cholesky_qr2_impl(A, PREC, False)
+            orth = float(jnp.linalg.norm(
+                jnp.matmul(Q.T, Q, precision="highest") - jnp.eye(n)))
+            resid = float(jnp.linalg.norm(
+                jnp.matmul(Q, R, precision="highest") - A) /
+                jnp.linalg.norm(A))
+            emit({"metric": f"cholqr2_accuracy_{m}x{n}",
+                  "orthogonality_error": orth, "backward_error": resid,
+                  "meets_1e-5": resid < 1e-5})
+    except Exception as ex:
+        emit({"metric": "cholqr_accuracy", "ok": False,
+              "error": f"{type(ex).__name__}: {ex}"[:500]})
+    _stage("done")
+
+
+if __name__ == "__main__":
+    main()

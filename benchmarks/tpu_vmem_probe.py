@@ -1,0 +1,215 @@
+"""Round-3 session-3 TPU probe: VMEM residency limits + split-precision trade.
+
+Two hardware questions, each stage one JSONL line on stdout:
+
+1. **Single-copy VMEM residency** — ``pallas_panel_supported`` budgets TWO
+   resident panel copies because the step body's ``at - W*v`` chain might
+   materialize a second panel-sized value unless Mosaic fuses it
+   (ops/pallas_panel.py). If the fused kernel actually compiles and runs at
+   single-copy sizes — (8192, 256), (11264, 256), (16384, 128) are all
+   ~8.4-11.5 MB one-copy but >16 MB two-copy — the gate can drop to one
+   copy (``DHQR_PALLAS_PANEL_COPIES=1``), making 8192^2 nb=256 all-Pallas
+   and 16384^2 nb=128 all-Pallas (both currently mixed XLA/Pallas).
+
+2. **Split trailing precision** — ``trailing_precision="high"`` runs the
+   trailing-update GEMMs (~all the flops) at 3 MXU passes instead of 6
+   while panels/T-factors stay at "highest". All-"high" measured 4.4e-5
+   backward error (fails the 1e-5 bar); if the failure is driven by the
+   *panel* chains rather than the bulk GEMMs, the split passes the bar at
+   ~half the dominant cost.
+
+Run ONE instance at a time (the axon relay allows a single TPU process).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# Budget overrides (read per call by pallas_panel._gate_params) let the
+# engine's internal gate admit every probed shape — hardware (Mosaic VMEM
+# allocation) is the arbiter during this probe, not the planning model.
+os.environ.setdefault("DHQR_PALLAS_PANEL_COPIES", "1")
+os.environ.setdefault("DHQR_PALLAS_VMEM_BYTES", str(100 * 1024 * 1024))
+
+
+def _stage(name: str) -> None:
+    print(f"::stage {name} t={time.time():.1f}", file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(3))
+    from bench import _Watchdog
+
+    _stage("import")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(_REPO, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    from dhqr_tpu.ops.blocked import _apply_q_impl, _blocked_qr_impl
+    from dhqr_tpu.ops.householder import _householder_qr_impl
+    from dhqr_tpu.ops.pallas_panel import _panel_qr_pallas_impl
+    from dhqr_tpu.ops.solve import r_matrix
+    from dhqr_tpu.utils.profiling import sync
+
+    _stage("backend_init")
+    with _Watchdog("backend_init", 150):
+        dev = jax.devices()[0]
+        platform = dev.platform
+        kind = getattr(dev, "device_kind", "?")
+        sync(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    _stage(f"backend_ready_{platform}")
+    rng = np.random.default_rng(0)
+
+    def emit(rec):
+        rec["platform"] = platform
+        rec["device_kind"] = kind
+        print(json.dumps(rec), flush=True)
+
+    emit({"metric": "probe_start", "value": 1,
+          "panel_copies": os.environ.get("DHQR_PALLAS_PANEL_COPIES")})
+
+    # ---- 1. Single-copy panel residency: compile + run + verify vs XLA ----
+    def panel_stage(m, nb, watchdog=240):
+        name = f"panel_{m}x{nb}"
+        _stage(name)
+        try:
+            with _Watchdog(name, watchdog):
+                panel = jnp.asarray(rng.standard_normal((m, nb)), jnp.float32)
+                sync(panel)
+                t0 = time.perf_counter()
+                comp = _panel_qr_pallas_impl.lower(
+                    panel, 0, interpret=False).compile()
+                compile_s = time.perf_counter() - t0
+                pf, al = comp(panel, 0)
+                sync(al)
+                ts = []
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    pf, al = comp(panel, 0)
+                    sync(al)
+                    ts.append(time.perf_counter() - t0)
+                # Cheap structural verification (the kernel's numerics are
+                # pinned by tests/test_pallas_panel.py; here the question is
+                # residency): every reflector has ||v||^2 = 2, R diag in al.
+                Y = jnp.tril(pf)
+                vnorms = jnp.sum(Y * Y, axis=0)
+                vdev = float(jnp.max(jnp.abs(vnorms - 2.0)))
+                finite = bool(jnp.all(jnp.isfinite(pf)) &
+                              jnp.all(jnp.isfinite(al)))
+                emit({"metric": name, "ok": True,
+                      "seconds": round(min(ts), 4),
+                      "compile_seconds": round(compile_s, 2),
+                      "max_vnorm_dev": vdev, "finite": finite})
+                return finite and vdev < 1e-4
+        except Exception as ex:
+            emit({"metric": name, "ok": False,
+                  "error": f"{type(ex).__name__}: {ex}"[:500]})
+            return False
+
+    # Device is a v5e ("TPU v5 lite") — VMEM is far larger than the generic
+    # 16 MB planning number, so probe well past the old gate. Mosaic's
+    # allocator is the arbiter; failures are caught and recorded per shape.
+    ok_8192_256 = panel_stage(8192, 256)
+    ok_16384_128 = panel_stage(16384, 128)
+    ok_4096_512 = panel_stage(4096, 512)
+    ok_16384_256 = panel_stage(16384, 256)
+    ok_8192_512 = panel_stage(8192, 512)
+    ok_16384_512 = panel_stage(16384, 512) if ok_8192_512 else False
+
+    # ---- 2. Full QR chain timings with the relaxed gate ----
+    def chain_time(n, nb, chain, watchdog, trailing=None, repeats=3,
+                   backward_error=False, pallas=True):
+        name = f"qr_{n}_nb{nb}" + ("_pallas" if pallas else "") + \
+            (f"_trail_{trailing}" if trailing else "")
+        _stage(name)
+        try:
+            with _Watchdog(name, watchdog):
+                A = jnp.asarray(rng.random((n, n)), jnp.float32)
+                sync(A)
+                kw = dict(precision="highest", pallas=pallas, norm="fast",
+                          panel_impl="loop", trailing_precision=trailing)
+                t0 = time.perf_counter()
+                single = _blocked_qr_impl.lower(A, nb, **kw).compile()
+                H, al = single(A)
+                sync(al)
+
+                def chained(A):
+                    def body(C, _):
+                        Hc, ac = _blocked_qr_impl(C, nb, **kw)
+                        return Hc, ac[0]
+                    return lax.scan(body, A, None, length=chain)
+
+                ck = jax.jit(chained).lower(A).compile()
+                compile_s = time.perf_counter() - t0
+                Hc, s = ck(A)
+                sync(s)
+
+                def tmin(f):
+                    ts = []
+                    for _ in range(repeats):
+                        t0 = time.perf_counter()
+                        r = f(A)
+                        sync(r[1])
+                        ts.append(time.perf_counter() - t0)
+                    return min(ts)
+
+                t1 = tmin(single)
+                tk = tmin(ck)
+                t = (tk - t1) / (chain - 1)
+                unreliable = not (tk > t1 * 1.05 and t > 0)
+                if unreliable:
+                    t = t1
+                flops = (4.0 / 3.0) * n**3
+                rec = {"metric": f"qr_gflops_per_chip_f32_{n}x{n}",
+                       "value": round(flops / t / 1e9, 2), "unit": "GFLOP/s",
+                       "seconds": round(t, 4), "block_size": nb,
+                       "pallas_panels": pallas, "chain_length": chain,
+                       "trailing_precision": trailing,
+                       "panel_copies_gate": 1,
+                       "seconds_single_dispatch": round(t1, 4),
+                       "seconds_chain": round(tk, 4),
+                       "compile_seconds": round(compile_s, 2),
+                       "chain_unreliable": unreliable}
+                if backward_error:
+                    QR = _apply_q_impl(H, r_matrix(H, al), nb,
+                                       precision="highest")
+                    rec[f"backward_error_{n}"] = float(
+                        jnp.linalg.norm(QR - A) / jnp.linalg.norm(A))
+                emit(rec)
+        except Exception as ex:
+            emit({"metric": name, "ok": False,
+                  "error": f"{type(ex).__name__}: {ex}"[:500]})
+
+    # Full-QR wins where the panel stages passed — likeliest headline
+    # movers first. (The split-precision stages ran in probe v1: trailing=
+    # "high" bought NOTHING — 9,777 vs 10,285 GFLOP/s, the trailing GEMMs
+    # are HBM-bound not MXU-pass-bound — and fails the bar at 2.7e-5.)
+    if ok_4096_512:
+        chain_time(4096, 512, 25, 480)
+    if ok_8192_256:
+        chain_time(8192, 256, 5, 480)
+    if ok_8192_512:
+        chain_time(8192, 512, 5, 480)
+    if ok_16384_256:
+        chain_time(16384, 256, 3, 600, repeats=2)
+    elif ok_16384_128:
+        chain_time(16384, 128, 3, 560, repeats=2)
+    if ok_16384_512:
+        chain_time(16384, 512, 3, 600, repeats=2)
+    _stage("done")
+
+
+if __name__ == "__main__":
+    main()

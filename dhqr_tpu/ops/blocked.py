@@ -66,16 +66,24 @@ def wy_upper(Y: jax.Array, precision=DEFAULT_PRECISION) -> jax.Array:
 
 
 def apply_block_reflector_h(
-    Y: jax.Array, C: jax.Array, precision=DEFAULT_PRECISION
+    Y: jax.Array, C: jax.Array, precision=DEFAULT_PRECISION,
+    gemm_precision=None,
 ) -> jax.Array:
-    """C <- (I - Y T^H Y^H) C, i.e. apply H_nb ... H_1 (the Q^H direction)."""
+    """C <- (I - Y T^H Y^H) C, i.e. apply H_nb ... H_1 (the Q^H direction).
+
+    ``gemm_precision`` (default: same as ``precision``) applies to the two
+    panel-sized GEMMs only; the T-factor (``wy_upper``) always uses
+    ``precision`` — it is an nb x nb dependent recurrence whose error every
+    later column inherits, while the big GEMMs' rounding is not amplified.
+    """
+    gp = precision if gemm_precision is None else gemm_precision
     U = wy_upper(Y, precision)
-    W = jnp.matmul(jnp.conj(Y.T), C, precision=precision)
+    W = jnp.matmul(jnp.conj(Y.T), C, precision=gp)
     Z = lax.linalg.triangular_solve(
         U, W, left_side=True, lower=False, transpose_a=True, conjugate_a=True,
         unit_diagonal=True,
     )
-    return C - jnp.matmul(Y, Z, precision=precision)
+    return C - jnp.matmul(Y, Z, precision=gp)
 
 
 def apply_block_reflector(
@@ -128,7 +136,7 @@ def _panel_factor(panel, offset, precision, norm, panel_impl):
 
 
 def _scan_panels(S, pcount, nb, precision, pallas, pallas_interpret,
-                 norm="accurate", panel_impl="loop"):
+                 norm="accurate", panel_impl="loop", gemm_precision=None):
     """Factor ``pcount`` uniform nb-wide panels of super-block S by scan.
 
     S is the (ms, ns) trailing submatrix whose top-left element is the
@@ -153,7 +161,8 @@ def _scan_panels(S, pcount, nb, precision, pallas, pallas_interpret,
         S = lax.dynamic_update_slice(S, pf, (jnp.int32(0), c))
         with jax.named_scope("trailing_update"):
             Y = shifted_tril(pf, c)
-            C_new = apply_block_reflector_h(Y, S, precision)
+            C_new = apply_block_reflector_h(Y, S, precision,
+                                            gemm_precision=gemm_precision)
             cmask = lax.iota(jnp.int32, ns) >= c + nb
             S = jnp.where(cmask[None, :], C_new, S)
         return S, alpha_k
@@ -165,17 +174,23 @@ def _scan_panels(S, pcount, nb, precision, pallas, pallas_interpret,
 @partial(
     jax.jit,
     static_argnames=("block_size", "precision", "pallas", "pallas_interpret",
-                     "norm", "panel_impl"),
+                     "norm", "panel_impl", "trailing_precision"),
 )
 def _blocked_qr_impl(
     A, block_size, precision=DEFAULT_PRECISION, pallas=False,
     pallas_interpret=False, norm="accurate", panel_impl="loop",
+    trailing_precision=None,
 ):
     from dhqr_tpu.ops.pallas_panel import _panel_qr_pallas_impl, pallas_panel_supported
 
     m, n = A.shape
     nb = min(block_size, n)
     num_full, rem, ppo = _panels_schedule(n, nb)
+    # Trailing-update GEMMs may run at a cheaper MXU precision than the
+    # panel/T-factor math: the trailing update holds ~all the flops, while
+    # the accuracy-critical dependent chains (reflector norms/dots, the
+    # T-factor recurrence) stay at ``precision``. None = no split.
+    tprec = precision if trailing_precision is None else trailing_precision
 
     if num_full + (1 if rem else 0) <= MAX_UNROLLED_PANELS:
         # Fully-unrolled shrinking-slice path: exact flops, small program.
@@ -201,7 +216,8 @@ def _blocked_qr_impl(
                     Y = jnp.tril(pf)  # reflectors incl. diagonal; R masked off
                     C = lax.slice(H, (k, k + b), (m, n))
                     H = H.at[k:, k + b :].set(
-                        apply_block_reflector_h(Y, C, precision)
+                        apply_block_reflector_h(Y, C, precision,
+                                                gemm_precision=tprec)
                     )
         return H, alpha
 
@@ -219,7 +235,7 @@ def _blocked_qr_impl(
         blk_pallas = pallas and pallas_panel_supported(m - K, nb, A.dtype)
         S, alpha_blk = _scan_panels(
             S, pcount, nb, precision, blk_pallas, pallas_interpret, norm=norm,
-            panel_impl=panel_impl,
+            panel_impl=panel_impl, gemm_precision=tprec,
         )
         H = H.at[K:, K:].set(S)
         alpha = alpha.at[K : K + pcount * nb].set(alpha_blk)
@@ -236,7 +252,8 @@ def _blocked_qr_impl(
 
 _blocked_qr_impl_donate = partial(
     jax.jit,
-    static_argnames=("block_size", "precision", "pallas", "pallas_interpret", "norm"),
+    static_argnames=("block_size", "precision", "pallas", "pallas_interpret",
+                     "norm", "panel_impl", "trailing_precision"),
     donate_argnums=(0,),
 )(_blocked_qr_impl.__wrapped__)
 
@@ -305,25 +322,31 @@ def _resolve_pallas(mode: str, m: int, nb: int, dtype) -> tuple[bool, bool]:
 def auto_block_size(m: int, dtype, use_pallas: str = "auto") -> int:
     """Panel width when the caller leaves ``block_size`` unset.
 
-    Round-3 hardware sweep (benchmarks/results/tpu_r3_longchain_stages.jsonl
-    + tpu_r3_tune2.jsonl): with the fused Pallas panel kernel, nb=256 beat
-    nb=128 at 4096^2 (7.5-10.3 vs 7.5 TFLOP/s across runs) — fewer, larger
-    trailing GEMMs — but only where the kernel's VMEM gate admits the
-    TALLEST panel at width 256 (m <= ~6k for f32); above that the mixed
-    XLA/Pallas nb=256 schedule measured slower than all-Pallas nb=128
-    (8.8 vs 10.0 TFLOP/s at 8192^2). Off-TPU (or with the kernel vetoed)
-    the panel loop is latency-bound either way: stay at 128.
+    Round-3 hardware sweeps (benchmarks/results/tpu_r3_longchain_stages.jsonl,
+    tpu_r3_tune2.jsonl, tpu_r3_vmem_probe2.jsonl): with the fused Pallas
+    panel kernel and the hardware-validated single-copy VMEM gate, all-Pallas
+    nb=256 won at 4096^2 and 8192^2 (10.3 / 10.9 TFLOP/s vs 8.5 / 8.8 at
+    nb=512), while at 16384^2 the panel-count halving flips the order:
+    nb=512 measured 12.9 TFLOP/s vs 12.2 at nb=256. So: 512 where m >= 16384
+    and the gate admits a 512-wide tallest panel; else 256 where the gate
+    admits 256; else 128. Off-TPU (or with the kernel vetoed) the panel loop
+    is latency-bound either way: stay at 128.
     """
     if use_pallas == "never":
         return DEFAULT_BLOCK_SIZE
-    try:
-        # The one routing predicate (_resolve_pallas) decides — duplicating
-        # its supported/on-TPU/veto/lowering-probe logic here would let the
-        # two sites drift.
-        enabled, interpret = _resolve_pallas(use_pallas, m, 256, dtype)
-    except ValueError:  # "always" but a 256-wide panel is unsupported here
-        return DEFAULT_BLOCK_SIZE
-    return 256 if enabled and not interpret else DEFAULT_BLOCK_SIZE
+    for nb in (512, 256):
+        if nb == 512 and m < 16384:
+            continue
+        try:
+            # The one routing predicate (_resolve_pallas) decides —
+            # duplicating its supported/on-TPU/veto/lowering-probe logic
+            # here would let the two sites drift.
+            enabled, interpret = _resolve_pallas(use_pallas, m, nb, dtype)
+        except ValueError:  # "always" but an nb-wide panel is unsupported
+            continue
+        if enabled and not interpret:
+            return nb
+    return DEFAULT_BLOCK_SIZE
 
 
 def blocked_householder_qr(
@@ -334,6 +357,7 @@ def blocked_householder_qr(
     use_pallas: str = "auto",
     norm: str = "accurate",
     panel_impl: str = "loop",
+    trailing_precision: "str | None" = None,
 ):
     """Factor ``A`` (m x n, m >= n): returns ``(H, alpha)`` in packed storage.
 
@@ -354,6 +378,14 @@ def blocked_householder_qr(
     With ``donate=True`` the input buffer is donated to XLA — the functional
     spelling of the reference's in-place ``householder!`` (src:113), halving
     peak memory; the caller's array is invalidated, so it is opt-in.
+
+    ``trailing_precision`` (default: same as ``precision``) sets the MXU
+    precision of the trailing-update GEMMs ONLY — the panel factorization and
+    the compact-WY T-factor keep ``precision``. The trailing update holds
+    ~all the flops, so e.g. ``precision="highest", trailing_precision="high"``
+    trades MXU passes (6 -> 3) on the bulk work while keeping the dependent
+    reflector chains at full accuracy. Measure the backward error for your
+    sizes before relying on it; the library default remains un-split.
     """
     m, n = A.shape
     if m < n:
@@ -365,7 +397,8 @@ def blocked_householder_qr(
     pallas, interpret = _resolve_pallas(use_pallas, m, min(nb, n), A.dtype)
     impl = _blocked_qr_impl_donate if donate else _blocked_qr_impl
     return impl(A, nb, precision=precision, pallas=pallas,
-                pallas_interpret=interpret, norm=norm, panel_impl=panel_impl)
+                pallas_interpret=interpret, norm=norm, panel_impl=panel_impl,
+                trailing_precision=trailing_precision)
 
 
 @partial(jax.jit, static_argnames=("block_size", "precision"))

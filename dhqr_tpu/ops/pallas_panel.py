@@ -52,11 +52,41 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-# VMEM working-set budget for the transposed panel (bytes). The chip has
-# ~16 MiB per core; the kernel factors the panel IN PLACE (the input block
-# is aliased to the output, see ``input_output_aliases`` below) so only one
-# panel copy plus the per-step reflector/dot scratch is resident.
-_VMEM_PANEL_BUDGET = 12 * 1024 * 1024
+# VMEM working-set model for the transposed panel. Defaults are
+# conservative (12 MiB budget, TWO assumed resident panel copies — the step
+# body's ``at - W*v`` chain could materialize a second panel-sized value if
+# Mosaic does not fuse it). On hardware where larger residency was MEASURED
+# to compile and run, the per-device-kind table below overrides: round-3
+# probe on a v5e ("TPU v5 lite") ran single-copy panels up to (16384, 512)
+# = 33.6 MB (benchmarks/results/tpu_r3_vmem_probe2.jsonl), i.e. Mosaic does
+# fuse the chain and v5e VMEM is far larger than the generic ~16 MB
+# planning number. DHQR_PALLAS_VMEM_BYTES / DHQR_PALLAS_PANEL_COPIES
+# override both (read per call, so tests/experiments can flip them).
+import os as _os
+
+_MEASURED_VMEM_KINDS = {
+    # device_kind -> (budget_bytes, resident_copies), hardware-validated
+    "TPU v5 lite": (34 * 1024 * 1024, 1),
+}
+
+
+def _gate_params() -> tuple:
+    """(budget_bytes, assumed_copies) for the current backend."""
+    budget, copies = 12 * 1024 * 1024, 2
+    try:
+        if jax.default_backend() == "tpu":
+            kind = getattr(jax.devices()[0], "device_kind", "")
+            if kind in _MEASURED_VMEM_KINDS:
+                budget, copies = _MEASURED_VMEM_KINDS[kind]
+    except Exception:
+        pass
+    env_budget = _os.environ.get("DHQR_PALLAS_VMEM_BYTES")
+    env_copies = _os.environ.get("DHQR_PALLAS_PANEL_COPIES")
+    if env_budget:
+        budget = int(env_budget)
+    if env_copies:
+        copies = int(env_copies)
+    return budget, copies
 
 
 def pallas_panel_supported(m: int, nb: int, dtype) -> bool:
@@ -72,12 +102,8 @@ def pallas_panel_supported(m: int, nb: int, dtype) -> bool:
         planes = 2
     else:
         return False
-    # The panel is factored in place (input aliased to output), but the
-    # step body still materializes panel-sized intermediates (the W*v
-    # outer product and the updated panel value) unless Mosaic fuses the
-    # chain — so budget TWO resident panel copies until the single-copy
-    # limit is validated on hardware.
-    return planes * (2 * m * nb * 4 + 4 * m * 4) <= _VMEM_PANEL_BUDGET
+    budget, copies = _gate_params()
+    return planes * (copies * m * nb * 4 + 4 * m * 4) <= budget
 
 
 def _sumsq_compensated(x):

@@ -147,9 +147,10 @@ def test_blocked_qr_fast_norm_end_to_end():
 
 
 def test_auto_block_size_rules(monkeypatch):
-    """None block_size resolves per backend: 128 off-TPU; on TPU 256 only
-    where the Pallas VMEM gate admits a 256-wide tallest panel and the
-    kernel path is not vetoed (measured optimum, round-3 hardware sweep)."""
+    """None block_size resolves per backend: 128 off-TPU; on TPU the widest
+    of {512 (m >= 16384 only), 256} whose tallest panel the Pallas VMEM
+    gate admits, else 128 (measured optimum at each scale, round-3
+    hardware sweeps)."""
     from dhqr_tpu.ops import blocked as B
 
     # this suite runs on CPU -> always the 128 default
@@ -157,6 +158,12 @@ def test_auto_block_size_rules(monkeypatch):
 
     monkeypatch.setattr(B.jax, "default_backend", lambda: "tpu")
     monkeypatch.setattr(B, "_pallas_lowers_on_this_backend", lambda _: True)
+    # Pin the gate to the conservative generic model regardless of what
+    # hardware the suite happens to run on (_gate_params consults the real
+    # device kind otherwise — on a v5e these env vars are what keep the
+    # assertions below deterministic).
+    monkeypatch.setenv("DHQR_PALLAS_VMEM_BYTES", str(12 * 1024 * 1024))
+    monkeypatch.setenv("DHQR_PALLAS_PANEL_COPIES", "2")
     assert B.auto_block_size(4096, jnp.float32) == 256
     # VMEM gate: a 16384-tall 256-wide f32 panel does not fit
     assert B.auto_block_size(16384, jnp.float32) == 128
@@ -171,6 +178,17 @@ def test_auto_block_size_rules(monkeypatch):
     # ...but falls back where a 256-wide panel is unsupported rather than
     # propagating _resolve_pallas's "always" ValueError
     assert B.auto_block_size(16384, jnp.float32, use_pallas="always") == 128
+    monkeypatch.delenv("DHQR_PALLAS_AUTO")
+
+    # Hardware-validated gate (the v5e numbers): 512 preferred at
+    # m >= 16384 where admitted, 256 below that even when 512 would fit.
+    monkeypatch.setenv("DHQR_PALLAS_VMEM_BYTES", str(34 * 1024 * 1024))
+    monkeypatch.setenv("DHQR_PALLAS_PANEL_COPIES", "1")
+    assert B.auto_block_size(16384, jnp.float32) == 512
+    assert B.auto_block_size(8192, jnp.float32) == 256  # 512 fits, not used
+    assert B.auto_block_size(4096, jnp.float32) == 256
+    # just past the 512 budget at m=16384+8k -> falls back to 256
+    assert B.auto_block_size(18432, jnp.float32) == 256
 
 
 def test_default_block_size_none_end_to_end():
@@ -187,3 +205,43 @@ def test_default_block_size_none_end_to_end():
     assert res < TOLERANCE_FACTOR * max(oracle_residual(A, b), 1e-12)
     x2 = np.asarray(lstsq(jnp.asarray(A), jnp.asarray(b)))
     np.testing.assert_allclose(x2, x, rtol=1e-10, atol=1e-12)
+
+
+@pytest.mark.parametrize("m,n,nb", [(100, 90, 32), (150, 122, 8)])
+def test_trailing_precision_noop_and_split(m, n, nb):
+    """``trailing_precision`` plumbing: explicitly passing the ambient
+    precision is bit-identical to the un-split default on both the unrolled
+    and two-level scan paths; f64 (where MXU precision is a no-op) matches
+    the unblocked engine regardless of the split."""
+    A, _ = random_problem(m, n, np.float64, seed=31)
+    H0, a0 = householder_qr(jnp.asarray(A))
+    H1, a1 = blocked_householder_qr(jnp.asarray(A), block_size=nb,
+                                    trailing_precision="default")
+    np.testing.assert_allclose(np.asarray(H1), np.asarray(H0), rtol=1e-9,
+                               atol=1e-11)
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(a0), rtol=1e-9,
+                               atol=1e-11)
+
+    Af = jnp.asarray(np.asarray(A), jnp.float32)
+    Hs, als = blocked_householder_qr(Af, block_size=nb)
+    Ht, alt = blocked_householder_qr(Af, block_size=nb,
+                                     trailing_precision="highest")
+    np.testing.assert_array_equal(np.asarray(Hs), np.asarray(Ht))
+    np.testing.assert_array_equal(np.asarray(als), np.asarray(alt))
+
+
+def test_trailing_precision_split_still_solves():
+    """The split trade (panel at highest, trailing GEMMs cheaper) must still
+    produce a usable factorization — looser tolerance by design (measured
+    trailing@high backward error ~1e-5-grade vs 1e-7 un-split; the knob is
+    a documented accuracy/throughput trade, not the default)."""
+    m, n, nb = 220, 200, 32
+    A, b = random_problem(m, n, np.float32, seed=32)
+    H, alpha = blocked_householder_qr(jnp.asarray(A), block_size=nb,
+                                      trailing_precision="high", donate=False)
+    c = blocked_apply_qt(H, alpha, jnp.asarray(b), block_size=nb)
+    x = np.asarray(back_substitute(H, alpha, c))
+    r = np.asarray(A) @ x - np.asarray(b)
+    # sanity: residual of the split solve is small in absolute terms even
+    # if it misses the 8x-LAPACK bar reserved for the full-precision path
+    assert np.linalg.norm(np.asarray(A).T @ r) < 1e-2 * np.linalg.norm(b)
