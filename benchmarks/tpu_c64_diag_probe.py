@@ -1,0 +1,146 @@
+"""Round-3 TPU diagnostic probe: isolate the complex64 / large-size failures.
+
+Earlier probes found three UNIMPLEMENTED failures on the v5e relay
+(tpu_r3_scale.jsonl, tpu_r3_tsqr_pallas.jsonl):
+
+* complex64 blocked QR at 1024^2 — even on the pure-XLA path;
+* float32 QR at 24576^2 and 32768^2 (2.4 / 4.3 GB buffers).
+
+This probe bisects, smallest-first, each op the engine uses:
+
+c64 ladder: matmul -> conj/transpose -> triangular_solve -> unblocked QR
+(no triangular_solve) -> blocked QR. Whichever rung fails first names the
+unimplemented primitive; if ``triangular_solve`` is the culprit the
+compact-WY T-factor apply can be respelled as log2(nb) small GEMMs (the
+unit-triangular doubling inverse) — worth knowing before building it.
+
+f32 size ladder: QR at 18432^2 and 20480^2 narrows where between 16384
+(works) and 24576 (fails) the backend gives up, and whether the limit is
+bytes or something else.
+
+Run ONE instance at a time (the axon relay allows a single TPU process).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _stage(name: str) -> None:
+    print(f"::stage {name} t={time.time():.1f}", file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(3))
+    from bench import _Watchdog
+
+    _stage("import")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(_REPO, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    from dhqr_tpu.utils.profiling import sync
+
+    _stage("backend_init")
+    with _Watchdog("backend_init", 150):
+        dev = jax.devices()[0]
+        platform = dev.platform
+        kind = getattr(dev, "device_kind", "?")
+        sync(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    _stage(f"backend_ready_{platform}")
+    rng = np.random.default_rng(0)
+
+    def emit(rec):
+        rec["platform"] = platform
+        rec["device_kind"] = kind
+        print(json.dumps(rec), flush=True)
+
+    def try_stage(name, fn, watchdog=180):
+        _stage(name)
+        try:
+            with _Watchdog(name, watchdog):
+                out = fn()
+                emit({"metric": name, "ok": True, **(out or {})})
+                return True
+        except Exception as ex:
+            emit({"metric": name, "ok": False,
+                  "error": f"{type(ex).__name__}: {ex}"[:300]})
+            return False
+
+    C = jnp.asarray(rng.random((256, 256)) + 1j * rng.random((256, 256)),
+                    jnp.complex64)
+
+    def c64_matmul():
+        r = jnp.matmul(C, C, precision="highest")
+        sync(jnp.abs(r[0, 0]))
+
+    def c64_conj_dot():
+        r = jnp.matmul(jnp.conj(C.T), C, precision="highest")
+        sync(jnp.abs(r[0, 0]))
+
+    def c64_trisolve():
+        U = jnp.triu(C) + 4 * jnp.eye(256, dtype=jnp.complex64)
+        r = lax.linalg.triangular_solve(U, C, left_side=True, lower=False)
+        sync(jnp.abs(r[0, 0]))
+
+    def c64_trisolve_unit_conj():
+        # The exact variant apply_block_reflector_h uses.
+        U = jnp.triu(C, k=1) * 0.01 + jnp.eye(256, dtype=jnp.complex64)
+        r = lax.linalg.triangular_solve(
+            U, C, left_side=True, lower=False, transpose_a=True,
+            conjugate_a=True, unit_diagonal=True)
+        sync(jnp.abs(r[0, 0]))
+
+    def c64_unblocked_qr():
+        from dhqr_tpu.ops.householder import _householder_qr_impl
+
+        H, al = _householder_qr_impl(C, precision="highest", norm="fast")
+        sync(jnp.abs(al[0]))
+
+    def c64_blocked_qr():
+        from dhqr_tpu.ops.blocked import _blocked_qr_impl
+
+        H, al = _blocked_qr_impl(C, 64, precision="highest", pallas=False,
+                                 norm="fast")
+        sync(jnp.abs(al[0]))
+
+    ok_mm = try_stage("c64_matmul_256", c64_matmul)
+    try_stage("c64_conj_dot_256", c64_conj_dot)
+    try_stage("c64_trisolve_256", c64_trisolve)
+    try_stage("c64_trisolve_unit_conj_256", c64_trisolve_unit_conj)
+    try_stage("c64_unblocked_qr_256", c64_unblocked_qr, watchdog=300)
+    try_stage("c64_blocked_qr_256", c64_blocked_qr, watchdog=300)
+
+    # f32 size ladder
+    from dhqr_tpu.ops.blocked import _blocked_qr_impl
+
+    def f32_qr(n):
+        def run():
+            A = jnp.asarray(rng.random((n, n)), jnp.float32)
+            sync(A)
+            t0 = time.perf_counter()
+            H, al = _blocked_qr_impl(A, 512, precision="highest",
+                                     pallas=True, norm="fast")
+            sync(al)
+            return {"seconds_first": round(time.perf_counter() - t0, 2)}
+        return run
+
+    try_stage("f32_qr_18432_nb512", f32_qr(18432), watchdog=560)
+    try_stage("f32_qr_20480_nb512", f32_qr(20480), watchdog=560)
+    _stage("done")
+
+
+if __name__ == "__main__":
+    main()

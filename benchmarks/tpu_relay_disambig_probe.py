@@ -1,0 +1,104 @@
+"""Round-3 TPU probe: disambiguate real UNIMPLEMENTED ops from relay
+compile-helper collateral damage.
+
+tpu_r3_c64_diag.jsonl shows EVERY stage failing UNIMPLEMENTED — including
+f32 shapes adjacent to ones that measured fine minutes earlier. The
+suspicious timeline: each earlier probe's first c64 *Mosaic* compile
+crashed the relay's compile helper (HTTP 500 "tpu_compile_helper
+subprocess exit code 1"), after which every subsequent compile in the
+session failed generically. So stage ORDER here is the experiment:
+
+1. uncached f32 QR (768^2, nb=64 — never compiled before): compile-helper
+   health check in a fresh process;
+2. f32 QR 18432^2 nb=512: the "size limit" claim, re-tested while healthy;
+3. c64 matmul 256^2 (pure XLA): is complex64 genuinely unimplemented?
+4. uncached f32 again (640^2): did stage 3 poison the helper for
+   non-complex work too?
+
+Run ONE instance at a time (the axon relay allows a single TPU process).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _stage(name: str) -> None:
+    print(f"::stage {name} t={time.time():.1f}", file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(3))
+    from bench import _Watchdog
+
+    _stage("import")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(_REPO, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    from dhqr_tpu.ops.blocked import _blocked_qr_impl
+    from dhqr_tpu.utils.profiling import sync
+
+    _stage("backend_init")
+    with _Watchdog("backend_init", 150):
+        dev = jax.devices()[0]
+        platform = dev.platform
+        kind = getattr(dev, "device_kind", "?")
+        sync(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    _stage(f"backend_ready_{platform}")
+    rng = np.random.default_rng(0)
+
+    def emit(rec):
+        rec["platform"] = platform
+        rec["device_kind"] = kind
+        print(json.dumps(rec), flush=True)
+
+    def try_stage(name, fn, watchdog=240):
+        _stage(name)
+        try:
+            with _Watchdog(name, watchdog):
+                t0 = time.perf_counter()
+                fn()
+                emit({"metric": name, "ok": True,
+                      "seconds_total": round(time.perf_counter() - t0, 2)})
+                return True
+        except Exception as ex:
+            emit({"metric": name, "ok": False,
+                  "error": f"{type(ex).__name__}: {ex}"[:300]})
+            return False
+
+    def f32_qr(n, nb):
+        def run():
+            A = jnp.asarray(rng.random((n, n)), jnp.float32)
+            sync(A)
+            H, al = _blocked_qr_impl(A, nb, precision="highest", pallas=True,
+                                     norm="fast")
+            sync(al)
+        return run
+
+    def c64_matmul():
+        C = jnp.asarray(rng.random((256, 256)) + 1j * rng.random((256, 256)),
+                        jnp.complex64)
+        r = jnp.matmul(C, C, precision="highest")
+        sync(jnp.abs(r[0, 0]))
+
+    try_stage("f32_qr_768_nb64_fresh", f32_qr(768, 64))
+    try_stage("f32_qr_18432_nb512", f32_qr(18432, 512), watchdog=560)
+    try_stage("c64_matmul_256", c64_matmul)
+    try_stage("f32_qr_640_nb64_after_c64", f32_qr(640, 64))
+    _stage("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,178 @@
+"""Round-3 TPU probe: scale ladder + rectangular + complex64 hardware data.
+
+Stages (each one JSONL line, watchdogged, largest-value-first):
+
+1. ``qr_12288`` at nb=256 and nb=512 — refines the auto-width crossover
+   (measured: 256 wins at 8192, 512 wins at 16384; where between?).
+2. ``qr_32768x4096`` nb=256 — the BASELINE.md config-4 SHAPE (blocked
+   compact-WY rectangular) on one chip. Device time ~0.1 s, chain=5.
+3. ``qr_c64_4096`` — first hardware datum for the complex64 engine with
+   the planar-arithmetic Pallas panel kernel (the TPU analogue of the
+   reference's ACTIVE hand-SIMD ComplexF64 hotloop, reference
+   src/DistributedHouseholderQR.jl:174-196). Complex flop model:
+   a complex MAC is 4 real multiplies + 4 adds, so dense complex QR
+   costs ~4x the real count: flops = 4 * (2mn^2 - (2/3)n^3).
+4. ``qr_32768`` nb=256 — the largest square that fits comfortably
+   (4.3 GB + workspace in 16 GB HBM); device time ~3-4 s, single
+   dispatch timing (RTT is noise at that scale).
+
+Run ONE instance at a time (the axon relay allows a single TPU process).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+# Gate override: Mosaic's allocator is the arbiter during this probe (the
+# per-kind table stays at the last VALIDATED budget; if the 67 MB panel
+# below compiles and wins, the table gets raised with the new datum).
+os.environ.setdefault("DHQR_PALLAS_VMEM_BYTES", str(100 * 1024 * 1024))
+os.environ.setdefault("DHQR_PALLAS_PANEL_COPIES", "1")
+
+
+def _stage(name: str) -> None:
+    print(f"::stage {name} t={time.time():.1f}", file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(3))
+    from bench import _Watchdog
+
+    _stage("import")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(_REPO, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    from dhqr_tpu.ops.blocked import _blocked_qr_impl
+    from dhqr_tpu.utils.profiling import sync
+
+    _stage("backend_init")
+    with _Watchdog("backend_init", 150):
+        dev = jax.devices()[0]
+        platform = dev.platform
+        kind = getattr(dev, "device_kind", "?")
+        sync(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    _stage(f"backend_ready_{platform}")
+    rng = np.random.default_rng(0)
+
+    def emit(rec):
+        rec["platform"] = platform
+        rec["device_kind"] = kind
+        print(json.dumps(rec), flush=True)
+
+    def chain_time(m, n, nb, chain, watchdog, dtype="f32", repeats=3):
+        name = f"qr_{dtype}_{m}x{n}_nb{nb}"
+        _stage(name)
+        try:
+            with _Watchdog(name, watchdog):
+                if dtype == "c64":
+                    A = jnp.asarray(rng.random((m, n)) +
+                                    1j * rng.random((m, n)), jnp.complex64)
+                    flops = 4.0 * (2.0 * m * n * n - (2.0 / 3.0) * n**3)
+                else:
+                    A = jnp.asarray(rng.random((m, n)), jnp.float32)
+                    flops = 2.0 * m * n * n - (2.0 / 3.0) * n**3
+                sync(A)
+                kw = dict(precision="highest", pallas=True, norm="fast",
+                          panel_impl="loop")
+                t0 = time.perf_counter()
+                single = _blocked_qr_impl.lower(A, nb, **kw).compile()
+                H, al = single(A)
+                sync(al)
+                compile_s = time.perf_counter() - t0
+
+                def tmin(f):
+                    ts = []
+                    for _ in range(repeats):
+                        t0 = time.perf_counter()
+                        r = f(A)
+                        sync(r[1])
+                        ts.append(time.perf_counter() - t0)
+                    return min(ts)
+
+                t1 = tmin(lambda A: single(A))
+                rec = {"metric": f"qr_gflops_per_chip_{dtype}_{m}x{n}",
+                       "unit": "GFLOP/s", "block_size": nb,
+                       "pallas_panels": True,
+                       "seconds_single_dispatch": round(t1, 4),
+                       "compile_seconds": round(compile_s, 2)}
+                if chain and chain > 1:
+                    def chained(A):
+                        def body(C, _):
+                            Hc, ac = _blocked_qr_impl(C, nb, **kw)
+                            return Hc, ac[0]
+                        return lax.scan(body, A, None, length=chain)
+                    ck = jax.jit(chained).lower(A).compile()
+                    Hc, s = ck(A)
+                    sync(s)
+                    tk = tmin(lambda A: (None, ck(A)[1]))
+                    t = (tk - t1) / (chain - 1)
+                    unreliable = not (tk > t1 * 1.05 and t > 0)
+                    if unreliable:
+                        t = t1
+                    rec.update(seconds_chain=round(tk, 4), chain_length=chain,
+                               chain_unreliable=unreliable)
+                else:
+                    t = t1  # device time >> RTT at this scale
+                rec["seconds"] = round(t, 4)
+                rec["value"] = round(flops / t / 1e9, 2)
+                if dtype == "c64":
+                    rec["flop_model"] = "4*(2mn^2-(2/3)n^3) complex-as-real"
+                emit(rec)
+        except Exception as ex:
+            emit({"metric": name, "ok": False,
+                  "error": f"{type(ex).__name__}: {ex}"[:500]})
+
+    # 0. VMEM frontier: does a 67 MB single-copy panel fit? (v5e datasheet
+    # VMEM is far above the 34 MB validated so far; Mosaic decides.)
+    big_panel_ok = False
+    _stage("panel_32768x512")
+    try:
+        with _Watchdog("panel_32768x512", 240):
+            from dhqr_tpu.ops.pallas_panel import _panel_qr_pallas_impl
+
+            panel = jnp.asarray(rng.standard_normal((32768, 512)),
+                                jnp.float32)
+            sync(panel)
+            comp = _panel_qr_pallas_impl.lower(
+                panel, 0, interpret=False).compile()
+            pf, al = comp(panel, 0)
+            sync(al)
+            vdev = float(jnp.max(jnp.abs(
+                jnp.sum(jnp.tril(pf) * jnp.tril(pf), axis=0) - 2.0)))
+            big_panel_ok = vdev < 1e-4 and bool(jnp.all(jnp.isfinite(al)))
+            emit({"metric": "panel_32768x512", "ok": big_panel_ok,
+                  "max_vnorm_dev": vdev})
+    except Exception as ex:
+        emit({"metric": "panel_32768x512", "ok": False,
+              "error": f"{type(ex).__name__}: {ex}"[:500]})
+
+    # 1. crossover refinement
+    chain_time(12288, 12288, 256, 3, 420)
+    chain_time(12288, 12288, 512, 3, 420)
+    # 2. BASELINE config-4 shape (rectangular compact-WY)
+    chain_time(32768, 4096, 256, 5, 480)
+    # 3. complex64 datum (planar Pallas panels active)
+    chain_time(4096, 4096, 256, 9, 420, dtype="c64")
+    # 4. largest square (single dispatch; device time >> RTT)
+    chain_time(32768, 32768, 256, 0, 560, repeats=2)
+    if big_panel_ok:
+        chain_time(32768, 32768, 512, 0, 560, repeats=2)
+    _stage("done")
+
+
+if __name__ == "__main__":
+    main()

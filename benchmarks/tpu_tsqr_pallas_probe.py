@@ -1,0 +1,253 @@
+"""Round-3 TPU probe: TSQR with fused Pallas leaves on hardware.
+
+The tall-skinny probe measured the XLA-leaf TSQR at 0.24-0.73 s per
+65536 x 256 factorization (12-36 GFLOP/s-equivalent — the vmapped leaf
+panel loops are latency/HBM-bound). This probe answers:
+
+1. does the VMAPPED Pallas panel kernel lower under Mosaic (vmap adds a
+   grid dimension — interpret-mode tests cannot catch a Mosaic rejection,
+   same blind spot as round 3's unbatched lowering probe)?
+2. how much does it recover? (leaves become in-VMEM kernels; trailing
+   GEMMs unchanged)
+
+Stages mirror tpu_tallskinny_probe.py exactly (same shapes, same chain
+protocol, same dense-QR-equivalent flop model) so lines are directly
+comparable.
+
+Run ONE instance at a time (the axon relay allows a single TPU process).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _stage(name: str) -> None:
+    print(f"::stage {name} t={time.time():.1f}", file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(3))
+    from bench import _Watchdog
+
+    _stage("import")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(_REPO, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    from dhqr_tpu.ops.tsqr import _tsqr_lstsq_impl, _tsqr_r_impl
+    from dhqr_tpu.utils.profiling import sync
+
+    _stage("backend_init")
+    with _Watchdog("backend_init", 150):
+        dev = jax.devices()[0]
+        platform = dev.platform
+        kind = getattr(dev, "device_kind", "?")
+        sync(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    _stage(f"backend_ready_{platform}")
+    rng = np.random.default_rng(0)
+
+    def emit(rec):
+        rec["platform"] = platform
+        rec["device_kind"] = kind
+        print(json.dumps(rec), flush=True)
+
+    def qr_flops(m, n):
+        return 2.0 * m * n * n - (2.0 / 3.0) * n**3
+
+    # 1. Mosaic lowering of the vmapped kernel (the go/no-go datum).
+    _stage("vmapped_lowering")
+    try:
+        with _Watchdog("vmapped_lowering", 240):
+            from dhqr_tpu.ops.pallas_panel import _panel_qr_pallas_impl
+
+            P = jnp.asarray(rng.standard_normal((8, 2048, 128)), jnp.float32)
+            f = jax.jit(jax.vmap(
+                lambda p: _panel_qr_pallas_impl(p, 0, interpret=False)))
+            pf, al = f(P)
+            sync(al)
+            emit({"metric": "vmapped_pallas_lowering", "ok": True,
+                  "finite": bool(jnp.all(jnp.isfinite(al)))})
+    except Exception as ex:
+        emit({"metric": "vmapped_pallas_lowering", "ok": False,
+              "error": f"{type(ex).__name__}: {ex}"[:500]})
+        _stage("done")  # no point measuring further
+        return
+
+    def tsqr_stage(m, n, nblk, chain, watchdog, repeats=3):
+        name = f"tsqr_r_pallas_{m}x{n}_blocks{nblk}"
+        _stage(name)
+        try:
+            with _Watchdog(name, watchdog):
+                A = jnp.asarray(rng.random((m, n)), jnp.float32)
+                sync(A)
+                kw = dict(precision="highest", pallas=True, interpret=False)
+                t0 = time.perf_counter()
+                single = jax.jit(lambda A: _tsqr_r_impl(
+                    A, nblk, 128, **kw)[0, 0]).lower(A).compile()
+                s = single(A)
+                sync(s)
+
+                def chained(A):
+                    def body(C, _):
+                        R = _tsqr_r_impl(C, nblk, 128, **kw)
+                        keep = jnp.where(jnp.isfinite(R[0, 0]),
+                                         jnp.float32(1.0), jnp.float32(0.0))
+                        return C * keep, R[0, 0]
+                    _, ss = lax.scan(body, A, None, length=chain)
+                    return ss[-1]
+
+                ck = jax.jit(chained).lower(A).compile()
+                compile_s = time.perf_counter() - t0
+                s = ck(A)
+                sync(s)
+
+                def tmin(f):
+                    ts = []
+                    for _ in range(repeats):
+                        t0 = time.perf_counter()
+                        r = f(A)
+                        sync(r)
+                        ts.append(time.perf_counter() - t0)
+                    return min(ts)
+
+                t1, tk = tmin(single), tmin(ck)
+                t = (tk - t1) / (chain - 1)
+                unreliable = not (tk > t1 * 1.05 and t > 0)
+                if unreliable:
+                    t = t1
+                emit({"metric": f"tsqr_r_pallas_f32_{m}x{n}_blocks{nblk}",
+                      "value": round(qr_flops(m, n) / t / 1e9, 2),
+                      "unit": "GFLOP/s",
+                      "flop_model": "2mn^2-(2/3)n^3 (dense-QR-equivalent)",
+                      "seconds": round(t, 5), "chain_length": chain,
+                      "seconds_single_dispatch": round(t1, 4),
+                      "seconds_chain": round(tk, 4),
+                      "compile_seconds": round(compile_s, 2),
+                      "chain_unreliable": unreliable,
+                      "engine": "tsqr+pallas", "n_blocks": nblk})
+        except Exception as ex:
+            emit({"metric": name, "ok": False,
+                  "error": f"{type(ex).__name__}: {ex}"[:500]})
+
+    # Same shape/blocks as the XLA-leaf baseline lines for direct diffs.
+    tsqr_stage(65536, 256, 8, 25, 420)
+    tsqr_stage(65536, 256, 32, 25, 420)
+
+    # lstsq at the BASELINE config-5 shape (XLA-leaf baseline: 1.55 s).
+    _stage("tsqr_lstsq_pallas_131072x512")
+    try:
+        with _Watchdog("tsqr_lstsq_pallas_131072x512", 480):
+            m2, n2 = 131072, 512
+            A2 = jnp.asarray(rng.random((m2, n2)), jnp.float32)
+            b2 = jnp.asarray(rng.random((m2,)), jnp.float32)
+            sync(A2)
+            kw = dict(precision="highest", pallas=True, interpret=False)
+            t0 = time.perf_counter()
+            single = jax.jit(lambda A, b: _tsqr_lstsq_impl(
+                A, b, 16, 128, **kw)[0]).lower(A2, b2).compile()
+            s = single(A2, b2)
+            sync(s)
+            compile_s = time.perf_counter() - t0
+            ts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                s = single(A2, b2)
+                sync(s)
+                ts.append(time.perf_counter() - t0)
+            t1 = min(ts)
+            emit({"metric": f"tsqr_lstsq_pallas_f32_{m2}x{n2}",
+                  "value": round((qr_flops(m2, n2) + 2.0 * m2 * n2)
+                                 / t1 / 1e9, 2),
+                  "unit": "GFLOP/s", "seconds_single_dispatch": round(t1, 4),
+                  "compile_seconds": round(compile_s, 2),
+                  "engine": "tsqr+pallas", "n_blocks": 16,
+                  "config": "BASELINE-5 shape",
+                  "note": "single-dispatch (RTT-bound if < ~0.1 s)"})
+    except Exception as ex:
+        emit({"metric": "tsqr_lstsq_pallas_131072x512", "ok": False,
+              "error": f"{type(ex).__name__}: {ex}"[:500]})
+
+    # ---- c64 diagnostics: the scale probe's c64 4096^2 stage failed with
+    # a bare UNIMPLEMENTED; isolate which piece (planar Pallas kernel vs
+    # the XLA complex path, e.g. complex triangular_solve) doesn't lower.
+    from dhqr_tpu.ops.blocked import _blocked_qr_impl
+
+    _stage("c64_pallas_panel")
+    try:
+        with _Watchdog("c64_pallas_panel", 240):
+            from dhqr_tpu.ops.pallas_panel import _panel_qr_pallas_impl
+
+            pc = jnp.asarray(rng.random((2048, 128)) +
+                             1j * rng.random((2048, 128)), jnp.complex64)
+            pf, al = _panel_qr_pallas_impl(pc, 0, interpret=False)
+            sync(al)
+            emit({"metric": "c64_pallas_panel_2048x128", "ok": True,
+                  "finite": bool(jnp.all(jnp.isfinite(al)))})
+    except Exception as ex:
+        emit({"metric": "c64_pallas_panel_2048x128", "ok": False,
+              "error": f"{type(ex).__name__}: {ex}"[:500]})
+
+    _stage("c64_xla_blocked")
+    try:
+        with _Watchdog("c64_xla_blocked", 300):
+            Ac = jnp.asarray(rng.random((1024, 1024)) +
+                             1j * rng.random((1024, 1024)), jnp.complex64)
+            sync(Ac)
+            H, al = _blocked_qr_impl(Ac, 128, precision="highest",
+                                     pallas=False, norm="fast")
+            sync(al)
+            emit({"metric": "c64_xla_blocked_1024", "ok": True,
+                  "finite": bool(jnp.all(jnp.isfinite(al)))})
+    except Exception as ex:
+        emit({"metric": "c64_xla_blocked_1024", "ok": False,
+              "error": f"{type(ex).__name__}: {ex}"[:500]})
+
+    # ---- largest square that fits 32-bit buffer addressing (32768^2 f32
+    # is exactly 2^32 bytes and failed; 24576^2 = 2.4 GB).
+    _stage("qr_24576_nb512")
+    try:
+        with _Watchdog("qr_24576_nb512", 560):
+            A3 = jnp.asarray(rng.random((24576, 24576)), jnp.float32)
+            sync(A3)
+            kw = dict(precision="highest", pallas=True, norm="fast",
+                      panel_impl="loop")
+            t0 = time.perf_counter()
+            single = _blocked_qr_impl.lower(A3, 512, **kw).compile()
+            H, al = single(A3)
+            sync(al)
+            compile_s = time.perf_counter() - t0
+            ts = []
+            for _ in range(2):
+                t0 = time.perf_counter()
+                H, al = single(A3)
+                sync(al)
+                ts.append(time.perf_counter() - t0)
+            t1 = min(ts)
+            n3 = 24576
+            emit({"metric": f"qr_gflops_per_chip_f32_{n3}x{n3}",
+                  "value": round((4.0 / 3.0) * n3**3 / t1 / 1e9, 2),
+                  "unit": "GFLOP/s", "block_size": 512,
+                  "pallas_panels": True, "seconds": round(t1, 4),
+                  "compile_seconds": round(compile_s, 2),
+                  "note": "single-dispatch; device time >> RTT"})
+    except Exception as ex:
+        emit({"metric": "qr_24576_nb512", "ok": False,
+              "error": f"{type(ex).__name__}: {ex}"[:500]})
+    _stage("done")
+
+
+if __name__ == "__main__":
+    main()

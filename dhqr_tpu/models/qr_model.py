@@ -265,11 +265,16 @@ def _lstsq_alt_engine(A, b, cfg: DHQRConfig, mesh):
     ``mesh_axis``, else the sole axis of a 1-D mesh, else an axis named
     "rows" — unlike the Householder mesh path, which shards columns.
     """
-    if cfg.layout != "block" or cfg.use_pallas != "auto":
+    if cfg.layout != "block":
         raise ValueError(
-            f"layout/use_pallas apply only to the householder engines; "
-            f"engine={cfg.engine!r} shards rows (layout={cfg.layout!r}, "
-            f"use_pallas={cfg.use_pallas!r})"
+            f"layout applies only to the householder engines; "
+            f"engine={cfg.engine!r} shards rows (layout={cfg.layout!r})"
+        )
+    if cfg.engine != "tsqr" and cfg.use_pallas != "auto":
+        raise ValueError(
+            f"use_pallas applies to engines with panel loops (householder, "
+            f"tsqr); engine={cfg.engine!r} is all-GEMM "
+            f"(use_pallas={cfg.use_pallas!r})"
         )
     axis = None
     if mesh is not None:
@@ -303,13 +308,14 @@ def _lstsq_alt_engine(A, b, cfg: DHQRConfig, mesh):
             return sharded_tsqr_lstsq(
                 A, b, mesh, block_size=cfg.block_size,
                 axis_name=axis, precision=cfg.precision,
+                use_pallas=cfg.use_pallas,
             )
         n_blocks = max(1, min(8, A.shape[0] // max(A.shape[1], 1)))
         while n_blocks > 1 and A.shape[0] % n_blocks:
             n_blocks -= 1
         return tsqr_lstsq(
             A, b, n_blocks=n_blocks, block_size=cfg.block_size,
-            precision=cfg.precision,
+            precision=cfg.precision, use_pallas=cfg.use_pallas,
         )
     if cfg.engine in ("cholqr2", "cholqr3"):
         shift = cfg.engine == "cholqr3"

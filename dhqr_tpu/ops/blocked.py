@@ -323,19 +323,20 @@ def auto_block_size(m: int, dtype, use_pallas: str = "auto") -> int:
     """Panel width when the caller leaves ``block_size`` unset.
 
     Round-3 hardware sweeps (benchmarks/results/tpu_r3_longchain_stages.jsonl,
-    tpu_r3_tune2.jsonl, tpu_r3_vmem_probe2.jsonl): with the fused Pallas
-    panel kernel and the hardware-validated single-copy VMEM gate, all-Pallas
-    nb=256 won at 4096^2 and 8192^2 (10.3 / 10.9 TFLOP/s vs 8.5 / 8.8 at
-    nb=512), while at 16384^2 the panel-count halving flips the order:
-    nb=512 measured 12.9 TFLOP/s vs 12.2 at nb=256. So: 512 where m >= 16384
-    and the gate admits a 512-wide tallest panel; else 256 where the gate
-    admits 256; else 128. Off-TPU (or with the kernel vetoed) the panel loop
-    is latency-bound either way: stay at 128.
+    tpu_r3_tune2.jsonl, tpu_r3_vmem_probe2.jsonl, tpu_r3_scale.jsonl): with
+    the fused Pallas panel kernel and the hardware-validated single-copy
+    VMEM gate, all-Pallas nb=256 won at 4096^2 and 8192^2 (10.3 / 10.9
+    TFLOP/s vs 8.5 / 8.8 at nb=512), while from 12288^2 up the panel-count
+    halving flips the order: nb=512 measured 13.0 vs 11.3 TFLOP/s at
+    12288^2 and 12.9 vs 12.2 at 16384^2. So: 512 where m >= 12288 and the
+    gate admits a 512-wide tallest panel; else 256 where the gate admits
+    256; else 128. Off-TPU (or with the kernel vetoed) the panel loop is
+    latency-bound either way: stay at 128.
     """
     if use_pallas == "never":
         return DEFAULT_BLOCK_SIZE
     for nb in (512, 256):
-        if nb == 512 and m < 16384:
+        if nb == 512 and m < 12288:
             continue
         try:
             # The one routing predicate (_resolve_pallas) decides —

@@ -57,16 +57,17 @@ from jax import lax
 # body's ``at - W*v`` chain could materialize a second panel-sized value if
 # Mosaic does not fuse it). On hardware where larger residency was MEASURED
 # to compile and run, the per-device-kind table below overrides: round-3
-# probe on a v5e ("TPU v5 lite") ran single-copy panels up to (16384, 512)
-# = 33.6 MB (benchmarks/results/tpu_r3_vmem_probe2.jsonl), i.e. Mosaic does
-# fuse the chain and v5e VMEM is far larger than the generic ~16 MB
+# probe on a v5e ("TPU v5 lite") ran single-copy panels up to (32768, 512)
+# = 67 MB with correct reflector norms (tpu_r3_vmem_probe2.jsonl ran the
+# ladder to 33.6 MB, tpu_r3_scale.jsonl extended it to 67 MB), i.e. Mosaic
+# does fuse the chain and v5e VMEM is far larger than the generic ~16 MB
 # planning number. DHQR_PALLAS_VMEM_BYTES / DHQR_PALLAS_PANEL_COPIES
 # override both (read per call, so tests/experiments can flip them).
 import os as _os
 
 _MEASURED_VMEM_KINDS = {
     # device_kind -> (budget_bytes, resident_copies), hardware-validated
-    "TPU v5 lite": (34 * 1024 * 1024, 1),
+    "TPU v5 lite": (68 * 1024 * 1024, 1),
 }
 
 

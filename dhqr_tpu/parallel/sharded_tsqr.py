@@ -41,27 +41,31 @@ def row_mesh(
     return column_mesh(n_devices, axis_name=axis_name, devices=devices)
 
 
-def _tsqr_shard_body(Al, bl, *, n: int, nb: int, axis: str, precision: str):
+def _tsqr_shard_body(Al, bl, *, n: int, nb: int, axis: str, precision: str,
+                     pallas: bool = False, interpret: bool = False):
     """Per-device: local QR + Q^H b, then replicated combine of the R heads.
 
     Leaf and combine stages are shared with the single-device tree
     (ops/tsqr) so the two paths cannot numerically diverge.
     """
     Bl, restore = as_matrix_rhs(bl)
-    R, c = _leaf_factor(Al, Bl, nb, precision)
+    R, c = _leaf_factor(Al, Bl, nb, precision, pallas, interpret)
     # ONE collective: gather every device's heads (P*n rows — tiny traffic).
     Rstack = lax.all_gather(R, axis).reshape(-1, n)
     cstack = lax.all_gather(c, axis).reshape(-1, c.shape[1])
     # Combine stage, replicated on every device (cheaper than a second
     # collective to scatter the result — same trade as the reference making
     # alpha a SharedArray, src:302).
-    return restore(_combine_solve(Rstack, cstack, nb, precision))
+    return restore(_combine_solve(Rstack, cstack, nb, precision, pallas,
+                                  interpret))
 
 
 @lru_cache(maxsize=None)
-def _build_tsqr(mesh: Mesh, axis_name: str, n: int, nb: int, precision: str):
+def _build_tsqr(mesh: Mesh, axis_name: str, n: int, nb: int, precision: str,
+                pallas: bool = False, interpret: bool = False):
     body = partial(
-        _tsqr_shard_body, n=n, nb=nb, axis=axis_name, precision=precision
+        _tsqr_shard_body, n=n, nb=nb, axis=axis_name, precision=precision,
+        pallas=pallas, interpret=interpret,
     )
     return jax.jit(
         shard_map(
@@ -81,12 +85,17 @@ def sharded_tsqr_lstsq(
     block_size: int = 128,
     axis_name: str = ROW_AXIS,
     precision: str = DEFAULT_PRECISION,
+    use_pallas: str = "auto",
 ) -> jax.Array:
     """Distributed tall-skinny least squares: rows sharded, one all-gather.
 
     Requires m divisible by the mesh size with each local block tall
-    (m/P >= n). Returns x replicated.
+    (m/P >= n). Returns x replicated. ``use_pallas`` routes the per-device
+    leaf panel loops through the fused VMEM kernel (resolved against the
+    LOCAL leaf shape m/P x nb — same semantics as ``tsqr_lstsq``).
     """
+    from dhqr_tpu.ops.tsqr import _resolve_tsqr_pallas
+
     m, n = A.shape
     nproc = mesh.shape[axis_name]
     if m % nproc != 0:
@@ -96,6 +105,9 @@ def sharded_tsqr_lstsq(
             f"local row blocks must stay tall: m/P = {m // nproc} < n = {n}"
         )
     nb = min(int(block_size), n)
+    pallas, interpret = _resolve_tsqr_pallas(use_pallas, m // nproc, n, nb,
+                                             A.dtype)
     A = jax.device_put(A, NamedSharding(mesh, P(axis_name, None)))
     b = jax.device_put(b, NamedSharding(mesh, P(axis_name)))
-    return _build_tsqr(mesh, axis_name, n, nb, precision)(A, b)
+    return _build_tsqr(mesh, axis_name, n, nb, precision, pallas,
+                       interpret)(A, b)

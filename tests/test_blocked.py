@@ -148,7 +148,7 @@ def test_blocked_qr_fast_norm_end_to_end():
 
 def test_auto_block_size_rules(monkeypatch):
     """None block_size resolves per backend: 128 off-TPU; on TPU the widest
-    of {512 (m >= 16384 only), 256} whose tallest panel the Pallas VMEM
+    of {512 (m >= 12288 only), 256} whose tallest panel the Pallas VMEM
     gate admits, else 128 (measured optimum at each scale, round-3
     hardware sweeps)."""
     from dhqr_tpu.ops import blocked as B
@@ -181,13 +181,14 @@ def test_auto_block_size_rules(monkeypatch):
     monkeypatch.delenv("DHQR_PALLAS_AUTO")
 
     # Hardware-validated gate (the v5e numbers): 512 preferred at
-    # m >= 16384 where admitted, 256 below that even when 512 would fit.
+    # m >= 12288 where admitted, 256 below that even when 512 would fit.
     monkeypatch.setenv("DHQR_PALLAS_VMEM_BYTES", str(34 * 1024 * 1024))
     monkeypatch.setenv("DHQR_PALLAS_PANEL_COPIES", "1")
     assert B.auto_block_size(16384, jnp.float32) == 512
+    assert B.auto_block_size(12288, jnp.float32) == 512
     assert B.auto_block_size(8192, jnp.float32) == 256  # 512 fits, not used
     assert B.auto_block_size(4096, jnp.float32) == 256
-    # just past the 512 budget at m=16384+8k -> falls back to 256
+    # just past the 512 budget at m=16384+2k -> falls back to 256
     assert B.auto_block_size(18432, jnp.float32) == 256
 
 
