@@ -1,0 +1,99 @@
+"""Round-3 TPU probe: large-size retest with a healthy compile helper.
+
+tpu_r3_disambig.jsonl proved the earlier 18432-24576 "failures" were
+collateral from a crashed compile helper (a failed c64 compile poisons the
+process), and 18432^2 actually works. This probe, run FIRST in a fresh
+process with no complex stages at all, measures the real size ceiling:
+24576^2 and 28672^2, nb=512 all-Pallas (the v5e gate admits 50 / 58.7 MB
+panels). 32768^2 stays excluded — its buffer is exactly 2^32 bytes, a
+genuine per-buffer addressing limit.
+
+Single-dispatch timing: device time (>= 0.6 s) dwarfs the 60-90 ms RTT.
+
+Run ONE instance at a time (the axon relay allows a single TPU process).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _stage(name: str) -> None:
+    print(f"::stage {name} t={time.time():.1f}", file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(3))
+    from bench import _Watchdog
+
+    _stage("import")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(_REPO, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    from dhqr_tpu.ops.blocked import _blocked_qr_impl
+    from dhqr_tpu.utils.profiling import sync
+
+    _stage("backend_init")
+    with _Watchdog("backend_init", 150):
+        dev = jax.devices()[0]
+        platform = dev.platform
+        kind = getattr(dev, "device_kind", "?")
+        sync(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    _stage(f"backend_ready_{platform}")
+    rng = np.random.default_rng(0)
+
+    def emit(rec):
+        rec["platform"] = platform
+        rec["device_kind"] = kind
+        print(json.dumps(rec), flush=True)
+
+    def qr_stage(n, nb, watchdog, repeats=2):
+        name = f"qr_f32_{n}_nb{nb}"
+        _stage(name)
+        try:
+            with _Watchdog(name, watchdog):
+                A = jnp.asarray(rng.random((n, n)), jnp.float32)
+                sync(A)
+                t0 = time.perf_counter()
+                comp = _blocked_qr_impl.lower(
+                    A, nb, precision="highest", pallas=True,
+                    norm="fast").compile()
+                H, al = comp(A)
+                sync(al)
+                compile_s = time.perf_counter() - t0
+                ts = []
+                for _ in range(repeats):
+                    t0 = time.perf_counter()
+                    H, al = comp(A)
+                    sync(al)
+                    ts.append(time.perf_counter() - t0)
+                t1 = min(ts)
+                emit({"metric": f"qr_gflops_per_chip_f32_{n}x{n}",
+                      "value": round((4.0 / 3.0) * n**3 / t1 / 1e9, 2),
+                      "unit": "GFLOP/s", "block_size": nb,
+                      "pallas_panels": True, "seconds": round(t1, 4),
+                      "compile_seconds": round(compile_s, 2),
+                      "note": "single-dispatch; device time >> RTT"})
+        except Exception as ex:
+            emit({"metric": name, "ok": False,
+                  "error": f"{type(ex).__name__}: {ex}"[:300]})
+
+    qr_stage(24576, 512, 560)
+    qr_stage(28672, 512, 560)
+    _stage("done")
+
+
+if __name__ == "__main__":
+    main()

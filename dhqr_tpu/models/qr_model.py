@@ -156,6 +156,8 @@ def qr(
     ...                                    # (and invalidated), like qr!'s overwrite
     >>> fact = qr(A, mesh=column_mesh(8))  # distributed: the DArray tier
     """
+    from dhqr_tpu.utils.platform import ensure_complex_supported
+
     cfg = dataclasses.replace(config or DHQRConfig(), **overrides)
     if cfg.engine != "householder":
         if cfg.engine not in LSTSQ_ENGINES:
@@ -168,6 +170,7 @@ def qr(
             "tsqr/cholqr engines are lstsq-only fast paths"
         )
     _check_panel_impl(cfg)
+    ensure_complex_supported(A.dtype)
     # Resolve the auto panel width once, up front: the factorization object
     # must record a concrete nb (its solves reuse it), and the mesh planner
     # needs an int. None = backend/shape auto (ops/blocked.auto_block_size);
@@ -397,6 +400,8 @@ def lstsq(
     result is the minimum-norm solution of the underdetermined system
     (single-device householder engine only).
     """
+    from dhqr_tpu.utils.platform import ensure_complex_supported
+
     cfg = dataclasses.replace(config or DHQRConfig(), **overrides)
     if cfg.norm not in ("accurate", "fast"):
         raise ValueError(
@@ -407,6 +412,7 @@ def lstsq(
         raise ValueError(
             f"unknown engine {cfg.engine!r}: expected one of {LSTSQ_ENGINES}"
         )
+    ensure_complex_supported(A.dtype)
     if cfg.block_size is None:
         # Same resolution rule as qr(): auto width only where the Pallas
         # kernel can actually take the panels — the single-device blocked

@@ -388,11 +388,14 @@ def blocked_householder_qr(
     reflector chains at full accuracy. Measure the backward error for your
     sizes before relying on it; the library default remains un-split.
     """
+    from dhqr_tpu.utils.platform import ensure_complex_supported
+
     m, n = A.shape
     if m < n:
         raise ValueError(f"blocked_householder_qr requires m >= n, got {A.shape}")
     if norm not in ("accurate", "fast"):
         raise ValueError(f"norm must be 'accurate' or 'fast', got {norm!r}")
+    ensure_complex_supported(A.dtype)
     nb = auto_block_size(m, A.dtype, use_pallas) if block_size is None \
         else int(block_size)
     pallas, interpret = _resolve_pallas(use_pallas, m, min(nb, n), A.dtype)

@@ -111,9 +111,12 @@ def cholesky_qr2(
     widens the window toward cond(A) ~ 1/eps and the extra pass restores
     O(eps) orthogonality that the shift alone would forfeit.
     """
+    from dhqr_tpu.utils.platform import ensure_complex_supported
+
     m, n = A.shape
     if m < n:
         raise ValueError(f"cholesky_qr2 requires m >= n, got {A.shape}")
+    ensure_complex_supported(A.dtype)
     return _cholesky_qr2_impl(A, precision, bool(shift))
 
 
@@ -132,6 +135,9 @@ def cholesky_qr_lstsq(
     shift: bool = False,
 ) -> jax.Array:
     """Least squares via CholeskyQR2 — the all-GEMM fast path for m >> n."""
+    from dhqr_tpu.utils.platform import ensure_complex_supported
+
     if A.shape[0] < A.shape[1]:
         raise ValueError(f"lstsq requires m >= n, got {A.shape}")
+    ensure_complex_supported(A.dtype)
     return _cholqr_lstsq_impl(A, b, precision, bool(shift))

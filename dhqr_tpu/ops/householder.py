@@ -182,7 +182,10 @@ def householder_qr(A: jax.Array, precision: str = DEFAULT_PRECISION,
     reference ``householder!``/``_householder!`` (src:113-148) as one compiled
     ``fori_loop`` program.
     """
+    from dhqr_tpu.utils.platform import ensure_complex_supported
+
     m, n = A.shape
     if m < n:
         raise ValueError(f"householder_qr requires m >= n, got {A.shape}")
+    ensure_complex_supported(A.dtype)
     return _householder_qr_impl(A, precision=precision, norm=norm)

@@ -104,8 +104,11 @@ def tsqr_lstsq(
     :func:`dhqr_tpu.ops.blocked.blocked_householder_qr`): "auto" resolves
     to the kernel on TPU for supported leaf shapes.
     """
+    from dhqr_tpu.utils.platform import ensure_complex_supported
+
     m, n = A.shape
     _check_tsqr_shape(m, n, n_blocks)
+    ensure_complex_supported(A.dtype)
     pallas, interpret = _resolve_tsqr_pallas(use_pallas, m // int(n_blocks),
                                              n, int(block_size), A.dtype)
     return _tsqr_lstsq_impl(A, b, int(n_blocks), int(block_size), precision,
@@ -155,8 +158,11 @@ def tsqr_r(
     (src:8-9), so R here may differ from another QR's R by a diagonal +-1
     factor — ``R^H R = A^H A`` holds regardless.
     """
+    from dhqr_tpu.utils.platform import ensure_complex_supported
+
     m, n = A.shape
     _check_tsqr_shape(m, n, n_blocks)
+    ensure_complex_supported(A.dtype)
     pallas, interpret = _resolve_tsqr_pallas(use_pallas, m // int(n_blocks),
                                              n, int(block_size), A.dtype)
     return _tsqr_r_impl(A, int(n_blocks), int(block_size), precision,

@@ -95,7 +95,9 @@ def sharded_tsqr_lstsq(
     LOCAL leaf shape m/P x nb — same semantics as ``tsqr_lstsq``).
     """
     from dhqr_tpu.ops.tsqr import _resolve_tsqr_pallas
+    from dhqr_tpu.utils.platform import ensure_complex_supported
 
+    ensure_complex_supported(A.dtype)
     m, n = A.shape
     nproc = mesh.shape[axis_name]
     if m % nproc != 0:

@@ -43,3 +43,75 @@ def enable_compile_cache(cache_dir: str | None = None,
     )
     jax.config.update("jax_persistent_cache_min_compile_time_secs",
                       min_compile_secs)
+
+
+from functools import lru_cache as _lru_cache
+
+
+@_lru_cache(maxsize=None)
+def _complex_probe_result() -> bool:
+    """One probe per process: run + read back an MXU-shaped c64 matmul.
+
+    Execute AND read back, at 256^2: the axon relay's c64 failure is
+    run-time and shape-dependent — an 8x8 c64 matmul compiles AND
+    executes, a 256x256 one fails UNIMPLEMENTED (both measured live), and
+    under the async tunnel only a host readback forces the error to
+    materialize.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        C = jnp.full((256, 256), 1 + 1j, jnp.complex64)
+        r = jax.jit(lambda c: c @ c)(C)
+        float(jnp.abs(r[0, 0]))
+        return True
+    except Exception:
+        return False
+
+
+def complex_supported_on_backend() -> bool:
+    """Does the default backend actually run complex64 math?
+
+    Standard TPU runtimes support complex64 (decomposed matmuls), but the
+    round-3 axon v5e relay does not — a 256^2 c64 XLA matmul fails
+    UNIMPLEMENTED at run time, and worse, the FAILED complex work crashes
+    the relay's remote compile helper so every later compile in the
+    process fails too (benchmarks/results/tpu_r3_disambig.jsonl: an f32
+    program that compiled fine at stage 1 fails after the c64 stage). A
+    tiny probe at first complex use converts that failure mode into one
+    clear error up front; on healthy backends the probe is a sub-second
+    compile, cached per process. ``DHQR_TPU_COMPLEX=1`` skips the probe
+    (trust the backend) — read per call, so setting it after a failed
+    probe still takes effect.
+    """
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return True
+    if os.environ.get("DHQR_TPU_COMPLEX") == "1":
+        return True
+    return _complex_probe_result()
+
+
+def ensure_complex_supported(dtype) -> None:
+    """Raise early (before any engine compile) for complex dtypes on
+    backends whose TPU compiler rejects them — see
+    :func:`complex_supported_on_backend` for why failing fast matters."""
+    import jax.numpy as jnp
+
+    if not jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating):
+        return
+    if complex_supported_on_backend():
+        return
+    raise ValueError(
+        "complex inputs are not supported by this TPU backend (the probe — "
+        "a 256x256 complex64 matmul, executed and read back — failed "
+        "UNIMPLEMENTED; the axon relay backend has no complex support at "
+        "MXU shapes, see benchmarks/results/tpu_r3_disambig.jsonl). Run "
+        "complex problems on CPU (jax.config.update('jax_platforms', "
+        "'cpu')). NOTE: the failed probe may have degraded this process's "
+        "remote compile helper — if later float compiles fail, restart "
+        "the process. Set DHQR_TPU_COMPLEX=1 to skip this check on "
+        "backends that do support complex."
+    )

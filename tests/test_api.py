@@ -211,3 +211,56 @@ def test_qr_explicit_matches_numpy_semantics(dtype):
     np.testing.assert_allclose(np.asarray(Q @ R), A, atol=1e-12)
     Rn = np.asarray(R)
     assert np.allclose(Rn, np.triu(Rn))
+
+
+def test_complex_guard_raises_before_compile(monkeypatch):
+    """On a backend whose TPU compiler rejects complex (the axon relay —
+    where a FAILED complex compile also poisons the process's compile
+    helper), every engine entry raises one clear error before any compile
+    is attempted. CPU/complex-capable backends are unaffected."""
+    from dhqr_tpu.ops.blocked import blocked_householder_qr
+    from dhqr_tpu.ops.cholqr import cholesky_qr2
+    from dhqr_tpu.ops.householder import householder_qr
+    from dhqr_tpu.ops.tsqr import tsqr_lstsq
+    from dhqr_tpu.utils import platform as plat
+
+    monkeypatch.setattr(plat, "complex_supported_on_backend", lambda: False)
+    A = jnp.zeros((16, 8), jnp.complex128)
+    from dhqr_tpu.ops.cholqr import cholesky_qr_lstsq
+    from dhqr_tpu.ops.tsqr import tsqr_r
+    from dhqr_tpu.parallel.sharded_tsqr import row_mesh, sharded_tsqr_lstsq
+
+    for call in (
+        lambda: householder_qr(A),
+        lambda: blocked_householder_qr(A),
+        lambda: cholesky_qr2(A),
+        lambda: cholesky_qr_lstsq(A, jnp.zeros(16, jnp.complex128)),
+        lambda: tsqr_lstsq(jnp.zeros((16, 2), jnp.complex64),
+                           jnp.zeros(16, jnp.complex64), n_blocks=2),
+        lambda: tsqr_r(jnp.zeros((16, 2), jnp.complex64), n_blocks=2),
+        lambda: sharded_tsqr_lstsq(jnp.zeros((16, 2), jnp.complex64),
+                                   jnp.zeros(16, jnp.complex64),
+                                   row_mesh(2)),
+    ):
+        with pytest.raises(ValueError, match="complex inputs are not"):
+            call()
+    # float paths never consult the probe result
+    H, al = householder_qr(jnp.zeros((8, 4), jnp.float32))
+    assert H.shape == (8, 4)
+
+
+def test_complex_probe_env_bypass(monkeypatch):
+    """DHQR_TPU_COMPLEX=1 trusts the backend without probing (read per
+    call, so setting it AFTER a cached failed probe still wins); off-TPU
+    the check short-circuits to True without probing."""
+    from dhqr_tpu.utils import platform as plat
+
+    assert plat.complex_supported_on_backend() is True  # CPU suite
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    # simulate a cached failed probe (the axon relay case)
+    monkeypatch.setattr(plat, "_complex_probe_result", lambda: False)
+    assert plat.complex_supported_on_backend() is False
+    monkeypatch.setenv("DHQR_TPU_COMPLEX", "1")
+    assert plat.complex_supported_on_backend() is True  # env overrides cache
