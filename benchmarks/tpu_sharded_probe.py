@@ -1,0 +1,113 @@
+"""Round-3 TPU probe: the SHARDED engines on real TPU hardware.
+
+The multichip proof so far is the driver's virtual-CPU dryrun
+(`__graft_entry__.dryrun_multichip`) — it validates compilation and
+collective correctness, but no shard_map program had ever executed on the
+real chip. Only one chip is reachable through the tunnel, so this runs
+every distributed engine on a ONE-device mesh: the degenerate case still
+builds and executes the full distributed program — shard_map tracing,
+psum-per-panel broadcast/reduce choreography, store-layout chaining, the
+TSQR all-gather combine, the CholQR psum — on TPU hardware, against the
+same `lstsq(mesh=...)` public surface a pod user calls.
+
+Stages (each one JSONL line): column-sharded blocked lstsq in both
+layouts (block + cyclic), row-sharded TSQR lstsq, row-sharded CholQR
+lstsq, each at 2048x1792 f32 with a residual check against the
+single-device engine answer.
+
+Run ONE instance at a time (the axon relay allows a single TPU process).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _stage(name: str) -> None:
+    print(f"::stage {name} t={time.time():.1f}", file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(3))
+    from bench import _Watchdog
+
+    _stage("import")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(_REPO, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    import dhqr_tpu
+    from dhqr_tpu.parallel.mesh import column_mesh
+    from dhqr_tpu.parallel.sharded_tsqr import row_mesh
+    from dhqr_tpu.utils.profiling import sync
+
+    _stage("backend_init")
+    with _Watchdog("backend_init", 150):
+        dev = jax.devices()[0]
+        platform = dev.platform
+        kind = getattr(dev, "device_kind", "?")
+        sync(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    _stage(f"backend_ready_{platform}")
+    rng = np.random.default_rng(0)
+
+    def emit(rec):
+        rec["platform"] = platform
+        rec["device_kind"] = kind
+        print(json.dumps(rec), flush=True)
+
+    m, n = 2048, 1792
+    A = jnp.asarray(rng.random((m, n)), jnp.float32)
+    b = jnp.asarray(rng.random(m), jnp.float32)
+    sync(A)
+
+    _stage("single_device_reference")
+    with _Watchdog("single_device_reference", 300):
+        x_ref = dhqr_tpu.lstsq(A, b, norm="fast")
+        sync(x_ref)
+        x_ref_h = np.asarray(x_ref)
+
+    def stage(name, fn, watchdog=420):
+        _stage(name)
+        try:
+            with _Watchdog(name, watchdog):
+                t0 = time.perf_counter()
+                x = fn()
+                sync(x)
+                total_s = time.perf_counter() - t0
+                rel = float(np.linalg.norm(np.asarray(x) - x_ref_h) /
+                            max(np.linalg.norm(x_ref_h), 1e-30))
+                emit({"metric": name, "ok": True,
+                      "seconds_total_first_call": round(total_s, 2),
+                      "rel_diff_vs_single_device": rel,
+                      "agrees": rel < 1e-3})
+        except Exception as ex:
+            emit({"metric": name, "ok": False,
+                  "error": f"{type(ex).__name__}: {ex}"[:400]})
+
+    cmesh = column_mesh(1)
+    stage("sharded_lstsq_block_layout_tpu",
+          lambda: dhqr_tpu.lstsq(A, b, mesh=cmesh, norm="fast"))
+    stage("sharded_lstsq_cyclic_layout_tpu",
+          lambda: dhqr_tpu.lstsq(A, b, mesh=cmesh, layout="cyclic",
+                                 norm="fast"))
+    rmesh = row_mesh(1)
+    stage("sharded_tsqr_lstsq_tpu",
+          lambda: dhqr_tpu.lstsq(A, b, mesh=rmesh, engine="tsqr"))
+    stage("sharded_cholqr_lstsq_tpu",
+          lambda: dhqr_tpu.lstsq(A, b, mesh=rmesh, engine="cholqr2"))
+    _stage("done")
+
+
+if __name__ == "__main__":
+    main()
