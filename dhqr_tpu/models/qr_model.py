@@ -110,6 +110,35 @@ class QRFactorization:
             self.H, self.alpha, eye, self.block_size, precision=self.precision
         )
 
+    def condition_estimate(self) -> jax.Array:
+        """Cheap LOWER bound on cond_2(A): ``max|r_ii| / min|r_ii|``.
+
+        R's diagonal magnitudes bound the extreme singular values
+        (``sigma_max >= max|r_ii|``, ``sigma_min <= min|r_ii|``), so the
+        ratio never overestimates. Without column pivoting it can
+        UNDERESTIMATE badly on adversarial matrices (a famous example:
+        the Kahan matrix), but it is the right cheap pre-check for the
+        CholeskyQR window (``cond(A) < ~1/sqrt(eps)`` — ops/cholqr.py):
+        if even the lower bound exceeds the window, do not route there.
+        O(n), no extra factorization work.
+        """
+        d = jnp.abs(self.alpha)
+        return jnp.max(d) / jnp.min(d)
+
+    def rank(self, rtol: Optional[float] = None) -> jax.Array:
+        """Numerical rank estimate: ``#{i : |r_ii| > rtol * max|r_ii|}``.
+
+        Default rtol = ``max(m, n) * eps`` of the dtype (the numpy
+        ``matrix_rank`` convention). Same caveat as
+        :meth:`condition_estimate`: without pivoting the R diagonal can
+        hide deficiency — treat as a diagnostic, not a guarantee.
+        """
+        m, n = self.H.shape
+        d = jnp.abs(self.alpha)
+        if rtol is None:
+            rtol = max(m, n) * float(jnp.finfo(d.dtype).eps)
+        return jnp.sum(d > rtol * jnp.max(d))
+
     # -- solves ------------------------------------------------------------
     def solve(self, b: jax.Array) -> jax.Array:
         """Least-squares solve ``x = argmin ||A x - b||`` — reference ``H \\ b``
@@ -170,6 +199,12 @@ def qr(
             "tsqr/cholqr engines are lstsq-only fast paths"
         )
     _check_panel_impl(cfg)
+    if cfg.refine:
+        raise ValueError(
+            "refine applies to lstsq() only — qr() returns the raw "
+            "factorization; call fact.solve and refine around it, or use "
+            "lstsq(A, b, refine=...)"
+        )
     ensure_complex_supported(A.dtype)
     # Resolve the auto panel width once, up front: the factorization object
     # must record a concrete nb (its solves reuse it), and the mesh planner
@@ -255,6 +290,66 @@ def qr_explicit(
     return fact.q_columns(), fact.r_matrix()
 
 
+def _validate_alt_engine_cfg(cfg: DHQRConfig) -> None:
+    """Option rejections shared by every route into the alt engines (the
+    plain path AND the refine path — adding refine must never change
+    whether a config error is reported)."""
+    if cfg.layout != "block":
+        raise ValueError(
+            f"layout applies only to the householder engines; "
+            f"engine={cfg.engine!r} shards rows (layout={cfg.layout!r})"
+        )
+    if cfg.engine != "tsqr" and cfg.use_pallas != "auto":
+        raise ValueError(
+            f"use_pallas applies to engines with panel loops (householder, "
+            f"tsqr); engine={cfg.engine!r} is all-GEMM "
+            f"(use_pallas={cfg.use_pallas!r})"
+        )
+
+
+def _lstsq_refined(A, b, cfg: DHQRConfig, mesh):
+    """``refine`` steps of QR-based iterative refinement around one
+    factorization: ``x += solve(b - A x)``, residual matvec at full
+    precision. Single-device householder rides the differentiable core
+    (refinement inside ``lstsq_diff``'s forward, gradients intact); the
+    mesh path factors once via ``qr()`` and loops the sharded solve; the
+    cholqr family reuses its explicit (Q, R) inside
+    :func:`dhqr_tpu.ops.cholqr.cholesky_qr_lstsq`. tsqr is rejected: its
+    tree never materializes a reusable factorization, so each step would
+    repeat the full factorization cost.
+    """
+    if cfg.refine < 0:
+        raise ValueError(f"refine must be >= 0, got {cfg.refine}")
+    if cfg.engine == "tsqr":
+        raise ValueError(
+            "refine is not supported with engine='tsqr' (no reusable "
+            "factorization in the tree); use householder or cholqr"
+        )
+    if cfg.engine in ("cholqr2", "cholqr3"):
+        _validate_alt_engine_cfg(cfg)  # same rejections as the refine=0 path
+        if mesh is not None:
+            raise ValueError(
+                "refine with the cholqr engines is single-device only"
+            )
+        from dhqr_tpu.ops.cholqr import cholesky_qr_lstsq
+
+        return cholesky_qr_lstsq(
+            A, b, precision=cfg.precision, shift=cfg.engine == "cholqr3",
+            refine=cfg.refine,
+        )
+    if mesh is None:
+        return _lstsq_impl(
+            A, b, cfg.block_size, cfg.blocked, cfg.precision, cfg.use_pallas,
+            norm=cfg.norm, panel_impl=cfg.panel_impl, refine=cfg.refine,
+        )
+    fact = qr(A, config=dataclasses.replace(cfg, refine=0), mesh=mesh)
+    x = fact.solve(b)
+    for _ in range(cfg.refine):
+        r = b - jnp.matmul(A, x, precision="highest")
+        x = x + fact.solve(r)
+    return x
+
+
 def _lstsq_alt_engine(A, b, cfg: DHQRConfig, mesh):
     """Route ``lstsq`` to the non-Householder engine families.
 
@@ -268,17 +363,7 @@ def _lstsq_alt_engine(A, b, cfg: DHQRConfig, mesh):
     ``mesh_axis``, else the sole axis of a 1-D mesh, else an axis named
     "rows" — unlike the Householder mesh path, which shards columns.
     """
-    if cfg.layout != "block":
-        raise ValueError(
-            f"layout applies only to the householder engines; "
-            f"engine={cfg.engine!r} shards rows (layout={cfg.layout!r})"
-        )
-    if cfg.engine != "tsqr" and cfg.use_pallas != "auto":
-        raise ValueError(
-            f"use_pallas applies to engines with panel loops (householder, "
-            f"tsqr); engine={cfg.engine!r} is all-GEMM "
-            f"(use_pallas={cfg.use_pallas!r})"
-        )
+    _validate_alt_engine_cfg(cfg)
     axis = None
     if mesh is not None:
         from dhqr_tpu.parallel.sharded_tsqr import ROW_AXIS
@@ -338,27 +423,38 @@ def _lstsq_alt_engine(A, b, cfg: DHQRConfig, mesh):
 
 
 @partial(jax.jit, static_argnames=(
-    "block_size", "blocked", "precision", "use_pallas", "norm", "panel_impl"))
+    "block_size", "blocked", "precision", "use_pallas", "norm", "panel_impl",
+    "refine"))
 def _lstsq_impl(A, b, block_size, blocked, precision, use_pallas,
-                norm="accurate", panel_impl="loop"):
+                norm="accurate", panel_impl="loop", refine=0):
     if blocked:
         from dhqr_tpu.ops.differentiable import lstsq_diff
 
         pallas, interp = _blocked._resolve_pallas(
             use_pallas, A.shape[0], min(block_size, A.shape[1]), A.dtype
         )
-        # custom-JVP core: identical forward, closed-form O(1)-memory
-        # gradients — jax.grad works through the public lstsq
+        # custom-JVP core: identical forward (incl. refinement sweeps),
+        # closed-form O(1)-memory gradients — jax.grad works through the
+        # public lstsq at every refine level
         return lstsq_diff(A, b, block_size, precision, pallas, interp, norm,
-                          panel_impl)
+                          panel_impl, refine)
     if use_pallas != "auto":
         raise ValueError(
             "use_pallas applies to the blocked engines only "
             f"(got use_pallas={use_pallas!r} with blocked=False)"
         )
     H, alpha = _hh.householder_qr(A, precision=precision, norm=norm)
-    c = _solve.apply_qt(H, alpha, b, precision=precision)
-    return _solve.back_substitute(H, alpha, c)
+
+    def qr_solve(rhs):
+        return _solve.back_substitute(
+            H, alpha, _solve.apply_qt(H, alpha, rhs, precision=precision)
+        )
+
+    x = qr_solve(b)
+    for _ in range(refine):
+        r = b - jnp.matmul(A, x, precision="highest")
+        x = x + qr_solve(r)
+    return x
 
 
 @partial(jax.jit, static_argnames=("block_size", "precision", "norm"))
@@ -436,9 +532,16 @@ def lstsq(
                 "m < n supports only the default blocked XLA path "
                 f"(got blocked={cfg.blocked}, use_pallas={cfg.use_pallas!r})"
             )
+        if cfg.refine:
+            raise ValueError(
+                "refine is not supported for m < n (the minimum-norm "
+                "solve is already exact to working precision)"
+            )
         return _minimum_norm_impl(
             A, b, cfg.block_size, cfg.precision, norm=cfg.norm
         )
+    if cfg.refine:
+        return _lstsq_refined(A, b, cfg, mesh)
     if cfg.engine != "householder":
         return _lstsq_alt_engine(A, b, cfg, mesh)
     if mesh is not None:

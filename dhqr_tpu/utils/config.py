@@ -61,6 +61,17 @@ class DHQRConfig:
         becomes compact-WY GEMMs above a small base width — see
         ops/householder._panel_qr_recursive). Ignored where the Pallas
         kernel takes the panel.
+      refine: iterative-refinement steps for ``lstsq`` (0 = off). Each
+        step reuses the factorization: ``r = b - A x; x += solve(r)`` —
+        one matvec plus one extra solve, a few percent of the
+        factorization cost, and it sharpens the f32 normal-equations
+        residual toward the f64-oracle level (QR-based refinement of the
+        least-squares solution; see tests/test_api.py for the measured
+        improvement). Supported on the householder engines and the
+        cholqr family (recovering accuracy near its conditioning window's
+        edge — the NaN boundary itself is unchanged); rejected for tsqr
+        (its tree never materializes a reusable factorization —
+        refactoring per step would double its cost).
     """
 
     block_size: "int | None" = None
@@ -72,6 +83,7 @@ class DHQRConfig:
     engine: str = "householder"
     norm: str = "accurate"
     panel_impl: str = "loop"
+    refine: int = 0
 
     @staticmethod
     def from_env(**overrides) -> "DHQRConfig":
@@ -97,5 +109,7 @@ class DHQRConfig:
             env["norm"] = os.environ["DHQR_NORM"]
         if "DHQR_PANEL_IMPL" in os.environ:
             env["panel_impl"] = os.environ["DHQR_PANEL_IMPL"]
+        if "DHQR_REFINE" in os.environ:
+            env["refine"] = int(os.environ["DHQR_REFINE"])
         env.update(overrides)
         return DHQRConfig(**env)
