@@ -73,9 +73,15 @@ def main(argv=None) -> int:
     )
     args = parser.parse_args(argv)
 
-    # Decide the platform before first backend use: a real TPU if one is
-    # explicitly requested, else a virtual CPU mesh of the requested size.
-    force_cpu = "tpu" not in os.environ.get("JAX_PLATFORMS", "").lower()
+    # Decide the platform before first backend use: a real TPU only on
+    # explicit request, else a virtual CPU mesh of the requested size.
+    # "Explicit" means JAX_PLATFORMS=tpu or DHQR_HARNESS_TPU=1 — the axon
+    # hosts pin JAX_PLATFORMS=axon ambiently (the TPU tunnel plugin), and
+    # an ambient pin must not silently put a correctness sweep on the
+    # shared chip; DHQR_HARNESS_TPU=1 is how to run the CLI on it.
+    plats = os.environ.get("JAX_PLATFORMS", "").lower()
+    force_cpu = not ("tpu" in plats
+                     or os.environ.get("DHQR_HARNESS_TPU") == "1")
     if force_cpu:
         flags = os.environ.get("XLA_FLAGS", "")
         if "host_platform_device_count" not in flags:
