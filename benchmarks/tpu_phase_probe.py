@@ -1,0 +1,166 @@
+"""Round-3 TPU probe: phase attribution + solve-side data.
+
+1. **Panel-kernel fraction** — chain-time the fused Pallas panel alone at
+   the production shapes ((12288, 512), (12288, 256), (4096, 256)) and
+   compare against the full-QR stage times. If the serial in-kernel
+   column sweep is a large fraction at nb=512, a two-level in-kernel
+   panel (sub-panels + compact-WY interior GEMMs) is the next perf
+   frontier; if small, the engine is trailing-GEMM-bound as designed and
+   kernel work would be wasted.
+   Panel flop model: sum_j 2*(nb - j)*m ~= 2*m*nb^2 (dots + rank-1s,
+   masked rows do no useful work but are executed anyway — the model
+   counts USEFUL flops so the number is comparable to the QR accounting).
+
+2. **Solve-side data** — multi-RHS lstsq (k=64) and refine=1 vs refine=0
+   at 4096^2, chain-timed: what does a solve cost next to the
+   factorization, and what does one refinement sweep add?
+
+Run ONE instance at a time (the axon relay allows a single TPU process).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _stage(name: str) -> None:
+    print(f"::stage {name} t={time.time():.1f}", file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(3))
+    from bench import _Watchdog
+
+    _stage("import")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(_REPO, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    from dhqr_tpu.ops.pallas_panel import _panel_qr_pallas_impl
+    from dhqr_tpu.utils.profiling import sync
+
+    _stage("backend_init")
+    with _Watchdog("backend_init", 150):
+        dev = jax.devices()[0]
+        platform = dev.platform
+        kind = getattr(dev, "device_kind", "?")
+        sync(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    _stage(f"backend_ready_{platform}")
+    rng = np.random.default_rng(0)
+
+    def emit(rec):
+        rec["platform"] = platform
+        rec["device_kind"] = kind
+        print(json.dumps(rec), flush=True)
+
+    def chain_min(single, chained, chain, repeats=3):
+        def tmin(f):
+            s = f()
+            sync(s)
+            ts = []
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                s = f()
+                sync(s)
+                ts.append(time.perf_counter() - t0)
+            return min(ts)
+
+        t1, tk = tmin(single), tmin(chained)
+        t = (tk - t1) / (chain - 1)
+        unreliable = not (tk > t1 * 1.05 and t > 0)
+        return (t1 if unreliable else t), t1, tk, unreliable
+
+    # ---- 1. panel-kernel chain timing ----
+    def panel_stage(m, nb, chain=25, watchdog=300):
+        name = f"panel_chain_{m}x{nb}"
+        _stage(name)
+        try:
+            with _Watchdog(name, watchdog):
+                P = jnp.asarray(rng.standard_normal((m, nb)), jnp.float32)
+                sync(P)
+
+                single = jax.jit(
+                    lambda P: _panel_qr_pallas_impl(P, 0)[1][0]
+                ).lower(P).compile()
+
+                def chained(P):
+                    def body(C, _):
+                        pf, al = _panel_qr_pallas_impl(C, 0)
+                        return pf, al[0]
+                    _, s = lax.scan(body, P, None, length=chain)
+                    return s[-1]
+
+                ck = jax.jit(chained).lower(P).compile()
+                t, t1, tk, unrel = chain_min(lambda: single(P),
+                                             lambda: ck(P), chain)
+                flops = 2.0 * m * nb * nb  # useful flops (see module doc)
+                emit({"metric": name, "seconds": round(t, 5),
+                      "useful_gflops_rate": round(flops / t / 1e9, 1),
+                      "chain_unreliable": unrel,
+                      "seconds_single_dispatch": round(t1, 4)})
+        except Exception as ex:
+            emit({"metric": name, "ok": False,
+                  "error": f"{type(ex).__name__}: {ex}"[:300]})
+
+    panel_stage(12288, 512)
+    panel_stage(12288, 256)
+    panel_stage(4096, 256)
+
+    # ---- 2. solve-side: multi-RHS + refine cost at 4096^2 ----
+    from dhqr_tpu.ops.differentiable import lstsq_diff
+
+    def lstsq_stage(n, k_rhs, refine, chain=5, watchdog=420):
+        name = f"lstsq_{n}_k{k_rhs}_refine{refine}"
+        _stage(name)
+        try:
+            with _Watchdog(name, watchdog):
+                A = jnp.asarray(rng.random((n, n)), jnp.float32)
+                B = jnp.asarray(rng.random((n, k_rhs)), jnp.float32) \
+                    if k_rhs > 1 else jnp.asarray(rng.random(n), jnp.float32)
+                sync(A)
+                args = (256, "highest", True, False, "fast", "loop", refine)
+
+                single = jax.jit(
+                    lambda A, B: lstsq_diff(A, B, *args).ravel()[0]
+                ).lower(A, B).compile()
+
+                def chained(A, B):
+                    def body(C, _):
+                        x = lstsq_diff(C, B, *args)
+                        keep = jnp.where(jnp.isfinite(x.ravel()[0]),
+                                         jnp.float32(1.0), jnp.float32(0.0))
+                        return C * keep, x.ravel()[0]
+                    _, s = lax.scan(body, A, None, length=chain)
+                    return s[-1]
+
+                ck = jax.jit(chained).lower(A, B).compile()
+                t, t1, tk, unrel = chain_min(lambda: single(A, B),
+                                             lambda: ck(A, B), chain)
+                emit({"metric": name, "seconds": round(t, 4),
+                      "chain_unreliable": unrel, "k_rhs": k_rhs,
+                      "refine": refine,
+                      "seconds_single_dispatch": round(t1, 4)})
+        except Exception as ex:
+            emit({"metric": name, "ok": False,
+                  "error": f"{type(ex).__name__}: {ex}"[:300]})
+
+    lstsq_stage(4096, 1, 0)
+    lstsq_stage(4096, 1, 1)
+    lstsq_stage(4096, 64, 0)
+    _stage("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -135,8 +135,41 @@ def _panel_factor(panel, offset, precision, norm, panel_impl):
         f"panel_impl must be 'loop' or 'recursive', got {panel_impl!r}")
 
 
+# Widest panel the fused kernel factors FLAT; wider panels split into
+# base-width kernel calls + compact-WY applies (_panel_factor_pallas).
+# The phase probe (benchmarks/results/tpu_r3_phase.jsonl) measured the
+# kernel's serial column sweep at ~1.1-1.2 TFLOP/s useful rate — ~1/3 of
+# total QR time at nb=512 — so splitting at 256 models ~0.57x the panel
+# cost. The default stays 512 (every committed nb=512 hardware number was
+# measured with FLAT 512 panels; the split is enabled by lowering this —
+# DHQR_PALLAS_FLAT_WIDTH=256 — once its ladder is measured on hardware).
+PALLAS_FLAT_WIDTH = int(_os.environ.get("DHQR_PALLAS_FLAT_WIDTH", "512"))
+
+
+def _panel_factor_pallas(panel, offset, precision, interpret, base=None):
+    """Fused-kernel panel factorization, split above ``base`` width.
+
+    Width <= base (default :data:`PALLAS_FLAT_WIDTH`): one flat kernel
+    call. Wider: the geqrt3 recursion (``householder._panel_qr_recursive``
+    — left half, compact-WY GEMM apply, right half at the shifted offset)
+    with the fused kernel as the leaf. Identical packed output to the
+    flat kernel.
+    """
+    from dhqr_tpu.ops.householder import _panel_qr_recursive
+    from dhqr_tpu.ops.pallas_panel import _panel_qr_pallas_impl
+
+    if base is None:
+        base = PALLAS_FLAT_WIDTH
+    return _panel_qr_recursive(
+        panel, offset, precision=precision, base=base,
+        leaf=lambda p, off: _panel_qr_pallas_impl(p, off,
+                                                  interpret=interpret),
+    )
+
+
 def _scan_panels(S, pcount, nb, precision, pallas, pallas_interpret,
-                 norm="accurate", panel_impl="loop", gemm_precision=None):
+                 norm="accurate", panel_impl="loop", gemm_precision=None,
+                 pallas_flat=None):
     """Factor ``pcount`` uniform nb-wide panels of super-block S by scan.
 
     S is the (ms, ns) trailing submatrix whose top-left element is the
@@ -151,10 +184,8 @@ def _scan_panels(S, pcount, nb, precision, pallas, pallas_interpret,
         c = q * nb
         panel = lax.dynamic_slice(S, (jnp.int32(0), c), (ms, nb))
         if pallas:
-            from dhqr_tpu.ops.pallas_panel import _panel_qr_pallas_impl
-
-            pf, alpha_k = _panel_qr_pallas_impl(
-                panel, c, interpret=pallas_interpret
+            pf, alpha_k = _panel_factor_pallas(
+                panel, c, precision, pallas_interpret, base=pallas_flat
             )
         else:
             pf, alpha_k = _panel_factor(panel, c, precision, norm, panel_impl)
@@ -174,18 +205,23 @@ def _scan_panels(S, pcount, nb, precision, pallas, pallas_interpret,
 @partial(
     jax.jit,
     static_argnames=("block_size", "precision", "pallas", "pallas_interpret",
-                     "norm", "panel_impl", "trailing_precision"),
+                     "norm", "panel_impl", "trailing_precision",
+                     "pallas_flat"),
 )
 def _blocked_qr_impl(
     A, block_size, precision=DEFAULT_PRECISION, pallas=False,
     pallas_interpret=False, norm="accurate", panel_impl="loop",
-    trailing_precision=None,
+    trailing_precision=None, pallas_flat=None,
 ):
-    from dhqr_tpu.ops.pallas_panel import _panel_qr_pallas_impl, pallas_panel_supported
+    from dhqr_tpu.ops.pallas_panel import pallas_panel_supported
 
     m, n = A.shape
     nb = min(block_size, n)
     num_full, rem, ppo = _panels_schedule(n, nb)
+    # Static so it participates in the jit cache key (a module-global read
+    # inside the trace would bake the import-time value into cached traces
+    # and silently ignore later changes).
+    flat = PALLAS_FLAT_WIDTH if pallas_flat is None else pallas_flat
     # Trailing-update GEMMs may run at a cheaper MXU precision than the
     # panel/T-factor math: the trailing update holds ~all the flops, while
     # the accuracy-critical dependent chains (reflector norms/dots, the
@@ -202,9 +238,10 @@ def _blocked_qr_impl(
             # update) timers (src:126-146), visible in XLA/perfetto traces.
             with jax.named_scope("panel_factor"):
                 panel = lax.slice(H, (k, k), (m, k + b))
-                if pallas and pallas_panel_supported(m - k, b, A.dtype):
-                    pf, alpha_k = _panel_qr_pallas_impl(
-                        panel, 0, interpret=pallas_interpret
+                if pallas and pallas_panel_supported(
+                        m - k, min(b, flat), A.dtype):
+                    pf, alpha_k = _panel_factor_pallas(
+                        panel, 0, precision, pallas_interpret, base=flat
                     )
                 else:
                     pf, alpha_k = _panel_factor(panel, 0, precision, norm,
@@ -232,10 +269,11 @@ def _blocked_qr_impl(
         pcount = min(ppo, num_full - ob)
         K = ob * nb
         S = lax.slice(H, (K, K), (m, n))
-        blk_pallas = pallas and pallas_panel_supported(m - K, nb, A.dtype)
+        blk_pallas = pallas and pallas_panel_supported(
+            m - K, min(nb, flat), A.dtype)
         S, alpha_blk = _scan_panels(
             S, pcount, nb, precision, blk_pallas, pallas_interpret, norm=norm,
-            panel_impl=panel_impl, gemm_precision=tprec,
+            panel_impl=panel_impl, gemm_precision=tprec, pallas_flat=flat,
         )
         H = H.at[K:, K:].set(S)
         alpha = alpha.at[K : K + pcount * nb].set(alpha_blk)
@@ -253,7 +291,8 @@ def _blocked_qr_impl(
 _blocked_qr_impl_donate = partial(
     jax.jit,
     static_argnames=("block_size", "precision", "pallas", "pallas_interpret",
-                     "norm", "panel_impl", "trailing_precision"),
+                     "norm", "panel_impl", "trailing_precision",
+                     "pallas_flat"),
     donate_argnums=(0,),
 )(_blocked_qr_impl.__wrapped__)
 
@@ -300,13 +339,17 @@ def _resolve_pallas(mode: str, m: int, nb: int, dtype) -> tuple[bool, bool]:
 
     if mode == "never":
         return False, False
-    supported = pallas_panel_supported(m, nb, dtype)
+    # Panels wider than PALLAS_FLAT_WIDTH are factored by recursive
+    # splitting into base-width kernel calls (_panel_factor_pallas), so
+    # VMEM only ever has to admit the base width.
+    supported = pallas_panel_supported(m, min(nb, PALLAS_FLAT_WIDTH), dtype)
     on_tpu = jax.default_backend() == "tpu"
     if mode == "always":
         if not supported:
             raise ValueError(
                 f"use_pallas='always' but an ({m}, {nb}) {jnp.dtype(dtype).name} "
-                "panel is unsupported (float32/complex64 only, must fit VMEM)"
+                "panel is unsupported (float32/complex64 only, the "
+                f"{min(nb, PALLAS_FLAT_WIDTH)}-wide kernel base must fit VMEM)"
             )
         return True, not on_tpu
     if mode == "auto":
@@ -402,7 +445,10 @@ def blocked_householder_qr(
     impl = _blocked_qr_impl_donate if donate else _blocked_qr_impl
     return impl(A, nb, precision=precision, pallas=pallas,
                 pallas_interpret=interpret, norm=norm, panel_impl=panel_impl,
-                trailing_precision=trailing_precision)
+                trailing_precision=trailing_precision,
+                # explicit (not the in-trace default) so the module global
+                # participates in the jit cache key via this wrapper
+                pallas_flat=PALLAS_FLAT_WIDTH)
 
 
 @partial(jax.jit, static_argnames=("block_size", "precision"))

@@ -137,7 +137,8 @@ RECURSIVE_BASE_WIDTH = 32
 
 
 def _panel_qr_recursive(panel, offset, precision=DEFAULT_PRECISION,
-                        norm="accurate", base=RECURSIVE_BASE_WIDTH):
+                        norm="accurate", base=RECURSIVE_BASE_WIDTH,
+                        leaf=None):
     """Divide-and-conquer panel QR (the LAPACK geqrt3 recursion, TPU-style).
 
     Left half by recursion; the left reflectors applied to the right half as
@@ -150,20 +151,28 @@ def _panel_qr_recursive(panel, offset, precision=DEFAULT_PRECISION,
     broadcast + hotloop chain (src:141-143, 198-213), which is memory-bound
     by construction; this is the panel-interior analogue of SURVEY.md §7
     stage 3. ``offset`` may be traced (the blocked engine's scan path).
+
+    ``leaf(panel, offset)`` factors a base-width panel (default: the masked
+    XLA loop). The same recursion body also serves the split-Pallas panel
+    (``ops.blocked._panel_factor_pallas``) with the fused kernel as leaf —
+    one divide-and-conquer to maintain, two leaf engines.
     """
     m, b = panel.shape
     if b <= base:
+        if leaf is not None:
+            return leaf(panel, offset)
         return _panel_qr_masked(panel, offset, precision=precision, norm=norm)
     from dhqr_tpu.ops.blocked import apply_block_reflector_h, shifted_tril
 
     h = b // 2
     left = lax.slice_in_dim(panel, 0, h, axis=1)
     right = lax.slice_in_dim(panel, h, b, axis=1)
-    left_f, alpha_l = _panel_qr_recursive(left, offset, precision, norm, base)
+    left_f, alpha_l = _panel_qr_recursive(left, offset, precision, norm, base,
+                                          leaf)
     Y = shifted_tril(left_f, offset)
     right = apply_block_reflector_h(Y, right, precision)
     right_f, alpha_r = _panel_qr_recursive(right, offset + h, precision, norm,
-                                           base)
+                                           base, leaf)
     return (jnp.concatenate([left_f, right_f], axis=1),
             jnp.concatenate([alpha_l, alpha_r]))
 

@@ -36,7 +36,8 @@ from dhqr_tpu.ops.householder import DEFAULT_PRECISION
 from dhqr_tpu.ops.solve import as_matrix_rhs, back_substitute, r_matrix
 
 
-def _leaf_factor(Ai, bi, nb, precision, pallas=False, interpret=False):
+def _leaf_factor(Ai, bi, nb, precision, pallas=False, interpret=False,
+                 pallas_flat=None):
     """One row block: packed QR + Q^H b, reduced to the (n, n) / (n, k) heads.
 
     ``pallas`` routes the leaf's panel factorizations through the fused
@@ -47,25 +48,27 @@ def _leaf_factor(Ai, bi, nb, precision, pallas=False, interpret=False):
     """
     n = Ai.shape[1]
     H, alpha = _blocked_qr_impl(Ai, nb, precision=precision, pallas=pallas,
-                                pallas_interpret=interpret)
+                                pallas_interpret=interpret,
+                                pallas_flat=pallas_flat)
     R = r_matrix(H, alpha)
     c = _apply_qt_impl(H, bi, nb, precision=precision)[:n]
     return R, c
 
 
 def _combine_solve(Rstack, cstack, nb, precision, pallas=False,
-                   interpret=False):
+                   interpret=False, pallas_flat=None):
     """Combine stage: QR the stacked heads, then solve R x = (Q^H c)[:n]."""
     H2, alpha2 = _blocked_qr_impl(Rstack, nb, precision=precision,
-                                  pallas=pallas, pallas_interpret=interpret)
+                                  pallas=pallas, pallas_interpret=interpret,
+                                  pallas_flat=pallas_flat)
     c2 = _apply_qt_impl(H2, cstack, nb, precision=precision)
     return back_substitute(H2, alpha2, c2)
 
 
 @partial(jax.jit, static_argnames=("n_blocks", "block_size", "precision",
-                                   "pallas", "interpret"))
+                                   "pallas", "interpret", "pallas_flat"))
 def _tsqr_lstsq_impl(A, b, n_blocks, block_size, precision, pallas=False,
-                     interpret=False):
+                     interpret=False, pallas_flat=None):
     m, n = A.shape
     rows = m // n_blocks
     nb = min(block_size, n)
@@ -75,13 +78,14 @@ def _tsqr_lstsq_impl(A, b, n_blocks, block_size, precision, pallas=False,
     Ab = A.reshape(n_blocks, rows, n)
     bb = B.reshape(n_blocks, rows, k)
     Rs, cs = jax.vmap(
-        lambda Ai, bi: _leaf_factor(Ai, bi, nb, precision, pallas, interpret)
+        lambda Ai, bi: _leaf_factor(Ai, bi, nb, precision, pallas, interpret,
+                                    pallas_flat)
     )(Ab, bb)
     # Combine: one QR of the stacked R factors (n_blocks*n x n — tiny).
     Rstack = Rs.reshape(n_blocks * n, n)
     cstack = cs.reshape(n_blocks * n, k)
     return restore(_combine_solve(Rstack, cstack, nb, precision, pallas,
-                                  interpret))
+                                  interpret, pallas_flat))
 
 
 def tsqr_lstsq(
@@ -111,8 +115,11 @@ def tsqr_lstsq(
     ensure_complex_supported(A.dtype)
     pallas, interpret = _resolve_tsqr_pallas(use_pallas, m // int(n_blocks),
                                              n, int(block_size), A.dtype)
+    from dhqr_tpu.ops.blocked import PALLAS_FLAT_WIDTH
+
     return _tsqr_lstsq_impl(A, b, int(n_blocks), int(block_size), precision,
-                            pallas=pallas, interpret=interpret)
+                            pallas=pallas, interpret=interpret,
+                            pallas_flat=PALLAS_FLAT_WIDTH)
 
 
 def _resolve_tsqr_pallas(mode, leaf_rows, n, block_size, dtype):
@@ -127,9 +134,9 @@ def _resolve_tsqr_pallas(mode, leaf_rows, n, block_size, dtype):
 
 
 @partial(jax.jit, static_argnames=("n_blocks", "block_size", "precision",
-                                   "pallas", "interpret"))
+                                   "pallas", "interpret", "pallas_flat"))
 def _tsqr_r_impl(A, n_blocks, block_size, precision, pallas=False,
-                 interpret=False):
+                 interpret=False, pallas_flat=None):
     m, n = A.shape
     rows = m // n_blocks
     nb = min(block_size, n)
@@ -137,11 +144,12 @@ def _tsqr_r_impl(A, n_blocks, block_size, precision, pallas=False,
     Rs = jax.vmap(
         lambda Ai: r_matrix(*_blocked_qr_impl(
             Ai, nb, precision=precision, pallas=pallas,
-            pallas_interpret=interpret))
+            pallas_interpret=interpret, pallas_flat=pallas_flat))
     )(Ab)
     H2, alpha2 = _blocked_qr_impl(Rs.reshape(n_blocks * n, n), nb,
                                   precision=precision, pallas=pallas,
-                                  pallas_interpret=interpret)
+                                  pallas_interpret=interpret,
+                                  pallas_flat=pallas_flat)
     return r_matrix(H2, alpha2)
 
 
@@ -165,8 +173,11 @@ def tsqr_r(
     ensure_complex_supported(A.dtype)
     pallas, interpret = _resolve_tsqr_pallas(use_pallas, m // int(n_blocks),
                                              n, int(block_size), A.dtype)
+    from dhqr_tpu.ops.blocked import PALLAS_FLAT_WIDTH
+
     return _tsqr_r_impl(A, int(n_blocks), int(block_size), precision,
-                        pallas=pallas, interpret=interpret)
+                        pallas=pallas, interpret=interpret,
+                        pallas_flat=PALLAS_FLAT_WIDTH)
 
 
 def _check_tsqr_shape(m: int, n: int, n_blocks: int) -> None:

@@ -147,7 +147,7 @@ def _blocked_shard_body(
     Al, *, n: int, nb: int, axis: str,
     precision: str = DEFAULT_PRECISION, layout: str = "block",
     norm: str = "accurate", pallas: bool = False, pallas_interpret: bool = False,
-    panel_impl: str = "loop",
+    panel_impl: str = "loop", pallas_flat: "int | None" = None,
 ):
     """Per-device body for the compact-WY engine.
 
@@ -184,13 +184,15 @@ def _blocked_shard_body(
             # Every device factors its own (m-k, b) slice; the psum keeps the
             # owner's result. SPMD-friendly redundant compute beats a branch.
             panel = lax.slice(Al, (k, kl), (m, kl + b))  # rows k:, offset 0
-            # gate validated once in sharded_blocked_qr: the VMEM budget is
-            # monotone in (m, nb), so every smaller panel fits too
+            # gate validated once in sharded_blocked_qr against the FLAT
+            # width (panels wider than pallas_flat split into base-width
+            # kernel calls); the VMEM budget is monotone in (m, nb), so
+            # every smaller panel fits too
             if pallas:
-                from dhqr_tpu.ops.pallas_panel import _panel_qr_pallas_impl
+                from dhqr_tpu.ops.blocked import _panel_factor_pallas
 
-                pf, alpha_k = _panel_qr_pallas_impl(
-                    panel, 0, interpret=pallas_interpret
+                pf, alpha_k = _panel_factor_pallas(
+                    panel, 0, precision, pallas_interpret, base=pallas_flat
                 )
             else:
                 from dhqr_tpu.ops.blocked import _panel_factor
@@ -231,10 +233,10 @@ def _blocked_shard_body(
             mine = p == owner
             panel = lax.dynamic_slice(Sl, (jnp.int32(0), kl), (ms, nb))
             if blk_pallas:
-                from dhqr_tpu.ops.pallas_panel import _panel_qr_pallas_impl
+                from dhqr_tpu.ops.blocked import _panel_factor_pallas
 
-                pf, alpha_k = _panel_qr_pallas_impl(
-                    panel, c, interpret=pallas_interpret
+                pf, alpha_k = _panel_factor_pallas(
+                    panel, c, precision, pallas_interpret, base=pallas_flat
                 )
             else:
                 from dhqr_tpu.ops.blocked import _panel_factor
@@ -284,13 +286,13 @@ def _build_unblocked(
 def _build_blocked(
     mesh: Mesh, axis_name: str, n: int, nb: int, precision: str, layout: str,
     norm: str = "accurate", pallas: bool = False, pallas_interpret: bool = False,
-    panel_impl: str = "loop",
+    panel_impl: str = "loop", pallas_flat: "int | None" = None,
 ):
     body = partial(
         _blocked_shard_body,
         n=n, nb=nb, axis=axis_name, precision=precision, layout=layout,
         norm=norm, pallas=pallas, pallas_interpret=pallas_interpret,
-        panel_impl=panel_impl,
+        panel_impl=panel_impl, pallas_flat=pallas_flat,
     )
     return jax.jit(
         shard_map(
@@ -466,6 +468,8 @@ def sharded_blocked_qr(
     _check_divisibility(m, n, nproc, nb, layout)
     from dhqr_tpu.ops.blocked import _resolve_pallas
 
+    from dhqr_tpu.ops.blocked import PALLAS_FLAT_WIDTH
+
     pallas, _ = _resolve_pallas(use_pallas, m, nb, A.dtype)
     # Interpret-vs-compile follows the MESH's platform, not the process
     # default backend — a CPU mesh on a TPU-default host (the virtual-mesh
@@ -475,7 +479,7 @@ def sharded_blocked_qr(
     A = jax.device_put(A, column_sharding(mesh, axis_name))
     H, alpha = _build_blocked(
         mesh, axis_name, n, nb, precision, layout, norm, pallas, interp,
-        panel_impl,
+        panel_impl, PALLAS_FLAT_WIDTH,
     )(A)
     if not _store_layout_output:
         H = _to_natural_layout(H, n, nproc, nb, layout)

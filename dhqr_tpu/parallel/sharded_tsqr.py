@@ -42,14 +42,16 @@ def row_mesh(
 
 
 def _tsqr_shard_body(Al, bl, *, n: int, nb: int, axis: str, precision: str,
-                     pallas: bool = False, interpret: bool = False):
+                     pallas: bool = False, interpret: bool = False,
+                     pallas_flat: "int | None" = None):
     """Per-device: local QR + Q^H b, then replicated combine of the R heads.
 
     Leaf and combine stages are shared with the single-device tree
     (ops/tsqr) so the two paths cannot numerically diverge.
     """
     Bl, restore = as_matrix_rhs(bl)
-    R, c = _leaf_factor(Al, Bl, nb, precision, pallas, interpret)
+    R, c = _leaf_factor(Al, Bl, nb, precision, pallas, interpret,
+                        pallas_flat)
     # ONE collective: gather every device's heads (P*n rows — tiny traffic).
     Rstack = lax.all_gather(R, axis).reshape(-1, n)
     cstack = lax.all_gather(c, axis).reshape(-1, c.shape[1])
@@ -57,15 +59,16 @@ def _tsqr_shard_body(Al, bl, *, n: int, nb: int, axis: str, precision: str,
     # collective to scatter the result — same trade as the reference making
     # alpha a SharedArray, src:302).
     return restore(_combine_solve(Rstack, cstack, nb, precision, pallas,
-                                  interpret))
+                                  interpret, pallas_flat))
 
 
 @lru_cache(maxsize=None)
 def _build_tsqr(mesh: Mesh, axis_name: str, n: int, nb: int, precision: str,
-                pallas: bool = False, interpret: bool = False):
+                pallas: bool = False, interpret: bool = False,
+                pallas_flat: "int | None" = None):
     body = partial(
         _tsqr_shard_body, n=n, nb=nb, axis=axis_name, precision=precision,
-        pallas=pallas, interpret=interpret,
+        pallas=pallas, interpret=interpret, pallas_flat=pallas_flat,
     )
     return jax.jit(
         shard_map(
@@ -109,7 +112,9 @@ def sharded_tsqr_lstsq(
     nb = min(int(block_size), n)
     pallas, interpret = _resolve_tsqr_pallas(use_pallas, m // nproc, n, nb,
                                              A.dtype)
+    from dhqr_tpu.ops.blocked import PALLAS_FLAT_WIDTH
+
     A = jax.device_put(A, NamedSharding(mesh, P(axis_name, None)))
     b = jax.device_put(b, NamedSharding(mesh, P(axis_name)))
     return _build_tsqr(mesh, axis_name, n, nb, precision, pallas,
-                       interpret)(A, b)
+                       interpret, PALLAS_FLAT_WIDTH)(A, b)

@@ -188,8 +188,15 @@ def test_auto_block_size_rules(monkeypatch):
     assert B.auto_block_size(12288, jnp.float32) == 512
     assert B.auto_block_size(8192, jnp.float32) == 256  # 512 fits, not used
     assert B.auto_block_size(4096, jnp.float32) == 256
-    # just past the 512 budget at m=16384+2k -> falls back to 256
+    # with the default FLAT width (512) the gate demands the full 512-wide
+    # panel in VMEM: just past that budget -> falls back to 256
     assert B.auto_block_size(18432, jnp.float32) == 256
+    # splitting lowers the gate to the base width: 512 stays available as
+    # long as an (m, 256) panel fits...
+    monkeypatch.setattr(B, "PALLAS_FLAT_WIDTH", 256)
+    assert B.auto_block_size(18432, jnp.float32) == 512
+    # ...and past the BASE-width budget the kernel path is off -> 128
+    assert B.auto_block_size(36864, jnp.float32) == 128
 
 
 def test_default_block_size_none_end_to_end():
@@ -246,3 +253,50 @@ def test_trailing_precision_split_still_solves():
     # sanity: residual of the split solve is small in absolute terms even
     # if it misses the 8x-LAPACK bar reserved for the full-precision path
     assert np.linalg.norm(np.asarray(A).T @ r) < 1e-2 * np.linalg.norm(b)
+
+
+def test_split_pallas_panel_matches_flat_and_xla():
+    """_panel_factor_pallas splits wide panels into base-width kernel
+    calls + compact-WY applies; the packed result must match both the
+    flat kernel and the XLA masked panel to f32 rounding (round-3 phase
+    probe: the flat kernel's serial sweep is ~1/3 of QR time at nb=512 —
+    splitting keeps the wide trailing updates at ~0.57x the panel cost)."""
+    from dhqr_tpu.ops.blocked import _panel_factor_pallas
+    from dhqr_tpu.ops.householder import _panel_qr_masked
+
+    rng = np.random.default_rng(51)
+    panel = jnp.asarray(rng.standard_normal((96, 64)), jnp.float32)
+    pf_split, al_split = _panel_factor_pallas(panel, 0, "highest",
+                                              interpret=True, base=16)
+    pf_flat, al_flat = _panel_factor_pallas(panel, 0, "highest",
+                                            interpret=True, base=64)
+    pf_xla, al_xla = _panel_qr_masked(panel, 0, precision="highest")
+    for pf, al in ((pf_flat, al_flat), (pf_xla, al_xla)):
+        np.testing.assert_allclose(np.asarray(pf_split), np.asarray(pf),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(al_split), np.asarray(al),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_split_pallas_through_engine(monkeypatch):
+    """The engine call sites route wide panels through the split when the
+    base width (not the full width) fits the gate — exercised by shrinking
+    PALLAS_FLAT_WIDTH so a 64-wide block splits on the interpret path,
+    on both the unrolled and two-level scan paths."""
+    from dhqr_tpu.ops import blocked as B
+
+    monkeypatch.setattr(B, "PALLAS_FLAT_WIDTH", 16)
+    rng = np.random.default_rng(52)
+    A = jnp.asarray(rng.standard_normal((160, 128)), jnp.float32)
+    H0, a0 = B.blocked_householder_qr(A, block_size=64, use_pallas="never")
+    H1, a1 = B.blocked_householder_qr(A, block_size=64, use_pallas="always")
+    np.testing.assert_allclose(np.asarray(H1), np.asarray(H0), rtol=2e-4,
+                               atol=2e-5)
+    # two-level scan path: > MAX_UNROLLED_PANELS panels, and nb=32 > the
+    # 16-wide flat width so the scan body's panels genuinely SPLIT (the
+    # only configuration combining traced row offsets with the recursion)
+    A2 = jnp.asarray(rng.standard_normal((400, 320)), jnp.float32)
+    H2, a2 = B.blocked_householder_qr(A2, block_size=32, use_pallas="always")
+    H3, a3 = B.blocked_householder_qr(A2, block_size=32, use_pallas="never")
+    np.testing.assert_allclose(np.asarray(H2), np.asarray(H3), rtol=2e-4,
+                               atol=2e-4)
