@@ -1,0 +1,32 @@
+"""Driver-bench helper tests (supervisor-side logic, no TPU needed)."""
+
+import json
+import sys
+
+
+def _bench():
+    sys.path.insert(0, "/root/repo")
+    import bench
+
+    return bench
+
+
+def test_best_recorded_tpu_scans_committed_artifacts():
+    """The CPU-fallback annotation finds a jitter-clean committed TPU
+    headline (chain >= 5 or device-dominated seconds) — the round's
+    hardware story survives a wedged relay at bench time."""
+    best = _bench()._best_recorded_tpu()
+    assert best, "no committed TPU artifacts found"
+    assert best["metric"].startswith("qr_gflops_per_chip_f32")
+    assert best["value"] > 10_000  # the round-3 measured range
+    assert best["artifact"].endswith(".jsonl")
+
+
+def test_parse_last_json_takes_last_parseable_line():
+    bench = _bench()
+    out = "\n".join([
+        "garbage", json.dumps({"a": 1}), "::stage x", json.dumps({"b": 2}),
+        "trailing noise",
+    ])
+    assert bench._parse_last_json(out) == {"b": 2}
+    assert bench._parse_last_json("no json at all") is None
