@@ -57,6 +57,15 @@ def run(n_devices: int) -> None:
     assert bool(jnp.all(jnp.isfinite(x))), "non-finite x (awkward n)"
     print(f"dryrun: sharded_lstsq awkward n={n_awk} ok", flush=True)
 
+    # Iterative refinement on the mesh: factor once via qr(mesh=...), loop
+    # the sharded solve (models/qr_model._lstsq_refined mesh branch).
+    from dhqr_tpu.models.qr_model import lstsq as _lstsq
+
+    x = _lstsq(A, b, mesh=cmesh, block_size=block_size, refine=1)
+    assert x.shape == (n,)
+    assert bool(jnp.all(jnp.isfinite(x))), "non-finite x (refine on mesh)"
+    print("dryrun: sharded lstsq refine=1 ok", flush=True)
+
     # TSQR wants a genuinely tall problem: local row blocks must stay tall
     nt = 8
     mt = 2 * nt * n_devices
