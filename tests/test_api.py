@@ -395,3 +395,40 @@ def test_zero_matrix_is_finite():
     # which would divide by zero too) - just pin that it does not crash.
     x = fact.solve(b)
     assert x.shape == (4,)
+
+
+@pytest.mark.slow
+def test_engine_cross_check_fuzz():
+    """Seeded mini-fuzz: random shapes x engines x options, every result
+    checked against the numpy lstsq oracle via the reference's 8x
+    normal-equations criterion. A broad safety net across the routing
+    surface (single-device paths; mesh paths have their own sweeps)."""
+    rng = np.random.default_rng(2026)
+    for trial in range(20):
+        n = int(rng.integers(8, 120))
+        m = n + int(rng.integers(0, 2 * n))
+        dtype = [np.float64, np.float32, np.complex128][
+            int(rng.integers(0, 3))]
+        A, b = random_problem(m, n, dtype, seed=1000 + trial)
+        kwargs = {"block_size": int(rng.choice([8, 16, 32, 128]))}
+        engine = ["householder", "householder", "tsqr", "cholqr2"][
+            int(rng.integers(0, 4))]
+        if engine == "tsqr":
+            if m % 2:
+                m -= 1
+                A, b = A[:m], b[:m]
+            kwargs = {}  # tsqr routing picks n_blocks itself
+        if engine == "householder":
+            kwargs["blocked"] = bool(rng.integers(0, 2))
+            if not kwargs["blocked"]:
+                kwargs.pop("block_size")
+            else:
+                kwargs["refine"] = int(rng.integers(0, 2))
+        x = np.asarray(lstsq(jnp.asarray(A), jnp.asarray(b), engine=engine,
+                             **kwargs))
+        res = normal_equations_residual(A, x, b)
+        floor = 1e-6 if dtype == np.float32 else 1e-12
+        assert res < TOLERANCE_FACTOR * max(oracle_residual(A, b), floor), (
+            f"trial {trial}: engine={engine} {m}x{n} {dtype.__name__} "
+            f"kwargs={kwargs} res={res:.3e}"
+        )
