@@ -50,16 +50,18 @@ def main(argv=None) -> int:
         "--dtypes", default="float64,complex128",
         help="comma-separated dtypes (reference: Float64, ComplexF64)",
     )
-    parser.add_argument("--layout", default="block", choices=["block", "cyclic"])
+    # Engine-option defaults are None sentinels: precedence is
+    # CLI flag > DHQR_* env var (DHQRConfig.from_env) > library default.
+    parser.add_argument("--layout", default=None, choices=["block", "cyclic"])
     parser.add_argument(
-        "--engine", default="householder",
+        "--engine", default=None,
         choices=["householder", "tsqr", "cholqr2", "cholqr3"],
         help="least-squares engine family (tsqr/cholqr shard ROWS; their "
         "mesh uses the same device count)",
     )
-    parser.add_argument("--block-size", type=int, default=128)
+    parser.add_argument("--block-size", type=int, default=None)
     parser.add_argument(
-        "--panel-impl", default="loop", choices=["loop", "recursive"],
+        "--panel-impl", default=None, choices=["loop", "recursive"],
         help="panel-interior algorithm for the blocked householder engines",
     )
     parser.add_argument(
@@ -113,14 +115,25 @@ def main(argv=None) -> int:
         random_problem,
     )
 
+    from dhqr_tpu.utils.config import DHQRConfig
+
     ndev = min(args.n_devices, len(jax.devices()))
     mesh = column_mesh(ndev) if ndev > 1 else None
-    row_engine = args.engine != "householder"
-    lkw = {} if row_engine else {"layout": args.layout,
-                                 "panel_impl": args.panel_impl}
+    overrides = {k: v for k, v in {
+        "layout": args.layout, "engine": args.engine,
+        "block_size": args.block_size, "panel_impl": args.panel_impl,
+    }.items() if v is not None}
+    cfg = DHQRConfig.from_env(**overrides)
+    # block_size=None stays None: lstsq resolves it per backend/shape
+    # (ops/blocked.auto_block_size - the measured nb=256/512 TPU optimum).
+    row_engine = cfg.engine != "householder"
+    if row_engine and cfg.layout != "block":
+        src = "--layout" if args.layout is not None else "DHQR_LAYOUT"
+        parser.error(f"{src}={cfg.layout} applies to the householder "
+                     f"engines only (engine={cfg.engine})")
     print(f"# devices: {len(jax.devices())} ({jax.default_backend()}), "
-          f"mesh size: {ndev}, engine: {args.engine}"
-          + ("" if row_engine else f", layout: {args.layout}"))
+          f"mesh size: {ndev}, engine: {cfg.engine}"
+          + ("" if row_engine else f", layout: {cfg.layout}"))
 
     failures = 0
     for dtype_name in args.dtypes.split(","):
@@ -134,10 +147,10 @@ def main(argv=None) -> int:
             # The householder mesh engines pad arbitrary n internally
             # (parallel/sharded_qr._pad_cols_orthogonal) — sizes run as
             # given. Row engines still need m divisible (local blocks tall).
-            if mesh is not None and args.engine != "householder" and m % ndev:
+            if mesh is not None and row_engine and m % ndev:
                 m += ndev - m % ndev
             size_mesh = mesh
-            if (mesh is not None and args.engine == "tsqr"
+            if (mesh is not None and cfg.engine == "tsqr"
                     and m // ndev < n):  # local row blocks must stay tall
                 print(f"# {m}x{n}: m/P < n, tsqr runs single-device")
                 size_mesh = None
@@ -145,10 +158,7 @@ def main(argv=None) -> int:
             Aj, bj = jnp.asarray(A), jnp.asarray(b)
             timer = PhaseTimer()
             with timer.measure("factor+solve"):
-                x = dhqr_tpu.lstsq(
-                    Aj, bj, mesh=size_mesh, engine=args.engine,
-                    block_size=args.block_size, **lkw,
-                )
+                x = dhqr_tpu.lstsq(Aj, bj, config=cfg, mesh=size_mesh)
                 timer.observe(x)
             res = normal_equations_residual(A, np.asarray(x), b)
             ref = oracle_residual(A, b)
@@ -171,10 +181,7 @@ def main(argv=None) -> int:
                 # warm (compile-cached) run — the first timing above includes
                 # XLA compilation, which the reference has no analogue of
                 with timer.measure("warm"):
-                    x = dhqr_tpu.lstsq(
-                        Aj, bj, mesh=size_mesh, engine=args.engine,
-                        block_size=args.block_size, **lkw,
-                    )
+                    x = dhqr_tpu.lstsq(Aj, bj, config=cfg, mesh=size_mesh)
                     timer.observe(x)
                 t_ours = timer.total("warm")
                 # reference prints "slowdown of distributed+threaded vs
