@@ -1,0 +1,88 @@
+"""Minimal relay-health probe: is the axon TPU tunnel answering?
+
+Two stages, each with its own watchdog and a ``::stage`` marker:
+
+1. ``backend_init`` — ``jax.devices()`` + client bring-up only. This is
+   where a wedged relay hangs (BENCH_r02/r03 both died here), and it
+   involves NO remote compile, so a watchdog hard-exit here cannot
+   re-wedge the relay (the 5-hour wedge of round 3 was caused by a hard
+   exit DURING a remote compile — see the session notes / memory).
+2. ``tiny_matmul`` — one 128x128 f32 matmul, 600 s watchdog (long enough
+   that the hard exit only fires on a true hang, never a slow compile).
+
+Prints one JSON line: {"alive": bool, "stage": ..., "seconds": ...}.
+Exit code 0 = alive, 2 = not alive (watchdog or error).
+
+Usage: python benchmarks/tpu_alive_probe.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+
+def _stage(name: str) -> None:
+    print(f"::stage {name} t={time.time():.1f}", file=sys.stderr, flush=True)
+
+
+class _Watchdog:
+    def __init__(self, stage: str, seconds: float):
+        self._stage, self._seconds = stage, seconds
+        self._done = threading.Event()
+        self._t = threading.Thread(target=self._fire, daemon=True)
+
+    def _fire(self):
+        if not self._done.wait(self._seconds):
+            print(json.dumps({"alive": False, "stage": self._stage,
+                              "why": f"watchdog {self._seconds}s"}),
+                  flush=True)
+            os._exit(2)
+
+    def __enter__(self):
+        self._t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._done.set()
+
+
+def main() -> int:
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(3))
+    t_start = time.time()
+    _stage("import_jax")
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+
+    _stage("backend_init")
+    with _Watchdog("backend_init", 240):
+        devs = jax.devices()
+        platform = devs[0].platform
+        kind = devs[0].device_kind
+    _stage("tiny_matmul")
+    with _Watchdog("tiny_matmul", 600):
+        x = jnp.ones((128, 128), dtype=jnp.float32)
+        y = (x @ x)[0, 0]
+        float(y)  # scalar readback = completion fence under the tunnel
+    dt = time.time() - t_start
+    print(json.dumps({"alive": True, "platform": platform,
+                      "device_kind": kind, "seconds": round(dt, 1)}),
+          flush=True)
+    return 0 if platform == "tpu" else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

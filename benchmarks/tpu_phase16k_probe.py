@@ -1,0 +1,195 @@
+"""Round-4 TPU probe: phase-attribute the 16384^2 headline (VERDICT r3 #4).
+
+Two mechanisms, because the axon tunnel may not surface device-side trace
+events:
+
+1. ``utils/profiling.trace`` around one warm full-size dispatch — writes a
+   perfetto/TensorBoard trace directory (committed when small enough; the
+   engines' named scopes panel_factor / trailing_update / back_substitute
+   = the reference's t1a/t1b/t2, src:126-146, 291-292).
+2. A DIFFERENTIAL breakdown that needs no profiler: chain-time (a) the
+   full QR and (b) the bare panel ladder — the fused Pallas kernel on
+   exactly the (m - k*nb, nb) panel shapes the factorization visits,
+   chained in one dispatch. panel_s = (b); trailing+other = (a) - (b).
+   The trailing GEMM flops are known exactly, so the table reports the
+   trailing update's achieved TF/s and what fraction of the wall is
+   panel vs trailing vs other.
+
+Emits JSONL rows; the final row is the breakdown table. Single TPU
+process; smallest-first; 560-580 s watchdogs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _stage(name: str) -> None:
+    print(f"::stage {name} t={time.time():.1f}", file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(3))
+    from bench import _Watchdog
+
+    _stage("import")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(_REPO, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    from dhqr_tpu.ops.blocked import _blocked_qr_impl
+    from dhqr_tpu.ops.pallas_panel import _panel_qr_pallas_impl
+    from dhqr_tpu.utils.profiling import sync, trace
+
+    _stage("backend_init")
+    with _Watchdog("backend_init", 240):
+        dev = jax.devices()[0]
+        platform = dev.platform
+        kind = getattr(dev, "device_kind", "?")
+        sync(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    _stage(f"backend_ready_{platform}")
+    rng = np.random.default_rng(0)
+
+    N = int(os.environ.get("DHQR_PHASE_N", "16384"))
+    NB = int(os.environ.get("DHQR_PHASE_NB", "512"))
+    CHAIN = 3
+    REPEATS = 2
+
+    def emit(rec):
+        rec["platform"] = platform
+        rec["device_kind"] = kind
+        print(json.dumps(rec), flush=True)
+
+    kw = dict(precision="highest", pallas=True, norm="fast",
+              panel_impl="loop")
+
+    def tmin(f, A, pick, repeats=REPEATS):
+        ts = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            r = f(A)
+            sync(pick(r))
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    # --- stage 1: full QR, single + chain (the headline protocol) -------
+    _stage(f"full_qr_{N}")
+    A = jnp.asarray(rng.random((N, N)), jnp.float32)
+    sync(A)
+    full_t = None
+    try:
+        with _Watchdog("full_qr", 580):
+            single = _blocked_qr_impl.lower(A, NB, **kw).compile()
+            H, al = single(A)
+            sync(al)
+
+            def chained(A):
+                def body(C, _):
+                    Hc, ac = _blocked_qr_impl(C, NB, **kw)
+                    return Hc, ac[0]
+                return lax.scan(body, A, None, length=CHAIN)
+
+            ck = jax.jit(chained).lower(A).compile()
+            _, s = ck(A)
+            sync(s)
+            t1 = tmin(single, A, lambda r: r[1])
+            tk = tmin(ck, A, lambda r: r[1])
+            full_t = (tk - t1) / (CHAIN - 1)
+            if not (tk > t1 * 1.05 and full_t > 0):
+                full_t = t1
+            flops = (4.0 / 3.0) * N**3
+            emit({"metric": f"full_qr_{N}_nb{NB}", "seconds": round(full_t, 4),
+                  "gflops": round(flops / full_t / 1e9, 2),
+                  "seconds_single": round(t1, 4), "seconds_chain": round(tk, 4)})
+    except Exception as ex:
+        emit({"metric": "full_qr", "ok": False,
+              "error": f"{type(ex).__name__}: {ex}"[:400]})
+        return
+
+    # --- stage 2: bare panel ladder, one dispatch ----------------------
+    # The factorization visits panels of height N - q*NB, width NB; the
+    # fused kernel factors each in VMEM. A scan over the TALLEST shape with
+    # masked rows would change the work; instead chain the exact ladder as
+    # one jitted program of dependent kernel calls (output feeds a cheap
+    # scalar into the next input so XLA cannot elide stages).
+    _stage("panel_ladder")
+    try:
+        with _Watchdog("panel_ladder", 580):
+            heights = [N - q * NB for q in range(N // NB)]
+
+            def ladder(A):
+                acc = jnp.float32(0.0)
+                outs = []
+                for h in heights:
+                    panel = lax.dynamic_slice(A, (0, 0), (h, NB)) + acc
+                    pf, a_k = _panel_qr_pallas_impl(panel, 0, interpret=False)
+                    acc = a_k[0] * jnp.float32(1e-30)  # data dependence only
+                    outs.append(a_k[0])
+                return jnp.stack(outs).sum() + acc
+
+            lj = jax.jit(ladder).lower(A).compile()
+            s = lj(A)
+            sync(s)
+            panel_t = tmin(lj, A, lambda r: r)
+            emit({"metric": f"panel_ladder_{N}_nb{NB}",
+                  "seconds": round(panel_t, 4),
+                  "panels": len(heights)})
+    except Exception as ex:
+        emit({"metric": "panel_ladder", "ok": False,
+              "error": f"{type(ex).__name__}: {ex}"[:400]})
+        panel_t = None
+
+    # --- stage 3: perfetto trace of one warm dispatch -------------------
+    _stage("profiler_trace")
+    trace_dir = os.path.join(_REPO, "benchmarks", "results",
+                             f"trace_qr{N}_nb{NB}")
+    trace_ok = False
+    try:
+        with _Watchdog("profiler_trace", 300):
+            with trace(trace_dir):
+                H, al = single(A)
+                sync(al)
+            trace_ok = True
+    except Exception as ex:
+        emit({"metric": "profiler_trace", "ok": False,
+              "error": f"{type(ex).__name__}: {ex}"[:400]})
+
+    # --- breakdown table -------------------------------------------------
+    if panel_t is not None and full_t:
+        other_t = max(full_t - panel_t, 0.0)
+        # Trailing-update GEMM flops: sum over panels of
+        # 4 * (m-k) * nb * (n-k-nb) (compact-WY: two applies' worth counted
+        # by the standard 2mnk per GEMM x the W/Y pair) — approximate with
+        # the classical attribution total_flops - panel_flops.
+        panel_flops = sum(2.0 * h * NB * NB - (2.0 / 3.0) * NB**3
+                          for h in [N - q * NB for q in range(N // NB)])
+        total_flops = (4.0 / 3.0) * N**3
+        trailing_flops = total_flops - panel_flops
+        emit({
+            "metric": f"phase_breakdown_{N}_nb{NB}",
+            "full_seconds": round(full_t, 4),
+            "panel_seconds": round(panel_t, 4),
+            "trailing_plus_other_seconds": round(other_t, 4),
+            "panel_fraction": round(panel_t / full_t, 3),
+            "panel_gflops": round(panel_flops / max(panel_t, 1e-9) / 1e9, 1),
+            "trailing_gflops_upper_bound": round(
+                trailing_flops / max(other_t, 1e-9) / 1e9, 1),
+            "trace_dir": trace_dir if trace_ok else None,
+        })
+    _stage("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,154 @@
+"""Round-4 TPU probe: split trailing-update precision (VERDICT r3 #3).
+
+``trailing_precision`` lets the trailing-update GEMMs — ~all the flops —
+run at MXU precision "high" (3 bf16 passes) while the panel factorization
+and T-factor recurrence stay at "highest" (6 passes). Halving MXU passes
+on the bulk work could be the largest single perf lever available; this
+probe measures BOTH sides of the trade at 4096/8192/16384:
+
+* backward error ||QR - A|| / ||A|| vs the 1e-5 BASELINE.md target (the
+  bound must hold with >= 5x margin before the pair becomes the bench
+  configuration, per the VERDICT's own bar);
+* chain-timed GFLOP/s (the RTT-cancelling protocol from bench.py).
+
+Emits one JSONL row per (size, precision-pair). Run ONE instance at a
+time (single TPU process rule); smallest-first with 560-580 s watchdogs
+(compile-heavy stages must not hard-exit mid-remote-compile — the round-3
+wedge).
+
+Prior evidence (tpu_r3_vmem_probe.jsonl): one unpaired tp="high" run at
+4096^2/nb=256 measured 9,777 GFLOP/s with backward error 2.7e-5 — SLOWER
+than the committed tp=None nb=256 rate (10.3 TF/s, different run) and
+ABOVE the 1e-5 target. This probe exists to settle it with back-to-back
+pairs per size; expect a documented negative result unless the pairing
+flips the speed story (run-to-run spread on the shared chip is +-15%).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _stage(name: str) -> None:
+    print(f"::stage {name} t={time.time():.1f}", file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(3))
+    from bench import _Watchdog
+
+    _stage("import")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(_REPO, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    from dhqr_tpu.ops.blocked import _apply_q_impl, _blocked_qr_impl
+    from dhqr_tpu.ops.solve import r_matrix
+    from dhqr_tpu.utils.profiling import sync
+
+    _stage("backend_init")
+    with _Watchdog("backend_init", 240):
+        dev = jax.devices()[0]
+        platform = dev.platform
+        kind = getattr(dev, "device_kind", "?")
+        sync(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    _stage(f"backend_ready_{platform}")
+    rng = np.random.default_rng(0)
+
+    def emit(rec):
+        rec["platform"] = platform
+        rec["device_kind"] = kind
+        print(json.dumps(rec), flush=True)
+
+    def stage(n, nb, tprec, chain, watchdog, repeats=3):
+        name = f"qr_{n}_nb{nb}_tp-{tprec or 'none'}"
+        _stage(name)
+        try:
+            with _Watchdog(name, watchdog):
+                A = jnp.asarray(rng.random((n, n)), jnp.float32)
+                sync(A)
+                kw = dict(precision="highest", pallas=True, norm="fast",
+                          panel_impl="loop", trailing_precision=tprec)
+                t0 = time.perf_counter()
+                single = _blocked_qr_impl.lower(A, nb, **kw).compile()
+                H, al = single(A)
+                sync(al)
+
+                def chained(A):
+                    def body(C, _):
+                        Hc, ac = _blocked_qr_impl(C, nb, **kw)
+                        return Hc, ac[0]
+                    return lax.scan(body, A, None, length=chain)
+
+                ck = jax.jit(chained).lower(A).compile()
+                compile_s = time.perf_counter() - t0
+                _, s = ck(A)
+                sync(s)
+
+                def tmin(f, pick):
+                    ts = []
+                    for _ in range(repeats):
+                        t0 = time.perf_counter()
+                        r = f(A)
+                        sync(pick(r))
+                        ts.append(time.perf_counter() - t0)
+                    return min(ts)
+
+                t1 = tmin(single, lambda r: r[1])
+                tk = tmin(ck, lambda r: r[1])
+                t = (tk - t1) / (chain - 1)
+                unreliable = not (tk > t1 * 1.05 and t > 0)
+                if unreliable:
+                    t = t1
+                # Backward error on the SAME factorization that was timed.
+                QR = _apply_q_impl(H, r_matrix(H, al), nb,
+                                   precision="highest")
+                berr = float(jnp.linalg.norm(QR - A) / jnp.linalg.norm(A))
+                flops = (4.0 / 3.0) * n**3
+                emit({"metric": f"qr_gflops_per_chip_f32_{n}x{n}",
+                      "value": round(flops / t / 1e9, 2),
+                      "unit": "GFLOP/s", "seconds": round(t, 4),
+                      "block_size": nb,
+                      "precision": "highest",
+                      "trailing_precision": tprec or "highest",
+                      "backward_error": berr,
+                      "backward_error_target": 1e-5,
+                      "margin_vs_target": round(1e-5 / max(berr, 1e-30), 1),
+                      "chain_length": chain,
+                      "seconds_single_dispatch": round(t1, 4),
+                      "seconds_chain": round(tk, 4),
+                      "compile_seconds": round(compile_s, 2),
+                      "chain_unreliable": unreliable})
+        except Exception as ex:
+            emit({"metric": name, "ok": False,
+                  "error": f"{type(ex).__name__}: {ex}"[:400]})
+
+    # Smallest-first; baseline (tp=None) at each size right before the
+    # split pair so the comparison shares cache/thermal conditions. nb per
+    # auto_block_size's measured optimum (256 below 12288, 512 at 16384).
+    stage(4096, 256, None, 25, 560)
+    stage(4096, 256, "high", 25, 560)
+    stage(8192, 256, None, 5, 560)
+    stage(8192, 256, "high", 5, 560)
+    stage(16384, 512, None, 3, 580, repeats=2)
+    stage(16384, 512, "high", 3, 580, repeats=2)
+    # Default-precision trailing ("default" = pure bf16 inputs) is the
+    # aggressive end — measure it at one size for the error curve.
+    stage(4096, 256, "default", 25, 560)
+    _stage("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,7 @@ the moral equivalent of the reference's local-process fake cluster
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import os
 import sys
 import time
@@ -128,9 +129,17 @@ def main(argv=None) -> int:
     # (ops/blocked.auto_block_size - the measured nb=256/512 TPU optimum).
     row_engine = cfg.engine != "householder"
     if row_engine and cfg.layout != "block":
-        src = "--layout" if args.layout is not None else "DHQR_LAYOUT"
-        parser.error(f"{src}={cfg.layout} applies to the householder "
-                     f"engines only (engine={cfg.engine})")
+        if args.layout is not None:
+            # Explicit flag conflict: hard error.
+            parser.error(f"--layout={cfg.layout} applies to the householder "
+                         f"engines only (engine={cfg.engine})")
+        # Env-sourced (an ambient DHQR_LAYOUT=cyclic in the shell must not
+        # abort a tsqr/cholqr run that predates the layout check — ADVICE
+        # r3): warn and fall back to the row engines' only layout.
+        print(f"# warning: DHQR_LAYOUT={cfg.layout} ignored — layout "
+              f"applies to the householder engines only "
+              f"(engine={cfg.engine}); using 'block'", file=sys.stderr)
+        cfg = dataclasses.replace(cfg, layout="block")
     print(f"# devices: {len(jax.devices())} ({jax.default_backend()}), "
           f"mesh size: {ndev}, engine: {cfg.engine}"
           + ("" if row_engine else f", layout: {cfg.layout}"))

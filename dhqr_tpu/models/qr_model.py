@@ -341,6 +341,7 @@ def _lstsq_refined(A, b, cfg: DHQRConfig, mesh):
         return _lstsq_impl(
             A, b, cfg.block_size, cfg.blocked, cfg.precision, cfg.use_pallas,
             norm=cfg.norm, panel_impl=cfg.panel_impl, refine=cfg.refine,
+            pallas_flat=_blocked.PALLAS_FLAT_WIDTH,
         )
     fact = qr(A, config=dataclasses.replace(cfg, refine=0), mesh=mesh)
     x = fact.solve(b)
@@ -424,9 +425,10 @@ def _lstsq_alt_engine(A, b, cfg: DHQRConfig, mesh):
 
 @partial(jax.jit, static_argnames=(
     "block_size", "blocked", "precision", "use_pallas", "norm", "panel_impl",
-    "refine"))
+    "refine", "pallas_flat"))
 def _lstsq_impl(A, b, block_size, blocked, precision, use_pallas,
-                norm="accurate", panel_impl="loop", refine=0):
+                norm="accurate", panel_impl="loop", refine=0,
+                pallas_flat=None):
     if blocked:
         from dhqr_tpu.ops.differentiable import lstsq_diff
 
@@ -437,7 +439,7 @@ def _lstsq_impl(A, b, block_size, blocked, precision, use_pallas,
         # closed-form O(1)-memory gradients — jax.grad works through the
         # public lstsq at every refine level
         return lstsq_diff(A, b, block_size, precision, pallas, interp, norm,
-                          panel_impl, refine)
+                          panel_impl, refine, pallas_flat)
     if use_pallas != "auto":
         raise ValueError(
             "use_pallas applies to the blocked engines only "
@@ -589,4 +591,5 @@ def lstsq(
     return _lstsq_impl(
         A, b, cfg.block_size, cfg.blocked, cfg.precision, cfg.use_pallas,
         norm=cfg.norm, panel_impl=cfg.panel_impl,
+        pallas_flat=_blocked.PALLAS_FLAT_WIDTH,
     )

@@ -320,7 +320,9 @@ def _pallas_lowers_on_this_backend(dtype_name: str) -> bool:
         return False
 
 
-def _resolve_pallas(mode: str, m: int, nb: int, dtype) -> tuple[bool, bool]:
+def _resolve_pallas(mode: str, m: int, nb: int, dtype,
+                    platform: "str | None" = None,
+                    device=None) -> tuple[bool, bool]:
     """Map a ``use_pallas`` config value to (enabled, interpret) for a shape.
 
     "always" forces the fused panel kernel, using the Pallas interpreter
@@ -334,6 +336,13 @@ def _resolve_pallas(mode: str, m: int, nb: int, dtype) -> tuple[bool, bool]:
     XLA path (the interpreter is a test vehicle, orders of magnitude slower).
     ``DHQR_PALLAS_AUTO=0`` vetoes auto-routing without touching call sites
     (an escape hatch if hardware benchmarking shows XLA panels faster).
+
+    ``device`` (preferred) or ``platform`` is the execution target that
+    "auto"/"always" resolve against — pass the MESH's device for sharded
+    callers (a TPU mesh driven from a CPU-default process must still get
+    the kernel, sized by the mesh chip's measured VMEM gate, and a virtual
+    CPU mesh on a TPU host must not); ``None`` means the process default
+    backend.
     """
     from dhqr_tpu.ops.pallas_panel import pallas_panel_supported
 
@@ -341,9 +350,15 @@ def _resolve_pallas(mode: str, m: int, nb: int, dtype) -> tuple[bool, bool]:
         return False, False
     # Panels wider than PALLAS_FLAT_WIDTH are factored by recursive
     # splitting into base-width kernel calls (_panel_factor_pallas), so
-    # VMEM only ever has to admit the base width.
-    supported = pallas_panel_supported(m, min(nb, PALLAS_FLAT_WIDTH), dtype)
-    on_tpu = jax.default_backend() == "tpu"
+    # VMEM only ever has to admit the base width. The gate is sized for
+    # the execution device when one is given.
+    supported = pallas_panel_supported(m, min(nb, PALLAS_FLAT_WIDTH), dtype,
+                                       device=device)
+    if device is not None:
+        platform = device.platform
+    if platform is None:
+        platform = jax.default_backend()
+    on_tpu = platform == "tpu"
     if mode == "always":
         if not supported:
             raise ValueError(
@@ -355,8 +370,10 @@ def _resolve_pallas(mode: str, m: int, nb: int, dtype) -> tuple[bool, bool]:
     if mode == "auto":
         veto = _os.environ.get("DHQR_PALLAS_AUTO", "") == "0"
         enabled = supported and on_tpu and not veto
-        if enabled and not _pallas_lowers_on_this_backend(
-                jnp.dtype(dtype).name):
+        # The lowering probe compiles on the PROCESS default backend — only
+        # meaningful when that is the platform we are resolving for.
+        if enabled and platform == jax.default_backend() and \
+                not _pallas_lowers_on_this_backend(jnp.dtype(dtype).name):
             enabled = False  # Mosaic rejected the kernel here — XLA path
         return enabled, False
     raise ValueError(f"use_pallas must be 'auto', 'always' or 'never', got {mode!r}")

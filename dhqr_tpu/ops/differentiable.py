@@ -40,11 +40,11 @@ from dhqr_tpu.ops.householder import DEFAULT_PRECISION
 from dhqr_tpu.ops.solve import back_substitute, r_matrix
 
 
-@partial(jax.custom_jvp, nondiff_argnums=(2, 3, 4, 5, 6, 7, 8))
+@partial(jax.custom_jvp, nondiff_argnums=(2, 3, 4, 5, 6, 7, 8, 9))
 def lstsq_diff(
     A, b, block_size=DEFAULT_BLOCK_SIZE, precision=DEFAULT_PRECISION,
     pallas=False, pallas_interpret=False, norm="accurate",
-    panel_impl="loop", refine=0,
+    panel_impl="loop", refine=0, pallas_flat=None,
 ):
     """``x = argmin ||A x - b||`` with closed-form O(1)-memory derivatives.
 
@@ -58,17 +58,25 @@ def lstsq_diff(
     minimizer, which refinement approaches rather than changes.
     """
     x, _ = _lstsq_fwd(A, b, block_size, precision, pallas, pallas_interpret,
-                      norm, panel_impl, refine)
+                      norm, panel_impl, refine, pallas_flat)
     return x
 
 
 def _lstsq_fwd(A, b, block_size, precision, pallas=False,
                pallas_interpret=False, norm="accurate", panel_impl="loop",
-               refine=0):
+               refine=0, pallas_flat=None):
+    if pallas_flat is None:
+        # Resolve the module global HERE (call time), not via
+        # _blocked_qr_impl's in-trace default — the explicit static arg
+        # keys the jit cache, so a PALLAS_FLAT_WIDTH change is honored on
+        # the next call instead of silently reusing a stale trace (the
+        # pattern blocked_householder_qr already follows).
+        from dhqr_tpu.ops.blocked import PALLAS_FLAT_WIDTH
+        pallas_flat = PALLAS_FLAT_WIDTH
     H, alpha = _blocked_qr_impl(
         A, block_size, precision=precision,
         pallas=pallas, pallas_interpret=pallas_interpret, norm=norm,
-        panel_impl=panel_impl,
+        panel_impl=panel_impl, pallas_flat=pallas_flat,
     )
 
     def qr_solve(rhs):
@@ -85,12 +93,12 @@ def _lstsq_fwd(A, b, block_size, precision, pallas=False,
 
 @lstsq_diff.defjvp
 def _lstsq_jvp(block_size, precision, pallas, pallas_interpret, norm,
-               panel_impl, refine, primals, tangents):
+               panel_impl, refine, pallas_flat, primals, tangents):
     A, b = primals
     dA, db = tangents
     x, (_, _, H, alpha, _) = _lstsq_fwd(
         A, b, block_size, precision, pallas, pallas_interpret, norm,
-        panel_impl, refine
+        panel_impl, refine, pallas_flat
     )
     m, n = A.shape
     vec = x.ndim == 1

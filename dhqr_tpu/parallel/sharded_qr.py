@@ -435,7 +435,7 @@ def sharded_blocked_qr(
     layout: str = "block",
     _store_layout_output: bool = False,
     norm: str = "accurate",
-    use_pallas: str = "never",
+    use_pallas: str = "auto",
     panel_impl: str = "loop",
 ):
     """Compact-WY distributed QR: one psum per panel, GEMM trailing updates.
@@ -470,11 +470,14 @@ def sharded_blocked_qr(
 
     from dhqr_tpu.ops.blocked import PALLAS_FLAT_WIDTH
 
-    pallas, _ = _resolve_pallas(use_pallas, m, nb, A.dtype)
-    # Interpret-vs-compile follows the MESH's platform, not the process
-    # default backend — a CPU mesh on a TPU-default host (the virtual-mesh
-    # test pattern) must get the interpreter, and vice versa.
-    interp = pallas and mesh.devices.flat[0].platform != "tpu"
+    # "auto" resolves against the MESH's device, not the process default
+    # backend — a TPU mesh driven from a CPU-default process still gets the
+    # kernel (VMEM gate sized by the mesh chip), and a virtual CPU mesh on
+    # a TPU host does not (same default as blocked_householder_qr since
+    # round 4; "always" on a CPU mesh runs the interpreter, the test
+    # vehicle — the returned interpret flag encodes exactly that).
+    pallas, interp = _resolve_pallas(use_pallas, m, nb, A.dtype,
+                                     device=mesh.devices.flat[0])
     A = _to_store_layout(A, n, nproc, nb, layout)
     A = jax.device_put(A, column_sharding(mesh, axis_name))
     H, alpha = _build_blocked(

@@ -294,7 +294,7 @@ def sharded_lstsq(
     precision: str = DEFAULT_PRECISION,
     layout: str = "block",
     norm: str = "accurate",
-    use_pallas: str = "never",
+    use_pallas: str = "auto",
     panel_impl: str = "loop",
 ) -> jax.Array:
     """One-shot distributed least squares: factor + solve on the mesh.

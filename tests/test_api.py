@@ -266,6 +266,58 @@ def test_complex_probe_env_bypass(monkeypatch):
     assert plat.complex_supported_on_backend() is True  # env overrides cache
 
 
+def test_complex_denylist_skips_probe(monkeypatch):
+    """On the KNOWN-complexless axon relay the execute-probe must never
+    run (a failed c64 execution poisons the relay's compile helper even
+    while raising the clear error — ADVICE r3): the denylist answers
+    first. Identified by the sitecustomize pool pin."""
+    import jax
+
+    from dhqr_tpu.utils import platform as plat
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    monkeypatch.delenv("DHQR_TPU_COMPLEX", raising=False)
+
+    def _probe_must_not_run():
+        raise AssertionError("execute-probe ran on a denylisted backend")
+
+    monkeypatch.setattr(plat, "_complex_probe_result", _probe_must_not_run)
+    assert plat.complex_supported_on_backend() is False
+
+
+def test_complex_probe_transient_failure_not_cached(monkeypatch):
+    """A transient probe failure (relay hiccup, OOM — anything without an
+    UNIMPLEMENTED-class marker) must not permanently mark complex
+    unsupported: the next call re-probes. Definitive failures ARE cached."""
+    import jax.numpy as real_jnp
+
+    from dhqr_tpu.utils import platform as plat
+
+    monkeypatch.setattr(plat, "_COMPLEX_PROBE_CACHE", [])
+    calls = []
+
+    def flaky_full(*a, **k):
+        calls.append(1)
+        raise RuntimeError("connection reset by peer")  # transient-shaped
+
+    monkeypatch.setattr(real_jnp, "full", flaky_full)
+    assert plat._complex_probe_result() is False
+    assert plat._complex_probe_result() is False
+    assert len(calls) == 2  # re-probed: transient outcome was not cached
+    assert plat._COMPLEX_PROBE_CACHE == []
+
+    def hard_full(*a, **k):
+        calls.append(1)
+        raise RuntimeError("UNIMPLEMENTED: complex matmul")
+
+    monkeypatch.setattr(real_jnp, "full", hard_full)
+    assert plat._complex_probe_result() is False
+    assert plat._complex_probe_result() is False
+    assert len(calls) == 3  # definitive outcome cached after one probe
+    assert plat._COMPLEX_PROBE_CACHE == [False]
+
+
 def test_condition_estimate_and_rank():
     """R-diag diagnostics: exact on orthogonally-scaled constructions,
     honest lower bound on a random matrix, full rank on well-conditioned

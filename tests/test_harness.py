@@ -25,3 +25,32 @@ def test_harness_cli_runs_and_passes():
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "ok  44x40" in proc.stdout
     assert "slowdown vs LAPACK" in proc.stdout
+
+
+def _run_harness(extra_args, extra_env):
+    return subprocess.run(
+        [sys.executable, "-m", "dhqr_tpu.harness", "1",
+         "--sizes", "24x20", "--dtypes", "float64", *extra_args],
+        capture_output=True, text=True, timeout=600,
+        env={
+            "PATH": "/usr/bin:/bin:/usr/local/bin",
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": REPO_ROOT,
+            "HOME": os.environ.get("HOME", "/tmp"),
+            **extra_env,
+        },
+    )
+
+
+def test_harness_env_layout_with_row_engine_warns_not_aborts():
+    """An ambient DHQR_LAYOUT=cyclic must not abort a tsqr run (ADVICE r3:
+    the env-sourced conflict downgrades to a warning + 'block' fallback);
+    an explicit --layout conflict still hard-fails."""
+    proc = _run_harness(["--engine", "tsqr"], {"DHQR_LAYOUT": "cyclic"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ok  24x20" in proc.stdout
+    assert "DHQR_LAYOUT=cyclic ignored" in proc.stderr
+
+    proc = _run_harness(["--engine", "tsqr", "--layout", "cyclic"], {})
+    assert proc.returncode != 0
+    assert "householder engines only" in proc.stderr
