@@ -335,36 +335,43 @@ def main() -> None:
     rng = np.random.default_rng(0)
 
     def qr_bench(n_, pallas=False, watchdog=120, repeats=REPEATS,
-                 backward_error=False, chain=0, nb=None, panel="loop"):
+                 backward_error=False, chain=0, nb=None, panel="loop",
+                 flat=None):
         """Measure blocked QR at n_ x n_ and print a COMPLETE headline JSON
         line for it — later (larger) stages supersede it; the supervisor
         keeps the last parseable line (so a wedge mid-escalation still
         records the largest size that finished). ``chain=k`` times a k-long
         in-jit scan of dependent factorizations to cancel the tunnel RTT
-        (see module docstring); 0 = single-dispatch timing (CPU fallback)."""
+        (see module docstring); 0 = single-dispatch timing (CPU fallback).
+        ``flat`` overrides the Pallas flat-panel width — flat < nb factors
+        each panel as flat-wide kernel calls + compact-WY applies (the
+        split-panel configuration, VERDICT r3 #2)."""
         name = f"qr_{n_}" + ("_pallas" if pallas else "") + \
             (f"_nb{nb}" if nb else "") + \
+            (f"_flat{flat}" if flat else "") + \
             ("_recursive" if panel == "recursive" else "")
         _stage(name)
         try:
             return _qr_bench_guarded(name, n_, pallas, watchdog, repeats,
-                                     backward_error, chain, nb or BLOCK, panel)
+                                     backward_error, chain, nb or BLOCK,
+                                     panel, flat)
         except Exception as e:  # a failed stage must not kill later stages
             print(f"::stage_failed {name} {type(e).__name__}: {e}",
                   file=sys.stderr, flush=True)
             return None
 
     def _qr_bench_guarded(name, n_, pallas, watchdog, repeats, backward_error,
-                          chain, nb, panel):
+                          chain, nb, panel, flat=None):
         from jax import lax
 
+        extra = {} if flat is None else {"pallas_flat": flat}
         with _Watchdog(name, watchdog):
             A = jnp.asarray(rng.random((n_, n_)), dtype=jnp.float32)
             sync(A)
             t0 = time.perf_counter()
             compiled = _blocked_qr_impl.lower(
                 A, nb, precision=PRECISION, pallas=pallas, norm=NORM,
-                panel_impl=panel,
+                panel_impl=panel, **extra,
             ).compile()
             compile_s = time.perf_counter() - t0
             H, alpha = compiled(A)
@@ -384,7 +391,7 @@ def main() -> None:
                     def body(C, _):
                         Hc, ac = _blocked_qr_impl(
                             C, nb, precision=PRECISION, pallas=pallas,
-                            norm=NORM, panel_impl=panel)
+                            norm=NORM, panel_impl=panel, **extra)
                         return Hc, ac[0]
                     Hc, s = lax.scan(body, A, None, length=chain)
                     return Hc, s
@@ -427,6 +434,8 @@ def main() -> None:
                 "pallas_panels": pallas,
                 "panel_impl": panel,
             }
+            if flat is not None:
+                result["pallas_flat"] = flat
             if t_chain is not None:
                 result["seconds_chain"] = round(t_chain, 4)
                 result["chain_length"] = chain
@@ -598,6 +607,14 @@ def main() -> None:
     # the 256->512 panel-width crossover point, tpu_r3_scale.jsonl).
     run_stage(3 * N, pallas=True, watchdog=460, chain=3, nb=512, repeats=2)
     run_stage(4 * N, pallas=True, watchdog=460, chain=3, nb=512, repeats=2)
+    # Split-panel configuration (VERDICT r3 #2): nb=512 panels factored as
+    # two 256-wide kernel calls + one compact-WY apply (phase probe
+    # predicts ~0.57x the panel cost) — gets the datum into the driver's
+    # own artifact even if the standalone ladder never runs. LAST among
+    # QR stages: it is the only cold-cache program in the escalation, and
+    # its compile must not starve the 12288/16384 headline stages inside
+    # the supervisor's window (headline first, experiments after).
+    run_stage(N, pallas=True, watchdog=420, chain=25, nb=512, flat=256)
     if not results:
         return
     # Comparison datum (never the headline); the best record is re-emitted

@@ -1,0 +1,60 @@
+#!/bin/bash
+# Round-4 hardware session: run the must-have headline FIRST, then the
+# perf experiments, strictly sequentially (ONE TPU process at a time).
+# Safe to re-run; every stage appends to its own durable artifact.
+#
+#   bash benchmarks/tpu_session_r4.sh [stage...]
+#
+# Stages (default: all, in this order — the order IS the protocol:
+# headline before risky probes, VERDICT r3 #1):
+#   alive     - relay health check (exits nonzero if wedged; later stages skip)
+#   bench     - full bench.py supervised run (headline into bench_r4_run.json
+#               + per-stage tee into bench_tpu_tee.jsonl)
+#   split     - split-panel ladder      -> tpu_r4_split.jsonl
+#   trailing  - trailing-precision pairs -> tpu_r4_trailing.jsonl
+#   phase     - 16384^2 phase breakdown -> tpu_r4_phase16k.jsonl
+set -u
+cd "$(dirname "$0")/.."
+RES=benchmarks/results
+mkdir -p "$RES"
+STAGES=${*:-"alive bench split trailing phase"}
+
+# Validate every stage name BEFORE running anything: a typo in a later
+# argument must not abort the session after earlier multi-hundred-second
+# stages already spent the hardware window.
+for s in $STAGES; do
+  case "$s" in
+    alive|bench|split|trailing|phase) ;;
+    *) echo "unknown stage '$s' (valid: alive bench split trailing phase)" >&2
+       exit 1 ;;
+  esac
+done
+
+run() { # name, logfile, cmd...
+  local name=$1 log=$2; shift 2
+  echo "=== $name: $* (log: $log)" >&2
+  "$@" 2>>"$log.stderr" | tee -a "$log"
+  local rc=${PIPESTATUS[0]}
+  echo "=== $name done rc=$rc" >&2
+  return "$rc"
+}
+
+for s in $STAGES; do
+  case "$s" in
+    alive)
+      run alive "$RES/tpu_r4_alive.log" \
+        python benchmarks/tpu_alive_probe.py || exit 2 ;;
+    bench)
+      run bench "$RES/bench_r4_run.json" python bench.py ;;
+    split)
+      run split "$RES/tpu_r4_split.jsonl" \
+        python benchmarks/tpu_split_probe.py ;;
+    trailing)
+      run trailing "$RES/tpu_r4_trailing.jsonl" \
+        python benchmarks/tpu_trailing_precision_probe.py ;;
+    phase)
+      run phase "$RES/tpu_r4_phase16k.jsonl" \
+        python benchmarks/tpu_phase16k_probe.py ;;
+    *) echo "unknown stage $s" >&2; exit 1 ;;
+  esac
+done

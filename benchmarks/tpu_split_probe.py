@@ -59,15 +59,18 @@ def main() -> None:
         print(json.dumps(rec), flush=True)
 
     def chain_time(n, nb, chain, watchdog, repeats=3,
-                   backward_error=False):
-        name = f"qr_split_{n}_nb{nb}"
+                   backward_error=False, flat=256):
+        """``flat`` is passed explicitly per stage (static jit arg), so one
+        process can ladder several flat widths without touching the module
+        global / env."""
+        name = f"qr_split_{n}_nb{nb}_flat{flat}"
         _stage(name)
         try:
             with _Watchdog(name, watchdog):
                 A = jnp.asarray(rng.random((n, n)), jnp.float32)
                 sync(A)
                 kw = dict(precision="highest", pallas=True, norm="fast",
-                          panel_impl="loop")
+                          panel_impl="loop", pallas_flat=flat)
                 t0 = time.perf_counter()
                 single = _blocked_qr_impl.lower(A, nb, **kw).compile()
                 H, al = single(A)
@@ -104,6 +107,7 @@ def main() -> None:
                        "value": round(flops / t / 1e9, 2),
                        "unit": "GFLOP/s", "seconds": round(t, 4),
                        "block_size": nb, "panel": "split-pallas",
+                       "pallas_flat": flat,
                        "chain_length": chain,
                        "seconds_single_dispatch": round(t1, 4),
                        "seconds_chain": round(tk, 4),
@@ -127,6 +131,23 @@ def main() -> None:
     chain_time(8192, 512, 5, 560)
     chain_time(12288, 512, 3, 580, repeats=2)
     chain_time(16384, 512, 3, 580, repeats=2)
+    # Finer split (4x128 kernel calls per 512 panel): more WY applies on
+    # the MXU, shorter serial sweeps — bracket the optimum from below.
+    chain_time(4096, 512, 25, 560, flat=128)
+    chain_time(12288, 512, 3, 580, repeats=2, flat=128)
+    # Split-256 (2x128): does the crossover logic hold at the nb=256 sizes?
+    chain_time(4096, 256, 25, 560, flat=128)
+    chain_time(8192, 256, 5, 560, flat=128)
+    # WIDER panels, split-factored: nb=1024 halves the number of trailing
+    # passes — fewer, larger GEMMs, so less per-pass masking/fusion
+    # overhead (DESIGN.md's ceiling arithmetic puts ~0.12 s of the 16384^2
+    # wall in that overhead; the trailing update is NOT bandwidth-bound at
+    # this size). The price is a longer in-panel sweep; flat=512 keeps the
+    # kernel at the widths already validated on this chip.
+    chain_time(1024, 1024, 5, 300, backward_error=True, flat=512)
+    chain_time(4096, 1024, 25, 560, flat=512)
+    chain_time(12288, 1024, 3, 580, repeats=2, flat=512)
+    chain_time(16384, 1024, 3, 580, repeats=2, flat=512)
     _stage("done")
 
 

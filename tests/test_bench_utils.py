@@ -30,3 +30,22 @@ def test_parse_last_json_takes_last_parseable_line():
     ])
     assert bench._parse_last_json(out) == {"b": 2}
     assert bench._parse_last_json("no json at all") is None
+
+
+def test_emit_tee_appends_and_warns_once(tmp_path, monkeypatch, capsys):
+    """DHQR_BENCH_TEE: every record is appended durably; a bad path warns
+    on stderr exactly once and never fails the bench (code-review r4)."""
+    bench = _bench()
+    tee = tmp_path / "tee.jsonl"
+    monkeypatch.setenv("DHQR_BENCH_TEE", str(tee))
+    bench._emit({"metric": "m1", "value": 1})
+    bench._emit({"metric": "m2", "value": 2})
+    rows = [json.loads(l) for l in tee.read_text().splitlines()]
+    assert [r["metric"] for r in rows] == ["m1", "m2"]
+
+    monkeypatch.setenv("DHQR_BENCH_TEE", str(tmp_path / "no_dir" / "x.jsonl"))
+    monkeypatch.setattr(bench._emit, "_tee_warned", False, raising=False)
+    bench._emit({"metric": "m3"})
+    bench._emit({"metric": "m4"})
+    err = capsys.readouterr().err
+    assert err.count("DHQR_BENCH_TEE append failed") == 1
