@@ -236,13 +236,10 @@ def qr(
                 A, mesh, block_size=nb, axis_name=col_axis,
                 precision=cfg.precision, layout=cfg.layout, norm=cfg.norm,
                 use_pallas=cfg.use_pallas, panel_impl=cfg.panel_impl,
+                trailing_precision=cfg.trailing_precision,
             )
         else:
-            if cfg.use_pallas != "auto":
-                raise ValueError(
-                    "use_pallas applies to the blocked engines only "
-                    f"(got use_pallas={cfg.use_pallas!r} with blocked=False)"
-                )
+            _reject_nonblocked_knobs(cfg.use_pallas, cfg.trailing_precision)
             H, alpha = _sharded.sharded_householder_qr(
                 A, mesh, axis_name=col_axis, precision=cfg.precision,
                 layout=cfg.layout, norm=cfg.norm,
@@ -256,10 +253,12 @@ def qr(
             A, cfg.block_size, donate=donate, precision=cfg.precision,
             use_pallas=cfg.use_pallas, norm=cfg.norm,
             panel_impl=cfg.panel_impl,
+            trailing_precision=cfg.trailing_precision,
         )
     else:
         if donate:
             raise ValueError("donate=True is only supported on the blocked path")
+        _reject_nonblocked_knobs(cfg.use_pallas, cfg.trailing_precision)
         H, alpha = _hh.householder_qr(A, precision=cfg.precision, norm=cfg.norm)
     return QRFactorization(
         H, alpha, block_size=cfg.block_size, precision=cfg.precision
@@ -290,6 +289,23 @@ def qr_explicit(
     return fact.q_columns(), fact.r_matrix()
 
 
+def _reject_nonblocked_knobs(use_pallas: str,
+                             trailing_precision: "str | None") -> None:
+    """Refuse blocked-only knobs on an unblocked path — one place, so a
+    future blocked-only knob (or message tweak) cannot silently drift
+    between the qr/lstsq tiers (code-review r4)."""
+    if use_pallas != "auto":
+        raise ValueError(
+            "use_pallas applies to the blocked engines only "
+            f"(got use_pallas={use_pallas!r} with blocked=False)"
+        )
+    if trailing_precision is not None:
+        raise ValueError(
+            "trailing_precision applies to the blocked engines only "
+            f"(got {trailing_precision!r} with blocked=False)"
+        )
+
+
 def _validate_alt_engine_cfg(cfg: DHQRConfig) -> None:
     """Option rejections shared by every route into the alt engines (the
     plain path AND the refine path — adding refine must never change
@@ -304,6 +320,11 @@ def _validate_alt_engine_cfg(cfg: DHQRConfig) -> None:
             f"use_pallas applies to engines with panel loops (householder, "
             f"tsqr); engine={cfg.engine!r} is all-GEMM "
             f"(use_pallas={cfg.use_pallas!r})"
+        )
+    if cfg.trailing_precision is not None:
+        raise ValueError(
+            "trailing_precision applies to the blocked householder engines "
+            f"only (engine={cfg.engine!r})"
         )
 
 
@@ -342,6 +363,7 @@ def _lstsq_refined(A, b, cfg: DHQRConfig, mesh):
             A, b, cfg.block_size, cfg.blocked, cfg.precision, cfg.use_pallas,
             norm=cfg.norm, panel_impl=cfg.panel_impl, refine=cfg.refine,
             pallas_flat=_blocked.PALLAS_FLAT_WIDTH,
+            trailing_precision=cfg.trailing_precision,
         )
     fact = qr(A, config=dataclasses.replace(cfg, refine=0), mesh=mesh)
     x = fact.solve(b)
@@ -425,10 +447,10 @@ def _lstsq_alt_engine(A, b, cfg: DHQRConfig, mesh):
 
 @partial(jax.jit, static_argnames=(
     "block_size", "blocked", "precision", "use_pallas", "norm", "panel_impl",
-    "refine", "pallas_flat"))
+    "refine", "pallas_flat", "trailing_precision"))
 def _lstsq_impl(A, b, block_size, blocked, precision, use_pallas,
                 norm="accurate", panel_impl="loop", refine=0,
-                pallas_flat=None):
+                pallas_flat=None, trailing_precision=None):
     if blocked:
         from dhqr_tpu.ops.differentiable import lstsq_diff
 
@@ -439,12 +461,8 @@ def _lstsq_impl(A, b, block_size, blocked, precision, use_pallas,
         # closed-form O(1)-memory gradients — jax.grad works through the
         # public lstsq at every refine level
         return lstsq_diff(A, b, block_size, precision, pallas, interp, norm,
-                          panel_impl, refine, pallas_flat)
-    if use_pallas != "auto":
-        raise ValueError(
-            "use_pallas applies to the blocked engines only "
-            f"(got use_pallas={use_pallas!r} with blocked=False)"
-        )
+                          panel_impl, refine, pallas_flat, trailing_precision)
+    _reject_nonblocked_knobs(use_pallas, trailing_precision)
     H, alpha = _hh.householder_qr(A, precision=precision, norm=norm)
 
     def qr_solve(rhs):
@@ -529,10 +547,12 @@ def lstsq(
                 f"m < n (got {A.shape}) is supported only on the "
                 "single-device householder path (minimum-norm solve)"
             )
-        if not cfg.blocked or cfg.use_pallas != "auto":
+        if not cfg.blocked or cfg.use_pallas != "auto" \
+                or cfg.trailing_precision is not None:
             raise ValueError(
                 "m < n supports only the default blocked XLA path "
-                f"(got blocked={cfg.blocked}, use_pallas={cfg.use_pallas!r})"
+                f"(got blocked={cfg.blocked}, use_pallas={cfg.use_pallas!r}, "
+                f"trailing_precision={cfg.trailing_precision!r})"
             )
         if cfg.refine:
             raise ValueError(
@@ -557,11 +577,7 @@ def lstsq(
 
         col_axis = cfg.mesh_axis or DEFAULT_AXIS
         if not cfg.blocked:
-            if cfg.use_pallas != "auto":
-                raise ValueError(
-                    "use_pallas applies to the blocked engines only "
-                    f"(got use_pallas={cfg.use_pallas!r} with blocked=False)"
-                )
+            _reject_nonblocked_knobs(cfg.use_pallas, cfg.trailing_precision)
             m, n = A.shape
             nb, n_pad = plan_padding(n, mesh.shape[col_axis], cfg.block_size)
             if n_pad != n:
@@ -587,9 +603,11 @@ def lstsq(
             block_size=cfg.block_size, axis_name=col_axis,
             precision=cfg.precision, layout=cfg.layout, norm=cfg.norm,
             use_pallas=cfg.use_pallas, panel_impl=cfg.panel_impl,
+            trailing_precision=cfg.trailing_precision,
         )
     return _lstsq_impl(
         A, b, cfg.block_size, cfg.blocked, cfg.precision, cfg.use_pallas,
         norm=cfg.norm, panel_impl=cfg.panel_impl,
         pallas_flat=_blocked.PALLAS_FLAT_WIDTH,
+        trailing_precision=cfg.trailing_precision,
     )

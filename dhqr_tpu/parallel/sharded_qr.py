@@ -148,6 +148,7 @@ def _blocked_shard_body(
     precision: str = DEFAULT_PRECISION, layout: str = "block",
     norm: str = "accurate", pallas: bool = False, pallas_interpret: bool = False,
     panel_impl: str = "loop", pallas_flat: "int | None" = None,
+    trailing_precision: "str | None" = None,
 ):
     """Per-device body for the compact-WY engine.
 
@@ -164,6 +165,9 @@ def _blocked_shard_body(
     gidx_base = _local_gidx(p, n, nloc, nb, layout)
     alpha = jnp.zeros((n,), dtype=Al.dtype)
     num_panels = n // nb  # nb | nloc and n = nproc * nloc (checked by callers)
+    # Trailing-update GEMM precision may be split from the panel/T-factor
+    # precision — same contract as the single-device engine (blocked.py).
+    tprec = precision if trailing_precision is None else trailing_precision
 
     # Static local-column shrinkage ("drop"): with the cyclic layout, by the
     # time panel kb starts, every device's first kb // nproc stored blocks
@@ -213,7 +217,8 @@ def _blocked_shard_body(
             drop = _done_cols(k // nb)
             Y = jnp.tril(pf)  # (m-k, b); zeros above row k handled by slicing
             C = lax.slice(Al, (k, drop), (m, nloc))
-            C_new = apply_block_reflector_h(Y, C, precision)
+            C_new = apply_block_reflector_h(Y, C, precision,
+                                            gemm_precision=tprec)
             cmask = (gidx_base[drop:] >= k + b)[None, :]
             Al = Al.at[k:, drop:].set(jnp.where(cmask, C_new, C))
         return Al, alpha
@@ -250,7 +255,8 @@ def _blocked_shard_body(
             Sl_upd = lax.dynamic_update_slice(Sl, pf, (jnp.int32(0), kl))
             Sl = jnp.where(mine, Sl_upd, Sl)
             Y = shifted_tril(pf, c)
-            C_new = apply_block_reflector_h(Y, Sl, precision)
+            C_new = apply_block_reflector_h(Y, Sl, precision,
+                                            gemm_precision=tprec)
             cmask = (gidx_base[drop:] >= k + nb)[None, :]
             Sl = jnp.where(cmask, C_new, Sl)
             return Sl, alpha_k
@@ -287,12 +293,14 @@ def _build_blocked(
     mesh: Mesh, axis_name: str, n: int, nb: int, precision: str, layout: str,
     norm: str = "accurate", pallas: bool = False, pallas_interpret: bool = False,
     panel_impl: str = "loop", pallas_flat: "int | None" = None,
+    trailing_precision: "str | None" = None,
 ):
     body = partial(
         _blocked_shard_body,
         n=n, nb=nb, axis=axis_name, precision=precision, layout=layout,
         norm=norm, pallas=pallas, pallas_interpret=pallas_interpret,
         panel_impl=panel_impl, pallas_flat=pallas_flat,
+        trailing_precision=trailing_precision,
     )
     return jax.jit(
         shard_map(
@@ -437,6 +445,7 @@ def sharded_blocked_qr(
     norm: str = "accurate",
     use_pallas: str = "auto",
     panel_impl: str = "loop",
+    trailing_precision: "str | None" = None,
 ):
     """Compact-WY distributed QR: one psum per panel, GEMM trailing updates.
 
@@ -463,6 +472,7 @@ def sharded_blocked_qr(
             _pad_cols_orthogonal(A, n_pad), mesh, block_size=nb,
             axis_name=axis_name, precision=precision, layout=layout,
             norm=norm, use_pallas=use_pallas, panel_impl=panel_impl,
+            trailing_precision=trailing_precision,
         )
         return H[:m, :n], alpha[:n]
     _check_divisibility(m, n, nproc, nb, layout)
@@ -482,7 +492,7 @@ def sharded_blocked_qr(
     A = jax.device_put(A, column_sharding(mesh, axis_name))
     H, alpha = _build_blocked(
         mesh, axis_name, n, nb, precision, layout, norm, pallas, interp,
-        panel_impl, PALLAS_FLAT_WIDTH,
+        panel_impl, PALLAS_FLAT_WIDTH, trailing_precision,
     )(A)
     if not _store_layout_output:
         H = _to_natural_layout(H, n, nproc, nb, layout)

@@ -296,6 +296,7 @@ def sharded_lstsq(
     norm: str = "accurate",
     use_pallas: str = "auto",
     panel_impl: str = "loop",
+    trailing_precision: "str | None" = None,
 ) -> jax.Array:
     """One-shot distributed least squares: factor + solve on the mesh.
 
@@ -323,6 +324,7 @@ def sharded_lstsq(
         A, mesh, block_size=nb, axis_name=axis_name, precision=precision,
         layout=layout, _store_layout_output=True, norm=norm,
         use_pallas=use_pallas, panel_impl=panel_impl,
+        trailing_precision=trailing_precision,
     )
     x = sharded_solve(
         H, alpha, b, mesh,

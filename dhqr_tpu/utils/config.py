@@ -61,6 +61,15 @@ class DHQRConfig:
         becomes compact-WY GEMMs above a small base width — see
         ops/householder._panel_qr_recursive). Ignored where the Pallas
         kernel takes the panel.
+      trailing_precision: MXU precision for the trailing-update GEMMs
+        ONLY (the blocked householder engines, single-device and
+        sharded); the panel factorization and compact-WY T-factor keep
+        ``precision``. None (the default) means no split. The trailing
+        update holds ~all the flops, so e.g. ``precision="highest",
+        trailing_precision="high"`` halves MXU passes (6 -> 3) on the
+        bulk work — measure the backward error for your sizes first
+        (the one hardware datum at 4096^2 f32 measured 2.7e-5, ABOVE
+        the 1e-5 target; see benchmarks/tpu_trailing_precision_probe.py).
       refine: iterative-refinement steps for ``lstsq`` (0 = off). Each
         step reuses the factorization: ``r = b - A x; x += solve(r)`` —
         one matvec plus one extra solve, a few percent of the
@@ -84,6 +93,7 @@ class DHQRConfig:
     norm: str = "accurate"
     panel_impl: str = "loop"
     refine: int = 0
+    trailing_precision: "str | None" = None
 
     @staticmethod
     def from_env(**overrides) -> "DHQRConfig":
@@ -111,5 +121,7 @@ class DHQRConfig:
             env["panel_impl"] = os.environ["DHQR_PANEL_IMPL"]
         if "DHQR_REFINE" in os.environ:
             env["refine"] = int(os.environ["DHQR_REFINE"])
+        if "DHQR_TRAILING_PRECISION" in os.environ:
+            env["trailing_precision"] = os.environ["DHQR_TRAILING_PRECISION"]
         env.update(overrides)
         return DHQRConfig(**env)

@@ -297,3 +297,41 @@ def test_sharded_realistic_panel_shape():
     from dhqr_tpu import _dryrun
 
     _dryrun.realistic(8)
+
+
+def test_sharded_trailing_precision_threads_through(mesh):
+    """cfg.trailing_precision reaches the sharded trailing GEMMs: with an
+    f64 problem on CPU every precision runs the same math, so the split
+    must be exactly equal; the point is the parameter plumbs end to end
+    (same contract as the single-device engine, blocked.py)."""
+    rng = np.random.default_rng(77)
+    n = 8 * mesh.shape["cols"]
+    A = jnp.asarray(rng.standard_normal((2 * n, n)))
+    H0, a0 = sharded_blocked_qr(A, mesh, block_size=4)
+    H1, a1 = sharded_blocked_qr(A, mesh, block_size=4,
+                                trailing_precision="high")
+    np.testing.assert_allclose(np.asarray(H1), np.asarray(H0), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(a0), rtol=1e-12)
+
+
+def test_lstsq_trailing_precision_surface(mesh):
+    """Public-config plumbing + rejections: the knob reaches lstsq on both
+    tiers and is refused where it cannot apply (unblocked, alt engines)."""
+    from dhqr_tpu.models.qr_model import lstsq as _lstsq
+    from dhqr_tpu.models.qr_model import qr as _qr
+
+    A, b = random_problem(64, 32, np.float64, seed=3)
+    Aj, bj = jnp.asarray(A), jnp.asarray(b)
+    ref = oracle_residual(A, b)
+    for kwargs in ({}, {"mesh": mesh}):
+        x = _lstsq(Aj, bj, trailing_precision="high", block_size=8, **kwargs)
+        assert normal_equations_residual(A, np.asarray(x), b) \
+            < TOLERANCE_FACTOR * ref
+    fact = _qr(Aj, trailing_precision="high", block_size=8)
+    assert fact.H.shape == (64, 32)
+    with pytest.raises(ValueError, match="trailing_precision applies"):
+        _lstsq(Aj, bj, blocked=False, trailing_precision="high")
+    with pytest.raises(ValueError, match="trailing_precision applies"):
+        _lstsq(Aj, bj, engine="cholqr2", trailing_precision="high")
+    with pytest.raises(ValueError, match="trailing_precision applies"):
+        _qr(Aj, blocked=False, trailing_precision="high")
