@@ -13,19 +13,21 @@
 #   split     - split-panel ladder      -> tpu_r4_split.jsonl
 #   trailing  - trailing-precision pairs -> tpu_r4_trailing.jsonl
 #   phase     - 16384^2 phase breakdown -> tpu_r4_phase16k.jsonl
+#   cembed    - c64 lstsq via real embedding -> tpu_r4_cembed.jsonl
 set -u
 cd "$(dirname "$0")/.."
 RES=benchmarks/results
 mkdir -p "$RES"
-STAGES=${*:-"alive bench split trailing phase"}
+STAGES=${*:-"alive bench split trailing phase cembed"}
 
 # Validate every stage name BEFORE running anything: a typo in a later
 # argument must not abort the session after earlier multi-hundred-second
 # stages already spent the hardware window.
 for s in $STAGES; do
   case "$s" in
-    alive|bench|split|trailing|phase) ;;
-    *) echo "unknown stage '$s' (valid: alive bench split trailing phase)" >&2
+    alive|bench|split|trailing|phase|cembed) ;;
+    *) echo "unknown stage '$s' (valid: alive bench split trailing phase" \
+            "cembed)" >&2
        exit 1 ;;
   esac
 done
@@ -55,6 +57,9 @@ for s in $STAGES; do
     phase)
       run phase "$RES/tpu_r4_phase16k.jsonl" \
         python benchmarks/tpu_phase16k_probe.py ;;
+    cembed)
+      run cembed "$RES/tpu_r4_cembed.jsonl" \
+        python benchmarks/tpu_cembed_probe.py ;;
     *) echo "unknown stage $s" >&2; exit 1 ;;
   esac
 done

@@ -1,0 +1,24 @@
+#!/bin/bash
+# Watch the relay; the moment it answers, run the round-4 hardware session.
+# ONE TPU process at a time: while this runs, nothing else may touch the TPU.
+#   bash benchmarks/tpu_watch_and_run.sh [max_wait_seconds]
+set -u
+cd "$(dirname "$0")/.."
+MAX_WAIT=${1:-21600}   # give up after 6 h by default
+SLEEP=900              # 15 min between probes
+start=$(date +%s)
+while :; do
+  if python benchmarks/tpu_alive_probe.py; then
+    echo "=== relay alive at $(date -u +%H:%M:%S); starting session" >&2
+    # Every stage except `alive` (this loop just proved the relay is up);
+    # keep this list in sync with the session script's default.
+    exec bash benchmarks/tpu_session_r4.sh bench split trailing phase cembed
+  fi
+  now=$(date +%s)
+  if [ $((now - start)) -ge "$MAX_WAIT" ]; then
+    echo "=== gave up after $((now - start)) s; relay still wedged" >&2
+    exit 2
+  fi
+  echo "=== relay still wedged at $(date -u +%H:%M:%S); sleeping $SLEEP s" >&2
+  sleep "$SLEEP"
+done

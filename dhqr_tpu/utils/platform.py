@@ -153,10 +153,13 @@ def ensure_complex_supported(dtype) -> None:
         "complex inputs are not supported by this TPU backend (the probe — "
         "a 256x256 complex64 matmul, executed and read back — failed "
         "UNIMPLEMENTED; the axon relay backend has no complex support at "
-        "MXU shapes, see benchmarks/results/tpu_r3_disambig.jsonl). Run "
-        "complex problems on CPU (jax.config.update('jax_platforms', "
-        "'cpu')). NOTE: the failed probe may have degraded this process's "
-        "remote compile helper — if later float compiles fail, restart "
-        "the process. Set DHQR_TPU_COMPLEX=1 to skip this check on "
-        "backends that do support complex."
+        "MXU shapes, see benchmarks/results/tpu_r3_disambig.jsonl). "
+        "complex64 LEAST-SQUARES still works here: dhqr_tpu.lstsq routes "
+        "it through the exactly-equivalent real embedded system "
+        "automatically (same f32 component precision). For factorizations "
+        "or complex128, run on CPU (jax.config.update('jax_platforms', "
+        "'cpu')). NOTE: a failed complex probe may have degraded this "
+        "process's remote compile helper — if later float compiles fail, "
+        "restart the process. Set DHQR_TPU_COMPLEX=1 to skip this check "
+        "on backends that do support complex."
     )
