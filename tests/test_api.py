@@ -486,6 +486,18 @@ def test_engine_cross_check_fuzz():
         )
 
 
+def _force_embedding(monkeypatch, warned=True):
+    """Simulate a complexless backend so lstsq takes the real-embedding
+    route — the one coupling point for every embedding test (the routing
+    predicate imports complex_supported_on_backend function-locally, so
+    patching the platform module is effective)."""
+    from dhqr_tpu.models import qr_model
+    from dhqr_tpu.utils import platform as plat
+
+    monkeypatch.setattr(plat, "complex_supported_on_backend", lambda: False)
+    monkeypatch.setattr(qr_model, "_EMBEDDING_WARNED", [True] if warned else [])
+
+
 def test_complex64_lstsq_real_embedding(monkeypatch):
     """On a complexless backend, c64 lstsq routes through the exactly-
     equivalent real embedded system instead of raising — same answer as
@@ -494,9 +506,6 @@ def test_complex64_lstsq_real_embedding(monkeypatch):
     complex capability on the axon relay)."""
     import warnings
 
-    from dhqr_tpu.models import qr_model
-    from dhqr_tpu.utils import platform as plat
-
     rng = np.random.default_rng(9)
     A = jnp.asarray((rng.random((48, 24)) - 0.5)
                     + 1j * (rng.random((48, 24)) - 0.5), jnp.complex64)
@@ -504,8 +513,7 @@ def test_complex64_lstsq_real_embedding(monkeypatch):
                     jnp.complex64)
     x_native = np.asarray(lstsq(A, b, block_size=8))
 
-    monkeypatch.setattr(plat, "complex_supported_on_backend", lambda: False)
-    monkeypatch.setattr(qr_model, "_EMBEDDING_WARNED", [])
+    _force_embedding(monkeypatch, warned=False)
     with warnings.catch_warnings(record=True) as caught:
         warnings.simplefilter("always")
         x_emb = np.asarray(lstsq(A, b, block_size=8))
@@ -531,3 +539,51 @@ def test_complex64_lstsq_real_embedding(monkeypatch):
     A128 = A.astype(jnp.complex128)
     with pytest.raises(ValueError, match="complex inputs are not"):
         lstsq(A128, b.astype(jnp.complex128), block_size=8)
+
+
+def test_complex64_embedding_mesh_path(monkeypatch):
+    """The embedding route composes with the mesh tier: the embedded real
+    system rides the sharded engines (divisibility handled by the internal
+    padding), and the recombined complex answer matches the native path."""
+    from dhqr_tpu.parallel.mesh import column_mesh
+
+    rng = np.random.default_rng(11)
+    A = jnp.asarray((rng.random((96, 48)) - 0.5)
+                    + 1j * (rng.random((96, 48)) - 0.5), jnp.complex64)
+    b = jnp.asarray((rng.random(96) - 0.5) + 1j * (rng.random(96) - 0.5),
+                    jnp.complex64)
+    x_native = np.asarray(lstsq(A, b, block_size=8))
+    _force_embedding(monkeypatch)
+    x_mesh = np.asarray(lstsq(A, b, mesh=column_mesh(8), block_size=8))
+    np.testing.assert_allclose(x_mesh, x_native, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.slow
+def test_embedding_cross_check_fuzz(monkeypatch):
+    """Seeded mini-fuzz of the real-embedding route: random c64 shapes x
+    engines, forced onto the embedding (complexless-backend simulation),
+    every answer checked against the NATIVE complex solve of the same
+    problem — the strongest oracle available, since both must agree to
+    f32 rounding."""
+    rng = np.random.default_rng(4242)
+    for trial in range(12):
+        n = int(rng.integers(6, 80))
+        m = n + int(rng.integers(0, 2 * n))
+        A = ((rng.random((m, n)) - 0.5)
+             + 1j * (rng.random((m, n)) - 0.5)).astype(np.complex64)
+        b = ((rng.random(m) - 0.5)
+             + 1j * (rng.random(m) - 0.5)).astype(np.complex64)
+        engine = ["householder", "householder", "cholqr2"][
+            int(rng.integers(0, 3))]
+        kwargs = {}
+        if engine == "householder":
+            kwargs["block_size"] = int(rng.choice([8, 16, 32]))
+            kwargs["refine"] = int(rng.integers(0, 2))
+        x_native = np.asarray(lstsq(jnp.asarray(A), jnp.asarray(b),
+                                    engine=engine, **kwargs))
+        with monkeypatch.context() as mp:
+            _force_embedding(mp)
+            x_emb = np.asarray(lstsq(A, b, engine=engine, **kwargs))
+        np.testing.assert_allclose(
+            x_emb, x_native, rtol=5e-3, atol=5e-3,
+            err_msg=f"trial {trial}: engine={engine} {m}x{n} {kwargs}")

@@ -49,3 +49,26 @@ def test_emit_tee_appends_and_warns_once(tmp_path, monkeypatch, capsys):
     bench._emit({"metric": "m4"})
     err = capsys.readouterr().err
     assert err.count("DHQR_BENCH_TEE append failed") == 1
+
+
+def test_best_recorded_tpu_excludes_inaccurate_splits(tmp_path, monkeypatch):
+    """A fast split-trailing-precision record whose backward error misses
+    the 1e-5 target must not become the best-recorded annotation."""
+    bench = _bench()
+    res = tmp_path / "benchmarks" / "results"
+    res.mkdir(parents=True)
+    rows = [
+        {"metric": "qr_gflops_per_chip_f32_4096x4096", "value": 99999.0,
+         "platform": "tpu", "chain_length": 25,
+         "trailing_precision": "high", "backward_error": 2.7e-5},
+        {"metric": "qr_gflops_per_chip_f32_4096x4096", "value": 80000.0,
+         "platform": "tpu", "chain_length": 25, "backward_error": 2.7e-5},
+        {"metric": "qr_gflops_per_chip_f32_4096x4096", "value": 50000.0,
+         "platform": "tpu", "chain_length": 25,
+         "backward_error_4096": 4.3e-7},
+    ]
+    (res / "fake.jsonl").write_text(
+        "\n".join(json.dumps(r) for r in rows) + "\n")
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    best = bench._best_recorded_tpu()
+    assert best["value"] == 50000.0  # accuracy-qualified record wins
