@@ -66,6 +66,13 @@ def main(argv=None) -> int:
         help="panel-interior algorithm for the blocked householder engines",
     )
     parser.add_argument(
+        "--trailing-precision", default=None,
+        choices=["default", "high", "highest"],
+        help="MXU precision for the trailing-update GEMMs only (blocked "
+        "householder engines; the panel/T-factor precision stays at the "
+        "DHQR_PRECISION env setting, default 'highest')",
+    )
+    parser.add_argument(
         "--profile-dir", default=None,
         help="write a jax.profiler trace here (the @profilehtml analogue)",
     )
@@ -123,6 +130,7 @@ def main(argv=None) -> int:
     overrides = {k: v for k, v in {
         "layout": args.layout, "engine": args.engine,
         "block_size": args.block_size, "panel_impl": args.panel_impl,
+        "trailing_precision": args.trailing_precision,
     }.items() if v is not None}
     cfg = DHQRConfig.from_env(**overrides)
     # block_size=None stays None: lstsq resolves it per backend/shape
@@ -140,6 +148,18 @@ def main(argv=None) -> int:
               f"applies to the householder engines only "
               f"(engine={cfg.engine}); using 'block'", file=sys.stderr)
         cfg = dataclasses.replace(cfg, layout="block")
+    if cfg.engine != "householder" and cfg.trailing_precision is not None:
+        # Same treatment as layout: explicit flag conflict errors, an
+        # ambient DHQR_TRAILING_PRECISION warns and is dropped — the sweep
+        # must not die in the first lstsq call's engine validation.
+        if args.trailing_precision is not None:
+            parser.error(f"--trailing-precision applies to the blocked "
+                         f"householder engines only (engine={cfg.engine})")
+        print(f"# warning: DHQR_TRAILING_PRECISION="
+              f"{cfg.trailing_precision} ignored — it applies to the "
+              f"blocked householder engines only (engine={cfg.engine})",
+              file=sys.stderr)
+        cfg = dataclasses.replace(cfg, trailing_precision=None)
     print(f"# devices: {len(jax.devices())} ({jax.default_backend()}), "
           f"mesh size: {ndev}, engine: {cfg.engine}"
           + ("" if row_engine else f", layout: {cfg.layout}"))
