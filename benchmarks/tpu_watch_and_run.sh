@@ -1,23 +1,47 @@
 #!/bin/bash
-# Watch the relay; the moment it answers, run the round-4 hardware session.
+# Watch the relay; the moment it answers, run the round-4 hardware session
+# sized to the time remaining before the driver's round-end bench window.
 # ONE TPU process at a time: while this runs, nothing else may touch the TPU.
-#   bash benchmarks/tpu_watch_and_run.sh [max_wait_seconds]
+#   bash benchmarks/tpu_watch_and_run.sh [deadline_HH:MM]
+#
+# The deadline (default 22:45 UTC) is when the TPU must be FREE again so
+# the driver's own round-end bench.py run cannot collide with a session
+# still in flight (a collision can wedge the relay for both). Stage tiers
+# by time remaining at recovery, headline first:
+#   >= 120 min : bench split trailing phase cembed   (everything)
+#   >=  60 min : bench split cembed
+#   >=  25 min : bench
+#   <   25 min : give up (leave the window to the driver)
 set -u
 cd "$(dirname "$0")/.."
-MAX_WAIT=${1:-21600}   # give up after 6 h by default
+# UTC explicitly (the driver's window is UTC; a non-UTC host must not
+# shift the tiering), with day rollover: a deadline time-of-day already
+# past means tomorrow's.
+DEADLINE=$(date -u -d "${1:-22:45}" +%s) || exit 1
+now0=$(date +%s)
+if [ "$DEADLINE" -le "$now0" ]; then
+  DEADLINE=$(( DEADLINE + 86400 ))
+fi
 SLEEP=900              # 15 min between probes
-start=$(date +%s)
 while :; do
-  if python benchmarks/tpu_alive_probe.py; then
-    echo "=== relay alive at $(date -u +%H:%M:%S); starting session" >&2
-    # Every stage except `alive` (this loop just proved the relay is up);
-    # keep this list in sync with the session script's default.
-    exec bash benchmarks/tpu_session_r4.sh bench split trailing phase cembed
-  fi
   now=$(date +%s)
-  if [ $((now - start)) -ge "$MAX_WAIT" ]; then
-    echo "=== gave up after $((now - start)) s; relay still wedged" >&2
+  rem=$(( DEADLINE - now ))
+  if [ "$rem" -lt 1500 ]; then
+    echo "=== $(date -u +%H:%M:%S): <25 min to deadline; giving up" >&2
     exit 2
+  fi
+  if python benchmarks/tpu_alive_probe.py; then
+    now=$(date +%s); rem=$(( DEADLINE - now ))
+    if   [ "$rem" -ge 7200 ]; then stages="bench split trailing phase cembed"
+    elif [ "$rem" -ge 3600 ]; then stages="bench split cembed"
+    elif [ "$rem" -ge 1500 ]; then stages="bench"
+    else
+      echo "=== relay recovered with only $rem s left; leaving the window" >&2
+      exit 2
+    fi
+    echo "=== relay alive at $(date -u +%H:%M:%S), $rem s to deadline;" \
+         "running: $stages" >&2
+    exec bash benchmarks/tpu_session_r4.sh $stages
   fi
   echo "=== relay still wedged at $(date -u +%H:%M:%S); sleeping $SLEEP s" >&2
   sleep "$SLEEP"

@@ -148,16 +148,21 @@ def main(argv=None) -> int:
               f"applies to the householder engines only "
               f"(engine={cfg.engine}); using 'block'", file=sys.stderr)
         cfg = dataclasses.replace(cfg, layout="block")
-    if cfg.engine != "householder" and cfg.trailing_precision is not None:
+    if cfg.trailing_precision is not None and (
+            cfg.engine != "householder" or not cfg.blocked):
         # Same treatment as layout: explicit flag conflict errors, an
         # ambient DHQR_TRAILING_PRECISION warns and is dropped — the sweep
-        # must not die in the first lstsq call's engine validation.
+        # must not die in the first lstsq call's validation. The knob
+        # needs the BLOCKED householder engines, so an env-sourced
+        # DHQR_BLOCKED=false conflicts exactly like a row engine does.
+        why = (f"engine={cfg.engine}" if cfg.engine != "householder"
+               else "blocked=False")
         if args.trailing_precision is not None:
             parser.error(f"--trailing-precision applies to the blocked "
-                         f"householder engines only (engine={cfg.engine})")
+                         f"householder engines only ({why})")
         print(f"# warning: DHQR_TRAILING_PRECISION="
               f"{cfg.trailing_precision} ignored — it applies to the "
-              f"blocked householder engines only (engine={cfg.engine})",
+              f"blocked householder engines only ({why})",
               file=sys.stderr)
         cfg = dataclasses.replace(cfg, trailing_precision=None)
     print(f"# devices: {len(jax.devices())} ({jax.default_backend()}), "

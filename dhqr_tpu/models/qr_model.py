@@ -535,6 +535,11 @@ def _lstsq_via_real_embedding(A, b, cfg: DHQRConfig, mesh):
     benchmarks/results/tpu_r3_disambig.jsonl) — including the fused
     Pallas panel kernel, which sees only f32. Cost: the embedded QR does
     2x the real flops of a native complex QR (16 vs 8 mn^2).
+
+    Differentiation caveat: the concrete-input path round-trips through
+    the host (deliberately — see below), so it is not differentiable;
+    ``jax.grad`` through a complex lstsq requires a complex-capable
+    backend (where the native differentiable core runs instead).
     """
     import warnings
 
