@@ -1,0 +1,149 @@
+"""Summarize the round-4 hardware artifacts into one decision table.
+
+Reads benchmarks/results/tpu_r4_*.jsonl + bench_tpu_tee.jsonl (whatever
+exists), prints:
+
+* the headline candidates (size, nb, flat, TF/s) sorted by rate,
+  accuracy-qualified rows only (backward error <= 1e-5 where reported);
+* the split-panel verdict per size (flat 512 vs 256 vs 128; nb 512 vs
+  1024) with the winner and margin;
+* the trailing-precision pairs (rate delta + backward error vs target);
+* the phase breakdown row and the c64-embedding rows, verbatim.
+
+Pure reporting — makes the post-session default-flipping decisions
+(PALLAS_FLAT_WIDTH, auto_block_size) reviewable at a glance.
+
+Usage: python benchmarks/analyze_r4.py
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+
+_RES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results")
+
+
+def _rows():
+    for path in sorted(glob.glob(os.path.join(_RES, "tpu_r4_*.jsonl"))) + \
+            [os.path.join(_RES, "bench_tpu_tee.jsonl")]:
+        if not os.path.exists(path):
+            continue
+        with open(path) as f:
+            for line in f:
+                try:
+                    r = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(r, dict):
+                    r["_artifact"] = os.path.basename(path)
+                    yield r
+
+
+def _errors(r) -> dict:
+    """Measured backward errors only (never the _target constant)."""
+    return {k: v for k, v in r.items()
+            if k.startswith("backward_error") and not k.endswith("_target")
+            and isinstance(v, (int, float))}
+
+
+def _accurate(r) -> bool:
+    return all(v <= 1e-5 for v in _errors(r).values())
+
+
+def _qualified(r) -> bool:
+    return _accurate(r) and r.get("trailing_precision") in (None, "highest")
+
+
+def main() -> None:
+    rows = list(_rows())
+    if not rows:
+        print("no tpu_r4 artifacts yet")
+        return
+
+    qr = [r for r in rows
+          if str(r.get("metric", "")).startswith("qr_gflops_per_chip_f32")
+          and isinstance(r.get("value"), (int, float))
+          and r.get("platform") == "tpu"
+          and not r.get("chain_unreliable")]
+
+    print("== headline candidates (accuracy-qualified, best first) ==")
+    qualified = [r for r in qr if _qualified(r)]
+    for r in sorted(qualified, key=lambda r: -r["value"])[:10]:
+        size = re.search(r"(\d+)x\d+$", r["metric"]).group(1)
+        print(f"  {size:>6}  nb={r.get('block_size', '?'):>4} "
+              f"flat={r.get('pallas_flat', '-'):>4} "
+              f"{r['value']:>9.1f} GF/s   [{r['_artifact']}]")
+
+    print("\n== split/width ladder by size ==")
+    by_size: dict = {}
+    for r in qr:
+        if r.get("trailing_precision") not in (None, "highest"):
+            continue  # tp-split rows are precision experiments, not
+            # width candidates — they must not shadow the matched-
+            # precision baseline sharing their (nb, flat) key
+        size = int(re.search(r"(\d+)x\d+$", r["metric"]).group(1))
+        key = (r.get("block_size"), r.get("pallas_flat"))
+        cur = by_size.setdefault(size, {})
+        if key not in cur or r["value"] > cur[key]["value"]:
+            cur[key] = r
+    for size in sorted(by_size):
+        variants = by_size[size]
+        # "best" must itself be a defensible default: accuracy-qualified
+        # rows only (a fast disqualified config must not drive a
+        # PALLAS_FLAT_WIDTH / auto_block_size flip).
+        pool = [r for r in variants.values() if _qualified(r)] \
+            or list(variants.values())
+        best = max(pool, key=lambda r: r["value"])
+        print(f"  {size}:")
+        for (nb, flat), r in sorted(variants.items(),
+                                    key=lambda kv: -kv[1]["value"]):
+            mark = " <== best" if r is best else ""
+            if not _qualified(r):
+                mark = " (disqualified: accuracy)"
+            tp = r.get("trailing_precision")
+            tp_s = f" tp={tp}" if tp not in (None, "highest") else ""
+            print(f"    nb={nb} flat={flat or '-'}{tp_s}: "
+                  f"{r['value']:.1f} GF/s{mark}")
+
+    print("\n== trailing-precision pairs (baseline vs split, per size) ==")
+    tp_rows = [r for r in rows if r.get("trailing_precision")]
+    by_pair: dict = {}
+    for r in tp_rows:
+        m = re.search(r"(\d+)x\d+$", str(r.get("metric", "")))
+        if m:
+            by_pair.setdefault(int(m.group(1)), []).append(r)
+    for size in sorted(by_pair):
+        base = [r for r in by_pair[size]
+                if r["trailing_precision"] == "highest"]
+        for r in by_pair[size]:
+            if r["trailing_precision"] == "highest":
+                continue
+            delta = ""
+            if base and isinstance(r.get("value"), (int, float)):
+                b = max(x["value"] for x in base
+                        if isinstance(x.get("value"), (int, float)))
+                delta = f", delta={100 * (r['value'] / b - 1):+.1f}%"
+            print(f"  {size}: tp={r['trailing_precision']} "
+                  f"{r.get('value')} GF/s{delta}, errors={_errors(r)}, "
+                  f"target=1e-5, qualified={_accurate(r)}")
+
+    print("\n== phase breakdown / embedding rows ==")
+    for r in rows:
+        m = str(r.get("metric", ""))
+        if m.startswith("phase_breakdown") or m.startswith("c64_embed"):
+            r2 = {k: v for k, v in r.items() if not k.startswith("_")}
+            print(f"  {json.dumps(r2)}")
+
+    failures = [r for r in rows if r.get("ok") is False]
+    if failures:
+        print("\n== failed stages ==")
+        for r in failures:
+            print(f"  {r.get('metric')}: {r.get('error')} "
+                  f"[{r['_artifact']}]")
+
+
+if __name__ == "__main__":
+    main()

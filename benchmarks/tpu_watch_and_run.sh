@@ -16,11 +16,18 @@ set -u
 cd "$(dirname "$0")/.."
 # UTC explicitly (the driver's window is UTC; a non-UTC host must not
 # shift the tiering), with day rollover: a deadline time-of-day already
-# past means tomorrow's.
-DEADLINE=$(date -u -d "${1:-22:45}" +%s) || exit 1
+# past means tomorrow's. A bare NUMBER keeps the script's original
+# max-wait-seconds semantics (deadline = now + N) so detached relaunches
+# with the old usage still work.
 now0=$(date +%s)
-if [ "$DEADLINE" -le "$now0" ]; then
-  DEADLINE=$(( DEADLINE + 86400 ))
+arg="${1:-22:45}"
+if [[ "$arg" =~ ^[0-9]+$ ]]; then
+  DEADLINE=$(( now0 + arg ))
+else
+  DEADLINE=$(date -u -d "$arg" +%s) || exit 1
+  if [ "$DEADLINE" -le "$now0" ]; then
+    DEADLINE=$(( DEADLINE + 86400 ))
+  fi
 fi
 SLEEP=900              # 15 min between probes
 while :; do
