@@ -14,6 +14,37 @@ import os
 _REPO = os.path.dirname(os.path.abspath(__file__))
 
 
+def default_to_virtual_cpu(n_devices: int = 8,
+                           optin_env: str = "DHQR_BENCH_TPU") -> bool:
+    """Default THIS process to an n-device virtual CPU platform, unless
+    the operator explicitly opted into hardware.
+
+    Opt-in = ``optin_env=1`` or a JAX_PLATFORMS value naming ``tpu``
+    (harness semantics — an EXPLICIT tpu request is honored; the ambient
+    axon pin is ``JAX_PLATFORMS=axon`` and does not match). Without
+    opt-in, sets JAX_PLATFORMS=cpu and the virtual device count so a
+    wedged relay can never hang the script at first backend touch. Call
+    BEFORE importing jax; afterwards the caller's
+    ``cpu_requested()/force_cpu_platform()`` pair makes the choice stick
+    against sitecustomize pins. Returns True when the virtual mesh was
+    forced (callers use this to keep single-host problem-size defaults).
+
+    One definition for every benchmark entry point (run.py, scaling.py,
+    the ladder sweep); ``dhqr_tpu/harness.py`` keeps its own variant
+    because its device count is a CLI positional.
+    """
+    if os.environ.get(optin_env) == "1" or \
+            "tpu" in os.environ.get("JAX_PLATFORMS", "").lower():
+        return False
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    return True
+
+
 def scrubbed_cpu_env(n_devices: int | None = None, **extra: str) -> dict:
     """Env for a child pinned to the CPU platform, axon hook removed.
 

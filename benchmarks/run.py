@@ -70,6 +70,13 @@ def main(argv=None) -> None:
 
     sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+    # Hardware needs explicit opt-in (DHQR_BENCH_TPU=1 or JAX_PLATFORMS
+    # naming tpu): ambient axon + a wedged relay would hang the first
+    # backend touch (round-4 hardening; shared recipe in _axon_env).
+    from _axon_env import default_to_virtual_cpu
+
+    forced_virtual = default_to_virtual_cpu(8)
+
     import jax
 
     from dhqr_tpu.utils.platform import (
@@ -100,8 +107,12 @@ def main(argv=None) -> None:
     ndev = len(jax.devices())
     if platform == "cpu":
         jax.config.update("jax_enable_x64", True)
-    # default scale: nominal sizes target pods; a single chip gets /4
-    scale = args.scale if args.scale is not None else (1 if ndev >= 8 else 4)
+    # default scale: nominal sizes target pods; a single chip gets /4 —
+    # and so does a FORCED virtual mesh (8 host-thread "devices" are not
+    # a pod; without this, a bare CPU invocation would attempt the
+    # nominal 16384^2-class problems at scale=1).
+    scale = args.scale if args.scale is not None else (
+        1 if ndev >= 8 and not forced_virtual else 4)
     nb = args.block_size
     rng = np.random.default_rng(0)
 

@@ -51,6 +51,15 @@ def main(argv=None) -> None:
                         "the curve's Amdahl term — see module docstring)")
     args = parser.parse_args(argv)
 
+    # Hardware needs explicit opt-in (DHQR_BENCH_TPU=1 or JAX_PLATFORMS
+    # naming tpu): ambient axon + a wedged relay would hang the first
+    # backend touch (round-4 hardening; shared recipe in _axon_env).
+    # Parse --devices ONCE here; the sweep below reuses this list.
+    counts = [int(tok) for tok in args.devices.split(",")]
+    from _axon_env import default_to_virtual_cpu
+
+    default_to_virtual_cpu(max(counts))
+
     import jax
 
     from dhqr_tpu.utils.platform import (
@@ -73,7 +82,6 @@ def main(argv=None) -> None:
 
     m = args.m or args.n
     n, nb = args.n, args.nb
-    counts = [int(t) for t in args.devices.split(",")]
     ndev = len(jax.devices())
     rng = np.random.default_rng(0)
     A = jnp.asarray(rng.random((m, n)), dtype=jnp.float32)

@@ -125,21 +125,13 @@ def main(argv=None) -> int:
             "sweep_reference_ladder.json" if args.full
             else "sweep_4400x4000.json")
 
-    # TPU requires explicit opt-in (DHQR_SWEEP_TPU=1, mirroring the harness's
-    # DHQR_HARNESS_TPU): the axon hosts pin JAX_PLATFORMS=axon ambiently, so
-    # a setdefault never fires there and the sweep would silently hang on a
-    # wedged relay (measured, round 4) instead of running the virtual mesh.
-    if os.environ.get("DHQR_SWEEP_TPU") != "1":
-        if "tpu" in os.environ.get("JAX_PLATFORMS", "").lower():
-            print("# notice: JAX_PLATFORMS requested TPU but the sweep "
-                  "defaults to the virtual CPU mesh — set DHQR_SWEEP_TPU=1 "
-                  "to run on hardware", file=sys.stderr)
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + f" --xla_force_host_platform_device_count={args.devices}"
-            ).strip()
-        os.environ["JAX_PLATFORMS"] = "cpu"
+    # Hardware needs explicit opt-in (DHQR_SWEEP_TPU=1 or JAX_PLATFORMS
+    # naming tpu); ambient axon + a wedged relay would hang the first
+    # backend touch (shared recipe in _axon_env, round-4 hardening).
+    sys.path.insert(0, _REPO)
+    from _axon_env import default_to_virtual_cpu
+
+    default_to_virtual_cpu(args.devices, optin_env="DHQR_SWEEP_TPU")
 
     artifact = run_sweep(
         args.devices,
