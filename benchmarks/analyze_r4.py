@@ -27,7 +27,10 @@ _RES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results")
 
 
 def _rows():
-    for path in sorted(glob.glob(os.path.join(_RES, "tpu_r4_*.jsonl"))) + \
+    # Same round tag as tpu_session_r4.sh: DHQR_ROUND=5 analyzes the
+    # round-5 artifacts that session would have written.
+    tag = f"r{os.environ.get('DHQR_ROUND', '4')}"
+    for path in sorted(glob.glob(os.path.join(_RES, f"tpu_{tag}_*.jsonl"))) + \
             [os.path.join(_RES, "bench_tpu_tee.jsonl")]:
         if not os.path.exists(path):
             continue
@@ -60,7 +63,7 @@ def _qualified(r) -> bool:
 def main() -> None:
     rows = list(_rows())
     if not rows:
-        print("no tpu_r4 artifacts yet")
+        print(f"no tpu_r{os.environ.get('DHQR_ROUND', '4')} artifacts yet")
         return
 
     qr = [r for r in rows

@@ -7,16 +7,19 @@
 #
 # Stages (default: all, in this order — the order IS the protocol:
 # headline before risky probes, VERDICT r3 #1):
+# Artifact names carry the round tag R = r${DHQR_ROUND:-4} (the analyzer
+# honors the same variable):
 #   alive     - relay health check (exits nonzero if wedged; later stages skip)
-#   bench     - full bench.py supervised run (headline into bench_r4_run.json
+#   bench     - full bench.py supervised run (headline into bench_${R}_run.json
 #               + per-stage tee into bench_tpu_tee.jsonl)
-#   split     - split-panel ladder      -> tpu_r4_split.jsonl
-#   trailing  - trailing-precision pairs -> tpu_r4_trailing.jsonl
-#   phase     - 16384^2 phase breakdown -> tpu_r4_phase16k.jsonl
-#   cembed    - c64 lstsq via real embedding -> tpu_r4_cembed.jsonl
+#   split     - split-panel ladder      -> tpu_${R}_split.jsonl
+#   trailing  - trailing-precision pairs -> tpu_${R}_trailing.jsonl
+#   phase     - 16384^2 phase breakdown -> tpu_${R}_phase16k.jsonl
+#   cembed    - c64 lstsq via real embedding -> tpu_${R}_cembed.jsonl
 set -u
 cd "$(dirname "$0")/.."
 RES=benchmarks/results
+R="r${DHQR_ROUND:-4}"   # artifact round tag: DHQR_ROUND=5 reuses this session in round 5
 mkdir -p "$RES"
 STAGES=${*:-"alive bench split trailing phase cembed"}
 
@@ -44,21 +47,21 @@ run() { # name, logfile, cmd...
 for s in $STAGES; do
   case "$s" in
     alive)
-      run alive "$RES/tpu_r4_alive.log" \
+      run alive "$RES/tpu_${R}_alive.log" \
         python benchmarks/tpu_alive_probe.py || exit 2 ;;
     bench)
-      run bench "$RES/bench_r4_run.json" python bench.py ;;
+      run bench "$RES/bench_${R}_run.json" python bench.py ;;
     split)
-      run split "$RES/tpu_r4_split.jsonl" \
+      run split "$RES/tpu_${R}_split.jsonl" \
         python benchmarks/tpu_split_probe.py ;;
     trailing)
-      run trailing "$RES/tpu_r4_trailing.jsonl" \
+      run trailing "$RES/tpu_${R}_trailing.jsonl" \
         python benchmarks/tpu_trailing_precision_probe.py ;;
     phase)
-      run phase "$RES/tpu_r4_phase16k.jsonl" \
+      run phase "$RES/tpu_${R}_phase16k.jsonl" \
         python benchmarks/tpu_phase16k_probe.py ;;
     cembed)
-      run cembed "$RES/tpu_r4_cembed.jsonl" \
+      run cembed "$RES/tpu_${R}_cembed.jsonl" \
         python benchmarks/tpu_cembed_probe.py ;;
     *) echo "unknown stage $s" >&2; exit 1 ;;
   esac
