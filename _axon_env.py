@@ -33,8 +33,15 @@ def default_to_virtual_cpu(n_devices: int = 8,
     the ladder sweep); ``dhqr_tpu/harness.py`` keeps its own variant
     because its device count is a CLI positional.
     """
-    if os.environ.get(optin_env) == "1" or \
-            "tpu" in os.environ.get("JAX_PLATFORMS", "").lower():
+    plat = os.environ.get("JAX_PLATFORMS", "").lower()
+    if os.environ.get(optin_env) == "1" or "tpu" in plat:
+        return False
+    if plat and plat != "cpu" and "axon" not in plat:
+        # An EXPLICIT non-axon platform choice (e.g. JAX_PLATFORMS=cuda)
+        # is the operator's, not the ambient pin's — honor it untouched.
+        # Only the unset/cpu/axon-pin cases fall through to the virtual
+        # CPU default (ADVICE r4: a setdefault-style overwrite here was
+        # silently stomping explicit choices).
         return False
     flags = os.environ.get("XLA_FLAGS", "")
     if "host_platform_device_count" not in flags:

@@ -90,10 +90,56 @@ BASELINE_GFLOPS = 4800.0  # 60% of A100 cuSOLVER geqrf f32 (~8 TF/s), see above
 TPU_TIMEOUT = int(os.environ.get("DHQR_BENCH_TPU_TIMEOUT", "470"))
 CPU_TIMEOUT = int(os.environ.get("DHQR_BENCH_CPU_TIMEOUT", "90"))
 _REPO = os.path.dirname(os.path.abspath(__file__))
+# Every emitted row carries the round it was measured in, so the
+# append-only tee artifact can be filtered per round (ADVICE r4: stale
+# earlier-round tee rows were able to win a later round's decision
+# table). The default tracks the current build round and is shared with
+# tpu_session_r4.sh / analyze_r4.py (all three default to 5; the watcher
+# exports DHQR_ROUND explicitly either way).
+
+
+def _parse_round(value, default: int = 5) -> int:
+    """Lenient DHQR_ROUND parse: '5', 'r5' and 'R5' all mean 5.
+
+    The artifact tags are written as 'r5', so operators naturally type
+    that; a ValueError at module import would kill the supervised bench
+    before any JSON line is emitted."""
+    try:
+        return int(str(value).lstrip("rR"))
+    except (TypeError, ValueError):
+        return default
+
+
+ROUND = _parse_round(os.environ.get("DHQR_ROUND", "5"))
 
 
 def _stage(name: str) -> None:
     print(f"::stage {name} t={time.time():.1f}", file=sys.stderr, flush=True)
+
+
+# Per-chip MXU peak (TFLOP/s, dense bf16 — the vendor-published number; no
+# official f32 peak exists for these parts) by PJRT device_kind. The bench
+# runs f32 at precision=highest (multi-pass MXU emulation), so ``mfu``
+# computed against the bf16 peak UNDERSTATES hardware utilization by the
+# pass count — it is the conservative, judgeable convention (VERDICT r4
+# #9: make "matching-or-beating" assessable without the A100 proxy).
+_MXU_PEAK_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,   # v5e — the axon relay chip (round-3 memory)
+    "TPU v5": 459.0,        # v5p
+    "TPU v5p": 459.0,
+    "TPU v6 lite": 918.0,   # v6e
+}
+
+
+def _mfu_fields(gflops: float, device_kind: str) -> dict:
+    """{"mfu": ..., "mfu_peak_tflops": ...} when the chip's peak is known,
+    {} otherwise (CPU fallback rows carry no MFU — not hardware evidence)."""
+    peak = _MXU_PEAK_TFLOPS.get(device_kind)
+    if not peak:
+        return {}
+    return {"mfu": round(gflops / 1e3 / peak, 4), "mfu_peak_tflops": peak,
+            "mfu_convention": "useful f32 FLOPs / dense bf16 MXU peak"}
 
 
 def _emit(record: dict) -> None:
@@ -104,6 +150,7 @@ def _emit(record: dict) -> None:
     timeout) cannot erase measurements that already finished (the round-3
     failure mode: measured numbers stranded in a dead child's pipe).
     """
+    record.setdefault("round", ROUND)
     line = json.dumps(record)
     print(line, flush=True)
     tee = os.environ.get("DHQR_BENCH_TEE")
@@ -344,6 +391,7 @@ def main() -> None:
     _stage("backend_init")
     with _Watchdog("backend_init", 150):
         platform = jax.devices()[0].platform
+        device_kind = jax.devices()[0].device_kind
         sync(jnp.ones((8, 8)) @ jnp.ones((8, 8)))  # force full backend bring-up
     _stage(f"backend_ready_{platform}")
 
@@ -434,12 +482,15 @@ def main() -> None:
                 else:
                     chain_unreliable = True
             flops = (4.0 / 3.0) * n_**3
+            gflops = flops / t / 1e9
             result = {
                 "metric": f"qr_gflops_per_chip_f32_{n_}x{n_}",
-                "value": round(flops / t / 1e9, 2),
+                "value": round(gflops, 2),
                 "unit": "GFLOP/s",
-                "vs_baseline": round(flops / t / 1e9 / BASELINE_GFLOPS, 4),
+                "vs_baseline": round(gflops / BASELINE_GFLOPS, 4),
                 "platform": platform,
+                "device_kind": device_kind,
+                **_mfu_fields(gflops, device_kind),
                 "seconds": round(t, 4),
                 "seconds_single_dispatch": round(t_single, 4),
                 "compile_seconds": round(compile_s, 2),

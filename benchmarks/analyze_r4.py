@@ -26,23 +26,57 @@ import re
 _RES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results")
 
 
+def _round() -> int:
+    # Same round tag as tpu_session_r4.sh / bench.py (all default to 5):
+    # DHQR_ROUND=4 analyzes the round-4 artifacts that session would have
+    # written. Lenient parse: 'r5' (the artifact-tag spelling) and '5'
+    # both work.
+    try:
+        return int(str(os.environ.get("DHQR_ROUND", "5")).lstrip("rR"))
+    except ValueError:
+        return 5
+
+
 def _rows():
-    # Same round tag as tpu_session_r4.sh: DHQR_ROUND=5 analyzes the
-    # round-5 artifacts that session would have written.
-    tag = f"r{os.environ.get('DHQR_ROUND', '4')}"
+    rnd = _round()
+    tag = f"r{rnd}"
+    seen: set = set()
     for path in sorted(glob.glob(os.path.join(_RES, f"tpu_{tag}_*.jsonl"))) + \
-            [os.path.join(_RES, "bench_tpu_tee.jsonl")]:
+            [os.path.join(_RES, f"bench_{tag}_run.jsonl"),
+             os.path.join(_RES, "bench_tpu_tee.jsonl")]:
         if not os.path.exists(path):
             continue
+        tee = os.path.basename(path) == "bench_tpu_tee.jsonl"
         with open(path) as f:
             for line in f:
                 try:
                     r = json.loads(line)
                 except ValueError:
                     continue
-                if isinstance(r, dict):
-                    r["_artifact"] = os.path.basename(path)
-                    yield r
+                if not isinstance(r, dict):
+                    continue
+                # The tee artifact is append-only ACROSS rounds: keep only
+                # rows stamped with the analyzed round (bench.py stamps
+                # "round" since round 5). Unstamped tee rows predate the
+                # stamp — they belong to rounds <= 4, so they are admitted
+                # whenever a pre-stamp round is being analyzed (their
+                # per-round origin is unrecoverable) and excluded from
+                # round-5+ tables (ADVICE r4: a stale fast tee row must
+                # not win a later round's decision table).
+                if tee:
+                    row_round = r.get("round", rnd if rnd <= 4 else None)
+                    if row_round != rnd:
+                        continue
+                # One measurement can land in several artifacts (the
+                # supervisor re-prints the child's teed headline into the
+                # session's bench_${R}_run.jsonl) — dedup on content so a
+                # duplicate cannot crowd the top-10 candidate table.
+                key = json.dumps(r, sort_keys=True)
+                if key in seen:
+                    continue
+                seen.add(key)
+                r["_artifact"] = os.path.basename(path)
+                yield r
 
 
 def _errors(r) -> dict:
@@ -63,7 +97,7 @@ def _qualified(r) -> bool:
 def main() -> None:
     rows = list(_rows())
     if not rows:
-        print(f"no tpu_r{os.environ.get('DHQR_ROUND', '4')} artifacts yet")
+        print(f"no tpu_r{_round()} artifacts yet")
         return
 
     qr = [r for r in rows
@@ -76,8 +110,10 @@ def main() -> None:
     qualified = [r for r in qr if _qualified(r)]
     for r in sorted(qualified, key=lambda r: -r["value"])[:10]:
         size = re.search(r"(\d+)x\d+$", r["metric"]).group(1)
-        print(f"  {size:>6}  nb={r.get('block_size', '?'):>4} "
-              f"flat={r.get('pallas_flat', '-'):>4} "
+        # `or`-normalized: an explicit null in the row reaches .get() as
+        # None, which would TypeError under the width format (ADVICE r4).
+        print(f"  {size:>6}  nb={r.get('block_size') or '?':>4} "
+              f"flat={r.get('pallas_flat') or '-':>4} "
               f"{r['value']:>9.1f} GF/s   [{r['_artifact']}]")
 
     print("\n== split/width ladder by size ==")

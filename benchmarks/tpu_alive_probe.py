@@ -7,6 +7,13 @@ Two stages, each with its own watchdog and a ``::stage`` marker:
    involves NO remote compile, so a watchdog hard-exit here cannot
    re-wedge the relay (the 5-hour wedge of round 3 was caused by a hard
    exit DURING a remote compile — see the session notes / memory).
+
+CAVEAT (measured round 5): when the main thread blocks inside the PJRT
+C++ init *without releasing the GIL*, the watchdog thread stalls on its
+own ``print`` and never reaches ``os._exit`` — the probe then hangs
+past every internal deadline. Callers MUST wrap the probe in an outer
+kernel-level kill (``timeout -k 30 900 python benchmarks/tpu_alive_probe.py``);
+``tpu_watch_and_run.sh`` does.
 2. ``tiny_matmul`` — one 128x128 f32 matmul, 600 s watchdog (long enough
    that the hard exit only fires on a true hang, never a slow compile).
 
@@ -20,7 +27,6 @@ from __future__ import annotations
 
 import json
 import os
-import signal
 import sys
 import threading
 import time
@@ -52,7 +58,12 @@ class _Watchdog:
 
 
 def main() -> int:
-    signal.signal(signal.SIGTERM, lambda *_: sys.exit(3))
+    # NO custom SIGTERM handler: a Python-level handler only runs between
+    # bytecodes, so a probe blocked inside the PJRT C++ init (the round-4/5
+    # wedge signature) would shrug off SIGTERM entirely — round 5 measured
+    # exactly that (handler installed -> `timeout` couldn't kill it; default
+    # disposition -> rc=143 immediately). The kernel-level default is the
+    # only exit path that always works, and the probe has no cleanup needs.
     t_start = time.time()
     _stage("import_jax")
     import jax

@@ -7,10 +7,10 @@
 #
 # Stages (default: all, in this order — the order IS the protocol:
 # headline before risky probes, VERDICT r3 #1):
-# Artifact names carry the round tag R = r${DHQR_ROUND:-4} (the analyzer
-# honors the same variable):
+# Artifact names carry the round tag R = r${DHQR_ROUND:-5} (bench.py and
+# the analyzer honor the same variable, same default):
 #   alive     - relay health check (exits nonzero if wedged; later stages skip)
-#   bench     - full bench.py supervised run (headline into bench_${R}_run.json
+#   bench     - full bench.py supervised run (headline into bench_${R}_run.jsonl
 #               + per-stage tee into bench_tpu_tee.jsonl)
 #   split     - split-panel ladder      -> tpu_${R}_split.jsonl
 #   trailing  - trailing-precision pairs -> tpu_${R}_trailing.jsonl
@@ -19,7 +19,7 @@
 set -u
 cd "$(dirname "$0")/.."
 RES=benchmarks/results
-R="r${DHQR_ROUND:-4}"   # artifact round tag: DHQR_ROUND=5 reuses this session in round 5
+R="r${DHQR_ROUND:-5}"   # artifact round tag; default matches bench.py/analyze_r4.py
 mkdir -p "$RES"
 STAGES=${*:-"alive bench split trailing phase cembed"}
 
@@ -47,10 +47,16 @@ run() { # name, logfile, cmd...
 for s in $STAGES; do
   case "$s" in
     alive)
+      # Outer kernel-level kill: the probe's internal watchdogs can be
+      # GIL-starved when PJRT init blocks in C++ (see the probe's CAVEAT)
+      # — without this, a wedged relay hangs the whole session here.
       run alive "$RES/tpu_${R}_alive.log" \
-        python benchmarks/tpu_alive_probe.py || exit 2 ;;
+        timeout -k 30 900 python benchmarks/tpu_alive_probe.py || exit 2 ;;
     bench)
-      run bench "$RES/bench_${R}_run.json" python bench.py ;;
+      # .jsonl, not .json: the stage tees bench.py's multi-line stdout and
+      # re-runs APPEND — the artifact is a line stream, never one JSON
+      # document (ADVICE r4).
+      run bench "$RES/bench_${R}_run.jsonl" python bench.py ;;
     split)
       run split "$RES/tpu_${R}_split.jsonl" \
         python benchmarks/tpu_split_probe.py ;;

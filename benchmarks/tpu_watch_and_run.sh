@@ -14,6 +14,9 @@
 #   <   25 min : give up (leave the window to the driver)
 set -u
 cd "$(dirname "$0")/.."
+# One round tag for the whole chain (watcher -> session -> bench.py ->
+# analyze_r4.py): export so every child stamps/filters the same round.
+export DHQR_ROUND="${DHQR_ROUND:-5}"
 # UTC explicitly (the driver's window is UTC; a non-UTC host must not
 # shift the tiering), with day rollover: a deadline time-of-day already
 # past means tomorrow's. A bare NUMBER keeps the script's original
@@ -37,7 +40,14 @@ while :; do
     echo "=== $(date -u +%H:%M:%S): <25 min to deadline; giving up" >&2
     exit 2
   fi
-  if python benchmarks/tpu_alive_probe.py; then
+  # Outer kernel-level kill (timeout -k): the probe's internal watchdogs
+  # are thread-based and can be GIL-starved when the PJRT init blocks in
+  # C++ without releasing the GIL (measured round 5 — the probe outlived
+  # both its 240 s watchdog and a plain SIGTERM when a handler was
+  # installed). 900 s is generous enough that a healthy-but-slow first
+  # compile is never killed mid-flight (the wedge risk), while a truly
+  # hung probe can no longer hang the watcher loop itself.
+  if timeout -k 30 900 python benchmarks/tpu_alive_probe.py; then
     now=$(date +%s); rem=$(( DEADLINE - now ))
     if   [ "$rem" -ge 7200 ]; then stages="bench split trailing phase cembed"
     elif [ "$rem" -ge 3600 ]; then stages="bench split cembed"

@@ -149,17 +149,35 @@ def ensure_complex_supported(dtype) -> None:
         return
     if complex_supported_on_backend():
         return
+    # Say exactly WHICH gate failed (ADVICE r4): a denylisted backend never
+    # ran the probe, and debugging a stale denylist (e.g. a leftover
+    # PALLAS_AXON_POOL_IPS on a healthy setup) needs that distinction.
+    if _known_complexless_backend():
+        how = (
+            "this backend is a KNOWN-complexless axon relay — denylisted "
+            "by its sitecustomize pin (PALLAS_AXON_POOL_IPS / 'axon' "
+            "platform_version) before any probe ran; its c64 failures "
+            "poison the remote compile helper, see "
+            "benchmarks/results/tpu_r3_disambig.jsonl. If the pin is "
+            "stale on an actually-healthy backend, set DHQR_TPU_COMPLEX=1 "
+            "to override"
+        )
+    else:
+        how = (
+            "the probe — a 256x256 complex64 matmul, executed and read "
+            "back — failed. A definitive UNIMPLEMENTED-class failure is "
+            "cached for the process; a transient failure (relay hiccup, "
+            "OOM) is NOT cached and the next complex call re-probes. "
+            "NOTE: a genuinely failed probe may have degraded this "
+            "process's remote compile helper — if later float compiles "
+            "fail, restart the process. Set DHQR_TPU_COMPLEX=1 to skip "
+            "the probe on backends that do support complex"
+        )
     raise ValueError(
-        "complex inputs are not supported by this TPU backend (the probe — "
-        "a 256x256 complex64 matmul, executed and read back — failed "
-        "UNIMPLEMENTED; the axon relay backend has no complex support at "
-        "MXU shapes, see benchmarks/results/tpu_r3_disambig.jsonl). "
+        f"complex inputs are not supported by this TPU backend ({how}). "
         "complex64 LEAST-SQUARES still works here: dhqr_tpu.lstsq routes "
         "it through the exactly-equivalent real embedded system "
         "automatically (same f32 component precision). For factorizations "
         "or complex128, run on CPU (jax.config.update('jax_platforms', "
-        "'cpu')). NOTE: a failed complex probe may have degraded this "
-        "process's remote compile helper — if later float compiles fail, "
-        "restart the process. Set DHQR_TPU_COMPLEX=1 to skip this check "
-        "on backends that do support complex."
+        "'cpu'))."
     )
