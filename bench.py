@@ -399,7 +399,7 @@ def main() -> None:
 
     def qr_bench(n_, pallas=False, watchdog=120, repeats=REPEATS,
                  backward_error=False, chain=0, nb=None, panel="loop",
-                 flat=None):
+                 flat=None, lookahead=False):
         """Measure blocked QR at n_ x n_ and print a COMPLETE headline JSON
         line for it — later (larger) stages supersede it; the supervisor
         keeps the last parseable line (so a wedge mid-escalation still
@@ -412,22 +412,25 @@ def main() -> None:
         name = f"qr_{n_}" + ("_pallas" if pallas else "") + \
             (f"_nb{nb}" if nb else "") + \
             (f"_flat{flat}" if flat else "") + \
-            ("_recursive" if panel == "recursive" else "")
+            ("_recursive" if panel == "recursive" else "") + \
+            ("_lookahead" if lookahead else "")
         _stage(name)
         try:
             return _qr_bench_guarded(name, n_, pallas, watchdog, repeats,
                                      backward_error, chain, nb or BLOCK,
-                                     panel, flat)
+                                     panel, flat, lookahead)
         except Exception as e:  # a failed stage must not kill later stages
             print(f"::stage_failed {name} {type(e).__name__}: {e}",
                   file=sys.stderr, flush=True)
             return None
 
     def _qr_bench_guarded(name, n_, pallas, watchdog, repeats, backward_error,
-                          chain, nb, panel, flat=None):
+                          chain, nb, panel, flat=None, lookahead=False):
         from jax import lax
 
         extra = {} if flat is None else {"pallas_flat": flat}
+        if lookahead:
+            extra["lookahead"] = True
         with _Watchdog(name, watchdog):
             A = jnp.asarray(rng.random((n_, n_)), dtype=jnp.float32)
             sync(A)
@@ -502,6 +505,8 @@ def main() -> None:
             }
             if flat is not None:
                 result["pallas_flat"] = flat
+            if lookahead:
+                result["lookahead"] = True
             if t_chain is not None:
                 result["seconds_chain"] = round(t_chain, 4)
                 result["chain_length"] = chain
@@ -681,6 +686,11 @@ def main() -> None:
     # its compile must not starve the 12288/16384 headline stages inside
     # the supervisor's window (headline first, experiments after).
     run_stage(N, pallas=True, watchdog=420, chain=25, nb=512, flat=256)
+    # Lookahead pair (round-5): same config as the nb=256 Pallas stage
+    # above — the default half already ran, so this one row IS the delta.
+    # Cold-cache program, so it sits with the experiments after the
+    # headline stages (same reasoning as the split stage).
+    run_stage(N, pallas=True, watchdog=420, chain=25, nb=256, lookahead=True)
     if not results:
         return
     # Comparison datum (never the headline); the best record is re-emitted

@@ -22,19 +22,20 @@ import glob
 import json
 import os
 import re
+import sys
 
-_RES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results")
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_RES = os.path.join(_HERE, "results")
+sys.path.insert(0, os.path.dirname(_HERE))
+
+from bench import _parse_round  # noqa: E402 — one parse rule, not two
 
 
 def _round() -> int:
-    # Same round tag as tpu_session_r4.sh / bench.py (all default to 5):
-    # DHQR_ROUND=4 analyzes the round-4 artifacts that session would have
-    # written. Lenient parse: 'r5' (the artifact-tag spelling) and '5'
-    # both work.
-    try:
-        return int(str(os.environ.get("DHQR_ROUND", "5")).lstrip("rR"))
-    except ValueError:
-        return 5
+    # Same round tag as tpu_session_r4.sh / bench.py (all default to 5,
+    # all strip an 'r'/'R' prefix): DHQR_ROUND=4 analyzes the round-4
+    # artifacts that session would have written.
+    return _parse_round(os.environ.get("DHQR_ROUND", "5"))
 
 
 def _rows():
@@ -124,7 +125,8 @@ def main() -> None:
             # width candidates — they must not shadow the matched-
             # precision baseline sharing their (nb, flat) key
         size = int(re.search(r"(\d+)x\d+$", r["metric"]).group(1))
-        key = (r.get("block_size"), r.get("pallas_flat"))
+        key = (r.get("block_size"), r.get("pallas_flat"),
+               bool(r.get("lookahead")))
         cur = by_size.setdefault(size, {})
         if key not in cur or r["value"] > cur[key]["value"]:
             cur[key] = r
@@ -137,14 +139,15 @@ def main() -> None:
             or list(variants.values())
         best = max(pool, key=lambda r: r["value"])
         print(f"  {size}:")
-        for (nb, flat), r in sorted(variants.items(),
-                                    key=lambda kv: -kv[1]["value"]):
+        for (nb, flat, la), r in sorted(variants.items(),
+                                        key=lambda kv: -kv[1]["value"]):
             mark = " <== best" if r is best else ""
             if not _qualified(r):
                 mark = " (disqualified: accuracy)"
             tp = r.get("trailing_precision")
             tp_s = f" tp={tp}" if tp not in (None, "highest") else ""
-            print(f"    nb={nb} flat={flat or '-'}{tp_s}: "
+            la_s = " lookahead" if la else ""
+            print(f"    nb={nb} flat={flat or '-'}{tp_s}{la_s}: "
                   f"{r['value']:.1f} GF/s{mark}")
 
     print("\n== trailing-precision pairs (baseline vs split, per size) ==")

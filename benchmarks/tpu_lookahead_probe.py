@@ -1,0 +1,143 @@
+"""Round-5 TPU probe: one-panel-lookahead schedule vs the default order.
+
+Lookahead factors panel k+1 (and issues its psum, on the sharded tier)
+BEFORE panel k's wide trailing GEMM (ops/blocked._scan_panels_lookahead).
+On one chip there is no collective to hide, so the single-device ladder
+here measures the pure reorder cost/benefit — XLA may still schedule the
+independent panel/trailing programs differently (the round-3 phase probe
+put the serial panel sweep at ~1/3 of total time at nb=512, the region
+the reference's author flags "this is most expensive", reference
+src/DistributedHouseholderQR.jl:141-143). Each stage emits a matched
+PAIR (default, lookahead) at the same (n, nb, flat) so the delta is
+read directly off adjacent rows.
+
+Run ONE instance at a time (the axon relay allows a single TPU process).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _stage(name: str) -> None:
+    print(f"::stage {name} t={time.time():.1f}", file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(3))
+    from bench import _Watchdog
+
+    _stage("import")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(_REPO, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    from dhqr_tpu.ops.blocked import _apply_q_impl, _blocked_qr_impl
+    from dhqr_tpu.ops.solve import r_matrix
+    from dhqr_tpu.utils.profiling import sync
+
+    _stage("backend_init")
+    with _Watchdog("backend_init", 150):
+        dev = jax.devices()[0]
+        platform = dev.platform
+        kind = getattr(dev, "device_kind", "?")
+        sync(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    _stage(f"backend_ready_{platform}")
+    rng = np.random.default_rng(0)
+
+    def emit(rec):
+        rec["platform"] = platform
+        rec["device_kind"] = kind
+        print(json.dumps(rec), flush=True)
+
+    def chain_time(n, nb, chain, watchdog, lookahead, repeats=3,
+                   backward_error=False):
+        name = f"qr_{n}_nb{nb}" + ("_lookahead" if lookahead else "_default")
+        _stage(name)
+        try:
+            with _Watchdog(name, watchdog):
+                A = jnp.asarray(rng.random((n, n)), jnp.float32)
+                sync(A)
+                kw = dict(precision="highest", pallas=True, norm="fast",
+                          panel_impl="loop", lookahead=lookahead)
+                t0 = time.perf_counter()
+                single = _blocked_qr_impl.lower(A, nb, **kw).compile()
+                H, al = single(A)
+                sync(al)
+
+                def chained(A):
+                    def body(C, _):
+                        Hc, ac = _blocked_qr_impl(C, nb, **kw)
+                        return Hc, ac[0]
+                    return lax.scan(body, A, None, length=chain)
+
+                ck = jax.jit(chained).lower(A).compile()
+                compile_s = time.perf_counter() - t0
+                Hc, s = ck(A)
+                sync(s)
+
+                def tmin(f, pick):
+                    ts = []
+                    for _ in range(repeats):
+                        t0 = time.perf_counter()
+                        r = f(A)
+                        sync(pick(r))
+                        ts.append(time.perf_counter() - t0)
+                    return min(ts)
+
+                t1 = tmin(single, lambda r: r[1])
+                tk = tmin(ck, lambda r: r[1])
+                t = (tk - t1) / (chain - 1)
+                unreliable = not (tk > t1 * 1.05 and t > 0)
+                if unreliable:
+                    t = t1
+                flops = (4.0 / 3.0) * n**3
+                rec = {"metric": f"qr_gflops_per_chip_f32_{n}x{n}",
+                       "value": round(flops / t / 1e9, 2),
+                       "unit": "GFLOP/s", "seconds": round(t, 4),
+                       "block_size": nb, "lookahead": lookahead,
+                       "chain_length": chain,
+                       "seconds_single_dispatch": round(t1, 4),
+                       "seconds_chain": round(tk, 4),
+                       "compile_seconds": round(compile_s, 2),
+                       "chain_unreliable": unreliable}
+                if backward_error:
+                    QR = _apply_q_impl(H, r_matrix(H, al), nb,
+                                       precision="highest")
+                    rec[f"backward_error_{n}"] = float(
+                        jnp.linalg.norm(QR - A) / jnp.linalg.norm(A))
+                emit(rec)
+        except Exception as ex:
+            emit({"metric": name, "ok": False,
+                  "error": f"{type(ex).__name__}: {ex}"[:400]})
+
+    # Matched pairs, smallest-first; accuracy evidence on the small size.
+    # The default halves of the pairs double as fresh controls against the
+    # round-3 numbers (same configs as tpu_r3_scale.jsonl).
+    chain_time(1024, 256, 5, 240, False, backward_error=True)
+    chain_time(1024, 256, 5, 240, True, backward_error=True)
+    chain_time(4096, 256, 25, 560, False)
+    chain_time(4096, 256, 25, 560, True)
+    chain_time(8192, 256, 5, 560, False)
+    chain_time(8192, 256, 5, 560, True)
+    chain_time(12288, 512, 3, 580, False, repeats=2)
+    chain_time(12288, 512, 3, 580, True, repeats=2)
+    chain_time(16384, 512, 3, 580, False, repeats=2)
+    chain_time(16384, 512, 3, 580, True, repeats=2)
+    _stage("done")
+
+
+if __name__ == "__main__":
+    main()

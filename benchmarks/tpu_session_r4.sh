@@ -13,24 +13,30 @@
 #   bench     - full bench.py supervised run (headline into bench_${R}_run.jsonl
 #               + per-stage tee into bench_tpu_tee.jsonl)
 #   split     - split-panel ladder      -> tpu_${R}_split.jsonl
+#   lookahead - lookahead-vs-default pairs -> tpu_${R}_lookahead.jsonl
 #   trailing  - trailing-precision pairs -> tpu_${R}_trailing.jsonl
 #   phase     - 16384^2 phase breakdown -> tpu_${R}_phase16k.jsonl
 #   cembed    - c64 lstsq via real embedding -> tpu_${R}_cembed.jsonl
 set -u
 cd "$(dirname "$0")/.."
 RES=benchmarks/results
-R="r${DHQR_ROUND:-5}"   # artifact round tag; default matches bench.py/analyze_r4.py
+# Artifact round tag; default matches bench.py/analyze_r4.py, and the 'r'
+# prefix is stripped if present so DHQR_ROUND=r5 and =5 agree with their
+# lenient parse (an unstripped 'r5' would write tpu_rr5_* artifacts the
+# analyzer never globs).
+_rnd="${DHQR_ROUND:-5}"; _rnd="${_rnd#r}"; _rnd="${_rnd#R}"
+R="r${_rnd}"
 mkdir -p "$RES"
-STAGES=${*:-"alive bench split trailing phase cembed"}
+STAGES=${*:-"alive bench split lookahead trailing phase cembed"}
 
 # Validate every stage name BEFORE running anything: a typo in a later
 # argument must not abort the session after earlier multi-hundred-second
 # stages already spent the hardware window.
 for s in $STAGES; do
   case "$s" in
-    alive|bench|split|trailing|phase|cembed) ;;
-    *) echo "unknown stage '$s' (valid: alive bench split trailing phase" \
-            "cembed)" >&2
+    alive|bench|split|lookahead|trailing|phase|cembed) ;;
+    *) echo "unknown stage '$s' (valid: alive bench split lookahead" \
+            "trailing phase cembed)" >&2
        exit 1 ;;
   esac
 done
@@ -42,6 +48,18 @@ run() { # name, logfile, cmd...
   local rc=${PIPESTATUS[0]}
   echo "=== $name done rc=$rc" >&2
   return "$rc"
+}
+
+# Probe stages keep their Python-level SIGTERM handlers (graceful claim
+# release when NOT wedged), but a PJRT wedge can GIL-starve every internal
+# watchdog (see tpu_alive_probe.py's CAVEAT) — so each probe also gets an
+# outer kernel-level bound. 3600 s is far above any healthy probe's total
+# runtime; on a wedge it caps the loss at one hour of the hardware window
+# instead of all of it. bench.py is excluded: its supervisor never touches
+# the backend itself and already SIGTERM/SIGKILL-escalates its child.
+probe() { # name, logfile, cmd...
+  local name=$1 log=$2; shift 2
+  run "$name" "$log" timeout -k 30 3600 "$@"
 }
 
 for s in $STAGES; do
@@ -58,16 +76,19 @@ for s in $STAGES; do
       # document (ADVICE r4).
       run bench "$RES/bench_${R}_run.jsonl" python bench.py ;;
     split)
-      run split "$RES/tpu_${R}_split.jsonl" \
+      probe split "$RES/tpu_${R}_split.jsonl" \
         python benchmarks/tpu_split_probe.py ;;
+    lookahead)
+      probe lookahead "$RES/tpu_${R}_lookahead.jsonl" \
+        python benchmarks/tpu_lookahead_probe.py ;;
     trailing)
-      run trailing "$RES/tpu_${R}_trailing.jsonl" \
+      probe trailing "$RES/tpu_${R}_trailing.jsonl" \
         python benchmarks/tpu_trailing_precision_probe.py ;;
     phase)
-      run phase "$RES/tpu_${R}_phase16k.jsonl" \
+      probe phase "$RES/tpu_${R}_phase16k.jsonl" \
         python benchmarks/tpu_phase16k_probe.py ;;
     cembed)
-      run cembed "$RES/tpu_${R}_cembed.jsonl" \
+      probe cembed "$RES/tpu_${R}_cembed.jsonl" \
         python benchmarks/tpu_cembed_probe.py ;;
     *) echo "unknown stage $s" >&2; exit 1 ;;
   esac

@@ -49,8 +49,9 @@ while :; do
   # hung probe can no longer hang the watcher loop itself.
   if timeout -k 30 900 python benchmarks/tpu_alive_probe.py; then
     now=$(date +%s); rem=$(( DEADLINE - now ))
-    if   [ "$rem" -ge 7200 ]; then stages="bench split trailing phase cembed"
-    elif [ "$rem" -ge 3600 ]; then stages="bench split cembed"
+    if   [ "$rem" -ge 7200 ]; then
+      stages="bench split lookahead trailing phase cembed"
+    elif [ "$rem" -ge 3600 ]; then stages="bench split lookahead cembed"
     elif [ "$rem" -ge 1500 ]; then stages="bench"
     else
       echo "=== relay recovered with only $rem s left; leaving the window" >&2

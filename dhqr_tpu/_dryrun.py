@@ -50,6 +50,14 @@ def run(n_devices: int) -> None:
         assert bool(jnp.all(jnp.isfinite(x))), f"non-finite x ({layout})"
         print(f"dryrun: sharded_lstsq layout={layout} ok", flush=True)
 
+    # Lookahead schedule (round 5): the psum-before-trailing-GEMM order
+    # must compile and run on the mesh exactly like the default order.
+    x = sharded_lstsq(A, b, cmesh, block_size=block_size, layout="cyclic",
+                      lookahead=True)
+    assert x.shape == (n,)
+    assert bool(jnp.all(jnp.isfinite(x))), "non-finite x (lookahead)"
+    print("dryrun: sharded_lstsq lookahead ok", flush=True)
+
     # Awkward n (not divisible by the mesh): the internal orthogonal-
     # extension padding must compile and run on the mesh too.
     n_awk = n - 3
@@ -85,19 +93,25 @@ def run(n_devices: int) -> None:
     assert bool(jnp.all(jnp.isfinite(x))), "non-finite x (cholqr)"
     print("dryrun: sharded_cholqr_lstsq ok", flush=True)
 
+    # Realistic panel widths, sized to fit the driver's dryrun window
+    # UNCONDITIONALLY (VERDICT r4 #7): n=512/nb=64 on 8 devices gives each
+    # device one real panel and runs the 8x residual check against the
+    # LAPACK oracle — the toy stages above only check finiteness. The full
+    # n=1024/nb=128 stage (the flagship panel width) stays opt-in.
+    realistic(n_devices, n=512, nb=64)
     if os.environ.get("DHQR_DRYRUN_FULL") == "1":
         realistic(n_devices)
 
 
-def realistic(n_devices: int) -> None:
-    """Realistic-panel stage (VERDICT r3 weak #7): the toy shapes above
-    cover code paths, but shape/VMEM-coupled bugs in the sharded scan need
-    real panel widths to reproduce off-hardware. n=1024, nb=128, 8 devices
-    gives each device a 128-column block = exactly one real-width panel,
-    and m=2048 keeps the trailing GEMMs MXU-shaped. Opt-in via
-    DHQR_DRYRUN_FULL=1 (or the slow-tier test) — the compile is tens of
-    seconds on a virtual CPU mesh and must not eat the driver's dryrun
-    timeout."""
+def realistic(n_devices: int, n: int = 1024, nb: int = 128) -> None:
+    """Realistic-panel stage (VERDICT r3 weak #7 / r4 #7): the toy shapes
+    above cover code paths, but shape/VMEM-coupled bugs in the sharded scan
+    need real panel widths to reproduce off-hardware. The default n=1024,
+    nb=128 on 8 devices gives each device a 128-column block = exactly one
+    real-width panel, and m=2n keeps the trailing GEMMs MXU-shaped; that
+    compile is tens of seconds on a virtual CPU mesh, so ``run`` invokes a
+    shrunk n=512/nb=64 variant unconditionally and keeps the full width
+    behind DHQR_DRYRUN_FULL=1 (or the slow-tier test)."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -109,7 +123,6 @@ def realistic(n_devices: int) -> None:
         oracle_residual,
     )
 
-    n, nb = 1024, 128
     m = 2 * n
     rng = np.random.default_rng(1)
     A = jnp.asarray(rng.random((m, n)), dtype=jnp.float32)

@@ -73,6 +73,12 @@ def main(argv=None) -> int:
         "DHQR_PRECISION env setting, default 'highest')",
     )
     parser.add_argument(
+        "--lookahead", action="store_true", default=None,
+        help="one-panel-lookahead schedule on the blocked householder "
+        "engines (panel psum overlaps the trailing GEMM; same per-column "
+        "arithmetic — see DHQRConfig.lookahead)",
+    )
+    parser.add_argument(
         "--profile-dir", default=None,
         help="write a jax.profiler trace here (the @profilehtml analogue)",
     )
@@ -131,6 +137,7 @@ def main(argv=None) -> int:
         "layout": args.layout, "engine": args.engine,
         "block_size": args.block_size, "panel_impl": args.panel_impl,
         "trailing_precision": args.trailing_precision,
+        "lookahead": args.lookahead,
     }.items() if v is not None}
     cfg = DHQRConfig.from_env(**overrides)
     # block_size=None stays None: lstsq resolves it per backend/shape
@@ -165,6 +172,17 @@ def main(argv=None) -> int:
               f"blocked householder engines only ({why})",
               file=sys.stderr)
         cfg = dataclasses.replace(cfg, trailing_precision=None)
+    if cfg.lookahead and (cfg.engine != "householder" or not cfg.blocked):
+        # Same split as trailing_precision: explicit flag conflict errors,
+        # ambient DHQR_LOOKAHEAD warns and is dropped.
+        why = (f"engine={cfg.engine}" if cfg.engine != "householder"
+               else "blocked=False")
+        if args.lookahead is not None:
+            parser.error(f"--lookahead applies to the blocked householder "
+                         f"engines only ({why})")
+        print(f"# warning: DHQR_LOOKAHEAD ignored — it applies to the "
+              f"blocked householder engines only ({why})", file=sys.stderr)
+        cfg = dataclasses.replace(cfg, lookahead=False)
     print(f"# devices: {len(jax.devices())} ({jax.default_backend()}), "
           f"mesh size: {ndev}, engine: {cfg.engine}"
           + ("" if row_engine else f", layout: {cfg.layout}"))

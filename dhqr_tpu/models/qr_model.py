@@ -237,9 +237,11 @@ def qr(
                 precision=cfg.precision, layout=cfg.layout, norm=cfg.norm,
                 use_pallas=cfg.use_pallas, panel_impl=cfg.panel_impl,
                 trailing_precision=cfg.trailing_precision,
+                lookahead=cfg.lookahead,
             )
         else:
-            _reject_nonblocked_knobs(cfg.use_pallas, cfg.trailing_precision)
+            _reject_nonblocked_knobs(cfg.use_pallas, cfg.trailing_precision,
+                                     cfg.lookahead)
             H, alpha = _sharded.sharded_householder_qr(
                 A, mesh, axis_name=col_axis, precision=cfg.precision,
                 layout=cfg.layout, norm=cfg.norm,
@@ -254,11 +256,13 @@ def qr(
             use_pallas=cfg.use_pallas, norm=cfg.norm,
             panel_impl=cfg.panel_impl,
             trailing_precision=cfg.trailing_precision,
+            lookahead=cfg.lookahead,
         )
     else:
         if donate:
             raise ValueError("donate=True is only supported on the blocked path")
-        _reject_nonblocked_knobs(cfg.use_pallas, cfg.trailing_precision)
+        _reject_nonblocked_knobs(cfg.use_pallas, cfg.trailing_precision,
+                                 cfg.lookahead)
         H, alpha = _hh.householder_qr(A, precision=cfg.precision, norm=cfg.norm)
     return QRFactorization(
         H, alpha, block_size=cfg.block_size, precision=cfg.precision
@@ -290,7 +294,8 @@ def qr_explicit(
 
 
 def _reject_nonblocked_knobs(use_pallas: str,
-                             trailing_precision: "str | None") -> None:
+                             trailing_precision: "str | None",
+                             lookahead: bool = False) -> None:
     """Refuse blocked-only knobs on an unblocked path — one place, so a
     future blocked-only knob (or message tweak) cannot silently drift
     between the qr/lstsq tiers (code-review r4)."""
@@ -303,6 +308,11 @@ def _reject_nonblocked_knobs(use_pallas: str,
         raise ValueError(
             "trailing_precision applies to the blocked engines only "
             f"(got {trailing_precision!r} with blocked=False)"
+        )
+    if lookahead:
+        raise ValueError(
+            "lookahead applies to the blocked engines only (the unblocked "
+            "panel loop has no panel-level schedule to reorder)"
         )
 
 
@@ -325,6 +335,11 @@ def _validate_alt_engine_cfg(cfg: DHQRConfig) -> None:
         raise ValueError(
             "trailing_precision applies to the blocked householder engines "
             f"only (engine={cfg.engine!r})"
+        )
+    if cfg.lookahead:
+        raise ValueError(
+            "lookahead applies to the blocked householder engines only "
+            f"(engine={cfg.engine!r})"
         )
 
 
@@ -364,6 +379,7 @@ def _lstsq_refined(A, b, cfg: DHQRConfig, mesh):
             norm=cfg.norm, panel_impl=cfg.panel_impl, refine=cfg.refine,
             pallas_flat=_blocked.PALLAS_FLAT_WIDTH,
             trailing_precision=cfg.trailing_precision,
+            lookahead=cfg.lookahead,
         )
     fact = qr(A, config=dataclasses.replace(cfg, refine=0), mesh=mesh)
     x = fact.solve(b)
@@ -447,10 +463,10 @@ def _lstsq_alt_engine(A, b, cfg: DHQRConfig, mesh):
 
 @partial(jax.jit, static_argnames=(
     "block_size", "blocked", "precision", "use_pallas", "norm", "panel_impl",
-    "refine", "pallas_flat", "trailing_precision"))
+    "refine", "pallas_flat", "trailing_precision", "lookahead"))
 def _lstsq_impl(A, b, block_size, blocked, precision, use_pallas,
                 norm="accurate", panel_impl="loop", refine=0,
-                pallas_flat=None, trailing_precision=None):
+                pallas_flat=None, trailing_precision=None, lookahead=False):
     if blocked:
         from dhqr_tpu.ops.differentiable import lstsq_diff
 
@@ -461,8 +477,9 @@ def _lstsq_impl(A, b, block_size, blocked, precision, use_pallas,
         # closed-form O(1)-memory gradients — jax.grad works through the
         # public lstsq at every refine level
         return lstsq_diff(A, b, block_size, precision, pallas, interp, norm,
-                          panel_impl, refine, pallas_flat, trailing_precision)
-    _reject_nonblocked_knobs(use_pallas, trailing_precision)
+                          panel_impl, refine, pallas_flat, trailing_precision,
+                          lookahead)
+    _reject_nonblocked_knobs(use_pallas, trailing_precision, lookahead)
     H, alpha = _hh.householder_qr(A, precision=precision, norm=norm)
 
     def qr_solve(rhs):
@@ -638,11 +655,12 @@ def lstsq(
                 "single-device householder path (minimum-norm solve)"
             )
         if not cfg.blocked or cfg.use_pallas != "auto" \
-                or cfg.trailing_precision is not None:
+                or cfg.trailing_precision is not None or cfg.lookahead:
             raise ValueError(
                 "m < n supports only the default blocked XLA path "
                 f"(got blocked={cfg.blocked}, use_pallas={cfg.use_pallas!r}, "
-                f"trailing_precision={cfg.trailing_precision!r})"
+                f"trailing_precision={cfg.trailing_precision!r}, "
+                f"lookahead={cfg.lookahead})"
             )
         if cfg.refine:
             raise ValueError(
@@ -667,7 +685,8 @@ def lstsq(
 
         col_axis = cfg.mesh_axis or DEFAULT_AXIS
         if not cfg.blocked:
-            _reject_nonblocked_knobs(cfg.use_pallas, cfg.trailing_precision)
+            _reject_nonblocked_knobs(cfg.use_pallas, cfg.trailing_precision,
+                                     cfg.lookahead)
             m, n = A.shape
             nb, n_pad = plan_padding(n, mesh.shape[col_axis], cfg.block_size)
             if n_pad != n:
@@ -694,10 +713,12 @@ def lstsq(
             precision=cfg.precision, layout=cfg.layout, norm=cfg.norm,
             use_pallas=cfg.use_pallas, panel_impl=cfg.panel_impl,
             trailing_precision=cfg.trailing_precision,
+            lookahead=cfg.lookahead,
         )
     return _lstsq_impl(
         A, b, cfg.block_size, cfg.blocked, cfg.precision, cfg.use_pallas,
         norm=cfg.norm, panel_impl=cfg.panel_impl,
         pallas_flat=_blocked.PALLAS_FLAT_WIDTH,
         trailing_precision=cfg.trailing_precision,
+        lookahead=cfg.lookahead,
     )

@@ -202,16 +202,141 @@ def _scan_panels(S, pcount, nb, precision, pallas, pallas_interpret,
     return S, alphas.reshape(pcount * nb)
 
 
+def _scan_panels_lookahead(S, pcount, nb, precision, pallas, pallas_interpret,
+                           norm="accurate", panel_impl="loop",
+                           gemm_precision=None, pallas_flat=None):
+    """One-panel-lookahead twin of :func:`_scan_panels` (same contract).
+
+    Standard lookahead reorders each step so the NEXT panel's
+    factorization sits between the pending panel's two trailing pieces:
+    panel q's transform is applied to panel q+1's columns only, panel q+1
+    is factored immediately, and only then is panel q's transform applied
+    to everything further right. The factored panel q+1 is written AFTER
+    the wide apply, so the wide GEMM depends only on panel q — on the
+    sharded tier that leaves the psum of panel q+1 with no consumer until
+    the next scan iteration, letting XLA's latency-hiding scheduler
+    overlap the collective with the wide trailing GEMM (the region the
+    reference's author flags "this is most expensive", src:141-143).
+    Column-wise the arithmetic is identical to the non-lookahead order:
+    every column still receives panel transforms 0, 1, 2, ... in sequence.
+
+    A final fix-up applies the last panel's transform to the columns right
+    of the super-block (the non-lookahead scan does that inside its last
+    iteration); the NEXT super-block's panel 0 is then already fully
+    updated when its own lookahead sweep factors it up front — the
+    super-block boundary is a one-panel bubble with no overlap.
+    """
+    ms, ns = S.shape
+
+    def factor(panel, off):
+        if pallas:
+            return _panel_factor_pallas(panel, off, precision,
+                                        pallas_interpret, base=pallas_flat)
+        return _panel_factor(panel, off, precision, norm, panel_impl)
+
+    with jax.named_scope("panel_factor"):
+        pf0, a0 = factor(lax.slice(S, (0, 0), (ms, nb)), 0)
+        S = lax.dynamic_update_slice(S, pf0, (jnp.int32(0), jnp.int32(0)))
+
+    def body(carry, q):
+        S, pf = carry
+        c = q * nb          # pending panel q's diagonal offset
+        c1 = c + nb         # panel q+1's start
+        Y = shifted_tril(pf, c)
+        with jax.named_scope("lookahead_update"):
+            C1 = lax.dynamic_slice(S, (jnp.int32(0), c1), (ms, nb))
+            C1 = apply_block_reflector_h(Y, C1, precision,
+                                         gemm_precision=gemm_precision)
+        with jax.named_scope("panel_factor"):
+            pf1, a1 = factor(C1, c1)
+        with jax.named_scope("trailing_update"):
+            # Reads the PRE-pf1 S: the wide GEMM must not depend on panel
+            # q+1's factorization (or, sharded, its psum) — the column
+            # sets are disjoint, so the masked select and the pf1 write
+            # commute.
+            C_new = apply_block_reflector_h(Y, S, precision,
+                                            gemm_precision=gemm_precision)
+            cmask = lax.iota(jnp.int32, ns) >= c1 + nb
+            S = jnp.where(cmask[None, :], C_new, S)
+        S = lax.dynamic_update_slice(S, pf1, (jnp.int32(0), c1))
+        return (S, pf1), a1
+
+    (S, pf_last), alphas = lax.scan(
+        body, (S, pf0), jnp.arange(pcount - 1, dtype=jnp.int32))
+    with jax.named_scope("trailing_update"):
+        c = (pcount - 1) * nb
+        Y = shifted_tril(pf_last, c)
+        C_new = apply_block_reflector_h(Y, S, precision,
+                                        gemm_precision=gemm_precision)
+        cmask = lax.iota(jnp.int32, ns) >= pcount * nb
+        S = jnp.where(cmask[None, :], C_new, S)
+    alphas = jnp.concatenate([a0, alphas.reshape((pcount - 1) * nb)])
+    return S, alphas
+
+
+def _unrolled_lookahead(A, nb, precision, pallas, pallas_interpret, norm,
+                        panel_impl, tprec, flat):
+    """One-panel-lookahead order on the fully-unrolled shrinking-slice path
+    (see :func:`_scan_panels_lookahead` for the scheme and why): factor
+    panel k+1 from its lookahead-updated columns BEFORE the pending panel
+    k's wide trailing GEMM. Handles the ragged final panel (widths vary in
+    the unrolled path)."""
+    from dhqr_tpu.ops.pallas_panel import pallas_panel_supported
+
+    m, n = A.shape
+    H = A
+    alpha = jnp.zeros((n,), dtype=A.dtype)
+
+    def factor(panel, off, height, width):
+        if pallas and pallas_panel_supported(height, min(width, flat),
+                                             A.dtype):
+            return _panel_factor_pallas(panel, off, precision,
+                                        pallas_interpret, base=flat)
+        return _panel_factor(panel, off, precision, norm, panel_impl)
+
+    b0 = min(nb, n)
+    with jax.named_scope("panel_factor"):
+        pf, alpha_k = factor(lax.slice(H, (0, 0), (m, b0)), 0, m, b0)
+        H = H.at[:, :b0].set(pf)
+        alpha = alpha.at[:b0].set(alpha_k)
+    kp, bp = 0, b0  # pending (already factored, not yet applied) panel
+    for k1 in range(b0, n, nb):
+        b1 = min(nb, n - k1)
+        Y = jnp.tril(pf)  # pending reflectors; rows of pf start at row kp
+        with jax.named_scope("lookahead_update"):
+            C1 = lax.slice(H, (kp, k1), (m, k1 + b1))
+            C1 = apply_block_reflector_h(Y, C1, precision,
+                                         gemm_precision=tprec)
+        with jax.named_scope("panel_factor"):
+            # Diagonal of panel k1 sits at row k1 = kp + bp, i.e. offset
+            # bp within the (m - kp)-tall slice.
+            pf1, alpha_k = factor(C1, bp, m - kp, b1)
+            H = H.at[kp:, k1 : k1 + b1].set(pf1)
+            alpha = alpha.at[k1 : k1 + b1].set(alpha_k)
+            # Carry the pending panel in its OWN row frame (rows k1:m, diag
+            # at local row 0) so the next iteration's jnp.tril is correct.
+            pf1 = lax.slice(pf1, (bp, 0), (m - kp, b1))
+        if k1 + b1 < n:
+            with jax.named_scope("trailing_update"):
+                C2 = lax.slice(H, (kp, k1 + b1), (m, n))
+                H = H.at[kp:, k1 + b1 :].set(
+                    apply_block_reflector_h(Y, C2, precision,
+                                            gemm_precision=tprec)
+                )
+        pf, kp, bp = pf1, k1, b1
+    return H, alpha
+
+
 @partial(
     jax.jit,
     static_argnames=("block_size", "precision", "pallas", "pallas_interpret",
                      "norm", "panel_impl", "trailing_precision",
-                     "pallas_flat"),
+                     "pallas_flat", "lookahead"),
 )
 def _blocked_qr_impl(
     A, block_size, precision=DEFAULT_PRECISION, pallas=False,
     pallas_interpret=False, norm="accurate", panel_impl="loop",
-    trailing_precision=None, pallas_flat=None,
+    trailing_precision=None, pallas_flat=None, lookahead=False,
 ):
     from dhqr_tpu.ops.pallas_panel import pallas_panel_supported
 
@@ -229,6 +354,11 @@ def _blocked_qr_impl(
     tprec = precision if trailing_precision is None else trailing_precision
 
     if num_full + (1 if rem else 0) <= MAX_UNROLLED_PANELS:
+        if lookahead and n > nb:
+            return _unrolled_lookahead(
+                A, nb, precision, pallas, pallas_interpret, norm, panel_impl,
+                tprec, flat,
+            )
         # Fully-unrolled shrinking-slice path: exact flops, small program.
         H = A
         alpha = jnp.zeros((n,), dtype=A.dtype)
@@ -271,7 +401,8 @@ def _blocked_qr_impl(
         S = lax.slice(H, (K, K), (m, n))
         blk_pallas = pallas and pallas_panel_supported(
             m - K, min(nb, flat), A.dtype)
-        S, alpha_blk = _scan_panels(
+        scan_fn = _scan_panels_lookahead if lookahead else _scan_panels
+        S, alpha_blk = scan_fn(
             S, pcount, nb, precision, blk_pallas, pallas_interpret, norm=norm,
             panel_impl=panel_impl, gemm_precision=tprec, pallas_flat=flat,
         )
@@ -292,7 +423,7 @@ _blocked_qr_impl_donate = partial(
     jax.jit,
     static_argnames=("block_size", "precision", "pallas", "pallas_interpret",
                      "norm", "panel_impl", "trailing_precision",
-                     "pallas_flat"),
+                     "pallas_flat", "lookahead"),
     donate_argnums=(0,),
 )(_blocked_qr_impl.__wrapped__)
 
@@ -419,6 +550,7 @@ def blocked_householder_qr(
     norm: str = "accurate",
     panel_impl: str = "loop",
     trailing_precision: "str | None" = None,
+    lookahead: bool = False,
 ):
     """Factor ``A`` (m x n, m >= n): returns ``(H, alpha)`` in packed storage.
 
@@ -447,6 +579,14 @@ def blocked_householder_qr(
     trades MXU passes (6 -> 3) on the bulk work while keeping the dependent
     reflector chains at full accuracy. Measure the backward error for your
     sizes before relying on it; the library default remains un-split.
+
+    ``lookahead=True`` factors each panel from its lookahead-updated
+    columns BEFORE the previous panel's wide trailing GEMM (classic
+    one-panel lookahead; see :func:`_scan_panels_lookahead`). Column-wise
+    the arithmetic is order-identical, so results match the default
+    schedule to roundoff in the GEMM column split; the scheduling freedom
+    matters most on the sharded tier, where it lets the panel psum overlap
+    the trailing GEMM.
     """
     from dhqr_tpu.utils.platform import ensure_complex_supported
 
@@ -465,7 +605,7 @@ def blocked_householder_qr(
                 trailing_precision=trailing_precision,
                 # explicit (not the in-trace default) so the module global
                 # participates in the jit cache key via this wrapper
-                pallas_flat=PALLAS_FLAT_WIDTH)
+                pallas_flat=PALLAS_FLAT_WIDTH, lookahead=lookahead)
 
 
 @partial(jax.jit, static_argnames=("block_size", "precision"))

@@ -40,11 +40,12 @@ from dhqr_tpu.ops.householder import DEFAULT_PRECISION
 from dhqr_tpu.ops.solve import back_substitute, r_matrix
 
 
-@partial(jax.custom_jvp, nondiff_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 10))
+@partial(jax.custom_jvp, nondiff_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 10, 11))
 def lstsq_diff(
     A, b, block_size=DEFAULT_BLOCK_SIZE, precision=DEFAULT_PRECISION,
     pallas=False, pallas_interpret=False, norm="accurate",
     panel_impl="loop", refine=0, pallas_flat=None, trailing_precision=None,
+    lookahead=False,
 ):
     """``x = argmin ||A x - b||`` with closed-form O(1)-memory derivatives.
 
@@ -59,13 +60,14 @@ def lstsq_diff(
     """
     x, _ = _lstsq_fwd(A, b, block_size, precision, pallas, pallas_interpret,
                       norm, panel_impl, refine, pallas_flat,
-                      trailing_precision)
+                      trailing_precision, lookahead)
     return x
 
 
 def _lstsq_fwd(A, b, block_size, precision, pallas=False,
                pallas_interpret=False, norm="accurate", panel_impl="loop",
-               refine=0, pallas_flat=None, trailing_precision=None):
+               refine=0, pallas_flat=None, trailing_precision=None,
+               lookahead=False):
     if pallas_flat is None:
         # Resolve the module global HERE (call time), not via
         # _blocked_qr_impl's in-trace default — the explicit static arg
@@ -78,7 +80,7 @@ def _lstsq_fwd(A, b, block_size, precision, pallas=False,
         A, block_size, precision=precision,
         pallas=pallas, pallas_interpret=pallas_interpret, norm=norm,
         panel_impl=panel_impl, pallas_flat=pallas_flat,
-        trailing_precision=trailing_precision,
+        trailing_precision=trailing_precision, lookahead=lookahead,
     )
 
     def qr_solve(rhs):
@@ -96,12 +98,12 @@ def _lstsq_fwd(A, b, block_size, precision, pallas=False,
 @lstsq_diff.defjvp
 def _lstsq_jvp(block_size, precision, pallas, pallas_interpret, norm,
                panel_impl, refine, pallas_flat, trailing_precision,
-               primals, tangents):
+               lookahead, primals, tangents):
     A, b = primals
     dA, db = tangents
     x, (_, _, H, alpha, _) = _lstsq_fwd(
         A, b, block_size, precision, pallas, pallas_interpret, norm,
-        panel_impl, refine, pallas_flat, trailing_precision
+        panel_impl, refine, pallas_flat, trailing_precision, lookahead
     )
     m, n = A.shape
     vec = x.ndim == 1

@@ -148,7 +148,7 @@ def _blocked_shard_body(
     precision: str = DEFAULT_PRECISION, layout: str = "block",
     norm: str = "accurate", pallas: bool = False, pallas_interpret: bool = False,
     panel_impl: str = "loop", pallas_flat: "int | None" = None,
-    trailing_precision: "str | None" = None,
+    trailing_precision: "str | None" = None, lookahead: bool = False,
 ):
     """Per-device body for the compact-WY engine.
 
@@ -179,6 +179,26 @@ def _blocked_shard_body(
     # prefix (low-p devices simply go idle — that is why cyclic exists).
     def _done_cols(kb: int) -> int:
         return (kb // nproc) * nb if layout == "cyclic" else 0
+
+    def _factor(panel, off):
+        if pallas:
+            from dhqr_tpu.ops.blocked import _panel_factor_pallas
+
+            return _panel_factor_pallas(panel, off, precision,
+                                        pallas_interpret, base=pallas_flat)
+        from dhqr_tpu.ops.blocked import _panel_factor
+
+        return _panel_factor(panel, off, precision, norm, panel_impl)
+
+    def _psum_owner(x, mine):
+        return lax.psum(jnp.where(mine, x, jnp.zeros_like(x)), axis)
+
+    if lookahead and num_panels > 1:
+        return _blocked_shard_lookahead(
+            Al, n=n, nb=nb, axis=axis, precision=precision, layout=layout,
+            factor=_factor, psum_owner=_psum_owner, done_cols=_done_cols,
+            tprec=tprec, gidx_base=gidx_base, p=p, nproc=nproc,
+        )
 
     if num_panels <= MAX_UNROLLED_PANELS:
         for k in range(0, n, nb):
@@ -267,6 +287,130 @@ def _blocked_shard_body(
     return Al, alpha
 
 
+def _blocked_shard_lookahead(
+    Al, *, n, nb, axis, precision, layout, factor, psum_owner, done_cols,
+    tprec, gidx_base, p, nproc,
+):
+    """One-panel-lookahead order for the sharded compact-WY body.
+
+    Same arithmetic per column as the default order (panel transforms
+    applied in sequence), but panel k+1 is factored — and its psum issued
+    — BEFORE panel k's wide local trailing GEMM, whose inputs do not
+    depend on that psum. XLA's latency-hiding scheduler can then overlay
+    the collective (the reference's dominant cost: the per-panel reflector
+    broadcast, src:141-143) with the trailing MXU work instead of
+    serializing psum -> GEMM -> psum every panel. Program-size strategy
+    matches :func:`_blocked_shard_body`: unrolled below
+    MAX_UNROLLED_PANELS, else super-blocks with an inner scan (the
+    super-block boundary is a one-panel bubble, handled by a fix-up apply
+    after each scan).
+    """
+    m, nloc = Al.shape
+    num_panels = n // nb
+    alpha = jnp.zeros((n,), dtype=Al.dtype)
+
+    if num_panels <= MAX_UNROLLED_PANELS:
+        owner0, kl0 = _panel_owner(0, n, nloc, nb, layout)
+        mine0 = p == owner0
+        with jax.named_scope("panel_factor"):
+            pf, a0 = factor(lax.slice(Al, (0, kl0), (m, kl0 + nb)), 0)
+            pf = psum_owner(pf, mine0)
+            a0 = psum_owner(a0, mine0)
+        alpha = alpha.at[:nb].set(a0)
+        Al = jnp.where(mine0, Al.at[:, kl0 : kl0 + nb].set(pf), Al)
+        kp = 0  # pending panel's start column; pf is diag-framed (rows kp:)
+        for k1 in range(nb, n, nb):
+            owner1, kl1 = _panel_owner(k1, n, nloc, nb, layout)
+            mine1 = p == owner1
+            Y = jnp.tril(pf)
+            with jax.named_scope("lookahead_update"):
+                C1 = lax.slice(Al, (kp, kl1), (m, kl1 + nb))
+                C1 = apply_block_reflector_h(Y, C1, precision,
+                                             gemm_precision=tprec)
+            with jax.named_scope("panel_factor"):
+                pf1, a1 = factor(C1, nb)  # diag at offset nb = k1 - kp
+                pf1 = psum_owner(pf1, mine1)
+                a1 = psum_owner(a1, mine1)
+            alpha = alpha.at[k1 : k1 + nb].set(a1)
+            drop = done_cols(kp // nb)
+            with jax.named_scope("trailing_update"):
+                # Reads Al BEFORE the pf1 write: the wide GEMM must not
+                # depend on panel k1's psum (disjoint column sets — the
+                # mask excludes panel k1, so the writes commute).
+                C = lax.slice(Al, (kp, drop), (m, nloc))
+                C_new = apply_block_reflector_h(Y, C, precision,
+                                                gemm_precision=tprec)
+                cmask = (gidx_base[drop:] >= k1 + nb)[None, :]
+                Al = Al.at[kp:, drop:].set(jnp.where(cmask, C_new, C))
+            Al = jnp.where(mine1,
+                           Al.at[kp:, kl1 : kl1 + nb].set(pf1), Al)
+            # Carry pending in its own row frame (rows k1:, diag at 0).
+            pf = lax.slice(pf1, (nb, 0), (m - kp, nb))
+            kp = k1
+        return Al, alpha
+
+    _, _, ppo = _panels_schedule(n, nb)
+    for ob in range(0, num_panels, ppo):
+        pcount = min(ppo, num_panels - ob)
+        K = ob * nb
+        drop = done_cols(ob)  # static: done before this super-block
+        Sl = lax.slice(Al, (K, drop), (m, nloc))
+        ms = m - K
+        owner0, kl0 = _panel_owner(K, n, nloc, nb, layout)
+        kl0 -= drop
+        mine0 = p == owner0
+        with jax.named_scope("panel_factor"):
+            pf0, a0 = factor(lax.slice(Sl, (0, kl0), (ms, kl0 + nb)), 0)
+            pf0 = psum_owner(pf0, mine0)
+            a0 = psum_owner(a0, mine0)
+        Sl = jnp.where(mine0, Sl.at[:, kl0 : kl0 + nb].set(pf0), Sl)
+        alpha = alpha.at[K : K + nb].set(a0)
+
+        def body(carry, q, ob=ob, ms=ms, K=K, drop=drop):
+            Sl, pf = carry  # pf: full super-block height, diag at q*nb
+            kb1 = ob + q + 1
+            k1 = kb1 * nb
+            c1 = k1 - K
+            c = c1 - nb
+            owner1, kl1 = _panel_owner_traced(kb1, nproc, nloc, nb, layout)
+            kl1 = kl1 - drop
+            mine1 = p == owner1
+            Y = shifted_tril(pf, c)
+            with jax.named_scope("lookahead_update"):
+                C1 = lax.dynamic_slice(Sl, (jnp.int32(0), kl1), (ms, nb))
+                C1 = apply_block_reflector_h(Y, C1, precision,
+                                             gemm_precision=tprec)
+            with jax.named_scope("panel_factor"):
+                pf1, a1 = factor(C1, c1)
+                pf1 = psum_owner(pf1, mine1)
+                a1 = psum_owner(a1, mine1)
+            with jax.named_scope("trailing_update"):
+                # Pre-pf1 Sl, as above: keep the wide GEMM independent of
+                # panel q+1's psum so the scheduler can overlap them.
+                C_new = apply_block_reflector_h(Y, Sl, precision,
+                                                gemm_precision=tprec)
+                cmask = (gidx_base[drop:] >= k1 + nb)[None, :]
+                Sl = jnp.where(cmask, C_new, Sl)
+            Sl_upd = lax.dynamic_update_slice(Sl, pf1, (jnp.int32(0), kl1))
+            Sl = jnp.where(mine1, Sl_upd, Sl)
+            return (Sl, pf1), a1
+
+        (Sl, pf_last), a_rest = lax.scan(
+            body, (Sl, pf0), jnp.arange(pcount - 1, dtype=jnp.int32))
+        with jax.named_scope("trailing_update"):
+            c = (pcount - 1) * nb
+            Y = shifted_tril(pf_last, c)
+            C_new = apply_block_reflector_h(Y, Sl, precision,
+                                            gemm_precision=tprec)
+            cmask = (gidx_base[drop:] >= K + pcount * nb)[None, :]
+            Sl = jnp.where(cmask, C_new, Sl)
+        Al = Al.at[K:, drop:].set(Sl)
+        if pcount > 1:
+            alpha = alpha.at[K + nb : K + pcount * nb].set(
+                a_rest.reshape((pcount - 1) * nb))
+    return Al, alpha
+
+
 @lru_cache(maxsize=None)
 def _build_unblocked(
     mesh: Mesh, axis_name: str, n: int, precision: str, layout: str,
@@ -293,14 +437,14 @@ def _build_blocked(
     mesh: Mesh, axis_name: str, n: int, nb: int, precision: str, layout: str,
     norm: str = "accurate", pallas: bool = False, pallas_interpret: bool = False,
     panel_impl: str = "loop", pallas_flat: "int | None" = None,
-    trailing_precision: "str | None" = None,
+    trailing_precision: "str | None" = None, lookahead: bool = False,
 ):
     body = partial(
         _blocked_shard_body,
         n=n, nb=nb, axis=axis_name, precision=precision, layout=layout,
         norm=norm, pallas=pallas, pallas_interpret=pallas_interpret,
         panel_impl=panel_impl, pallas_flat=pallas_flat,
-        trailing_precision=trailing_precision,
+        trailing_precision=trailing_precision, lookahead=lookahead,
     )
     return jax.jit(
         shard_map(
@@ -446,6 +590,7 @@ def sharded_blocked_qr(
     use_pallas: str = "auto",
     panel_impl: str = "loop",
     trailing_precision: "str | None" = None,
+    lookahead: bool = False,
 ):
     """Compact-WY distributed QR: one psum per panel, GEMM trailing updates.
 
@@ -454,6 +599,11 @@ def sharded_blocked_qr(
     :func:`sharded_householder_qr`); ``_store_layout_output`` keeps H in the
     internal storage order (used by ``sharded_lstsq`` to chain directly into
     the solve without two cross-device column permutes).
+
+    ``lookahead=True`` issues each panel's psum BEFORE the previous
+    panel's wide trailing GEMM (one-panel lookahead, same per-column
+    arithmetic — see :func:`_blocked_shard_lookahead`), giving the
+    scheduler room to overlap the collective with MXU work.
     """
     m, n = A.shape
     nproc = mesh.shape[axis_name]
@@ -472,7 +622,7 @@ def sharded_blocked_qr(
             _pad_cols_orthogonal(A, n_pad), mesh, block_size=nb,
             axis_name=axis_name, precision=precision, layout=layout,
             norm=norm, use_pallas=use_pallas, panel_impl=panel_impl,
-            trailing_precision=trailing_precision,
+            trailing_precision=trailing_precision, lookahead=lookahead,
         )
         return H[:m, :n], alpha[:n]
     _check_divisibility(m, n, nproc, nb, layout)
@@ -492,7 +642,7 @@ def sharded_blocked_qr(
     A = jax.device_put(A, column_sharding(mesh, axis_name))
     H, alpha = _build_blocked(
         mesh, axis_name, n, nb, precision, layout, norm, pallas, interp,
-        panel_impl, PALLAS_FLAT_WIDTH, trailing_precision,
+        panel_impl, PALLAS_FLAT_WIDTH, trailing_precision, lookahead,
     )(A)
     if not _store_layout_output:
         H = _to_natural_layout(H, n, nproc, nb, layout)

@@ -297,6 +297,7 @@ def sharded_lstsq(
     use_pallas: str = "auto",
     panel_impl: str = "loop",
     trailing_precision: "str | None" = None,
+    lookahead: bool = False,
 ) -> jax.Array:
     """One-shot distributed least squares: factor + solve on the mesh.
 
@@ -324,7 +325,7 @@ def sharded_lstsq(
         A, mesh, block_size=nb, axis_name=axis_name, precision=precision,
         layout=layout, _store_layout_output=True, norm=norm,
         use_pallas=use_pallas, panel_impl=panel_impl,
-        trailing_precision=trailing_precision,
+        trailing_precision=trailing_precision, lookahead=lookahead,
     )
     x = sharded_solve(
         H, alpha, b, mesh,

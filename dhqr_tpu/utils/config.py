@@ -70,6 +70,15 @@ class DHQRConfig:
         bulk work — measure the backward error for your sizes first
         (the one hardware datum at 4096^2 f32 measured 2.7e-5, ABOVE
         the 1e-5 target; see benchmarks/tpu_trailing_precision_probe.py).
+      lookahead: one-panel-lookahead schedule on the blocked householder
+        engines (single-device and sharded): each panel is factored from
+        its lookahead-updated columns BEFORE the previous panel's wide
+        trailing GEMM, so on the sharded tier the panel's psum (the
+        reference's per-panel reflector broadcast, src:141-143) can
+        overlap the trailing MXU work. Per-column arithmetic is
+        unchanged — results match the default schedule to the roundoff
+        of the GEMM column split. Default False until the hardware
+        ladder (benchmarks/tpu_lookahead_probe.py) justifies flipping.
       refine: iterative-refinement steps for ``lstsq`` (0 = off). Each
         step reuses the factorization: ``r = b - A x; x += solve(r)`` —
         one matvec plus one extra solve, a few percent of the
@@ -94,6 +103,7 @@ class DHQRConfig:
     panel_impl: str = "loop"
     refine: int = 0
     trailing_precision: "str | None" = None
+    lookahead: bool = False
 
     @staticmethod
     def from_env(**overrides) -> "DHQRConfig":
@@ -123,5 +133,8 @@ class DHQRConfig:
             env["refine"] = int(os.environ["DHQR_REFINE"])
         if "DHQR_TRAILING_PRECISION" in os.environ:
             env["trailing_precision"] = os.environ["DHQR_TRAILING_PRECISION"]
+        if "DHQR_LOOKAHEAD" in os.environ:
+            env["lookahead"] = os.environ["DHQR_LOOKAHEAD"].strip().lower() \
+                not in ("0", "false", "no", "off", "n", "")
         env.update(overrides)
         return DHQRConfig(**env)

@@ -335,3 +335,35 @@ def test_lstsq_trailing_precision_surface(mesh):
         _lstsq(Aj, bj, engine="cholqr2", trailing_precision="high")
     with pytest.raises(ValueError, match="trailing_precision applies"):
         _qr(Aj, blocked=False, trailing_precision="high")
+
+
+@pytest.mark.parametrize("layout", ["block", "cyclic"])
+def test_sharded_lookahead_matches_default(mesh, layout):
+    """The lookahead schedule issues each panel's psum before the previous
+    panel's wide trailing GEMM — per-column arithmetic is unchanged, so
+    the sharded result must match the default schedule to roundoff on
+    both program paths (unrolled and super-block scan)."""
+    for (m, n, nb) in [(96, 64, 8),    # 8 panels: unrolled
+                       (160, 96, 4)]:  # 24 panels: scan path
+        A, _ = random_problem(m, n, np.float64, seed=54)
+        H0, a0 = sharded_blocked_qr(jnp.asarray(A), mesh, block_size=nb,
+                                    layout=layout)
+        H1, a1 = sharded_blocked_qr(jnp.asarray(A), mesh, block_size=nb,
+                                    layout=layout, lookahead=True)
+        np.testing.assert_allclose(np.asarray(H1), np.asarray(H0),
+                                   rtol=1e-12, atol=1e-12)
+        np.testing.assert_allclose(np.asarray(a1), np.asarray(a0),
+                                   rtol=1e-12, atol=1e-12)
+
+
+def test_sharded_lookahead_matches_serial(mesh):
+    """Lookahead + padding dispatch (awkward n) against the single-device
+    engine — the full public-surface composition."""
+    A, b = random_problem(130, 100, np.float64, seed=55)
+    H0, a0 = blocked_householder_qr(jnp.asarray(A), block_size=16)
+    H1, a1 = sharded_blocked_qr(jnp.asarray(A), mesh, block_size=16,
+                                layout="cyclic", lookahead=True)
+    np.testing.assert_allclose(np.asarray(H1), np.asarray(H0), rtol=1e-9,
+                               atol=1e-11)
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(a0), rtol=1e-9,
+                               atol=1e-11)
