@@ -294,9 +294,20 @@ def _best_recorded_tpu() -> dict:
                             and clean and accurate
                             and r.get("value", 0) > best.get("value", 0)):
                         best = {"value": r["value"], "metric": r["metric"],
-                                "artifact": os.path.basename(path)}
+                                "artifact": os.path.basename(path),
+                                # round-3 rows predate the device_kind
+                                # field; every committed TPU artifact was
+                                # measured on the axon v5e (see memory /
+                                # PARITY.md), so default the MFU basis to
+                                # that chip when the row doesn't say.
+                                "device_kind": r.get("device_kind",
+                                                     "TPU v5 lite")}
         except OSError:
             continue
+    if best:
+        mfu = _mfu_fields(best["value"], best["device_kind"])
+        if mfu:
+            best["mfu"] = mfu["mfu"]
     return best
 
 
@@ -324,6 +335,12 @@ def _supervise() -> int:
             result["best_recorded_tpu_gflops"] = recorded["value"]
             result["best_recorded_tpu_metric"] = recorded["metric"]
             result["best_recorded_tpu_artifact"] = recorded["artifact"]
+            if "mfu" in recorded:
+                # Self-describing: the basis chip travels with the number
+                # (for pre-round-5 artifacts it is the documented v5e
+                # default, not a row-recorded fact — see _best_recorded_tpu).
+                result["best_recorded_tpu_mfu"] = recorded["mfu"]
+                result["best_recorded_tpu_device_kind"] = recorded["device_kind"]
         print(json.dumps(result))
         return 0
     print(json.dumps({

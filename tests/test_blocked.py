@@ -351,3 +351,57 @@ def test_lookahead_pallas_interpret():
                                    atol=5e-5)
         np.testing.assert_allclose(np.asarray(a1), np.asarray(a0), rtol=5e-5,
                                    atol=5e-5)
+
+
+def test_lookahead_composes_with_trailing_precision():
+    """lookahead + trailing_precision split must take the same GEMM
+    precision in the lookahead/wide applies as the default schedule."""
+    rng = np.random.default_rng(56)
+    A = jnp.asarray(rng.standard_normal((160, 128)), dtype=jnp.float32)
+    for tp in (None, "high"):
+        H0, a0 = blocked_householder_qr(A, block_size=16,
+                                        trailing_precision=tp)
+        H1, a1 = blocked_householder_qr(A, block_size=16,
+                                        trailing_precision=tp,
+                                        lookahead=True)
+        np.testing.assert_allclose(np.asarray(H1), np.asarray(H0),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(a1), np.asarray(a0),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_lookahead_composes_with_split_pallas(monkeypatch):
+    """lookahead + split-panel factorization (flat width below nb): the
+    recursive base-width kernel path must feed the lookahead schedule
+    exactly like the flat kernel."""
+    from dhqr_tpu.ops import blocked as B
+
+    monkeypatch.setattr(B, "PALLAS_FLAT_WIDTH", 16)
+    rng = np.random.default_rng(57)
+    A = jnp.asarray(rng.standard_normal((96, 64)), dtype=jnp.float32)
+    H0, a0 = blocked_householder_qr(A, block_size=32, use_pallas="always")
+    H1, a1 = blocked_householder_qr(A, block_size=32, use_pallas="always",
+                                    lookahead=True)
+    np.testing.assert_allclose(np.asarray(H1), np.asarray(H0), rtol=5e-5,
+                               atol=5e-5)
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(a0), rtol=5e-5,
+                               atol=5e-5)
+
+
+def test_lookahead_factorization_checkpoints():
+    """A lookahead-built factorization round-trips through the checkpoint
+    store bit-for-bit (H, alpha are schedule-independent artifacts)."""
+    import tempfile
+
+    from dhqr_tpu.models.qr_model import qr
+    from dhqr_tpu.utils.checkpoint import load_factorization, save_factorization
+
+    A, _ = random_problem(96, 80, np.float64, seed=58)
+    fact = qr(jnp.asarray(A), block_size=16, lookahead=True)
+    with tempfile.TemporaryDirectory() as d:
+        path = f"{d}/f.npz"
+        save_factorization(path, fact)
+        back = load_factorization(path)
+    np.testing.assert_array_equal(np.asarray(back.H), np.asarray(fact.H))
+    np.testing.assert_array_equal(np.asarray(back.alpha),
+                                  np.asarray(fact.alpha))
