@@ -416,7 +416,7 @@ def main() -> None:
 
     def qr_bench(n_, pallas=False, watchdog=120, repeats=REPEATS,
                  backward_error=False, chain=0, nb=None, panel="loop",
-                 flat=None, lookahead=False):
+                 flat=None, lookahead=False, agg=None):
         """Measure blocked QR at n_ x n_ and print a COMPLETE headline JSON
         line for it — later (larger) stages supersede it; the supervisor
         keeps the last parseable line (so a wedge mid-escalation still
@@ -430,24 +430,28 @@ def main() -> None:
             (f"_nb{nb}" if nb else "") + \
             (f"_flat{flat}" if flat else "") + \
             ("_recursive" if panel == "recursive" else "") + \
-            ("_lookahead" if lookahead else "")
+            ("_lookahead" if lookahead else "") + \
+            (f"_agg{agg}" if agg else "")
         _stage(name)
         try:
             return _qr_bench_guarded(name, n_, pallas, watchdog, repeats,
                                      backward_error, chain, nb or BLOCK,
-                                     panel, flat, lookahead)
+                                     panel, flat, lookahead, agg)
         except Exception as e:  # a failed stage must not kill later stages
             print(f"::stage_failed {name} {type(e).__name__}: {e}",
                   file=sys.stderr, flush=True)
             return None
 
     def _qr_bench_guarded(name, n_, pallas, watchdog, repeats, backward_error,
-                          chain, nb, panel, flat=None, lookahead=False):
+                          chain, nb, panel, flat=None, lookahead=False,
+                          agg=None):
         from jax import lax
 
         extra = {} if flat is None else {"pallas_flat": flat}
         if lookahead:
             extra["lookahead"] = True
+        if agg:
+            extra["agg_panels"] = agg
         with _Watchdog(name, watchdog):
             A = jnp.asarray(rng.random((n_, n_)), dtype=jnp.float32)
             sync(A)
@@ -524,6 +528,8 @@ def main() -> None:
                 result["pallas_flat"] = flat
             if lookahead:
                 result["lookahead"] = True
+            if agg:
+                result["agg_panels"] = agg
             if t_chain is not None:
                 result["seconds_chain"] = round(t_chain, 4)
                 result["chain_length"] = chain
@@ -708,6 +714,9 @@ def main() -> None:
     # Cold-cache program, so it sits with the experiments after the
     # headline stages (same reasoning as the split stage).
     run_stage(N, pallas=True, watchdog=420, chain=25, nb=256, lookahead=True)
+    # Aggregated-trailing-update pair (round-5): k=4 at the same config —
+    # k-fold fewer wide trailing passes (see ops/blocked._scan_panels_grouped).
+    run_stage(N, pallas=True, watchdog=420, chain=25, nb=256, agg=4)
     if not results:
         return
     # Comparison datum (never the headline); the best record is re-emitted

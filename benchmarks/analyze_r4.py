@@ -126,7 +126,7 @@ def main() -> None:
             # precision baseline sharing their (nb, flat) key
         size = int(re.search(r"(\d+)x\d+$", r["metric"]).group(1))
         key = (r.get("block_size"), r.get("pallas_flat"),
-               bool(r.get("lookahead")))
+               bool(r.get("lookahead")), r.get("agg_panels"))
         cur = by_size.setdefault(size, {})
         if key not in cur or r["value"] > cur[key]["value"]:
             cur[key] = r
@@ -139,15 +139,16 @@ def main() -> None:
             or list(variants.values())
         best = max(pool, key=lambda r: r["value"])
         print(f"  {size}:")
-        for (nb, flat, la), r in sorted(variants.items(),
-                                        key=lambda kv: -kv[1]["value"]):
+        for (nb, flat, la, agg), r in sorted(variants.items(),
+                                             key=lambda kv: -kv[1]["value"]):
             mark = " <== best" if r is best else ""
             if not _qualified(r):
                 mark = " (disqualified: accuracy)"
             tp = r.get("trailing_precision")
             tp_s = f" tp={tp}" if tp not in (None, "highest") else ""
             la_s = " lookahead" if la else ""
-            print(f"    nb={nb} flat={flat or '-'}{tp_s}{la_s}: "
+            agg_s = f" agg={agg}" if agg else ""
+            print(f"    nb={nb} flat={flat or '-'}{tp_s}{la_s}{agg_s}: "
                   f"{r['value']:.1f} GF/s{mark}")
 
     print("\n== trailing-precision pairs (baseline vs split, per size) ==")

@@ -50,8 +50,8 @@ while :; do
   if timeout -k 30 900 python benchmarks/tpu_alive_probe.py; then
     now=$(date +%s); rem=$(( DEADLINE - now ))
     if   [ "$rem" -ge 7200 ]; then
-      stages="bench split lookahead trailing phase cembed"
-    elif [ "$rem" -ge 3600 ]; then stages="bench split lookahead cembed"
+      stages="bench agg split lookahead trailing phase cembed"
+    elif [ "$rem" -ge 3600 ]; then stages="bench agg split cembed"
     elif [ "$rem" -ge 1500 ]; then stages="bench"
     else
       echo "=== relay recovered with only $rem s left; leaving the window" >&2

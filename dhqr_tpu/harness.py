@@ -79,6 +79,12 @@ def main(argv=None) -> int:
         "arithmetic — see DHQRConfig.lookahead)",
     )
     parser.add_argument(
+        "--agg-panels", type=int, default=None,
+        help="aggregate the trailing update over this many consecutive "
+        "panels (single-device blocked householder engine; see "
+        "DHQRConfig.agg_panels)",
+    )
+    parser.add_argument(
         "--profile-dir", default=None,
         help="write a jax.profiler trace here (the @profilehtml analogue)",
     )
@@ -138,6 +144,7 @@ def main(argv=None) -> int:
         "block_size": args.block_size, "panel_impl": args.panel_impl,
         "trailing_precision": args.trailing_precision,
         "lookahead": args.lookahead,
+        "agg_panels": args.agg_panels,
     }.items() if v is not None}
     cfg = DHQRConfig.from_env(**overrides)
     # block_size=None stays None: lstsq resolves it per backend/shape
@@ -183,6 +190,35 @@ def main(argv=None) -> int:
         print(f"# warning: DHQR_LOOKAHEAD ignored — it applies to the "
               f"blocked householder engines only ({why})", file=sys.stderr)
         cfg = dataclasses.replace(cfg, lookahead=False)
+    if cfg.agg_panels and cfg.lookahead:
+        # Mutually exclusive schedules. Same ambient-vs-flag split as the
+        # other knobs: two explicit flags is a hard usage error; an
+        # env-sourced half of the conflict is dropped with a warning so an
+        # ambient leftover (e.g. DHQR_LOOKAHEAD=1 from a prior sweep)
+        # cannot abort the run mid-sweep with a raw ValueError.
+        if args.agg_panels is not None and args.lookahead is not None:
+            parser.error("--agg-panels and --lookahead are mutually "
+                         "exclusive schedules")
+        if args.agg_panels is not None:  # lookahead came from the env
+            print("# warning: DHQR_LOOKAHEAD ignored — mutually exclusive "
+                  "with the explicit --agg-panels", file=sys.stderr)
+            cfg = dataclasses.replace(cfg, lookahead=False)
+        else:  # agg came from the env (lookahead explicit or also env)
+            print("# warning: DHQR_AGG_PANELS ignored — mutually exclusive "
+                  "with lookahead", file=sys.stderr)
+            cfg = dataclasses.replace(cfg, agg_panels=None)
+    if cfg.agg_panels and (cfg.engine != "householder" or not cfg.blocked
+                           or ndev > 1):
+        why = (f"engine={cfg.engine}" if cfg.engine != "householder"
+               else "blocked=False" if not cfg.blocked
+               else f"mesh size {ndev} (single-device only for now)")
+        if args.agg_panels is not None:
+            parser.error(f"--agg-panels applies to the single-device "
+                         f"blocked householder engine only ({why})")
+        print(f"# warning: DHQR_AGG_PANELS ignored — it applies to the "
+              f"single-device blocked householder engine only ({why})",
+              file=sys.stderr)
+        cfg = dataclasses.replace(cfg, agg_panels=None)
     print(f"# devices: {len(jax.devices())} ({jax.default_backend()}), "
           f"mesh size: {ndev}, engine: {cfg.engine}"
           + ("" if row_engine else f", layout: {cfg.layout}"))

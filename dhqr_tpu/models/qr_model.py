@@ -34,6 +34,22 @@ from dhqr_tpu.utils.config import DHQRConfig
 LSTSQ_ENGINES = ("householder", "tsqr", "cholqr2", "cholqr3")
 
 
+def _check_sched_knobs(cfg: DHQRConfig) -> None:
+    """Shared schedule-knob validation for qr() and lstsq() — the ops-level
+    wrapper also checks, but lstsq's jitted route bypasses it, and a bad
+    value must not be silently ignored there."""
+    if cfg.agg_panels is not None and cfg.agg_panels < 2:
+        raise ValueError(
+            f"agg_panels must be >= 2 (got {cfg.agg_panels}); "
+            "None means per-panel updates"
+        )
+    if cfg.agg_panels and cfg.lookahead:
+        raise ValueError(
+            "agg_panels and lookahead are mutually exclusive (the grouped "
+            "schedule has no pending-panel reorder yet)"
+        )
+
+
 def _check_panel_impl(cfg: DHQRConfig) -> None:
     """Shared panel_impl validation for qr() and lstsq()."""
     if cfg.panel_impl not in ("loop", "recursive"):
@@ -199,6 +215,7 @@ def qr(
             "tsqr/cholqr engines are lstsq-only fast paths"
         )
     _check_panel_impl(cfg)
+    _check_sched_knobs(cfg)
     if cfg.refine:
         raise ValueError(
             "refine applies to lstsq() only — qr() returns the raw "
@@ -231,6 +248,12 @@ def qr(
         # sliced back there) — recomputed here so the factorization object
         # records the panel width the solve stage will reuse.
         nb, _ = plan_padding(A.shape[1], mesh.shape[col_axis], cfg.block_size)
+        if cfg.agg_panels:
+            raise ValueError(
+                "agg_panels is single-device only for now (the sharded "
+                "aggregated update needs owner-contiguous group slicing "
+                "— see ops/blocked._scan_panels_grouped)"
+            )
         if cfg.blocked:
             H, alpha = _sharded.sharded_blocked_qr(
                 A, mesh, block_size=nb, axis_name=col_axis,
@@ -256,13 +279,13 @@ def qr(
             use_pallas=cfg.use_pallas, norm=cfg.norm,
             panel_impl=cfg.panel_impl,
             trailing_precision=cfg.trailing_precision,
-            lookahead=cfg.lookahead,
+            lookahead=cfg.lookahead, agg_panels=cfg.agg_panels,
         )
     else:
         if donate:
             raise ValueError("donate=True is only supported on the blocked path")
         _reject_nonblocked_knobs(cfg.use_pallas, cfg.trailing_precision,
-                                 cfg.lookahead)
+                                 cfg.lookahead, cfg.agg_panels)
         H, alpha = _hh.householder_qr(A, precision=cfg.precision, norm=cfg.norm)
     return QRFactorization(
         H, alpha, block_size=cfg.block_size, precision=cfg.precision
@@ -295,7 +318,8 @@ def qr_explicit(
 
 def _reject_nonblocked_knobs(use_pallas: str,
                              trailing_precision: "str | None",
-                             lookahead: bool = False) -> None:
+                             lookahead: bool = False,
+                             agg_panels: "int | None" = None) -> None:
     """Refuse blocked-only knobs on an unblocked path — one place, so a
     future blocked-only knob (or message tweak) cannot silently drift
     between the qr/lstsq tiers (code-review r4)."""
@@ -313,6 +337,11 @@ def _reject_nonblocked_knobs(use_pallas: str,
         raise ValueError(
             "lookahead applies to the blocked engines only (the unblocked "
             "panel loop has no panel-level schedule to reorder)"
+        )
+    if agg_panels:
+        raise ValueError(
+            "agg_panels applies to the blocked engines only (the unblocked "
+            "panel loop has no panel-level updates to aggregate)"
         )
 
 
@@ -339,6 +368,11 @@ def _validate_alt_engine_cfg(cfg: DHQRConfig) -> None:
     if cfg.lookahead:
         raise ValueError(
             "lookahead applies to the blocked householder engines only "
+            f"(engine={cfg.engine!r})"
+        )
+    if cfg.agg_panels:
+        raise ValueError(
+            "agg_panels applies to the blocked householder engines only "
             f"(engine={cfg.engine!r})"
         )
 
@@ -379,7 +413,7 @@ def _lstsq_refined(A, b, cfg: DHQRConfig, mesh):
             norm=cfg.norm, panel_impl=cfg.panel_impl, refine=cfg.refine,
             pallas_flat=_blocked.PALLAS_FLAT_WIDTH,
             trailing_precision=cfg.trailing_precision,
-            lookahead=cfg.lookahead,
+            lookahead=cfg.lookahead, agg_panels=cfg.agg_panels,
         )
     fact = qr(A, config=dataclasses.replace(cfg, refine=0), mesh=mesh)
     x = fact.solve(b)
@@ -463,10 +497,11 @@ def _lstsq_alt_engine(A, b, cfg: DHQRConfig, mesh):
 
 @partial(jax.jit, static_argnames=(
     "block_size", "blocked", "precision", "use_pallas", "norm", "panel_impl",
-    "refine", "pallas_flat", "trailing_precision", "lookahead"))
+    "refine", "pallas_flat", "trailing_precision", "lookahead", "agg_panels"))
 def _lstsq_impl(A, b, block_size, blocked, precision, use_pallas,
                 norm="accurate", panel_impl="loop", refine=0,
-                pallas_flat=None, trailing_precision=None, lookahead=False):
+                pallas_flat=None, trailing_precision=None, lookahead=False,
+                agg_panels=None):
     if blocked:
         from dhqr_tpu.ops.differentiable import lstsq_diff
 
@@ -478,8 +513,9 @@ def _lstsq_impl(A, b, block_size, blocked, precision, use_pallas,
         # public lstsq at every refine level
         return lstsq_diff(A, b, block_size, precision, pallas, interp, norm,
                           panel_impl, refine, pallas_flat, trailing_precision,
-                          lookahead)
-    _reject_nonblocked_knobs(use_pallas, trailing_precision, lookahead)
+                          lookahead, agg_panels)
+    _reject_nonblocked_knobs(use_pallas, trailing_precision, lookahead,
+                             agg_panels)
     H, alpha = _hh.householder_qr(A, precision=precision, norm=norm)
 
     def qr_solve(rhs):
@@ -626,6 +662,7 @@ def lstsq(
             f"norm must be 'accurate' or 'fast', got {cfg.norm!r}"
         )
     _check_panel_impl(cfg)
+    _check_sched_knobs(cfg)
     if cfg.engine not in LSTSQ_ENGINES:
         raise ValueError(
             f"unknown engine {cfg.engine!r}: expected one of {LSTSQ_ENGINES}"
@@ -655,12 +692,13 @@ def lstsq(
                 "single-device householder path (minimum-norm solve)"
             )
         if not cfg.blocked or cfg.use_pallas != "auto" \
-                or cfg.trailing_precision is not None or cfg.lookahead:
+                or cfg.trailing_precision is not None or cfg.lookahead \
+                or cfg.agg_panels:
             raise ValueError(
                 "m < n supports only the default blocked XLA path "
                 f"(got blocked={cfg.blocked}, use_pallas={cfg.use_pallas!r}, "
                 f"trailing_precision={cfg.trailing_precision!r}, "
-                f"lookahead={cfg.lookahead})"
+                f"lookahead={cfg.lookahead}, agg_panels={cfg.agg_panels})"
             )
         if cfg.refine:
             raise ValueError(
@@ -683,6 +721,11 @@ def lstsq(
         )
         from dhqr_tpu.parallel.sharded_solve import sharded_lstsq, sharded_solve
 
+        if cfg.agg_panels:
+            raise ValueError(
+                "agg_panels is single-device only for now (the sharded "
+                "aggregated update needs owner-contiguous group slicing)"
+            )
         col_axis = cfg.mesh_axis or DEFAULT_AXIS
         if not cfg.blocked:
             _reject_nonblocked_knobs(cfg.use_pallas, cfg.trailing_precision,
@@ -720,5 +763,5 @@ def lstsq(
         norm=cfg.norm, panel_impl=cfg.panel_impl,
         pallas_flat=_blocked.PALLAS_FLAT_WIDTH,
         trailing_precision=cfg.trailing_precision,
-        lookahead=cfg.lookahead,
+        lookahead=cfg.lookahead, agg_panels=cfg.agg_panels,
     )

@@ -202,6 +202,92 @@ def _scan_panels(S, pcount, nb, precision, pallas, pallas_interpret,
     return S, alphas.reshape(pcount * nb)
 
 
+def _scan_panels_grouped(S, pcount, nb, k, precision, pallas,
+                         pallas_interpret, norm="accurate", panel_impl="loop",
+                         gemm_precision=None, pallas_flat=None):
+    """Aggregated-update twin of :func:`_scan_panels` (same contract).
+
+    Panels still factor at ``nb`` width (the Pallas/VMEM-optimal grain),
+    but the trailing matrix right of a GROUP of ``k`` consecutive panels
+    is updated ONCE per group, by the group's aggregated compact-WY
+    transform — with the tau=1 convention the aggregate factor is just
+    ``wy_upper`` of the k panels' packed reflectors side by side, so no
+    new recurrence is needed. Wide trailing passes drop k-fold (each one
+    carries fixed per-pass cost: T-factor, masking, fusion overhead — the
+    "other" bucket in docs/DESIGN.md's 16384^2 ceiling arithmetic), and
+    the in-group interior applies shrink from full remaining width to the
+    group's k*nb columns (removing the masked-flop waste of the per-panel
+    scan). The price is the larger aggregate T-solve and Y^H Y GEMM,
+    ~O(m (k nb)^2) per group — a few percent of total flops at k <= 4.
+
+    Groups of k panels run under ``lax.scan`` (panel loop unrolled k-wide
+    inside the body — program size scales with k, not pcount); a
+    remainder of ``pcount % k`` panels falls back to the per-panel scan.
+    """
+    ms, ns = S.shape
+
+    def factor(panel, off):
+        if pallas:
+            return _panel_factor_pallas(panel, off, precision,
+                                        pallas_interpret, base=pallas_flat)
+        return _panel_factor(panel, off, precision, norm, panel_impl)
+
+    ngroups, rem = pcount // k, pcount % k
+    W = k * nb
+
+    def body(S, g):
+        cg = g * W  # group's first column (and diagonal row) within S
+        G = lax.dynamic_slice(S, (jnp.int32(0), cg), (ms, W))
+        alphas = []
+        for j in range(k):  # static unroll: program size ~ k
+            c = j * nb
+            with jax.named_scope("panel_factor"):
+                panel = lax.slice(G, (0, c), (ms, c + nb))
+                pf, a_j = factor(panel, cg + c)
+                G = lax.dynamic_update_slice(G, pf,
+                                             (jnp.int32(0), jnp.int32(c)))
+            alphas.append(a_j)
+            if j < k - 1:
+                with jax.named_scope("group_interior_update"):
+                    # c is a STATIC unrolled offset, so the interior apply
+                    # slices the not-yet-factored columns directly — no
+                    # masked-flop waste (unlike the per-panel scan, whose
+                    # traced offset forces full-width compute + mask).
+                    Y = shifted_tril(pf, cg + c)
+                    Gr = lax.slice(G, (0, c + nb), (ms, W))
+                    G = G.at[:, c + nb :].set(
+                        apply_block_reflector_h(
+                            Y, Gr, precision,
+                            gemm_precision=gemm_precision))
+        S = lax.dynamic_update_slice(S, G, (jnp.int32(0), cg))
+        with jax.named_scope("trailing_update_agg"):
+            Yg = shifted_tril(G, cg)  # all k panels' reflectors, tau=1
+            C_new = apply_block_reflector_h(
+                Yg, S, precision, gemm_precision=gemm_precision)
+            cmask = lax.iota(jnp.int32, ns) >= cg + W
+            S = jnp.where(cmask[None, :], C_new, S)
+        return S, jnp.concatenate(alphas)
+
+    alpha_parts = []
+    if ngroups:
+        S, alphas = lax.scan(body, S, jnp.arange(ngroups, dtype=jnp.int32))
+        alpha_parts.append(alphas.reshape(ngroups * W))
+    if rem:
+        # Trailing pcount % k panels: per-panel scan on the remaining
+        # slice (rows/cols past the grouped region), exactly the default
+        # path's semantics.
+        C0 = ngroups * W
+        S_rem = lax.slice(S, (C0, C0), (ms, ns))
+        S_rem, alpha_rem = _scan_panels(
+            S_rem, rem, nb, precision, pallas, pallas_interpret, norm=norm,
+            panel_impl=panel_impl, gemm_precision=gemm_precision,
+            pallas_flat=pallas_flat,
+        )
+        S = S.at[C0:, C0:].set(S_rem)
+        alpha_parts.append(alpha_rem)
+    return S, jnp.concatenate(alpha_parts)
+
+
 def _scan_panels_lookahead(S, pcount, nb, precision, pallas, pallas_interpret,
                            norm="accurate", panel_impl="loop",
                            gemm_precision=None, pallas_flat=None):
@@ -331,12 +417,13 @@ def _unrolled_lookahead(A, nb, precision, pallas, pallas_interpret, norm,
     jax.jit,
     static_argnames=("block_size", "precision", "pallas", "pallas_interpret",
                      "norm", "panel_impl", "trailing_precision",
-                     "pallas_flat", "lookahead"),
+                     "pallas_flat", "lookahead", "agg_panels"),
 )
 def _blocked_qr_impl(
     A, block_size, precision=DEFAULT_PRECISION, pallas=False,
     pallas_interpret=False, norm="accurate", panel_impl="loop",
     trailing_precision=None, pallas_flat=None, lookahead=False,
+    agg_panels=None,
 ):
     from dhqr_tpu.ops.pallas_panel import pallas_panel_supported
 
@@ -395,17 +482,32 @@ def _blocked_qr_impl(
     # is needed; the outer loop exists purely to re-slice shapes.
     H = A
     alpha = jnp.zeros((n,), dtype=A.dtype)
+    # With aggregation, the super-block size must admit at least one full
+    # k-panel group, or every super-block falls into the grouped scan's
+    # remainder fallback and agg_panels silently measures the default
+    # schedule (code-review r5): round ppo UP to a multiple of k. The
+    # super-block count only shrinks, so program size stays bounded.
+    if agg_panels and agg_panels > 1:
+        ppo = -(-ppo // agg_panels) * agg_panels
     for ob in range(0, num_full, ppo):
         pcount = min(ppo, num_full - ob)
         K = ob * nb
         S = lax.slice(H, (K, K), (m, n))
         blk_pallas = pallas and pallas_panel_supported(
             m - K, min(nb, flat), A.dtype)
-        scan_fn = _scan_panels_lookahead if lookahead else _scan_panels
-        S, alpha_blk = scan_fn(
-            S, pcount, nb, precision, blk_pallas, pallas_interpret, norm=norm,
-            panel_impl=panel_impl, gemm_precision=tprec, pallas_flat=flat,
-        )
+        if agg_panels and agg_panels > 1:
+            S, alpha_blk = _scan_panels_grouped(
+                S, pcount, nb, agg_panels, precision, blk_pallas,
+                pallas_interpret, norm=norm, panel_impl=panel_impl,
+                gemm_precision=tprec, pallas_flat=flat,
+            )
+        else:
+            scan_fn = _scan_panels_lookahead if lookahead else _scan_panels
+            S, alpha_blk = scan_fn(
+                S, pcount, nb, precision, blk_pallas, pallas_interpret,
+                norm=norm, panel_impl=panel_impl, gemm_precision=tprec,
+                pallas_flat=flat,
+            )
         H = H.at[K:, K:].set(S)
         alpha = alpha.at[K : K + pcount * nb].set(alpha_blk)
     if rem:
@@ -423,7 +525,7 @@ _blocked_qr_impl_donate = partial(
     jax.jit,
     static_argnames=("block_size", "precision", "pallas", "pallas_interpret",
                      "norm", "panel_impl", "trailing_precision",
-                     "pallas_flat", "lookahead"),
+                     "pallas_flat", "lookahead", "agg_panels"),
     donate_argnums=(0,),
 )(_blocked_qr_impl.__wrapped__)
 
@@ -551,6 +653,7 @@ def blocked_householder_qr(
     panel_impl: str = "loop",
     trailing_precision: "str | None" = None,
     lookahead: bool = False,
+    agg_panels: "int | None" = None,
 ):
     """Factor ``A`` (m x n, m >= n): returns ``(H, alpha)`` in packed storage.
 
@@ -587,6 +690,13 @@ def blocked_householder_qr(
     schedule to roundoff in the GEMM column split; the scheduling freedom
     matters most on the sharded tier, where it lets the panel psum overlap
     the trailing GEMM.
+
+    ``agg_panels=k`` (k > 1) applies the trailing update once per k
+    consecutive panels as the group's aggregated compact-WY transform
+    (see :func:`_scan_panels_grouped`): k-fold fewer wide trailing
+    passes, at ~O(m (k nb)^2) extra aggregate-T flops per group. Takes
+    effect on the scanned (two-level) path — small problems on the
+    fully-unrolled path ignore it; mutually exclusive with ``lookahead``.
     """
     from dhqr_tpu.utils.platform import ensure_complex_supported
 
@@ -595,6 +705,14 @@ def blocked_householder_qr(
         raise ValueError(f"blocked_householder_qr requires m >= n, got {A.shape}")
     if norm not in ("accurate", "fast"):
         raise ValueError(f"norm must be 'accurate' or 'fast', got {norm!r}")
+    if agg_panels is not None and agg_panels < 2:
+        raise ValueError(f"agg_panels must be >= 2 (got {agg_panels}); "
+                         "None means per-panel updates")
+    if agg_panels and lookahead:
+        raise ValueError(
+            "agg_panels and lookahead are mutually exclusive (the grouped "
+            "schedule has no pending-panel reorder yet)"
+        )
     ensure_complex_supported(A.dtype)
     nb = auto_block_size(m, A.dtype, use_pallas) if block_size is None \
         else int(block_size)
@@ -605,7 +723,8 @@ def blocked_householder_qr(
                 trailing_precision=trailing_precision,
                 # explicit (not the in-trace default) so the module global
                 # participates in the jit cache key via this wrapper
-                pallas_flat=PALLAS_FLAT_WIDTH, lookahead=lookahead)
+                pallas_flat=PALLAS_FLAT_WIDTH, lookahead=lookahead,
+                agg_panels=agg_panels)
 
 
 @partial(jax.jit, static_argnames=("block_size", "precision"))

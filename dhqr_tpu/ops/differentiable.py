@@ -40,12 +40,13 @@ from dhqr_tpu.ops.householder import DEFAULT_PRECISION
 from dhqr_tpu.ops.solve import back_substitute, r_matrix
 
 
-@partial(jax.custom_jvp, nondiff_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 10, 11))
+@partial(jax.custom_jvp,
+         nondiff_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12))
 def lstsq_diff(
     A, b, block_size=DEFAULT_BLOCK_SIZE, precision=DEFAULT_PRECISION,
     pallas=False, pallas_interpret=False, norm="accurate",
     panel_impl="loop", refine=0, pallas_flat=None, trailing_precision=None,
-    lookahead=False,
+    lookahead=False, agg_panels=None,
 ):
     """``x = argmin ||A x - b||`` with closed-form O(1)-memory derivatives.
 
@@ -60,14 +61,14 @@ def lstsq_diff(
     """
     x, _ = _lstsq_fwd(A, b, block_size, precision, pallas, pallas_interpret,
                       norm, panel_impl, refine, pallas_flat,
-                      trailing_precision, lookahead)
+                      trailing_precision, lookahead, agg_panels)
     return x
 
 
 def _lstsq_fwd(A, b, block_size, precision, pallas=False,
                pallas_interpret=False, norm="accurate", panel_impl="loop",
                refine=0, pallas_flat=None, trailing_precision=None,
-               lookahead=False):
+               lookahead=False, agg_panels=None):
     if pallas_flat is None:
         # Resolve the module global HERE (call time), not via
         # _blocked_qr_impl's in-trace default — the explicit static arg
@@ -81,6 +82,7 @@ def _lstsq_fwd(A, b, block_size, precision, pallas=False,
         pallas=pallas, pallas_interpret=pallas_interpret, norm=norm,
         panel_impl=panel_impl, pallas_flat=pallas_flat,
         trailing_precision=trailing_precision, lookahead=lookahead,
+        agg_panels=agg_panels,
     )
 
     def qr_solve(rhs):
@@ -98,12 +100,13 @@ def _lstsq_fwd(A, b, block_size, precision, pallas=False,
 @lstsq_diff.defjvp
 def _lstsq_jvp(block_size, precision, pallas, pallas_interpret, norm,
                panel_impl, refine, pallas_flat, trailing_precision,
-               lookahead, primals, tangents):
+               lookahead, agg_panels, primals, tangents):
     A, b = primals
     dA, db = tangents
     x, (_, _, H, alpha, _) = _lstsq_fwd(
         A, b, block_size, precision, pallas, pallas_interpret, norm,
-        panel_impl, refine, pallas_flat, trailing_precision, lookahead
+        panel_impl, refine, pallas_flat, trailing_precision, lookahead,
+        agg_panels
     )
     m, n = A.shape
     vec = x.ndim == 1

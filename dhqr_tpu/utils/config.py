@@ -79,6 +79,15 @@ class DHQRConfig:
         unchanged — results match the default schedule to the roundoff
         of the GEMM column split. Default False until the hardware
         ladder (benchmarks/tpu_lookahead_probe.py) justifies flipping.
+      agg_panels: aggregate the trailing update over k consecutive
+        panels (single-device blocked householder engine, scanned path):
+        panels still factor at ``block_size`` width, but the matrix right
+        of each k-panel group is updated once, by the group's aggregated
+        compact-WY transform — k-fold fewer wide trailing passes at
+        ~O(m (k nb)^2) extra aggregate-T flops per group (see
+        ops/blocked._scan_panels_grouped). None (default) = per-panel
+        updates; mutually exclusive with ``lookahead``; not yet available
+        on the mesh tier.
       refine: iterative-refinement steps for ``lstsq`` (0 = off). Each
         step reuses the factorization: ``r = b - A x; x += solve(r)`` —
         one matvec plus one extra solve, a few percent of the
@@ -104,6 +113,7 @@ class DHQRConfig:
     refine: int = 0
     trailing_precision: "str | None" = None
     lookahead: bool = False
+    agg_panels: "int | None" = None
 
     @staticmethod
     def from_env(**overrides) -> "DHQRConfig":
@@ -136,5 +146,8 @@ class DHQRConfig:
         if "DHQR_LOOKAHEAD" in os.environ:
             env["lookahead"] = os.environ["DHQR_LOOKAHEAD"].strip().lower() \
                 not in ("0", "false", "no", "off", "n", "")
+        if "DHQR_AGG_PANELS" in os.environ:
+            raw = os.environ["DHQR_AGG_PANELS"].strip()
+            env["agg_panels"] = int(raw) if raw and raw != "0" else None
         env.update(overrides)
         return DHQRConfig(**env)
