@@ -1,0 +1,81 @@
+"""CPU flop-overhead control for the round-5 schedule knobs.
+
+The TPU upside of ``agg_panels`` is fewer wide trailing passes (fixed
+per-pass cost); its downside is the extra aggregate-T flops. A CPU
+timing at a flop-bound size isolates the DOWNSIDE: XLA-CPU has no MXU
+pass structure to save, so the agg-vs-default CPU delta is an upper
+bound on the pure extra-flop cost the TPU must amortize. Lookahead is
+measured the same way (expected ~neutral: same flops, reordered).
+
+Emits one JSON line per config into stdout (append to
+``results/agg_cpu_control.jsonl`` via the shell). CPU-only by
+construction — never touches the TPU relay.
+
+Usage: python benchmarks/agg_cpu_control.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from _axon_env import default_to_virtual_cpu
+
+default_to_virtual_cpu(n_devices=1, optin_env="DHQR_NEVER_SET")
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dhqr_tpu.ops.blocked import _blocked_qr_impl
+    from dhqr_tpu.utils.profiling import sync
+
+    rng = np.random.default_rng(0)
+    # Two regimes: (2048, 64) keeps the aggregate-T small relative to the
+    # trailing work; (4096, 128) doubles the group width (W = k*nb up to
+    # 512), where the extra aggregate-T flops should start to show.
+    for n, nb in ((2048, 64), (4096, 128)):
+        A = jnp.asarray(rng.random((n, n)), jnp.float32)
+        flops = (4.0 / 3.0) * n**3
+
+        def timed(**kw):
+            c = _blocked_qr_impl.lower(A, nb, precision="highest",
+                                       norm="fast", **kw).compile()
+            H, al = c(A)
+            sync(al)
+            ts = []
+            for _ in range(5):  # min-of-5: shared-host CPU jitter is
+                t0 = time.perf_counter()  # easily +-10% run to run
+                H, al = c(A)
+                sync(al)
+                ts.append(time.perf_counter() - t0)
+            return min(ts)
+
+        base = timed()
+        rows = [{"schedule": "default", "seconds": round(base, 4)}]
+        for k in (2, 4):
+            t = timed(agg_panels=k)
+            rows.append({"schedule": f"agg{k}", "seconds": round(t, 4),
+                         "vs_default": round(t / base, 4)})
+        t = timed(lookahead=True)
+        rows.append({"schedule": "lookahead", "seconds": round(t, 4),
+                     "vs_default": round(t / base, 4)})
+        for r in rows:
+            r.update({"metric": "qr_cpu_flop_control", "n": n,
+                      "block_size": nb,
+                      "gflops": round(flops / r["seconds"] / 1e9, 1),
+                      "platform": "cpu"})
+            print(json.dumps(r), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -113,9 +113,14 @@ def main() -> None:
         size = re.search(r"(\d+)x\d+$", r["metric"]).group(1)
         # `or`-normalized: an explicit null in the row reaches .get() as
         # None, which would TypeError under the width format (ADVICE r4).
+        # Schedule markers: an aggregated/lookahead row that tops the
+        # table must not read as the default engine's headline.
+        sched = ("" if not r.get("agg_panels") else
+                 f" agg={r['agg_panels']}") + \
+                ("" if not r.get("lookahead") else " lookahead")
         print(f"  {size:>6}  nb={r.get('block_size') or '?':>4} "
               f"flat={r.get('pallas_flat') or '-':>4} "
-              f"{r['value']:>9.1f} GF/s   [{r['_artifact']}]")
+              f"{r['value']:>9.1f} GF/s{sched}   [{r['_artifact']}]")
 
     print("\n== split/width ladder by size ==")
     by_size: dict = {}

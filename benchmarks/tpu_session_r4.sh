@@ -97,3 +97,8 @@ for s in $STAGES; do
     *) echo "unknown stage $s" >&2; exit 1 ;;
   esac
 done
+
+# Durable decision table the moment the session ends — the analysis must
+# not depend on someone remembering to run it before the round closes.
+python benchmarks/analyze_r4.py > "$RES/analysis_${R}.txt" 2>&1 || true
+echo "=== analysis written to $RES/analysis_${R}.txt" >&2

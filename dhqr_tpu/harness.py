@@ -78,10 +78,22 @@ def main(argv=None) -> int:
         "engines (panel psum overlaps the trailing GEMM; same per-column "
         "arithmetic — see DHQRConfig.lookahead)",
     )
+    def _agg_panels_arg(raw: str) -> int:
+        # Parse-time validation so a bad value dies as a clean usage error
+        # BEFORE backend bring-up; "0" means off, matching the
+        # DHQR_AGG_PANELS env spelling (config.py). The 0 survives to the
+        # overrides merge (so an explicit --agg-panels 0 cancels an
+        # ambient env value) and is normalized to None after.
+        v = int(raw)
+        if v == 1 or v < 0:
+            raise __import__("argparse").ArgumentTypeError(
+                f"must be 0 (off) or >= 2, got {v}")
+        return v
+
     parser.add_argument(
-        "--agg-panels", type=int, default=None,
+        "--agg-panels", type=_agg_panels_arg, default=None,
         help="aggregate the trailing update over this many consecutive "
-        "panels (single-device blocked householder engine; see "
+        "panels; 0 = off (single-device blocked householder engine; see "
         "DHQRConfig.agg_panels)",
     )
     parser.add_argument(
@@ -147,6 +159,8 @@ def main(argv=None) -> int:
         "agg_panels": args.agg_panels,
     }.items() if v is not None}
     cfg = DHQRConfig.from_env(**overrides)
+    if cfg.agg_panels == 0:  # explicit --agg-panels 0 = off (see above)
+        cfg = dataclasses.replace(cfg, agg_panels=None)
     # block_size=None stays None: lstsq resolves it per backend/shape
     # (ops/blocked.auto_block_size - the measured nb=256/512 TPU optimum).
     row_engine = cfg.engine != "householder"
