@@ -191,7 +191,7 @@ def _parse_last_json(out: str):
     return None
 
 
-def _run_child(env: dict, timeout: int) -> dict:
+def _run_child(env: dict, timeout: int, init_deadline: "int | None" = None) -> dict:
     """Run the bench child; return attempt record (json line or failure info).
 
     Child stdout/stderr go to temp files, not pipes: on a timeout the
@@ -200,18 +200,57 @@ def _run_child(env: dict, timeout: int) -> dict:
     yields its result — the child prints the metric line as soon as it
     exists (see ``main``), and the supervisor takes the LAST parseable
     JSON line either way.
+
+    ``init_deadline`` (used when the watcher's fresh probe says the relay
+    is wedged): give the child only this long to pass ``backend_init`` —
+    the supervisor polls the child's stderr for the ``backend_ready``
+    stage marker, and a child that shows it gets the FULL ``timeout``
+    (the relay recovered; killing a now-healthy run mid-compile would
+    both lose the headline and risk re-wedging the relay — code-review
+    r5). Backend init issues no remote compile, so the early kill on a
+    still-wedged relay is wedge-safe.
     """
     import tempfile
 
     with tempfile.TemporaryFile("w+") as fout, \
-            tempfile.TemporaryFile("w+") as ferr:
+            tempfile.NamedTemporaryFile("w+", suffix=".err") as ferr:
         proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__)],
             env=env, stdout=fout, stderr=ferr, text=True,
         )
         killed = timed_out = False
+        t_start = time.time()
+
+        def _wait_full():
+            # One overall budget: the init poll spends from the same
+            # ``timeout`` wallet, so a ready child never extends the
+            # supervisor's total window past what the driver allotted.
+            proc.wait(timeout=max(1.0, timeout - (time.time() - t_start)))
+
         try:
-            proc.wait(timeout=timeout)
+            if init_deadline:
+                t0 = time.time()
+                ready = False
+                while time.time() - t0 < init_deadline:
+                    if proc.poll() is not None:
+                        break
+                    with open(ferr.name) as f:
+                        if "::stage backend_ready" in f.read():
+                            ready = True
+                            break
+                    time.sleep(5)
+                if proc.poll() is None and not ready:
+                    with open(ferr.name) as f:
+                        ready = "::stage backend_ready" in f.read()
+                if proc.poll() is None and not ready:
+                    print("::init_deadline child never passed backend_init "
+                          f"in {init_deadline}s — stopping the attempt",
+                          file=sys.stderr, flush=True)
+                    raise subprocess.TimeoutExpired(proc.args, init_deadline)
+                if proc.poll() is None:
+                    _wait_full()
+            else:
+                _wait_full()
         except subprocess.TimeoutExpired:
             # Graceful first: SIGTERM + grace (the child converts it to
             # sys.exit so the PJRT client shuts down and releases its
@@ -311,6 +350,24 @@ def _best_recorded_tpu() -> dict:
     return best
 
 
+def _relay_recently_wedged(max_age_s: float = 2400) -> bool:
+    """True when the watcher's last probe (within ``max_age_s``) found the
+    relay wedged. Used only to put an early ``init_deadline`` on the
+    supervised TPU attempt — never to skip it (the attempt itself
+    re-tests reality, and a child that passes backend_init gets the full
+    budget). ``max_age_s`` covers the watcher's worst verdict-refresh
+    cycle (900 s sleep + up to 900 s hung probe + slack — code-review
+    r5); absent/stale/unreadable state = False."""
+    path = os.path.join(_REPO, "benchmarks", "results", "relay_state.json")
+    try:
+        with open(path) as f:
+            st = json.load(f)
+        return (not st.get("alive", True)
+                and time.time() - float(st.get("ts", 0)) < max_age_s)
+    except (OSError, ValueError):
+        return False
+
+
 def _supervise() -> int:
     """TPU attempt first and once; CPU fallback with scrubbed env; ONE JSON line."""
     tpu_env = dict(os.environ, DHQR_BENCH_SUPERVISED="1")
@@ -320,7 +377,18 @@ def _supervise() -> int:
     tpu_env.setdefault(
         "DHQR_BENCH_TEE",
         os.path.join(_REPO, "benchmarks", "results", "bench_tpu_tee.jsonl"))
-    tpu = _run_child(tpu_env, TPU_TIMEOUT)
+    # A fresh watcher verdict of "wedged" puts an early deadline on the
+    # child's BACKEND INIT only (healthy init is ~5-20 s; 120 s is
+    # generous): a still-wedged relay is discovered in 2 minutes instead
+    # of the full TPU budget, while a recovered relay — whose child shows
+    # the backend_ready marker — keeps every second of it.
+    init_deadline = None
+    if _relay_recently_wedged():
+        init_deadline = 120
+        print("::relay_state wedged (fresh watcher probe) — child gets "
+              f"{init_deadline}s to pass backend_init, full "
+              f"{TPU_TIMEOUT}s once it does", file=sys.stderr, flush=True)
+    tpu = _run_child(tpu_env, TPU_TIMEOUT, init_deadline=init_deadline)
     if tpu["ok"]:
         print(json.dumps(tpu["result"]))
         return 0

@@ -47,7 +47,13 @@ while :; do
   # installed). 900 s is generous enough that a healthy-but-slow first
   # compile is never killed mid-flight (the wedge risk), while a truly
   # hung probe can no longer hang the watcher loop itself.
+  # Each probe outcome lands in a state file bench.py consults: a FRESH
+  # "wedged" verdict lets the round-end supervised bench shorten (never
+  # skip) its own TPU attempt instead of burning most of the driver's
+  # window re-discovering the wedge.
   if timeout -k 30 900 python benchmarks/tpu_alive_probe.py; then
+    echo "{\"ts\": $(date +%s), \"alive\": true}" \
+      > benchmarks/results/relay_state.json
     now=$(date +%s); rem=$(( DEADLINE - now ))
     if   [ "$rem" -ge 7200 ]; then
       stages="bench agg split lookahead trailing phase cembed"
@@ -61,6 +67,8 @@ while :; do
          "running: $stages" >&2
     exec bash benchmarks/tpu_session_r4.sh $stages
   fi
+  echo "{\"ts\": $(date +%s), \"alive\": false}" \
+    > benchmarks/results/relay_state.json
   echo "=== relay still wedged at $(date -u +%H:%M:%S); sleeping $SLEEP s" >&2
   sleep "$SLEEP"
 done

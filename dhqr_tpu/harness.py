@@ -86,7 +86,7 @@ def main(argv=None) -> int:
         # ambient env value) and is normalized to None after.
         v = int(raw)
         if v == 1 or v < 0:
-            raise __import__("argparse").ArgumentTypeError(
+            raise argparse.ArgumentTypeError(
                 f"must be 0 (off) or >= 2, got {v}")
         return v
 
