@@ -7,11 +7,13 @@
 # The deadline (default 22:45 UTC) is when the TPU must be FREE again so
 # the driver's own round-end bench.py run cannot collide with a session
 # still in flight (a collision can wedge the relay for both). Stage tiers
-# by time remaining at recovery, headline first:
-#   >= 120 min : bench split trailing phase cembed   (everything)
-#   >=  60 min : bench split cembed
-#   >=  25 min : bench
-#   <   25 min : give up (leave the window to the driver)
+# by time remaining at recovery, headline first — sized to the session's
+# WIDENED bench window (DHQR_BENCH_TPU_TIMEOUT=1500 in tpu_session_r4.sh:
+# the bench stage alone can hold the TPU ~28 min):
+#   >= 180 min : bench agg split lookahead trailing phase cembed  (everything)
+#   >=  90 min : bench agg split cembed
+#   >=  30 min : bench
+#   <   30 min : give up (leave the window to the driver)
 set -u
 cd "$(dirname "$0")/.."
 # One round tag for the whole chain (watcher -> session -> bench.py ->
@@ -36,8 +38,8 @@ SLEEP=900              # 15 min between probes
 while :; do
   now=$(date +%s)
   rem=$(( DEADLINE - now ))
-  if [ "$rem" -lt 1500 ]; then
-    echo "=== $(date -u +%H:%M:%S): <25 min to deadline; giving up" >&2
+  if [ "$rem" -lt 1800 ]; then
+    echo "=== $(date -u +%H:%M:%S): <30 min to deadline; giving up" >&2
     exit 2
   fi
   # Outer kernel-level kill (timeout -k): the probe's internal watchdogs
@@ -55,10 +57,10 @@ while :; do
     echo "{\"ts\": $(date +%s), \"alive\": true}" \
       > benchmarks/results/relay_state.json
     now=$(date +%s); rem=$(( DEADLINE - now ))
-    if   [ "$rem" -ge 7200 ]; then
+    if   [ "$rem" -ge 10800 ]; then
       stages="bench agg split lookahead trailing phase cembed"
-    elif [ "$rem" -ge 3600 ]; then stages="bench agg split cembed"
-    elif [ "$rem" -ge 1500 ]; then stages="bench"
+    elif [ "$rem" -ge 5400 ]; then stages="bench agg split cembed"
+    elif [ "$rem" -ge 1800 ]; then stages="bench"
     else
       echo "=== relay recovered with only $rem s left; leaving the window" >&2
       exit 2

@@ -367,3 +367,70 @@ def test_sharded_lookahead_matches_serial(mesh):
                                atol=1e-11)
     np.testing.assert_allclose(np.asarray(a1), np.asarray(a0), rtol=1e-9,
                                atol=1e-11)
+
+
+def test_lookahead_trailing_gemm_independent_of_panel_psum():
+    """Pin the lookahead overlap argument structurally (DESIGN.md): in the
+    sharded lookahead scan body, NO dot_general may transitively depend
+    on the current iteration's psums — the psum'd panel must feed only
+    the carry (consumed next iteration), or the scheduler cannot overlap
+    the collective with the wide trailing GEMM and the schedule silently
+    degenerates to the default's psum -> GEMM -> psum serialization."""
+    from functools import partial
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from dhqr_tpu.parallel import sharded_qr as SQ
+
+    mesh4 = column_mesh(4)
+    body = partial(SQ._blocked_shard_body, n=64, nb=4, axis="cols",
+                   layout="cyclic", lookahead=True)  # 16 panels: scan path
+    f = shard_map(lambda a: body(a), mesh=mesh4, in_specs=P(None, "cols"),
+                  out_specs=(P(None, "cols"), P()), check_vma=False)
+    jaxpr = jax.make_jaxpr(f)(jnp.zeros((96, 64)))
+    JaxprT = type(jaxpr.jaxpr)
+
+    scan_bodies = []
+
+    def find_scans(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "scan":
+                scan_bodies.append(eqn.params["jaxpr"].jaxpr)
+            for p in eqn.params.values():
+                inner = getattr(p, "jaxpr", p)
+                if isinstance(inner, JaxprT):
+                    find_scans(inner)
+
+    find_scans(jaxpr.jaxpr)
+    # The lookahead panel loop = the scan bodies carrying psums directly
+    # (panel-interior fori_loops also lower to scans, but psum-free).
+    la_bodies = [s for s in scan_bodies
+                 if any(e.primitive.name == "psum" for e in s.eqns)]
+    assert la_bodies, "no psum-bearing scan body found"
+    for sb in la_bodies:
+        producers = {}
+        for eqn in sb.eqns:
+            for ov in eqn.outvars:
+                producers[ov] = eqn
+        psum_ids = {id(e) for e in sb.eqns if e.primitive.name == "psum"}
+        var_t = type(sb.eqns[0].outvars[0])
+
+        def depends_on_psum(eqn, seen):
+            for iv in eqn.invars:
+                if not isinstance(iv, var_t) or iv in seen:
+                    continue
+                seen.add(iv)
+                p = producers.get(iv)
+                if p is None:
+                    continue
+                if id(p) in psum_ids or depends_on_psum(p, seen):
+                    return True
+            return False
+
+        dots = [e for e in sb.eqns if e.primitive.name == "dot_general"]
+        assert dots
+        for d in dots:
+            assert not depends_on_psum(d, set()), (
+                f"dot_general {d.outvars[0].aval.shape} depends on this "
+                "iteration's psum — lookahead overlap broken")
