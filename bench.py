@@ -785,6 +785,15 @@ def main() -> None:
     # Aggregated-trailing-update pair (round-5): k=4 at the same config —
     # k-fold fewer wide trailing passes (see ops/blocked._scan_panels_grouped).
     run_stage(N, pallas=True, watchdog=420, chain=25, nb=256, agg=4)
+    # Householder-reconstruction panels (round-5): panels via the
+    # backend's explicit QR + reconstruction instead of the serial sweep
+    # (ops/householder._panel_qr_reconstruct) — pallas=False so the
+    # panel_impl actually routes (the fused kernel bypasses it). The
+    # fastest panel engine on CPU; its TPU fate rests on XLA's QR
+    # lowering for tall-skinny shapes, measured here.
+    run_stage(N, watchdog=420, chain=25, nb=256, panel="reconstruct")
+    run_stage(3 * N, watchdog=460, chain=3, nb=512, repeats=2,
+              panel="reconstruct")
     if not results:
         return
     # Comparison datum (never the headline); the best record is re-emitted

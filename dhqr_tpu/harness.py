@@ -62,8 +62,11 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--block-size", type=int, default=None)
     parser.add_argument(
-        "--panel-impl", default=None, choices=["loop", "recursive"],
-        help="panel-interior algorithm for the blocked householder engines",
+        "--panel-impl", default=None,
+        choices=["loop", "recursive", "reconstruct"],
+        help="panel-interior algorithm for the blocked householder engines "
+        "(reconstruct: explicit QR + Householder reconstruction, real "
+        "dtypes only)",
     )
     parser.add_argument(
         "--trailing-precision", default=None,

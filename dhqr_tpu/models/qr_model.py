@@ -52,9 +52,10 @@ def _check_sched_knobs(cfg: DHQRConfig) -> None:
 
 def _check_panel_impl(cfg: DHQRConfig) -> None:
     """Shared panel_impl validation for qr() and lstsq()."""
-    if cfg.panel_impl not in ("loop", "recursive"):
+    if cfg.panel_impl not in ("loop", "recursive", "reconstruct"):
         raise ValueError(
-            f"panel_impl must be 'loop' or 'recursive', got {cfg.panel_impl!r}"
+            f"panel_impl must be 'loop', 'recursive' or 'reconstruct', "
+            f"got {cfg.panel_impl!r}"
         )
     if cfg.panel_impl != "loop" and not cfg.blocked:
         raise ValueError(

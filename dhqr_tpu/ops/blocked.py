@@ -123,16 +123,35 @@ def _panel_factor(panel, offset, precision, norm, panel_impl):
     """Panel-interior engine selector: "loop" = the masked fori_loop
     (reference-shaped numerics, one GEMV + rank-1 per column); "recursive" =
     geqrt3-style divide and conquer (panel interior on the MXU, see
-    ops/householder._panel_qr_recursive)."""
-    from dhqr_tpu.ops.householder import _panel_qr_masked, _panel_qr_recursive
+    ops/householder._panel_qr_recursive); "reconstruct" = explicit QR +
+    Householder reconstruction (real dtypes; see
+    ops/householder._panel_qr_reconstruct)."""
+    from dhqr_tpu.ops.householder import (
+        _panel_qr_masked,
+        _panel_qr_reconstruct,
+        _panel_qr_recursive,
+    )
 
     if panel_impl == "recursive":
         return _panel_qr_recursive(panel, offset, precision=precision,
                                    norm=norm)
+    if panel_impl == "reconstruct":
+        # Trace-time guard on the ONE chokepoint every route (qr, the
+        # jitted lstsq core, sharded bodies) passes through — a complex
+        # panel would otherwise produce silently wrong reflectors (the
+        # sign/LU identities below assume real arithmetic).
+        if jnp.issubdtype(panel.dtype, jnp.complexfloating):
+            raise ValueError(
+                "panel_impl='reconstruct' supports real dtypes only (the "
+                "complex variant needs the phase-tracking modified LU — "
+                "LAPACK zunhr_col; use 'loop' or 'recursive' for complex)"
+            )
+        return _panel_qr_reconstruct(panel, offset)
     if panel_impl == "loop":
         return _panel_qr_masked(panel, offset, precision=precision, norm=norm)
     raise ValueError(
-        f"panel_impl must be 'loop' or 'recursive', got {panel_impl!r}")
+        f"panel_impl must be 'loop', 'recursive' or 'reconstruct', "
+        f"got {panel_impl!r}")
 
 
 # Widest panel the fused kernel factors FLAT; wider panels split into
@@ -713,6 +732,9 @@ def blocked_householder_qr(
             "agg_panels and lookahead are mutually exclusive (the grouped "
             "schedule has no pending-panel reorder yet)"
         )
+    # (complex + panel_impl='reconstruct' is rejected at the _panel_factor
+    # chokepoint — every XLA-path route converges there, and the Pallas
+    # path legitimately ignores panel_impl, so no wrapper-level guard.)
     ensure_complex_supported(A.dtype)
     nb = auto_block_size(m, A.dtype, use_pallas) if block_size is None \
         else int(block_size)

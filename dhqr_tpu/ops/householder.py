@@ -133,6 +133,93 @@ def _panel_qr_masked(panel, offset, precision=DEFAULT_PRECISION,
     return lax.fori_loop(0, nb, step, (panel, alpha))
 
 
+def _lu_nopivot(M, base: int = 32):
+    """Unpivoted LU of a square matrix, packed: tril(P,-1)+I = L, triu(P) = U.
+
+    Recursion (left LU → two triangular solves → Schur update → right LU)
+    keeps the work in GEMMs; the base case is the textbook elimination
+    sweep. NO pivoting by design: the only caller factors ``Q1_top - S``
+    with ``S = -sign(diag Q1)``, whose diagonal is bounded away from zero
+    (|Q1_ii| + 1 in magnitude — Ballard et al., "Reconstructing
+    Householder Vectors from TSQR", the stability result behind LAPACK's
+    dorhr_col).
+    """
+    b = M.shape[0]
+    if b <= base:
+        def step(j, P):
+            piv = P[j, j]
+            idx = lax.iota(jnp.int32, b)
+            l = jnp.where(idx > j, P[:, j] / piv, 0)
+            urow = jnp.where(idx > j, P[j, :], 0)
+            P = P - jnp.outer(l, urow)
+            return P.at[:, j].set(jnp.where(idx > j, l, P[:, j]))
+
+        return lax.fori_loop(0, b - 1, step, M)
+    h = b // 2
+    P11 = _lu_nopivot(M[:h, :h], base)
+    L11 = jnp.tril(P11, -1) + jnp.eye(h, dtype=M.dtype)
+    U11 = jnp.triu(P11)
+    U12 = lax.linalg.triangular_solve(L11, M[:h, h:], left_side=True,
+                                      lower=True, unit_diagonal=True)
+    L21 = lax.linalg.triangular_solve(U11, M[h:, :h], left_side=False,
+                                      lower=False)
+    S22 = M[h:, h:] - jnp.matmul(L21, U12, precision="highest")
+    P22 = _lu_nopivot(S22, base)
+    return jnp.block([[P11, U12], [L21, P22]])
+
+
+def _panel_qr_reconstruct(panel, offset):
+    """Panel QR via explicit-Q factorization + Householder reconstruction.
+
+    Instead of the serial column sweep, factor the panel with the
+    backend's explicit QR (``jnp.linalg.qr`` — GEMM-rich internally),
+    then RECONSTRUCT the packed reflectors (our ``||v||^2 = 2``, tau = 1
+    storage) from Q: with ``S = -sign(diag Q_top)``, the unpivoted LU
+    ``Q_top - S = L (-W)`` yields unit-triangular Householder directions
+    ``Y = [L; -Q_bot W^{-1}]`` and real scales ``tau_i = W_ii / s_i``;
+    ``v_i = Y[:, i] sqrt(tau_i)`` then satisfies our convention exactly
+    (Ballard/Demmel/Grigori et al. 2014; LAPACK dorhr_col). Real dtypes
+    only — the complex variant needs the modified LU that tracks the
+    diagonal phases during elimination (LAPACK zunhr_col), not shipped.
+
+    ``offset`` may be traced: the panel is rolled so its active rows
+    (``offset:``) sit on top, the stale bottom rows are zeroed (zero rows
+    leave reflectors untouched), and the preserved R rows are restored
+    after rolling back.
+
+    (No ``precision`` knob, unlike the loop/recursive engines:
+    ``jnp.linalg.qr`` exposes none, and the reconstruction's dependent
+    triangular solves and the Schur GEMM inside :func:`_lu_nopivot` run
+    at "highest" unconditionally — they sit on the accuracy-critical
+    path.)
+    """
+    m, b = panel.shape
+    rows = lax.iota(jnp.int32, m)
+    rolled = jnp.roll(panel, -offset, axis=0)
+    live = (rows < m - offset)[:, None]
+    active = jnp.where(live, rolled, jnp.zeros_like(rolled))
+    Q1, R1 = jnp.linalg.qr(active, mode="reduced")
+    d = jnp.diagonal(Q1[:b])
+    s = jnp.where(d >= 0, -jnp.ones_like(d), jnp.ones_like(d))
+    M = Q1[:b] - jnp.diag(s)
+    P = _lu_nopivot(M)
+    L1 = jnp.tril(P, -1) + jnp.eye(b, dtype=P.dtype)
+    W = -jnp.triu(P)
+    tau = jnp.diagonal(W) / s
+    # Y2 = -Q1_bot W^{-1} (right-side upper-triangular solve)
+    Y2 = lax.linalg.triangular_solve(W, -Q1[b:], left_side=False,
+                                     lower=False)
+    scale = jnp.sqrt(jnp.maximum(tau, 0))[None, :]
+    V = jnp.concatenate([L1, Y2], axis=0) * scale
+    Rh = s[:, None] * R1
+    alpha = jnp.diagonal(Rh)
+    cols = lax.iota(jnp.int32, b)
+    top = jnp.where(cols[:b, None] < cols[None, :], Rh, V[:b])
+    packed = jnp.concatenate([top, V[b:]], axis=0)
+    merged = jnp.where(live, packed, rolled)
+    return jnp.roll(merged, offset, axis=0), alpha
+
+
 RECURSIVE_BASE_WIDTH = 32
 
 

@@ -56,11 +56,17 @@ class DHQRConfig:
         "cholqr3" (all-GEMM Cholesky passes; cholqr3 is the shifted
         wide-window form — see ops/cholqr.py for conditioning windows).
       panel_impl: panel-interior algorithm on the XLA path — "loop" (one
-        masked GEMV + rank-1 per column, the reference-shaped numerics) or
+        masked GEMV + rank-1 per column, the reference-shaped numerics),
         "recursive" (geqrt3-style divide and conquer: the panel interior
         becomes compact-WY GEMMs above a small base width — see
-        ops/householder._panel_qr_recursive). Ignored where the Pallas
-        kernel takes the panel.
+        ops/householder._panel_qr_recursive), or "reconstruct" (factor
+        the panel with the backend's explicit QR, then reconstruct the
+        packed ||v||^2=2 reflectors via the no-pivot-LU identity —
+        Ballard et al. 2014 / LAPACK dorhr_col; real dtypes only, and
+        the per-column signs follow Q's convention rather than the
+        running-pivot rule, so results are a valid but not bitwise-
+        identical factorization). Ignored where the Pallas kernel takes
+        the panel.
       trailing_precision: MXU precision for the trailing-update GEMMs
         ONLY (the blocked householder engines, single-device and
         sharded); the panel factorization and compact-WY T-factor keep

@@ -414,7 +414,12 @@ def test_lookahead_trailing_gemm_independent_of_panel_psum():
             for ov in eqn.outvars:
                 producers[ov] = eqn
         psum_ids = {id(e) for e in sb.eqns if e.primitive.name == "psum"}
-        var_t = type(sb.eqns[0].outvars[0])
+        # The base Var class, NOT type(some outvar): an equation whose
+        # first output is a DropVar (DropVar subclasses Var and appears
+        # only as an outvar) would otherwise make the filter reject every
+        # ordinary Var and the whole check pass vacuously. The intent is
+        # only to skip Literals.
+        from jax.extend.core import Var as var_t
 
         def depends_on_psum(eqn, seen):
             for iv in eqn.invars:
