@@ -237,3 +237,33 @@ class TestReconstructPanel:
             L = np.tril(P, -1) + np.eye(b)
             U = np.triu(P)
             np.testing.assert_allclose(L @ U, M, rtol=1e-10, atol=1e-10)
+
+    def test_edge_shapes_and_rank_deficiency(self):
+        """Square panels (empty Q bottom block), exact column dependency,
+        a zero column, and width-1 panels all stay finite and valid —
+        the degenerate cases the loop engine guards with its f=0 rule."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from dhqr_tpu.ops.blocked import _apply_q_impl
+        from dhqr_tpu.ops.householder import _panel_qr_reconstruct
+        from dhqr_tpu.ops.solve import r_matrix
+
+        rng = np.random.default_rng(76)
+
+        def backward(Aj, b):
+            H, al = _panel_qr_reconstruct(Aj, 0)
+            assert bool(jnp.all(jnp.isfinite(H)))
+            assert bool(jnp.all(jnp.isfinite(al)))
+            m = Aj.shape[0]
+            R = r_matrix(H, al)
+            Rf = jnp.concatenate([R, jnp.zeros((m - b, b), R.dtype)])
+            QR = _apply_q_impl(H, Rf, b, precision="highest")
+            return float(jnp.linalg.norm(QR - Aj) / jnp.linalg.norm(Aj))
+
+        assert backward(jnp.asarray(rng.standard_normal((16, 16))), 16) < 1e-13
+        B = rng.standard_normal((40, 8))
+        B[:, 4] = B[:, 2]
+        B[:, 7] = 0.0
+        assert backward(jnp.asarray(B), 8) < 1e-13
+        assert backward(jnp.asarray(rng.standard_normal((10, 1))), 1) < 1e-13
