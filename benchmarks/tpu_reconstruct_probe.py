@@ -1,0 +1,142 @@
+"""Round-5 TPU probe: Householder-reconstruction panels vs the fused sweep.
+
+``panel_impl="reconstruct"`` factors panels with the backend's explicit
+QR and reconstructs the packed reflectors (GEMM-shaped algebra;
+ops/householder._panel_qr_reconstruct); ``"reconstruct:<chunk>"`` routes
+the explicit QR through a two-level TSQR tree (batched chunk QRs + one
+combine) for backends whose monolithic tall-matrix QR lowering is slow.
+Stages measure, per (n, nb): the all-Pallas baseline (the committed
+headline config), direct reconstruct, and two tree chunk sizes — all
+with pallas=False for the reconstruct rows so the panel_impl actually
+routes.
+
+Run ONE instance at a time (the axon relay allows a single TPU process).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _stage(name: str) -> None:
+    print(f"::stage {name} t={time.time():.1f}", file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(3))
+    from bench import _Watchdog
+
+    _stage("import")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(_REPO, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    from dhqr_tpu.ops.blocked import _apply_q_impl, _blocked_qr_impl
+    from dhqr_tpu.ops.solve import r_matrix
+    from dhqr_tpu.utils.profiling import sync
+
+    _stage("backend_init")
+    with _Watchdog("backend_init", 150):
+        dev = jax.devices()[0]
+        platform = dev.platform
+        kind = getattr(dev, "device_kind", "?")
+        sync(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    _stage(f"backend_ready_{platform}")
+    rng = np.random.default_rng(0)
+
+    def emit(rec):
+        rec["platform"] = platform
+        rec["device_kind"] = kind
+        print(json.dumps(rec), flush=True)
+
+    def chain_time(n, nb, chain, watchdog, panel, pallas, repeats=3,
+                   backward_error=False):
+        name = f"qr_{n}_nb{nb}_{panel.replace(':', '-')}" + \
+            ("_pallas" if pallas else "")
+        _stage(name)
+        try:
+            with _Watchdog(name, watchdog):
+                A = jnp.asarray(rng.random((n, n)), jnp.float32)
+                sync(A)
+                kw = dict(precision="highest", pallas=pallas, norm="fast",
+                          panel_impl=panel)
+                t0 = time.perf_counter()
+                single = _blocked_qr_impl.lower(A, nb, **kw).compile()
+                H, al = single(A)
+                sync(al)
+
+                def chained(A):
+                    def body(C, _):
+                        Hc, ac = _blocked_qr_impl(C, nb, **kw)
+                        return Hc, ac[0]
+                    return lax.scan(body, A, None, length=chain)
+
+                ck = jax.jit(chained).lower(A).compile()
+                compile_s = time.perf_counter() - t0
+                Hc, s = ck(A)
+                sync(s)
+
+                def tmin(f, pick):
+                    ts = []
+                    for _ in range(repeats):
+                        t0 = time.perf_counter()
+                        r = f(A)
+                        sync(pick(r))
+                        ts.append(time.perf_counter() - t0)
+                    return min(ts)
+
+                t1 = tmin(single, lambda r: r[1])
+                tk = tmin(ck, lambda r: r[1])
+                t = (tk - t1) / (chain - 1)
+                unreliable = not (tk > t1 * 1.05 and t > 0)
+                if unreliable:
+                    t = t1
+                flops = (4.0 / 3.0) * n**3
+                rec = {"metric": f"qr_gflops_per_chip_f32_{n}x{n}",
+                       "value": round(flops / t / 1e9, 2),
+                       "unit": "GFLOP/s", "seconds": round(t, 4),
+                       "block_size": nb, "panel_impl": panel,
+                       "pallas_panels": pallas,
+                       "chain_length": chain,
+                       "seconds_single_dispatch": round(t1, 4),
+                       "seconds_chain": round(tk, 4),
+                       "compile_seconds": round(compile_s, 2),
+                       "chain_unreliable": unreliable}
+                if backward_error:
+                    QR = _apply_q_impl(H, r_matrix(H, al), nb,
+                                       precision="highest")
+                    rec[f"backward_error_{n}"] = float(
+                        jnp.linalg.norm(QR - A) / jnp.linalg.norm(A))
+                emit(rec)
+        except Exception as ex:
+            emit({"metric": name, "ok": False,
+                  "error": f"{type(ex).__name__}: {ex}"[:400]})
+
+    # Accuracy first (cheap); baseline half of each group is the
+    # committed-config control. Smallest-first; tree chunks bracket the
+    # VMEM-friendly range.
+    chain_time(1024, 256, 5, 240, "reconstruct", False, backward_error=True)
+    chain_time(4096, 256, 25, 560, "loop", True)            # baseline
+    chain_time(4096, 256, 25, 560, "reconstruct", False)
+    chain_time(4096, 256, 25, 560, "reconstruct:1024", False)
+    chain_time(4096, 256, 25, 560, "reconstruct:2048", False)
+    chain_time(12288, 512, 3, 580, "loop", True, repeats=2)  # baseline
+    chain_time(12288, 512, 3, 580, "reconstruct", False, repeats=2)
+    chain_time(12288, 512, 3, 580, "reconstruct:2048", False, repeats=2)
+    _stage("done")
+
+
+if __name__ == "__main__":
+    main()

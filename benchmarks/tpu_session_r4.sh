@@ -15,6 +15,7 @@
 #   split     - split-panel ladder      -> tpu_${R}_split.jsonl
 #   lookahead - lookahead-vs-default pairs -> tpu_${R}_lookahead.jsonl
 #   agg       - aggregated-trailing-update ladder -> tpu_${R}_agg.jsonl
+#   reconstruct - reconstruction-panel ladder -> tpu_${R}_reconstruct.jsonl
 #   trailing  - trailing-precision pairs -> tpu_${R}_trailing.jsonl
 #   phase     - 16384^2 phase breakdown -> tpu_${R}_phase16k.jsonl
 #   cembed    - c64 lstsq via real embedding -> tpu_${R}_cembed.jsonl
@@ -28,16 +29,16 @@ RES=benchmarks/results
 _rnd="${DHQR_ROUND:-5}"; _rnd="${_rnd#r}"; _rnd="${_rnd#R}"
 R="r${_rnd}"
 mkdir -p "$RES"
-STAGES=${*:-"alive bench agg split lookahead trailing phase cembed"}
+STAGES=${*:-"alive bench agg reconstruct split lookahead trailing phase cembed"}
 
 # Validate every stage name BEFORE running anything: a typo in a later
 # argument must not abort the session after earlier multi-hundred-second
 # stages already spent the hardware window.
 for s in $STAGES; do
   case "$s" in
-    alive|bench|agg|split|lookahead|trailing|phase|cembed) ;;
-    *) echo "unknown stage '$s' (valid: alive bench agg split lookahead" \
-            "trailing phase cembed)" >&2
+    alive|bench|agg|reconstruct|split|lookahead|trailing|phase|cembed) ;;
+    *) echo "unknown stage '$s' (valid: alive bench agg reconstruct split" \
+            "lookahead trailing phase cembed)" >&2
        exit 1 ;;
   esac
 done
@@ -86,6 +87,9 @@ for s in $STAGES; do
     agg)
       probe agg "$RES/tpu_${R}_agg.jsonl" \
         python benchmarks/tpu_agg_probe.py ;;
+    reconstruct)
+      probe reconstruct "$RES/tpu_${R}_reconstruct.jsonl" \
+        python benchmarks/tpu_reconstruct_probe.py ;;
     split)
       probe split "$RES/tpu_${R}_split.jsonl" \
         python benchmarks/tpu_split_probe.py ;;

@@ -58,8 +58,12 @@ while :; do
       > benchmarks/results/relay_state.json
     now=$(date +%s); rem=$(( DEADLINE - now ))
     if   [ "$rem" -ge 10800 ]; then
-      stages="bench agg split lookahead trailing phase cembed"
-    elif [ "$rem" -ge 5400 ]; then stages="bench agg split cembed"
+      stages="bench agg reconstruct split lookahead trailing phase cembed"
+    # Mid tier DELIBERATELY swaps split for reconstruct/agg: the round-5
+    # levers outrank the round-3 split ladder when the window cannot fit
+    # both (bench ~28 min + agg ~20 + reconstruct ~20 + cembed ~10 fills
+    # the 90-min tier; split still runs in the full tier above).
+    elif [ "$rem" -ge 5400 ]; then stages="bench agg reconstruct cembed"
     elif [ "$rem" -ge 1800 ]; then stages="bench"
     else
       echo "=== relay recovered with only $rem s left; leaving the window" >&2

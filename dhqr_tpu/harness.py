@@ -61,12 +61,31 @@ def main(argv=None) -> int:
         "mesh uses the same device count)",
     )
     parser.add_argument("--block-size", type=int, default=None)
+
+    def _panel_impl_arg(raw: str) -> str:
+        # Parse-time validation mirroring qr_model._check_panel_impl, so
+        # the reconstruct:<chunk> spelling is CLI-reachable and a typo
+        # dies as a usage error before backend bring-up.
+        if raw in ("loop", "recursive"):
+            return raw
+        if raw.startswith("reconstruct"):
+            from dhqr_tpu.ops.blocked import _reconstruct_chunk
+
+            try:
+                _reconstruct_chunk(raw)
+            except ValueError as e:
+                raise argparse.ArgumentTypeError(str(e))
+            return raw
+        raise argparse.ArgumentTypeError(
+            f"must be loop, recursive, reconstruct or reconstruct:<chunk>, "
+            f"got {raw!r}")
+
     parser.add_argument(
-        "--panel-impl", default=None,
-        choices=["loop", "recursive", "reconstruct"],
-        help="panel-interior algorithm for the blocked householder engines "
-        "(reconstruct: explicit QR + Householder reconstruction, real "
-        "dtypes only)",
+        "--panel-impl", default=None, type=_panel_impl_arg,
+        help="panel-interior algorithm for the blocked householder "
+        "engines: loop, recursive, reconstruct, or reconstruct:<chunk> "
+        "(explicit QR + Householder reconstruction, optionally via a "
+        "TSQR tree; real dtypes only)",
     )
     parser.add_argument(
         "--trailing-precision", default=None,

@@ -52,10 +52,14 @@ def _check_sched_knobs(cfg: DHQRConfig) -> None:
 
 def _check_panel_impl(cfg: DHQRConfig) -> None:
     """Shared panel_impl validation for qr() and lstsq()."""
-    if cfg.panel_impl not in ("loop", "recursive", "reconstruct"):
+    if cfg.panel_impl.startswith("reconstruct"):
+        from dhqr_tpu.ops.blocked import _reconstruct_chunk
+
+        _reconstruct_chunk(cfg.panel_impl)  # raises on a malformed spelling
+    elif cfg.panel_impl not in ("loop", "recursive"):
         raise ValueError(
-            f"panel_impl must be 'loop', 'recursive' or 'reconstruct', "
-            f"got {cfg.panel_impl!r}"
+            f"panel_impl must be 'loop', 'recursive', 'reconstruct' or "
+            f"'reconstruct:<chunk>', got {cfg.panel_impl!r}"
         )
     if cfg.panel_impl != "loop" and not cfg.blocked:
         raise ValueError(

@@ -135,7 +135,7 @@ def _panel_factor(panel, offset, precision, norm, panel_impl):
     if panel_impl == "recursive":
         return _panel_qr_recursive(panel, offset, precision=precision,
                                    norm=norm)
-    if panel_impl == "reconstruct":
+    if panel_impl.startswith("reconstruct"):
         # Trace-time guard on the ONE chokepoint every route (qr, the
         # jitted lstsq core, sharded bodies) passes through — a complex
         # panel would otherwise produce silently wrong reflectors (the
@@ -146,12 +146,31 @@ def _panel_factor(panel, offset, precision, norm, panel_impl):
                 "complex variant needs the phase-tracking modified LU — "
                 "LAPACK zunhr_col; use 'loop' or 'recursive' for complex)"
             )
-        return _panel_qr_reconstruct(panel, offset)
+        return _panel_qr_reconstruct(panel, offset,
+                                     tree_chunk=_reconstruct_chunk(panel_impl))
     if panel_impl == "loop":
         return _panel_qr_masked(panel, offset, precision=precision, norm=norm)
     raise ValueError(
-        f"panel_impl must be 'loop', 'recursive' or 'reconstruct', "
-        f"got {panel_impl!r}")
+        f"panel_impl must be 'loop', 'recursive', 'reconstruct' or "
+        f"'reconstruct:<chunk>', got {panel_impl!r}")
+
+
+def _reconstruct_chunk(panel_impl: str) -> int:
+    """Row-chunk size from the ``reconstruct[:<chunk>]`` spelling (0 =
+    direct QR). Raises on malformed spellings so a typo cannot silently
+    select the direct path."""
+    if panel_impl == "reconstruct":
+        return 0
+    try:
+        chunk = int(panel_impl.split(":", 1)[1])
+        if chunk <= 0:
+            raise ValueError
+        return chunk
+    except (IndexError, ValueError):
+        raise ValueError(
+            f"malformed reconstruct spelling {panel_impl!r}: expected "
+            "'reconstruct' or 'reconstruct:<positive chunk>'"
+        ) from None
 
 
 # Widest panel the fused kernel factors FLAT; wider panels split into

@@ -168,7 +168,30 @@ def _lu_nopivot(M, base: int = 32):
     return jnp.block([[P11, U12], [L21, P22]])
 
 
-def _panel_qr_reconstruct(panel, offset):
+def _explicit_qr_tree(active, chunk: int):
+    """Reduced QR of ``active`` (m x b, zero rows allowed) via a two-level
+    TSQR tree: batched per-chunk QRs, one combine QR of the stacked R
+    factors, and a batched GEMM assembling Q — the tall-matrix work
+    becomes batched-QR + GEMM instead of one long Householder sweep.
+    Rows are zero-padded to a chunk multiple; Householder-based chunk QRs
+    keep zero rows zero, so the padded Q's bottom rows vanish and the
+    slice back to m rows stays exactly orthonormal.
+    """
+    m, b = active.shape
+    chunk = max(chunk, b)
+    pad = (-m) % chunk
+    Ap = jnp.concatenate([active, jnp.zeros((pad, b), active.dtype)]) \
+        if pad else active
+    C = Ap.shape[0] // chunk
+    blocks = Ap.reshape(C, chunk, b)
+    Qs, Rs = jax.vmap(lambda x: jnp.linalg.qr(x, mode="reduced"))(blocks)
+    Q2, R = jnp.linalg.qr(Rs.reshape(C * b, b), mode="reduced")
+    Q1 = jnp.matmul(Qs, Q2.reshape(C, b, b),
+                    precision="highest").reshape(C * chunk, b)
+    return Q1[:m], R
+
+
+def _panel_qr_reconstruct(panel, offset, tree_chunk: int = 0):
     """Panel QR via explicit-Q factorization + Householder reconstruction.
 
     Instead of the serial column sweep, factor the panel with the
@@ -192,13 +215,23 @@ def _panel_qr_reconstruct(panel, offset):
     triangular solves and the Schur GEMM inside :func:`_lu_nopivot` run
     at "highest" unconditionally — they sit on the accuracy-critical
     path.)
+
+    ``tree_chunk > 0`` computes the explicit QR through a two-level TSQR
+    tree with that row-chunk size (:func:`_explicit_qr_tree`) instead of
+    one direct ``jnp.linalg.qr`` — batched chunk QRs map better onto
+    accelerators whose monolithic tall-matrix QR lowering is slow.
+    Selected via the ``panel_impl="reconstruct:<chunk>"`` spelling, which
+    rides the existing static string through every jit cache key.
     """
     m, b = panel.shape
     rows = lax.iota(jnp.int32, m)
     rolled = jnp.roll(panel, -offset, axis=0)
     live = (rows < m - offset)[:, None]
     active = jnp.where(live, rolled, jnp.zeros_like(rolled))
-    Q1, R1 = jnp.linalg.qr(active, mode="reduced")
+    if tree_chunk:
+        Q1, R1 = _explicit_qr_tree(active, tree_chunk)
+    else:
+        Q1, R1 = jnp.linalg.qr(active, mode="reduced")
     d = jnp.diagonal(Q1[:b])
     s = jnp.where(d >= 0, -jnp.ones_like(d), jnp.ones_like(d))
     M = Q1[:b] - jnp.diag(s)

@@ -267,3 +267,37 @@ class TestReconstructPanel:
         B[:, 7] = 0.0
         assert backward(jnp.asarray(B), 8) < 1e-13
         assert backward(jnp.asarray(rng.standard_normal((10, 1))), 1) < 1e-13
+
+    def test_tree_variant_validity(self):
+        """reconstruct:<chunk> (TSQR-tree explicit QR) produces a valid
+        packed factorization, including non-dividing chunk sizes and the
+        chunk < b clamp; malformed spellings are rejected."""
+        import jax.numpy as jnp
+        import numpy as np
+        import pytest
+
+        from dhqr_tpu.ops.blocked import (
+            _apply_qt_impl,
+            _reconstruct_chunk,
+            blocked_householder_qr,
+        )
+        from dhqr_tpu.ops.solve import back_substitute
+        from dhqr_tpu.utils.testing import (
+            TOLERANCE_FACTOR,
+            normal_equations_residual,
+            oracle_residual,
+            random_problem,
+        )
+
+        A, b = random_problem(300, 256, np.float64, seed=77)
+        for pi in ("reconstruct:64", "reconstruct:40", "reconstruct:8"):
+            H, al = blocked_householder_qr(jnp.asarray(A), block_size=16,
+                                           panel_impl=pi)
+            x = back_substitute(H, al, _apply_qt_impl(H, jnp.asarray(b), 16))
+            assert normal_equations_residual(A, np.asarray(x), b) < \
+                TOLERANCE_FACTOR * max(oracle_residual(A, b), 1e-300), pi
+        assert _reconstruct_chunk("reconstruct") == 0
+        assert _reconstruct_chunk("reconstruct:128") == 128
+        for bad in ("reconstruct:", "reconstruct:-8", "reconstruct:abc"):
+            with pytest.raises(ValueError, match="malformed"):
+                _reconstruct_chunk(bad)
