@@ -117,7 +117,9 @@ def main() -> None:
         # table must not read as the default engine's headline.
         sched = ("" if not r.get("agg_panels") else
                  f" agg={r['agg_panels']}") + \
-                ("" if not r.get("lookahead") else " lookahead")
+                ("" if not r.get("lookahead") else " lookahead") + \
+                ("" if r.get("panel_impl") in ("loop", None) else
+                 f" {r['panel_impl']}")
         print(f"  {size:>6}  nb={r.get('block_size') or '?':>4} "
               f"flat={r.get('pallas_flat') or '-':>4} "
               f"{r['value']:>9.1f} GF/s{sched}   [{r['_artifact']}]")
@@ -131,7 +133,8 @@ def main() -> None:
             # precision baseline sharing their (nb, flat) key
         size = int(re.search(r"(\d+)x\d+$", r["metric"]).group(1))
         key = (r.get("block_size"), r.get("pallas_flat"),
-               bool(r.get("lookahead")), r.get("agg_panels"))
+               bool(r.get("lookahead")), r.get("agg_panels"),
+               r.get("panel_impl") or "loop")
         cur = by_size.setdefault(size, {})
         if key not in cur or r["value"] > cur[key]["value"]:
             cur[key] = r
@@ -144,8 +147,8 @@ def main() -> None:
             or list(variants.values())
         best = max(pool, key=lambda r: r["value"])
         print(f"  {size}:")
-        for (nb, flat, la, agg), r in sorted(variants.items(),
-                                             key=lambda kv: -kv[1]["value"]):
+        for (nb, flat, la, agg, pi), r in sorted(
+                variants.items(), key=lambda kv: -kv[1]["value"]):
             mark = " <== best" if r is best else ""
             if not _qualified(r):
                 mark = " (disqualified: accuracy)"
@@ -153,7 +156,8 @@ def main() -> None:
             tp_s = f" tp={tp}" if tp not in (None, "highest") else ""
             la_s = " lookahead" if la else ""
             agg_s = f" agg={agg}" if agg else ""
-            print(f"    nb={nb} flat={flat or '-'}{tp_s}{la_s}{agg_s}: "
+            pi_s = f" {pi}" if pi not in ("loop", None) else ""
+            print(f"    nb={nb} flat={flat or '-'}{tp_s}{la_s}{agg_s}{pi_s}: "
                   f"{r['value']:.1f} GF/s{mark}")
 
     print("\n== trailing-precision pairs (baseline vs split, per size) ==")
