@@ -426,11 +426,23 @@ class _Watchdog:
     eval loop), so a timer thread + hard exit is the only way out. Partial
     stdout survives because the supervisor captures it in a temp file. The
     exit runs BEFORE the supervisor's own SIGTERM would, sparing the relay
-    a mid-claim external kill."""
+    a mid-claim external kill.
+
+    ``DHQR_BENCH_WATCHDOG_SCALE`` multiplies every stage deadline. The
+    round-5 session measured the asymmetry that makes this knob exist: a
+    watchdog that fires MID-COMPILE hard-exits a client the remote compile
+    helper is still serving, wedging the relay for every later session
+    (the qr_4096 stage at 08:36: cold compiles ran ~2x round-3 speed —
+    13/26/57 s at 512/1024/2048 — so 240 s fired mid-4096-compile and the
+    whole hardware window after it read backend_init hangs). A too-long
+    watchdog only costs minutes of one stage. Watcher-launched recovery
+    sessions therefore set scale=3; the driver's own ~600 s window keeps
+    the tighter defaults (its supervisor bounds the child externally)."""
 
     def __init__(self, stage: str, seconds: float):
         import threading
 
+        seconds *= float(os.environ.get("DHQR_BENCH_WATCHDOG_SCALE", "1"))
         self._stage, self._seconds = stage, seconds
         self._done = threading.Event()
         self._t = threading.Thread(target=self._fire, daemon=True)

@@ -82,8 +82,15 @@ for s in $STAGES; do
       # the full escalation (incl. the round-5 lookahead/agg stages, cold
       # compiles) needs the room; the probe() 3600 s outer bound and the
       # child's per-stage watchdogs still cap a wedge.
+      # Watchdog scale 3: a stage that would fire mid-compile wedges the
+      # relay for every later session (measured 08:36 this round — the
+      # 240 s qr_4096 watchdog vs ~2x-slower-than-r3 cold compiles); in a
+      # session that owns its wall clock, minutes of a hung stage are the
+      # cheaper failure. The child window widens to match; probe()'s
+      # 3600 s outer bound still caps a truly wedged run.
       probe bench "$RES/bench_${R}_run.jsonl" \
-        env DHQR_BENCH_TPU_TIMEOUT=1500 python bench.py ;;
+        env DHQR_BENCH_TPU_TIMEOUT=2800 DHQR_BENCH_WATCHDOG_SCALE=3 \
+        python bench.py ;;
     agg)
       probe agg "$RES/tpu_${R}_agg.jsonl" \
         python benchmarks/tpu_agg_probe.py ;;

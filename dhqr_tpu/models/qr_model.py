@@ -253,23 +253,17 @@ def qr(
         # sliced back there) — recomputed here so the factorization object
         # records the panel width the solve stage will reuse.
         nb, _ = plan_padding(A.shape[1], mesh.shape[col_axis], cfg.block_size)
-        if cfg.agg_panels:
-            raise ValueError(
-                "agg_panels is single-device only for now (the sharded "
-                "aggregated update needs owner-contiguous group slicing "
-                "— see ops/blocked._scan_panels_grouped)"
-            )
         if cfg.blocked:
             H, alpha = _sharded.sharded_blocked_qr(
                 A, mesh, block_size=nb, axis_name=col_axis,
                 precision=cfg.precision, layout=cfg.layout, norm=cfg.norm,
                 use_pallas=cfg.use_pallas, panel_impl=cfg.panel_impl,
                 trailing_precision=cfg.trailing_precision,
-                lookahead=cfg.lookahead,
+                lookahead=cfg.lookahead, agg_panels=cfg.agg_panels,
             )
         else:
             _reject_nonblocked_knobs(cfg.use_pallas, cfg.trailing_precision,
-                                     cfg.lookahead)
+                                     cfg.lookahead, cfg.agg_panels)
             H, alpha = _sharded.sharded_householder_qr(
                 A, mesh, axis_name=col_axis, precision=cfg.precision,
                 layout=cfg.layout, norm=cfg.norm,
@@ -726,15 +720,10 @@ def lstsq(
         )
         from dhqr_tpu.parallel.sharded_solve import sharded_lstsq, sharded_solve
 
-        if cfg.agg_panels:
-            raise ValueError(
-                "agg_panels is single-device only for now (the sharded "
-                "aggregated update needs owner-contiguous group slicing)"
-            )
         col_axis = cfg.mesh_axis or DEFAULT_AXIS
         if not cfg.blocked:
             _reject_nonblocked_knobs(cfg.use_pallas, cfg.trailing_precision,
-                                     cfg.lookahead)
+                                     cfg.lookahead, cfg.agg_panels)
             m, n = A.shape
             nb, n_pad = plan_padding(n, mesh.shape[col_axis], cfg.block_size)
             if n_pad != n:
@@ -761,7 +750,7 @@ def lstsq(
             precision=cfg.precision, layout=cfg.layout, norm=cfg.norm,
             use_pallas=cfg.use_pallas, panel_impl=cfg.panel_impl,
             trailing_precision=cfg.trailing_precision,
-            lookahead=cfg.lookahead,
+            lookahead=cfg.lookahead, agg_panels=cfg.agg_panels,
         )
     return _lstsq_impl(
         A, b, cfg.block_size, cfg.blocked, cfg.precision, cfg.use_pallas,

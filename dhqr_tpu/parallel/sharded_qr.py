@@ -149,6 +149,7 @@ def _blocked_shard_body(
     norm: str = "accurate", pallas: bool = False, pallas_interpret: bool = False,
     panel_impl: str = "loop", pallas_flat: "int | None" = None,
     trailing_precision: "str | None" = None, lookahead: bool = False,
+    agg_panels: "int | None" = None,
 ):
     """Per-device body for the compact-WY engine.
 
@@ -198,6 +199,13 @@ def _blocked_shard_body(
             Al, n=n, nb=nb, axis=axis, precision=precision, layout=layout,
             factor=_factor, psum_owner=_psum_owner, done_cols=_done_cols,
             tprec=tprec, gidx_base=gidx_base, p=p, nproc=nproc,
+        )
+
+    if agg_panels and agg_panels > 1 and num_panels > 1:
+        return _blocked_shard_agg(
+            Al, n=n, nb=nb, k=agg_panels, axis=axis, precision=precision,
+            layout=layout, factor=_factor, done_cols=_done_cols, tprec=tprec,
+            gidx_base=gidx_base, p=p, nproc=nproc,
         )
 
     if num_panels <= MAX_UNROLLED_PANELS:
@@ -411,6 +419,141 @@ def _blocked_shard_lookahead(
     return Al, alpha
 
 
+def _blocked_shard_agg(
+    Al, *, n, nb, k, axis, precision, layout, factor, done_cols,
+    tprec, gidx_base, p, nproc,
+):
+    """Aggregated-trailing-update order for the sharded compact-WY body.
+
+    The sharded twin of ``ops.blocked._scan_panels_grouped``, with a
+    collectives twist that only exists on the mesh: instead of one psum per
+    panel (k per group — the batched form of the reference's per-column
+    reflector broadcast, src:141-143), the group's k*nb columns are
+    gathered with ONE psum. That moves the same total words over ICI in
+    1/k as many collective launches, and — because the gathered group is
+    then replicated — every device can factor the WHOLE group redundantly
+    with zero further communication (bit-identical inputs give
+    bit-identical panels; redundant compute already being the body's
+    idiom, see :func:`_blocked_shard_body`). The wide local trailing
+    update then runs once per group with the aggregated tau=1 compact-WY
+    transform (``shifted_tril`` of the k packed panels side by side), so
+    wide passes drop k-fold exactly as on the single-device tier.
+
+    Program-size strategy matches the default body: groups statically
+    unrolled below MAX_UNROLLED_PANELS panels, else super-blocks with an
+    inner ``lax.scan`` over groups (the super-block size is rounded up to
+    a multiple of k so aggregation always engages; a final sub-k panel
+    remainder runs the default per-panel order, statically unrolled).
+    """
+    m, nloc = Al.shape
+    num_panels = n // nb
+    alpha = jnp.zeros((n,), dtype=Al.dtype)
+
+    def group(Sl, c0, gsize, owners, gidx_live, end_col):
+        """Factor one gsize-panel group on the live slice Sl (ms, ncols).
+
+        ``c0``: diag row offset of the group within Sl (traced in scans);
+        ``owners``: per-panel (mine, local col offset) pairs;
+        ``end_col``: global column index just past the group (mask bound).
+        Returns the updated slice and the group's stacked alpha block.
+        """
+        ms = Sl.shape[0]
+        W = gsize * nb
+        owners = [(mine, jnp.asarray(kl, jnp.int32)) for mine, kl in owners]
+        with jax.named_scope("group_gather"):
+            contrib = jnp.zeros((ms, W), dtype=Sl.dtype)
+            for j, (mine, kl) in enumerate(owners):
+                loc = lax.dynamic_slice(Sl, (jnp.int32(0), kl), (ms, nb))
+                contrib = lax.dynamic_update_slice(
+                    contrib, jnp.where(mine, loc, jnp.zeros_like(loc)),
+                    (jnp.int32(0), jnp.int32(j * nb)))
+            G = lax.psum(contrib, axis)
+        alphas = []
+        for j in range(gsize):
+            c = j * nb
+            with jax.named_scope("panel_factor"):
+                pf, a_j = factor(lax.slice(G, (0, c), (ms, c + nb)), c0 + c)
+                G = G.at[:, c : c + nb].set(pf)
+            alphas.append(a_j)
+            if j < gsize - 1:
+                with jax.named_scope("group_interior_update"):
+                    Y = shifted_tril(pf, c0 + c)
+                    Gr = lax.slice(G, (0, c + nb), (ms, W))
+                    G = G.at[:, c + nb :].set(
+                        apply_block_reflector_h(Y, Gr, precision,
+                                                gemm_precision=tprec))
+        for j, (mine, kl) in enumerate(owners):
+            pfj = lax.slice(G, (0, j * nb), (ms, (j + 1) * nb))
+            Sl_upd = lax.dynamic_update_slice(Sl, pfj, (jnp.int32(0), kl))
+            Sl = jnp.where(mine, Sl_upd, Sl)
+        with jax.named_scope("trailing_update_agg"):
+            Yg = shifted_tril(G, c0)
+            C_new = apply_block_reflector_h(Yg, Sl, precision,
+                                            gemm_precision=tprec)
+            cmask = (gidx_live >= end_col)[None, :]
+            Sl = jnp.where(cmask, C_new, Sl)
+        return Sl, jnp.concatenate(alphas)
+
+    if num_panels <= MAX_UNROLLED_PANELS:
+        for g0 in range(0, num_panels, k):
+            gsize = min(k, num_panels - g0)
+            k0 = g0 * nb
+            drop = done_cols(g0)
+            owners = []
+            for j in range(gsize):
+                ow, kl = _panel_owner(k0 + j * nb, n, nloc, nb, layout)
+                owners.append((p == ow, kl - drop))
+            Sl = lax.slice(Al, (k0, drop), (m, nloc))
+            Sl, a_grp = group(Sl, 0, gsize, owners, gidx_base[drop:],
+                              k0 + gsize * nb)
+            Al = Al.at[k0:, drop:].set(Sl)
+            alpha = alpha.at[k0 : k0 + gsize * nb].set(a_grp)
+        return Al, alpha
+
+    _, _, ppo = _panels_schedule(n, nb)
+    # Round the super-block UP to a multiple of k so every super-block
+    # holds whole groups and aggregation genuinely engages (same guard as
+    # the single-device dispatch, ops/blocked._blocked_qr_impl).
+    ppo = -(-ppo // k) * k
+    for ob in range(0, num_panels, ppo):
+        pcount = min(ppo, num_panels - ob)
+        K = ob * nb
+        drop = done_cols(ob)
+        Sl = lax.slice(Al, (K, drop), (m, nloc))
+        ms = m - K
+        gidx_live = gidx_base[drop:]
+        ngroups, rem = pcount // k, pcount % k
+
+        def body(Sl, g, ob=ob, K=K, drop=drop):
+            kb0 = ob + g * k
+            owners = []
+            for j in range(k):
+                ow, kl = _panel_owner_traced(kb0 + j, nproc, nloc, nb, layout)
+                owners.append((p == ow, kl - drop))
+            return group(Sl, kb0 * nb - K, k, owners, gidx_live,
+                         (kb0 + k) * nb)
+
+        if ngroups:
+            Sl, a_grp = lax.scan(body, Sl,
+                                 jnp.arange(ngroups, dtype=jnp.int32))
+            alpha = alpha.at[K : K + ngroups * k * nb].set(
+                a_grp.reshape(ngroups * k * nb))
+        # Sub-k remainder (last super-block only, at most k-1 panels): one
+        # ragged group — static placement, and it keeps the
+        # one-gather-psum win exactly like the unrolled tier's final group.
+        if rem:
+            kg0 = (ob + ngroups * k) * nb
+            owners = []
+            for r in range(rem):
+                ow, kl = _panel_owner(kg0 + r * nb, n, nloc, nb, layout)
+                owners.append((p == ow, kl - drop))
+            Sl, a_rem = group(Sl, kg0 - K, rem, owners, gidx_live,
+                              kg0 + rem * nb)
+            alpha = alpha.at[kg0 : kg0 + rem * nb].set(a_rem)
+        Al = Al.at[K:, drop:].set(Sl)
+    return Al, alpha
+
+
 @lru_cache(maxsize=None)
 def _build_unblocked(
     mesh: Mesh, axis_name: str, n: int, precision: str, layout: str,
@@ -438,6 +581,7 @@ def _build_blocked(
     norm: str = "accurate", pallas: bool = False, pallas_interpret: bool = False,
     panel_impl: str = "loop", pallas_flat: "int | None" = None,
     trailing_precision: "str | None" = None, lookahead: bool = False,
+    agg_panels: "int | None" = None,
 ):
     body = partial(
         _blocked_shard_body,
@@ -445,6 +589,7 @@ def _build_blocked(
         norm=norm, pallas=pallas, pallas_interpret=pallas_interpret,
         panel_impl=panel_impl, pallas_flat=pallas_flat,
         trailing_precision=trailing_precision, lookahead=lookahead,
+        agg_panels=agg_panels,
     )
     return jax.jit(
         shard_map(
@@ -591,6 +736,7 @@ def sharded_blocked_qr(
     panel_impl: str = "loop",
     trailing_precision: "str | None" = None,
     lookahead: bool = False,
+    agg_panels: "int | None" = None,
 ):
     """Compact-WY distributed QR: one psum per panel, GEMM trailing updates.
 
@@ -604,9 +750,24 @@ def sharded_blocked_qr(
     panel's wide trailing GEMM (one-panel lookahead, same per-column
     arithmetic — see :func:`_blocked_shard_lookahead`), giving the
     scheduler room to overlap the collective with MXU work.
+
+    ``agg_panels=k`` (k > 1) gathers each k-panel group with ONE psum,
+    factors the group replicated, and applies the aggregated compact-WY
+    trailing update once per group — 1/k the collective launches and wide
+    passes for the same words (see :func:`_blocked_shard_agg`). Mutually
+    exclusive with ``lookahead``.
     """
     m, n = A.shape
     nproc = mesh.shape[axis_name]
+    if agg_panels is not None and agg_panels < 2:
+        raise ValueError(f"agg_panels must be >= 2 (got {agg_panels}); "
+                         "use None to disable aggregation")
+    if agg_panels and lookahead:
+        raise ValueError(
+            "agg_panels and lookahead are mutually exclusive (the grouped "
+            "schedule already defers the wide update; combining them has "
+            "no defined order)"
+        )
     from dhqr_tpu.parallel.layout import plan_padding
 
     nb, n_pad = plan_padding(n, nproc, block_size)
@@ -623,6 +784,7 @@ def sharded_blocked_qr(
             axis_name=axis_name, precision=precision, layout=layout,
             norm=norm, use_pallas=use_pallas, panel_impl=panel_impl,
             trailing_precision=trailing_precision, lookahead=lookahead,
+            agg_panels=agg_panels,
         )
         return H[:m, :n], alpha[:n]
     _check_divisibility(m, n, nproc, nb, layout)
@@ -643,6 +805,7 @@ def sharded_blocked_qr(
     H, alpha = _build_blocked(
         mesh, axis_name, n, nb, precision, layout, norm, pallas, interp,
         panel_impl, PALLAS_FLAT_WIDTH, trailing_precision, lookahead,
+        agg_panels,
     )(A)
     if not _store_layout_output:
         H = _to_natural_layout(H, n, nproc, nb, layout)

@@ -298,6 +298,7 @@ def sharded_lstsq(
     panel_impl: str = "loop",
     trailing_precision: "str | None" = None,
     lookahead: bool = False,
+    agg_panels: "int | None" = None,
 ) -> jax.Array:
     """One-shot distributed least squares: factor + solve on the mesh.
 
@@ -326,6 +327,7 @@ def sharded_lstsq(
         layout=layout, _store_layout_output=True, norm=norm,
         use_pallas=use_pallas, panel_impl=panel_impl,
         trailing_precision=trailing_precision, lookahead=lookahead,
+        agg_panels=agg_panels,
     )
     x = sharded_solve(
         H, alpha, b, mesh,

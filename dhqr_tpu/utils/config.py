@@ -86,14 +86,21 @@ class DHQRConfig:
         of the GEMM column split. Default False until the hardware
         ladder (benchmarks/tpu_lookahead_probe.py) justifies flipping.
       agg_panels: aggregate the trailing update over k consecutive
-        panels (single-device blocked householder engine, scanned path):
+        panels (blocked householder engines, single-device and sharded):
         panels still factor at ``block_size`` width, but the matrix right
         of each k-panel group is updated once, by the group's aggregated
         compact-WY transform — k-fold fewer wide trailing passes at
         ~O(m (k nb)^2) extra aggregate-T flops per group (see
-        ops/blocked._scan_panels_grouped). None (default) = per-panel
-        updates; mutually exclusive with ``lookahead``; not yet available
-        on the mesh tier.
+        ops/blocked._scan_panels_grouped). On a mesh the group is also
+        gathered with ONE psum instead of k per-panel psums — same words
+        over ICI, 1/k the collective launches (see
+        parallel/sharded_qr._blocked_shard_agg). None (default) =
+        per-panel updates; mutually exclusive with ``lookahead``. The
+        single-device fully-unrolled path (num_panels <=
+        DHQR_MAX_PANELS) silently ignores it — aggregation is a
+        scanned-path lever there; the SHARDED unrolled path does
+        aggregate (its win, one gather psum per group, exists at every
+        panel count).
       refine: iterative-refinement steps for ``lstsq`` (0 = off). Each
         step reuses the factorization: ``r = b - A x; x += solve(r)`` —
         one matvec plus one extra solve, a few percent of the
