@@ -88,8 +88,12 @@ for s in $STAGES; do
       # session that owns its wall clock, minutes of a hung stage are the
       # cheaper failure. The child window widens to match; probe()'s
       # 3600 s outer bound still caps a truly wedged run.
+      # SKIP_BANKED: stages that already produced a round-tagged TPU row
+      # (in the tee) re-emit it instead of re-compiling — a short
+      # recovery window jumps straight to the unbanked headline sizes.
       probe bench "$RES/bench_${R}_run.jsonl" \
         env DHQR_BENCH_TPU_TIMEOUT=2800 DHQR_BENCH_WATCHDOG_SCALE=3 \
+            DHQR_BENCH_SKIP_BANKED=1 \
         python bench.py ;;
     agg)
       probe agg "$RES/tpu_${R}_agg.jsonl" \

@@ -240,6 +240,39 @@ def _scan_panels(S, pcount, nb, precision, pallas, pallas_interpret,
     return S, alphas.reshape(pcount * nb)
 
 
+def _factor_group(G, c0, gsize, nb, factor, precision, gemm_precision):
+    """Factor a gathered ``gsize * nb``-wide panel group in place.
+
+    The shared core of the aggregated schedules (single-device
+    :func:`_scan_panels_grouped` and the mesh tier's
+    ``sharded_qr._blocked_shard_agg``): panels factor left to right at
+    the nb grain, each applying its compact-WY transform to the group's
+    remaining columns only — ``c`` is a STATIC unrolled offset, so the
+    interior applies slice the not-yet-factored columns directly with no
+    masked-flop waste (unlike the per-panel scan, whose traced offset
+    forces full-width compute + mask). ``c0`` is the group's diagonal row
+    offset within G (traced in scanned callers). Returns the factored
+    group and its concatenated alpha block.
+    """
+    ms, W = G.shape
+    alphas = []
+    for j in range(gsize):
+        c = j * nb
+        with jax.named_scope("panel_factor"):
+            pf, a_j = factor(lax.slice(G, (0, c), (ms, c + nb)), c0 + c)
+            G = lax.dynamic_update_slice(G, pf,
+                                         (jnp.int32(0), jnp.int32(c)))
+        alphas.append(a_j)
+        if j < gsize - 1:
+            with jax.named_scope("group_interior_update"):
+                Y = shifted_tril(pf, c0 + c)
+                Gr = lax.slice(G, (0, c + nb), (ms, W))
+                G = G.at[:, c + nb :].set(
+                    apply_block_reflector_h(Y, Gr, precision,
+                                            gemm_precision=gemm_precision))
+    return G, jnp.concatenate(alphas)
+
+
 def _scan_panels_grouped(S, pcount, nb, k, precision, pallas,
                          pallas_interpret, norm="accurate", panel_impl="loop",
                          gemm_precision=None, pallas_flat=None):
@@ -276,27 +309,8 @@ def _scan_panels_grouped(S, pcount, nb, k, precision, pallas,
     def body(S, g):
         cg = g * W  # group's first column (and diagonal row) within S
         G = lax.dynamic_slice(S, (jnp.int32(0), cg), (ms, W))
-        alphas = []
-        for j in range(k):  # static unroll: program size ~ k
-            c = j * nb
-            with jax.named_scope("panel_factor"):
-                panel = lax.slice(G, (0, c), (ms, c + nb))
-                pf, a_j = factor(panel, cg + c)
-                G = lax.dynamic_update_slice(G, pf,
-                                             (jnp.int32(0), jnp.int32(c)))
-            alphas.append(a_j)
-            if j < k - 1:
-                with jax.named_scope("group_interior_update"):
-                    # c is a STATIC unrolled offset, so the interior apply
-                    # slices the not-yet-factored columns directly — no
-                    # masked-flop waste (unlike the per-panel scan, whose
-                    # traced offset forces full-width compute + mask).
-                    Y = shifted_tril(pf, cg + c)
-                    Gr = lax.slice(G, (0, c + nb), (ms, W))
-                    G = G.at[:, c + nb :].set(
-                        apply_block_reflector_h(
-                            Y, Gr, precision,
-                            gemm_precision=gemm_precision))
+        G, alphas = _factor_group(G, cg, k, nb, factor, precision,
+                                  gemm_precision)
         S = lax.dynamic_update_slice(S, G, (jnp.int32(0), cg))
         with jax.named_scope("trailing_update_agg"):
             Yg = shifted_tril(G, cg)  # all k panels' reflectors, tau=1
@@ -304,7 +318,7 @@ def _scan_panels_grouped(S, pcount, nb, k, precision, pallas,
                 Yg, S, precision, gemm_precision=gemm_precision)
             cmask = lax.iota(jnp.int32, ns) >= cg + W
             S = jnp.where(cmask[None, :], C_new, S)
-        return S, jnp.concatenate(alphas)
+        return S, alphas
 
     alpha_parts = []
     if ngroups:

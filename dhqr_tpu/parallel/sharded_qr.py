@@ -38,6 +38,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from dhqr_tpu.ops.blocked import (
     MAX_UNROLLED_PANELS,
+    _factor_group,
     _panels_schedule,
     apply_block_reflector_h,
     shifted_tril,
@@ -443,7 +444,9 @@ def _blocked_shard_agg(
     unrolled below MAX_UNROLLED_PANELS panels, else super-blocks with an
     inner ``lax.scan`` over groups (the super-block size is rounded up to
     a multiple of k so aggregation always engages; a final sub-k panel
-    remainder runs the default per-panel order, statically unrolled).
+    remainder runs as ONE ragged aggregated group — single gather psum —
+    unlike ops/blocked's single-device remainder, which falls back to the
+    per-panel scan).
     """
     m, nloc = Al.shape
     num_panels = n // nb
@@ -468,20 +471,8 @@ def _blocked_shard_agg(
                     contrib, jnp.where(mine, loc, jnp.zeros_like(loc)),
                     (jnp.int32(0), jnp.int32(j * nb)))
             G = lax.psum(contrib, axis)
-        alphas = []
-        for j in range(gsize):
-            c = j * nb
-            with jax.named_scope("panel_factor"):
-                pf, a_j = factor(lax.slice(G, (0, c), (ms, c + nb)), c0 + c)
-                G = G.at[:, c : c + nb].set(pf)
-            alphas.append(a_j)
-            if j < gsize - 1:
-                with jax.named_scope("group_interior_update"):
-                    Y = shifted_tril(pf, c0 + c)
-                    Gr = lax.slice(G, (0, c + nb), (ms, W))
-                    G = G.at[:, c + nb :].set(
-                        apply_block_reflector_h(Y, Gr, precision,
-                                                gemm_precision=tprec))
+        G, alphas = _factor_group(G, c0, gsize, nb, factor, precision,
+                                  tprec)
         for j, (mine, kl) in enumerate(owners):
             pfj = lax.slice(G, (0, j * nb), (ms, (j + 1) * nb))
             Sl_upd = lax.dynamic_update_slice(Sl, pfj, (jnp.int32(0), kl))
@@ -492,7 +483,7 @@ def _blocked_shard_agg(
                                             gemm_precision=tprec)
             cmask = (gidx_live >= end_col)[None, :]
             Sl = jnp.where(cmask, C_new, Sl)
-        return Sl, jnp.concatenate(alphas)
+        return Sl, alphas
 
     if num_panels <= MAX_UNROLLED_PANELS:
         for g0 in range(0, num_panels, k):

@@ -72,3 +72,80 @@ def test_best_recorded_tpu_excludes_inaccurate_splits(tmp_path, monkeypatch):
     monkeypatch.setattr(bench, "_REPO", str(tmp_path))
     best = bench._best_recorded_tpu()
     assert best["value"] == 50000.0  # accuracy-qualified record wins
+
+
+def test_best_tpu_this_round_requires_round_tag(tmp_path, monkeypatch):
+    """The this-round carry (distinct from best_recorded) answers 'did
+    hardware run in THIS round': only round-tagged platform=tpu rows
+    qualify; untagged rows (pre-round-4 artifacts), stale-round rows,
+    and CPU rows must not — even when their values are larger."""
+    bench = _bench()
+    res = tmp_path / "benchmarks" / "results"
+    res.mkdir(parents=True)
+    rows = [
+        {"metric": "qr_gflops_per_chip_f32_12288x12288", "value": 13037.0,
+         "platform": "tpu"},                                # untagged (r3)
+        {"metric": "qr_gflops_per_chip_f32_4096x4096", "value": 9000.0,
+         "platform": "tpu", "round": bench.ROUND - 1},      # stale round
+        {"metric": "qr_gflops_per_chip_f32_4096x4096", "value": 8000.0,
+         "platform": "cpu", "round": bench.ROUND},          # not hardware
+        {"metric": "qr_gflops_per_chip_f32_2048x2048", "value": 107.9,
+         "platform": "tpu", "round": bench.ROUND},          # qualifies
+    ]
+    (res / "fake.jsonl").write_text(
+        "\n".join(json.dumps(r) for r in rows) + "\n")
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    best = bench._best_tpu_this_round()
+    assert best["value"] == 107.9 and best["artifact"] == "fake.jsonl"
+
+
+def test_banked_row_matching(tmp_path, monkeypatch):
+    """DHQR_BENCH_SKIP_BANKED: a round-tagged TPU row for the exact stage
+    config banks (by stage name, or by config tuple for rows predating
+    the stage field); other configs, other rounds, CPU rows, and banked
+    re-emits do not."""
+    bench = _bench()
+    tee = tmp_path / "tee.jsonl"
+    base = {"metric": "qr_gflops_per_chip_f32_2048x2048", "value": 100.0,
+            "platform": "tpu", "round": bench.ROUND, "block_size": 128,
+            "pallas_panels": False, "panel_impl": "loop"}
+    rows = [
+        base,                                           # config-tuple match
+        {**base, "metric": "qr_gflops_per_chip_f32_4096x4096",
+         "stage": "qr_4096_pallas_nb256", "value": 9000.0},  # stage match
+        {**base, "round": bench.ROUND - 1, "value": 1.0},    # stale round
+        {**base, "platform": "cpu", "value": 2.0},           # not hardware
+        {**base, "banked": True, "value": 3.0},              # no chains
+        # Stage-name collision from an older bench version (names only
+        # started encoding non-loop panel engines in round 5): the
+        # panel_impl equality guard must keep a reconstruct row from
+        # answering for a loop stage of the same name (code-review r5).
+        {**base, "metric": "qr_gflops_per_chip_f32_4096x4096",
+         "stage": "qr_4096_nb256", "panel_impl": "reconstruct",
+         "block_size": 256, "value": 7000.0},
+    ]
+    tee.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    monkeypatch.setenv("DHQR_BENCH_TEE", str(tee))
+
+    # gate off -> never banks
+    monkeypatch.delenv("DHQR_BENCH_SKIP_BANKED", raising=False)
+    assert bench._banked_row("qr_2048", 2048, False, 128, "loop",
+                             None, False, None) is None
+    monkeypatch.setenv("DHQR_BENCH_SKIP_BANKED", "1")
+    got = bench._banked_row("qr_2048", 2048, False, 128, "loop",
+                            None, False, None)
+    assert got and got["value"] == 100.0  # tuple match; banked row excluded
+    got = bench._banked_row("qr_4096_pallas_nb256", 4096, True, 256, "loop",
+                            None, False, None)
+    assert got and got["value"] == 9000.0  # stage-name match
+    # different config (lookahead) of the same metric: no match
+    assert bench._banked_row("qr_2048_lookahead", 2048, False, 128, "loop",
+                             None, True, None) is None
+    # same stage NAME, different panel engine: the equality guard blocks
+    # the reconstruct row from banking the loop stage...
+    assert bench._banked_row("qr_4096_nb256", 4096, False, 256, "loop",
+                             None, False, None) is None
+    # ...while the reconstruct stage itself banks it by name
+    got = bench._banked_row("qr_4096_nb256", 4096, False, 256, "reconstruct",
+                            None, False, None)
+    assert got and got["value"] == 7000.0

@@ -532,8 +532,9 @@ def test_sharded_agg_scan_remainder_branch():
     unexercised — 24 panels divide evenly for both k in the parity sweep
     above): 160/4 = 40 panels with k=3 rounds the super-block to
     ppo=6, so the last super-block holds pcount=4 panels = one full
-    group + ONE remainder panel, which must run the default per-panel
-    order and still match the default schedule end to end."""
+    group + ONE remainder panel, which runs as a ragged single-panel
+    aggregated group (one gather psum) and must still match the default
+    schedule end to end."""
     mesh8 = column_mesh(8)
     A, _ = random_problem(192, 160, np.float64, seed=60)
     H0, a0 = sharded_blocked_qr(jnp.asarray(A), mesh8, block_size=4,
