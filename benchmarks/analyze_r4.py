@@ -68,6 +68,13 @@ def _rows():
                     row_round = r.get("round", rnd if rnd <= 4 else None)
                     if row_round != rnd:
                         continue
+                # Banked re-emits (DHQR_BENCH_SKIP_BANKED recovery
+                # sessions re-print an earlier stage's row instead of
+                # re-measuring) are provenance duplicates whose extra
+                # "banked" flag defeats the content dedup below — the
+                # original measurement is already in the tee.
+                if r.get("banked"):
+                    continue
                 # One measurement can land in several artifacts (the
                 # supervisor re-prints the child's teed headline into the
                 # session's bench_${R}_run.jsonl) — dedup on content so a

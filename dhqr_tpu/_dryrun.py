@@ -58,6 +58,15 @@ def run(n_devices: int) -> None:
     assert bool(jnp.all(jnp.isfinite(x))), "non-finite x (lookahead)"
     print("dryrun: sharded_lstsq lookahead ok", flush=True)
 
+    # Aggregated schedule (round-5 session 2): one gather psum per
+    # k-panel group + replicated group factorization
+    # (sharded_qr._blocked_shard_agg) must compile and run on the mesh.
+    x = sharded_lstsq(A, b, cmesh, block_size=block_size, layout="cyclic",
+                      agg_panels=2)
+    assert x.shape == (n,)
+    assert bool(jnp.all(jnp.isfinite(x))), "non-finite x (agg_panels)"
+    print("dryrun: sharded_lstsq agg_panels=2 ok", flush=True)
+
     # Awkward n (not divisible by the mesh): the internal orthogonal-
     # extension padding must compile and run on the mesh too.
     n_awk = n - 3
