@@ -149,3 +149,14 @@ def test_banked_row_matching(tmp_path, monkeypatch):
     got = bench._banked_row("qr_4096_nb256", 4096, False, 256, "reconstruct",
                             None, False, None)
     assert got and got["value"] == 7000.0
+
+
+def test_watchdog_scale_env(monkeypatch):
+    """DHQR_BENCH_WATCHDOG_SCALE multiplies stage deadlines (recovery
+    sessions run scale=3: a mid-compile hard exit wedges the relay, so
+    owned-wall-clock sessions prefer long watchdogs)."""
+    bench = _bench()
+    monkeypatch.delenv("DHQR_BENCH_WATCHDOG_SCALE", raising=False)
+    assert bench._Watchdog("s", 240)._seconds == 240
+    monkeypatch.setenv("DHQR_BENCH_WATCHDOG_SCALE", "3")
+    assert bench._Watchdog("s", 240)._seconds == 720

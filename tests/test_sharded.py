@@ -545,3 +545,31 @@ def test_sharded_agg_scan_remainder_branch():
                                atol=1e-10)
     np.testing.assert_allclose(np.asarray(a1), np.asarray(a0), rtol=1e-10,
                                atol=1e-10)
+
+
+def test_sharded_agg_composes_with_panel_engines():
+    """agg_panels on the mesh composes with the non-default panel
+    interiors: the reconstruct engine (traced-offset roll/mask frame
+    inside the gathered group) and the Pallas kernel (interpret mode on
+    CPU). Parity vs the same engine without aggregation."""
+    mesh4 = column_mesh(4)
+    rng = np.random.default_rng(62)
+    A64 = jnp.asarray(rng.standard_normal((96, 64)))
+    H0, a0 = sharded_blocked_qr(A64, mesh4, block_size=8, layout="cyclic",
+                                panel_impl="reconstruct")
+    H1, a1 = sharded_blocked_qr(A64, mesh4, block_size=8, layout="cyclic",
+                                panel_impl="reconstruct", agg_panels=2)
+    np.testing.assert_allclose(np.asarray(H1), np.asarray(H0), rtol=1e-9,
+                               atol=1e-9)
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(a0), rtol=1e-9,
+                               atol=1e-9)
+
+    A32 = jnp.asarray(rng.standard_normal((96, 64)), dtype=jnp.float32)
+    H0, a0 = sharded_blocked_qr(A32, mesh4, block_size=8, layout="cyclic",
+                                use_pallas="always")
+    H1, a1 = sharded_blocked_qr(A32, mesh4, block_size=8, layout="cyclic",
+                                use_pallas="always", agg_panels=2)
+    np.testing.assert_allclose(np.asarray(H1), np.asarray(H0), rtol=5e-5,
+                               atol=5e-5)
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(a0), rtol=5e-5,
+                               atol=5e-5)
