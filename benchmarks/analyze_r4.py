@@ -126,7 +126,8 @@ def main() -> None:
                  f" agg={r['agg_panels']}") + \
                 ("" if not r.get("lookahead") else " lookahead") + \
                 ("" if r.get("panel_impl") in ("loop", None) else
-                 f" {r['panel_impl']}")
+                 f" {r['panel_impl']}") + \
+                ("" if not r.get("donate") else " donate")
         print(f"  {size:>6}  nb={r.get('block_size') or '?':>4} "
               f"flat={r.get('pallas_flat') or '-':>4} "
               f"{r['value']:>9.1f} GF/s{sched}   [{r['_artifact']}]")
@@ -141,7 +142,7 @@ def main() -> None:
         size = int(re.search(r"(\d+)x\d+$", r["metric"]).group(1))
         key = (r.get("block_size"), r.get("pallas_flat"),
                bool(r.get("lookahead")), r.get("agg_panels"),
-               r.get("panel_impl") or "loop")
+               r.get("panel_impl") or "loop", bool(r.get("donate")))
         cur = by_size.setdefault(size, {})
         if key not in cur or r["value"] > cur[key]["value"]:
             cur[key] = r
@@ -154,7 +155,7 @@ def main() -> None:
             or list(variants.values())
         best = max(pool, key=lambda r: r["value"])
         print(f"  {size}:")
-        for (nb, flat, la, agg, pi), r in sorted(
+        for (nb, flat, la, agg, pi, don), r in sorted(
                 variants.items(), key=lambda kv: -kv[1]["value"]):
             mark = " <== best" if r is best else ""
             if not _qualified(r):
@@ -164,8 +165,9 @@ def main() -> None:
             la_s = " lookahead" if la else ""
             agg_s = f" agg={agg}" if agg else ""
             pi_s = f" {pi}" if pi not in ("loop", None) else ""
-            print(f"    nb={nb} flat={flat or '-'}{tp_s}{la_s}{agg_s}{pi_s}: "
-                  f"{r['value']:.1f} GF/s{mark}")
+            don_s = " donate" if don else ""
+            print(f"    nb={nb} flat={flat or '-'}{tp_s}{la_s}{agg_s}{pi_s}"
+                  f"{don_s}: {r['value']:.1f} GF/s{mark}")
 
     print("\n== trailing-precision pairs (baseline vs split, per size) ==")
     tp_rows = [r for r in rows if r.get("trailing_precision")]

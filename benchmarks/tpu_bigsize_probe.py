@@ -42,7 +42,7 @@ def main() -> None:
                       os.path.join(_REPO, ".jax_cache"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
-    from dhqr_tpu.ops.blocked import _blocked_qr_impl
+    from dhqr_tpu.ops.blocked import _blocked_qr_impl, _blocked_qr_impl_donate
     from dhqr_tpu.utils.profiling import sync
 
     _stage("backend_init")
@@ -59,39 +59,69 @@ def main() -> None:
         rec["device_kind"] = kind
         print(json.dumps(rec), flush=True)
 
-    def qr_stage(n, nb, watchdog, repeats=2):
-        name = f"qr_f32_{n}_nb{nb}"
+    def qr_stage(n, nb, watchdog, repeats=2, donate=False):
+        """One capacity/timing stage. ``donate=True`` runs the DONATING
+        engine: XLA may alias the input buffer into the output, saving
+        one full matrix of HBM — the lever that decides whether 28672^2
+        (OOM on the non-donating jit, round 3) fits the chip. For that
+        path A is generated ON DEVICE per dispatch (donation invalidates
+        it, and re-uploading 3.3 GB through the tunnel would dwarf the
+        measurement), and the previous dispatch's outputs are dropped
+        BEFORE the next A exists — holding them across the call would
+        restore the 2-matrix peak donation is meant to avoid."""
+        impl = _blocked_qr_impl_donate if donate else _blocked_qr_impl
+        name = f"qr_f32_{n}_nb{nb}" + ("_donate" if donate else "")
         _stage(name)
         try:
             with _Watchdog(name, watchdog):
-                A = jnp.asarray(rng.random((n, n)), jnp.float32)
+                if donate:
+                    make = jax.jit(
+                        lambda k: jax.random.uniform(k, (n, n), jnp.float32))
+                    A = make(jax.random.key(0))
+                else:
+                    A = jnp.asarray(rng.random((n, n)), jnp.float32)
                 sync(A)
                 t0 = time.perf_counter()
-                comp = _blocked_qr_impl.lower(
+                comp = impl.lower(
                     A, nb, precision="highest", pallas=True,
                     norm="fast").compile()
                 H, al = comp(A)
                 sync(al)
                 compile_s = time.perf_counter() - t0
                 ts = []
-                for _ in range(repeats):
+                for i in range(repeats):
+                    if donate:
+                        H = al = A = None  # free before the next make()
+                        A = make(jax.random.key(i + 1))
+                        sync(A)
                     t0 = time.perf_counter()
                     H, al = comp(A)
                     sync(al)
                     ts.append(time.perf_counter() - t0)
                 t1 = min(ts)
-                emit({"metric": f"qr_gflops_per_chip_f32_{n}x{n}",
-                      "value": round((4.0 / 3.0) * n**3 / t1 / 1e9, 2),
-                      "unit": "GFLOP/s", "block_size": nb,
-                      "pallas_panels": True, "seconds": round(t1, 4),
-                      "compile_seconds": round(compile_s, 2),
-                      "note": "single-dispatch; device time >> RTT"})
+                rec = {"metric": f"qr_gflops_per_chip_f32_{n}x{n}",
+                       "value": round((4.0 / 3.0) * n**3 / t1 / 1e9, 2),
+                       "unit": "GFLOP/s", "block_size": nb,
+                       "pallas_panels": True, "seconds": round(t1, 4),
+                       "compile_seconds": round(compile_s, 2),
+                       "note": ("donating engine; single-dispatch"
+                                if donate else
+                                "single-dispatch; device time >> RTT")}
+                if donate:
+                    rec["donate"] = True
+                emit(rec)
         except Exception as ex:
             emit({"metric": name, "ok": False,
                   "error": f"{type(ex).__name__}: {ex}"[:300]})
 
     qr_stage(24576, 512, 560)
+    # Donating control at a size that already fits: quantifies any cost
+    # of the aliased program before the capacity attempt below.
+    qr_stage(24576, 512, 560, donate=True)
     qr_stage(28672, 512, 560)
+    # The capacity attempt: one matrix of HBM saved by donation is
+    # exactly the margin 28672^2 missed in round 3.
+    qr_stage(28672, 512, 560, donate=True)
     _stage("done")
 
 

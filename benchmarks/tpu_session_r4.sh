@@ -19,6 +19,7 @@
 #   trailing  - trailing-precision pairs -> tpu_${R}_trailing.jsonl
 #   phase     - 16384^2 phase breakdown -> tpu_${R}_phase16k.jsonl
 #   cembed    - c64 lstsq via real embedding -> tpu_${R}_cembed.jsonl
+#   bigsize   - 24576/28672 capacity incl. donating engine -> tpu_${R}_bigsize.jsonl
 set -u
 cd "$(dirname "$0")/.."
 RES=benchmarks/results
@@ -29,16 +30,16 @@ RES=benchmarks/results
 _rnd="${DHQR_ROUND:-5}"; _rnd="${_rnd#r}"; _rnd="${_rnd#R}"
 R="r${_rnd}"
 mkdir -p "$RES"
-STAGES=${*:-"alive bench agg reconstruct split lookahead trailing phase cembed"}
+STAGES=${*:-"alive bench agg reconstruct split lookahead trailing phase cembed bigsize"}
 
 # Validate every stage name BEFORE running anything: a typo in a later
 # argument must not abort the session after earlier multi-hundred-second
 # stages already spent the hardware window.
 for s in $STAGES; do
   case "$s" in
-    alive|bench|agg|reconstruct|split|lookahead|trailing|phase|cembed) ;;
+    alive|bench|agg|reconstruct|split|lookahead|trailing|phase|cembed|bigsize) ;;
     *) echo "unknown stage '$s' (valid: alive bench agg reconstruct split" \
-            "lookahead trailing phase cembed)" >&2
+            "lookahead trailing phase cembed bigsize)" >&2
        exit 1 ;;
   esac
 done
@@ -116,6 +117,9 @@ for s in $STAGES; do
     cembed)
       probe cembed "$RES/tpu_${R}_cembed.jsonl" \
         python benchmarks/tpu_cembed_probe.py ;;
+    bigsize)
+      probe bigsize "$RES/tpu_${R}_bigsize.jsonl" \
+        python benchmarks/tpu_bigsize_probe.py ;;
     *) echo "unknown stage $s" >&2; exit 1 ;;
   esac
 done

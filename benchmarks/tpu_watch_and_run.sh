@@ -58,7 +58,7 @@ while :; do
       > benchmarks/results/relay_state.json
     now=$(date +%s); rem=$(( DEADLINE - now ))
     if   [ "$rem" -ge 10800 ]; then
-      stages="bench agg reconstruct split lookahead trailing phase cembed"
+      stages="bench agg reconstruct split lookahead trailing phase cembed bigsize"
     # Mid tier DELIBERATELY swaps split for reconstruct/agg: the round-5
     # levers outrank the round-3 split ladder when the window cannot fit
     # both (bench ~28 min + agg ~20 + reconstruct ~20 + cembed ~10 fills
