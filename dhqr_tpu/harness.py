@@ -115,8 +115,8 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--agg-panels", type=_agg_panels_arg, default=None,
         help="aggregate the trailing update over this many consecutive "
-        "panels; 0 = off (single-device blocked householder engine; see "
-        "DHQRConfig.agg_panels)",
+        "panels; 0 = off (blocked householder engines, single-device and "
+        "sharded; see DHQRConfig.agg_panels)",
     )
     parser.add_argument(
         "--profile-dir", default=None,
@@ -243,16 +243,17 @@ def main(argv=None) -> int:
             print("# warning: DHQR_AGG_PANELS ignored — mutually exclusive "
                   "with lookahead", file=sys.stderr)
             cfg = dataclasses.replace(cfg, agg_panels=None)
-    if cfg.agg_panels and (cfg.engine != "householder" or not cfg.blocked
-                           or ndev > 1):
+    # agg_panels runs on BOTH tiers since round-5 session 2 (the sharded
+    # aggregated engine, parallel/sharded_qr._blocked_shard_agg) — only
+    # the non-householder / unblocked engines still reject it.
+    if cfg.agg_panels and (cfg.engine != "householder" or not cfg.blocked):
         why = (f"engine={cfg.engine}" if cfg.engine != "householder"
-               else "blocked=False" if not cfg.blocked
-               else f"mesh size {ndev} (single-device only for now)")
+               else "blocked=False")
         if args.agg_panels is not None:
-            parser.error(f"--agg-panels applies to the single-device "
-                         f"blocked householder engine only ({why})")
+            parser.error(f"--agg-panels applies to the blocked "
+                         f"householder engines only ({why})")
         print(f"# warning: DHQR_AGG_PANELS ignored — it applies to the "
-              f"single-device blocked householder engine only ({why})",
+              f"blocked householder engines only ({why})",
               file=sys.stderr)
         cfg = dataclasses.replace(cfg, agg_panels=None)
     print(f"# devices: {len(jax.devices())} ({jax.default_backend()}), "

@@ -54,3 +54,26 @@ def test_harness_env_layout_with_row_engine_warns_not_aborts():
     proc = _run_harness(["--engine", "tsqr", "--layout", "cyclic"], {})
     assert proc.returncode != 0
     assert "householder engines only" in proc.stderr
+
+
+def test_harness_agg_panels_on_mesh():
+    """--agg-panels with a multi-device mesh runs the sharded aggregated
+    engine (round-5 session 2) — the old 'single-device only' gate is
+    gone; the unblocked/row-engine rejections remain."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "dhqr_tpu.harness", "2",
+         "--sizes", "44x40", "--dtypes", "float64", "--agg-panels", "2"],
+        capture_output=True, text=True, timeout=600,
+        env={
+            "PATH": "/usr/bin:/bin:/usr/local/bin",
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": REPO_ROOT,
+            "HOME": os.environ.get("HOME", "/tmp"),
+        },
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ok  44x40" in proc.stdout
+
+    proc = _run_harness(["--engine", "cholqr2", "--agg-panels", "2"], {})
+    assert proc.returncode != 0
+    assert "blocked householder engines only" in proc.stderr
