@@ -554,6 +554,7 @@ def main() -> None:
     # destructor runs and the TPU claim is released — dying inside a
     # blocking recv wedges the relay for every later process.
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(3))
+    t_child0 = time.time()
 
     _stage("import_jax")
     import jax
@@ -581,6 +582,45 @@ def main() -> None:
     _stage(f"backend_ready_{platform}")
 
     rng = np.random.default_rng(0)
+
+    # Child-side budget awareness: the supervisor SIGTERMs this process at
+    # its window's end, and a SIGTERM landing mid-compile cannot run the
+    # Python handler (GIL held in C++) — the escalation to SIGKILL then
+    # wedges the relay (the round-5 failure mode, see _Watchdog). Stop
+    # STARTING stages while there is still time to exit cleanly instead:
+    # a skipped stage costs one data point, a mid-compile kill costs every
+    # later session's hardware window.
+    # TPU only: a mid-compile kill on CPU wedges nothing, and the 90 s
+    # CPU fallback must never skip its single headline stage.
+    budget = TPU_TIMEOUT
+
+    def out_of_budget(name: str, watchdog: float) -> bool:
+        # DHQR_BENCH_FORCE_BUDGET: test hatch — lets a CPU run drive the
+        # skip path end-to-end (there is no TPU in CI).
+        if platform != "tpu" and not os.environ.get("DHQR_BENCH_FORCE_BUDGET"):
+            return False
+        # The stage must fit its realistic worst case INSIDE the budget:
+        # the UNSCALED watchdogs are sized ~1.5x the expected compile+run
+        # pair, so 0.75x the base watchdog approximates the slowest
+        # healthy stage, + 45 s to flush/exit. (Deliberately NOT the
+        # DHQR_BENCH_WATCHDOG_SCALE-multiplied value: the scale raises
+        # the in-child kill threshold, it does not change how long a
+        # healthy stage takes — scaling `need` too would skip the
+        # 12288/16384 headline stages a recovery window exists for.) A
+        # flat cap would let a long stage start with minutes left and
+        # straddle the supervisor's SIGTERM mid-compile — the exact
+        # wedge this stop exists to avoid (code-review r5); a stage that
+        # HANGS past its start can still straddle, but a hung compile is
+        # a wedge already in progress either way.
+        need = 0.75 * watchdog + 45.0
+        remaining = budget - (time.time() - t_child0)
+        if remaining < need:
+            print(f"::budget_stop {name} and later stages skipped "
+                  f"({remaining:.0f}s left of the {budget}s child budget; "
+                  f"stage needs ~{need:.0f}s)",
+                  file=sys.stderr, flush=True)
+            return True
+        return False
 
     def qr_bench(n_, pallas=False, watchdog=120, repeats=REPEATS,
                  backward_error=False, chain=0, nb=None, panel="loop",
@@ -619,6 +659,8 @@ def main() -> None:
             banked["banked"] = True
             _emit(banked)
             return banked
+        if out_of_budget(name, watchdog):  # after the (free) banked re-emit
+            return None
         try:
             return _qr_bench_guarded(name, n_, pallas, watchdog, repeats,
                                      backward_error, chain, nb or BLOCK,
@@ -742,6 +784,8 @@ def main() -> None:
         this framework's engine)."""
         name = f"xla_builtin_qr_{n_}"
         _stage(name)
+        if out_of_budget(name, watchdog):
+            return
         try:
             with _Watchdog(name, watchdog):
                 A = jnp.asarray(rng.random((n_, n_)), dtype=jnp.float32)
@@ -862,7 +906,13 @@ def main() -> None:
     run_stage(512, watchdog=150, chain=9, backward_error=False)
     run_stage(1024, watchdog=150, chain=5, backward_error=True)
     run_stage(2048, watchdog=170, chain=5)
-    run_stage(N, watchdog=240, chain=3)
+    # 340 s, not 240: the stage compiles TWO cold programs (single-dispatch
+    # + the chained scan), and the 08:36 session measured cold compiles at
+    # 13/26/57 s for 512/1024/2048 — doubling per size puts the 4096 pair
+    # at ~230 s, so 240 fired MID-COMPILE and wedged the relay. With the
+    # earlier stages warm/banked (~50-95 s), 340 still fits the
+    # supervisor's child budget.
+    run_stage(N, watchdog=340, chain=3)
     # Pallas full-size IMMEDIATELY after the first full-size number: it is
     # the headline candidate (13.5 TFLOP/s round 3 vs 4.3 for the XLA
     # panel), so its stage must not sit behind tuning variants a wedged

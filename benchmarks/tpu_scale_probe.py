@@ -142,12 +142,12 @@ def main() -> None:
     _stage("panel_32768x512")
     try:
         with _Watchdog("panel_32768x512", 240):
-            from dhqr_tpu.ops.pallas_panel import _panel_qr_pallas_impl
+            from dhqr_tpu.ops.pallas_panel import _panel_qr_pallas_jit
 
             panel = jnp.asarray(rng.standard_normal((32768, 512)),
                                 jnp.float32)
             sync(panel)
-            comp = _panel_qr_pallas_impl.lower(
+            comp = _panel_qr_pallas_jit.lower(
                 panel, 0, interpret=False).compile()
             pf, al = comp(panel, 0)
             sync(al)

@@ -92,7 +92,12 @@ for s in $STAGES; do
       # SKIP_BANKED: stages that already produced a round-tagged TPU row
       # (in the tee) re-emit it instead of re-compiling — a short
       # recovery window jumps straight to the unbanked headline sizes.
-      probe bench "$RES/bench_${R}_run.jsonl" \
+      # Outer bound 4500 (not probe()'s 3600): the widened TPU child
+      # window (2800) + CPU fallback can legitimately reach ~3400 s, and
+      # the outer kill is the one bound that can land as SIGKILL
+      # mid-claim — it must only fire on a truly hung supervisor.
+      run bench "$RES/bench_${R}_run.jsonl" \
+        timeout -k 30 4500 \
         env DHQR_BENCH_TPU_TIMEOUT=2800 DHQR_BENCH_WATCHDOG_SCALE=3 \
             DHQR_BENCH_SKIP_BANKED=1 \
         python bench.py ;;

@@ -59,7 +59,7 @@ def main() -> None:
 
     from dhqr_tpu.ops.blocked import _apply_q_impl, _blocked_qr_impl
     from dhqr_tpu.ops.householder import _householder_qr_impl
-    from dhqr_tpu.ops.pallas_panel import _panel_qr_pallas_impl
+    from dhqr_tpu.ops.pallas_panel import _panel_qr_pallas_jit
     from dhqr_tpu.ops.solve import r_matrix
     from dhqr_tpu.utils.profiling import sync
 
@@ -89,7 +89,7 @@ def main() -> None:
                 panel = jnp.asarray(rng.standard_normal((m, nb)), jnp.float32)
                 sync(panel)
                 t0 = time.perf_counter()
-                comp = _panel_qr_pallas_impl.lower(
+                comp = _panel_qr_pallas_jit.lower(
                     panel, 0, interpret=False).compile()
                 compile_s = time.perf_counter() - t0
                 pf, al = comp(panel, 0)

@@ -407,13 +407,14 @@ def _lstsq_refined(A, b, cfg: DHQRConfig, mesh):
             refine=cfg.refine,
         )
     if mesh is None:
-        return _lstsq_impl(
-            A, b, cfg.block_size, cfg.blocked, cfg.precision, cfg.use_pallas,
-            norm=cfg.norm, panel_impl=cfg.panel_impl, refine=cfg.refine,
-            pallas_flat=_blocked.PALLAS_FLAT_WIDTH,
-            trailing_precision=cfg.trailing_precision,
-            lookahead=cfg.lookahead, agg_panels=cfg.agg_panels,
-        )
+        with _blocked._pallas_cache_guard(_lstsq_interp(A, cfg)):
+            return _lstsq_impl(
+                A, b, cfg.block_size, cfg.blocked, cfg.precision,
+                cfg.use_pallas, norm=cfg.norm, panel_impl=cfg.panel_impl,
+                refine=cfg.refine, pallas_flat=_blocked.PALLAS_FLAT_WIDTH,
+                trailing_precision=cfg.trailing_precision,
+                lookahead=cfg.lookahead, agg_panels=cfg.agg_panels,
+            )
     fact = qr(A, config=dataclasses.replace(cfg, refine=0), mesh=mesh)
     x = fact.solve(b)
     for _ in range(cfg.refine):
@@ -492,6 +493,18 @@ def _lstsq_alt_engine(A, b, cfg: DHQRConfig, mesh):
     raise ValueError(
         f"unknown engine {cfg.engine!r}: expected one of {LSTSQ_ENGINES}"
     )
+
+
+def _lstsq_interp(A, cfg) -> bool:
+    """Will ``_lstsq_impl`` trace an interpret-mode Pallas kernel? Same
+    resolution the impl performs inside its jit, evaluated pre-call so the
+    compile can be kept out of the persistent cache (see
+    ``ops.blocked._pallas_cache_guard``)."""
+    if not cfg.blocked:
+        return False
+    return _blocked._resolve_pallas(
+        cfg.use_pallas, A.shape[0], min(cfg.block_size, A.shape[1]), A.dtype
+    )[1]
 
 
 @partial(jax.jit, static_argnames=(
@@ -752,10 +765,11 @@ def lstsq(
             trailing_precision=cfg.trailing_precision,
             lookahead=cfg.lookahead, agg_panels=cfg.agg_panels,
         )
-    return _lstsq_impl(
-        A, b, cfg.block_size, cfg.blocked, cfg.precision, cfg.use_pallas,
-        norm=cfg.norm, panel_impl=cfg.panel_impl,
-        pallas_flat=_blocked.PALLAS_FLAT_WIDTH,
-        trailing_precision=cfg.trailing_precision,
-        lookahead=cfg.lookahead, agg_panels=cfg.agg_panels,
-    )
+    with _blocked._pallas_cache_guard(_lstsq_interp(A, cfg)):
+        return _lstsq_impl(
+            A, b, cfg.block_size, cfg.blocked, cfg.precision, cfg.use_pallas,
+            norm=cfg.norm, panel_impl=cfg.panel_impl,
+            pallas_flat=_blocked.PALLAS_FLAT_WIDTH,
+            trailing_precision=cfg.trailing_precision,
+            lookahead=cfg.lookahead, agg_panels=cfg.agg_panels,
+        )

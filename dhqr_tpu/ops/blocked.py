@@ -596,13 +596,37 @@ def _pallas_lowers_on_this_backend(dtype_name: str) -> bool:
     instead of crashing the caller. "always" still raises, by design.
     """
     try:
-        from dhqr_tpu.ops.pallas_panel import _panel_qr_pallas_impl
+        from dhqr_tpu.ops.pallas_panel import _panel_qr_pallas_jit
 
         probe = jnp.zeros((128, 8), dtype=jnp.dtype(dtype_name))
-        _panel_qr_pallas_impl.lower(probe, 0, interpret=False).compile()
+        _panel_qr_pallas_jit.lower(probe, 0, interpret=False).compile()
         return True
     except Exception:
         return False
+
+
+def _pallas_cache_guard(interpret: bool):
+    """Keep interpret-mode Pallas programs OUT of the persistent
+    compilation cache (wrap the jit CALL, where the compile happens).
+
+    Interpret mode lowers the kernel to host callbacks, and an executable
+    carrying callbacks is not safely deserializable in another process —
+    the callback registry indices are process-local, so a cross-process
+    cache hit can segfault the reader inside
+    ``compilation_cache.get_executable_and_time`` (measured 2026-08-01:
+    ``tests/test_sharded.py`` Pallas tests crashed reproducibly at file
+    scope reading entries written by a differently-ordered process, while
+    passing in isolation). Interpret mode is a CPU test vehicle, so the
+    cost is only a per-process recompile of the interpret programs; the
+    hardware path (``interpret=False``) keeps full caching.
+    """
+    if not interpret:
+        from contextlib import nullcontext
+
+        return nullcontext()
+    from jax._src.config import enable_compilation_cache
+
+    return enable_compilation_cache(False)
 
 
 def _resolve_pallas(mode: str, m: int, nb: int, dtype,
@@ -773,13 +797,16 @@ def blocked_householder_qr(
         else int(block_size)
     pallas, interpret = _resolve_pallas(use_pallas, m, min(nb, n), A.dtype)
     impl = _blocked_qr_impl_donate if donate else _blocked_qr_impl
-    return impl(A, nb, precision=precision, pallas=pallas,
-                pallas_interpret=interpret, norm=norm, panel_impl=panel_impl,
-                trailing_precision=trailing_precision,
-                # explicit (not the in-trace default) so the module global
-                # participates in the jit cache key via this wrapper
-                pallas_flat=PALLAS_FLAT_WIDTH, lookahead=lookahead,
-                agg_panels=agg_panels)
+    with _pallas_cache_guard(interpret):
+        return impl(A, nb, precision=precision, pallas=pallas,
+                    pallas_interpret=interpret, norm=norm,
+                    panel_impl=panel_impl,
+                    trailing_precision=trailing_precision,
+                    # explicit (not the in-trace default) so the module
+                    # global participates in the jit cache key via this
+                    # wrapper
+                    pallas_flat=PALLAS_FLAT_WIDTH, lookahead=lookahead,
+                    agg_panels=agg_panels)
 
 
 @partial(jax.jit, static_argnames=("block_size", "precision"))

@@ -312,8 +312,20 @@ def _panel_kernel_c64(off_ref, ar_ref, ai_ref, or_ref, oi_ref,
     alr_ref[:, :], ali_ref[:, :] = lax.fori_loop(0, nb, step, (zero, zero))
 
 
-@partial(jax.jit, static_argnames=("interpret",))
 def _panel_qr_pallas_impl(panel, offset, interpret=False):
+    """Guarded entry: interpret-mode compiles stay out of the persistent
+    cache (``ops.blocked._pallas_cache_guard`` — host-callback executables
+    are process-local). When called inside another jit's trace the guard
+    is a harmless no-op (the real compile happens later at the outer jit,
+    whose own entry point carries the guard)."""
+    from dhqr_tpu.ops.blocked import _pallas_cache_guard
+
+    with _pallas_cache_guard(interpret):
+        return _panel_qr_pallas_jit(panel, offset, interpret=interpret)
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def _panel_qr_pallas_jit(panel, offset, interpret=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 

@@ -115,11 +115,14 @@ def tsqr_lstsq(
     ensure_complex_supported(A.dtype)
     pallas, interpret = _resolve_tsqr_pallas(use_pallas, m // int(n_blocks),
                                              n, int(block_size), A.dtype)
-    from dhqr_tpu.ops.blocked import PALLAS_FLAT_WIDTH
+    from dhqr_tpu.ops.blocked import (PALLAS_FLAT_WIDTH,
+                                        _pallas_cache_guard)
 
-    return _tsqr_lstsq_impl(A, b, int(n_blocks), int(block_size), precision,
-                            pallas=pallas, interpret=interpret,
-                            pallas_flat=PALLAS_FLAT_WIDTH)
+    with _pallas_cache_guard(interpret):
+        return _tsqr_lstsq_impl(A, b, int(n_blocks), int(block_size),
+                                precision, pallas=pallas,
+                                interpret=interpret,
+                                pallas_flat=PALLAS_FLAT_WIDTH)
 
 
 def _resolve_tsqr_pallas(mode, leaf_rows, n, block_size, dtype):
@@ -173,11 +176,13 @@ def tsqr_r(
     ensure_complex_supported(A.dtype)
     pallas, interpret = _resolve_tsqr_pallas(use_pallas, m // int(n_blocks),
                                              n, int(block_size), A.dtype)
-    from dhqr_tpu.ops.blocked import PALLAS_FLAT_WIDTH
+    from dhqr_tpu.ops.blocked import (PALLAS_FLAT_WIDTH,
+                                        _pallas_cache_guard)
 
-    return _tsqr_r_impl(A, int(n_blocks), int(block_size), precision,
-                        pallas=pallas, interpret=interpret,
-                        pallas_flat=PALLAS_FLAT_WIDTH)
+    with _pallas_cache_guard(interpret):
+        return _tsqr_r_impl(A, int(n_blocks), int(block_size), precision,
+                            pallas=pallas, interpret=interpret,
+                            pallas_flat=PALLAS_FLAT_WIDTH)
 
 
 def _check_tsqr_shape(m: int, n: int, n_blocks: int) -> None:

@@ -793,11 +793,14 @@ def sharded_blocked_qr(
                                      device=mesh.devices.flat[0])
     A = _to_store_layout(A, n, nproc, nb, layout)
     A = jax.device_put(A, column_sharding(mesh, axis_name))
-    H, alpha = _build_blocked(
-        mesh, axis_name, n, nb, precision, layout, norm, pallas, interp,
-        panel_impl, PALLAS_FLAT_WIDTH, trailing_precision, lookahead,
-        agg_panels,
-    )(A)
+    from dhqr_tpu.ops.blocked import _pallas_cache_guard
+
+    with _pallas_cache_guard(interp):
+        H, alpha = _build_blocked(
+            mesh, axis_name, n, nb, precision, layout, norm, pallas, interp,
+            panel_impl, PALLAS_FLAT_WIDTH, trailing_precision, lookahead,
+            agg_panels,
+        )(A)
     if not _store_layout_output:
         H = _to_natural_layout(H, n, nproc, nb, layout)
     return H, alpha

@@ -116,5 +116,8 @@ def sharded_tsqr_lstsq(
 
     A = jax.device_put(A, NamedSharding(mesh, P(axis_name, None)))
     b = jax.device_put(b, NamedSharding(mesh, P(axis_name)))
-    return _build_tsqr(mesh, axis_name, n, nb, precision, pallas,
-                       interpret, PALLAS_FLAT_WIDTH)(A, b)
+    from dhqr_tpu.ops.blocked import _pallas_cache_guard
+
+    with _pallas_cache_guard(interpret):
+        return _build_tsqr(mesh, axis_name, n, nb, precision, pallas,
+                           interpret, PALLAS_FLAT_WIDTH)(A, b)

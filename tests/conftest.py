@@ -46,3 +46,23 @@ def _clear_jax_caches_between_modules():
     """
     yield
     jax.clear_caches()
+
+
+@pytest.fixture
+def fresh_compile_state():
+    """Clear JAX's in-memory caches before a shard_map+Pallas-interpret
+    compile.
+
+    jaxlib 0.9.0 segfaults compiling (or deserializing) such a program in
+    a heavily loaded process — reproducibly after ~69 tests' worth of
+    resident executables, while the same compile passes in a fresh
+    process (measured 2026-08-01: tests/test_sharded.py Pallas tests
+    crashed at file and suite scope in backend_compile_and_load /
+    compilation_cache.get_executable_and_time; green with a clear
+    immediately before). Request this fixture in ANY test that compiles a
+    new shard_map program with interpret-mode Pallas inside. Related:
+    ops.blocked._pallas_cache_guard keeps those programs out of the
+    persistent cache (their host-callback executables are not safely
+    deserializable across processes).
+    """
+    jax.clear_caches()

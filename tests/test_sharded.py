@@ -221,7 +221,7 @@ def test_cyclic_lstsq_end_to_end(mesh, dtype):
     assert res < TOLERANCE_FACTOR * oracle_residual(A, b)
 
 
-def test_sharded_blocked_qr_pallas_panels():
+def test_sharded_blocked_qr_pallas_panels(fresh_compile_state):
     """Fused Pallas panels inside the shard_map body (interpret mode on the
     CPU mesh) match the XLA panel path — the distributed tier's L0 kernel."""
     rng = np.random.default_rng(29)
@@ -238,7 +238,7 @@ def test_sharded_blocked_qr_pallas_panels():
                                    rtol=5e-4)
 
 
-def test_sharded_blocked_qr_complex64():
+def test_sharded_blocked_qr_complex64(fresh_compile_state):
     """complex64 (the TPU-native complex dtype) through the distributed
     compact-WY engine, including the fused planar-Pallas panel tier."""
     rng = np.random.default_rng(33)
@@ -263,7 +263,7 @@ def test_sharded_blocked_qr_complex64():
                                rtol=1e-3)
 
 
-def test_sharded_split_pallas_panels(monkeypatch):
+def test_sharded_split_pallas_panels(monkeypatch, fresh_compile_state):
     """The sharded bodies route wide panels through the split factor
     (base-width kernel calls) when the flat width is below nb — gate and
     call site must agree (round-3 review: the relaxed base-width gate
@@ -547,7 +547,7 @@ def test_sharded_agg_scan_remainder_branch():
                                atol=1e-10)
 
 
-def test_sharded_agg_composes_with_panel_engines():
+def test_sharded_agg_composes_with_panel_engines(fresh_compile_state):
     """agg_panels on the mesh composes with the non-default panel
     interiors: the reconstruct engine (traced-offset roll/mask frame
     inside the gathered group) and the Pallas kernel (interpret mode on

@@ -96,7 +96,7 @@ def test_tsqr_multi_rhs():
 
 
 @pytest.mark.parametrize("dtype", [np.float32, np.complex64])
-def test_tsqr_pallas_leaves_match_xla(dtype):
+def test_tsqr_pallas_leaves_match_xla(dtype, fresh_compile_state):
     """use_pallas="always" (interpret on CPU) routes the vmapped leaf and
     combine panel loops through the fused kernel — results must match the
     XLA leaves to f32 rounding. Round-3 hardware motivation: the XLA leaf
@@ -117,7 +117,7 @@ def test_tsqr_pallas_leaves_match_xla(dtype):
                                atol=2e-4 * np.linalg.norm(R_xla))
 
 
-def test_sharded_tsqr_pallas_leaves():
+def test_sharded_tsqr_pallas_leaves(fresh_compile_state):
     """Row-sharded TSQR with the kernel in each device's leaf (interpret on
     the CPU mesh) matches the XLA-leaf sharded path and the oracle."""
     mesh = row_mesh(8)
@@ -132,7 +132,7 @@ def test_sharded_tsqr_pallas_leaves():
     assert res < TOLERANCE_FACTOR * max(oracle_residual(A, b), 1e-30)
 
 
-def test_lstsq_engine_tsqr_accepts_use_pallas():
+def test_lstsq_engine_tsqr_accepts_use_pallas(fresh_compile_state):
     """The lstsq router passes use_pallas through to tsqr (and still rejects
     it for the all-GEMM cholqr engines)."""
     from dhqr_tpu.models.qr_model import lstsq
