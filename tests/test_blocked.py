@@ -512,3 +512,20 @@ def test_agg_panels_gradients_match_default():
                  (Aj,), (jnp.ones_like(Aj),))[1]
     np.testing.assert_allclose(np.asarray(t0), np.asarray(t1), rtol=1e-9,
                                atol=1e-11)
+
+
+def test_donating_engine_invalidates_input_buffer():
+    """The donating jit really donates: the input buffer is consumed
+    (aliased into the output), which is the one-matrix-of-HBM margin the
+    28672^2 capacity attempt rides on (benchmarks/tpu_bigsize_probe.py).
+    A silent regression to copy semantics would make that attempt
+    meaningless while still returning correct numbers."""
+    from dhqr_tpu.ops.blocked import _blocked_qr_impl_donate
+
+    A = jnp.asarray(np.random.default_rng(70).standard_normal((64, 32)),
+                    jnp.float32)
+    H0, a0 = blocked_householder_qr(A, block_size=16)
+    H1, a1 = _blocked_qr_impl_donate(A, 16)
+    np.testing.assert_array_equal(np.asarray(H1), np.asarray(H0))
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a0))
+    assert A.is_deleted(), "donated input still alive — aliasing lost"
