@@ -67,6 +67,14 @@ def run(n_devices: int) -> None:
     assert bool(jnp.all(jnp.isfinite(x))), "non-finite x (agg_panels)"
     print("dryrun: sharded_lstsq agg_panels=2 ok", flush=True)
 
+    # Grouped lookahead (the mesh-only agg+lookahead composition): each
+    # group's gather psum issued before the previous group's wide GEMM.
+    x = sharded_lstsq(A, b, cmesh, block_size=block_size, layout="cyclic",
+                      agg_panels=2, lookahead=True)
+    assert x.shape == (n,)
+    assert bool(jnp.all(jnp.isfinite(x))), "non-finite x (agg+lookahead)"
+    print("dryrun: sharded_lstsq agg_panels=2 lookahead ok", flush=True)
+
     # Awkward n (not divisible by the mesh): the internal orthogonal-
     # extension padding must compile and run on the mesh too.
     n_awk = n - 3

@@ -226,22 +226,26 @@ def main(argv=None) -> int:
         print(f"# warning: DHQR_LOOKAHEAD ignored — it applies to the "
               f"blocked householder engines only ({why})", file=sys.stderr)
         cfg = dataclasses.replace(cfg, lookahead=False)
-    if cfg.agg_panels and cfg.lookahead:
-        # Mutually exclusive schedules. Same ambient-vs-flag split as the
-        # other knobs: two explicit flags is a hard usage error; an
-        # env-sourced half of the conflict is dropped with a warning so an
-        # ambient leftover (e.g. DHQR_LOOKAHEAD=1 from a prior sweep)
-        # cannot abort the run mid-sweep with a raw ValueError.
+    if cfg.agg_panels and cfg.lookahead and ndev == 1:
+        # Mutually exclusive on ONE device (on a mesh the pair is the
+        # grouped-lookahead composition and passes through). Same
+        # ambient-vs-flag split as the other knobs: two explicit flags is
+        # a hard usage error; an env-sourced half of the conflict is
+        # dropped with a warning so an ambient leftover (e.g.
+        # DHQR_LOOKAHEAD=1 from a prior sweep) cannot abort the run
+        # mid-sweep with a raw ValueError.
         if args.agg_panels is not None and args.lookahead is not None:
             parser.error("--agg-panels and --lookahead are mutually "
-                         "exclusive schedules")
+                         "exclusive schedules on one device (a mesh "
+                         "composes them as grouped lookahead)")
         if args.agg_panels is not None:  # lookahead came from the env
             print("# warning: DHQR_LOOKAHEAD ignored — mutually exclusive "
-                  "with the explicit --agg-panels", file=sys.stderr)
+                  "with the explicit --agg-panels on one device",
+                  file=sys.stderr)
             cfg = dataclasses.replace(cfg, lookahead=False)
         else:  # agg came from the env (lookahead explicit or also env)
             print("# warning: DHQR_AGG_PANELS ignored — mutually exclusive "
-                  "with lookahead", file=sys.stderr)
+                  "with lookahead on one device", file=sys.stderr)
             cfg = dataclasses.replace(cfg, agg_panels=None)
     # agg_panels runs on BOTH tiers since round-5 session 2 (the sharded
     # aggregated engine, parallel/sharded_qr._blocked_shard_agg) — only

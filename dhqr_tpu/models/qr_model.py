@@ -34,7 +34,7 @@ from dhqr_tpu.utils.config import DHQRConfig
 LSTSQ_ENGINES = ("householder", "tsqr", "cholqr2", "cholqr3")
 
 
-def _check_sched_knobs(cfg: DHQRConfig) -> None:
+def _check_sched_knobs(cfg: DHQRConfig, mesh=None) -> None:
     """Shared schedule-knob validation for qr() and lstsq() — the ops-level
     wrapper also checks, but lstsq's jitted route bypasses it, and a bad
     value must not be silently ignored there."""
@@ -43,10 +43,12 @@ def _check_sched_knobs(cfg: DHQRConfig) -> None:
             f"agg_panels must be >= 2 (got {cfg.agg_panels}); "
             "None means per-panel updates"
         )
-    if cfg.agg_panels and cfg.lookahead:
+    if cfg.agg_panels and cfg.lookahead and mesh is None:
         raise ValueError(
-            "agg_panels and lookahead are mutually exclusive (the grouped "
-            "schedule has no pending-panel reorder yet)"
+            "agg_panels and lookahead are mutually exclusive on the "
+            "single-device tier (both only add flops there); on a mesh "
+            "the pair is the grouped-lookahead composition — pass mesh= "
+            "(see parallel/sharded_qr._blocked_shard_agg)"
         )
 
 
@@ -220,7 +222,7 @@ def qr(
             "tsqr/cholqr engines are lstsq-only fast paths"
         )
     _check_panel_impl(cfg)
-    _check_sched_knobs(cfg)
+    _check_sched_knobs(cfg, mesh)
     if cfg.refine:
         raise ValueError(
             "refine applies to lstsq() only — qr() returns the raw "
@@ -674,7 +676,7 @@ def lstsq(
             f"norm must be 'accurate' or 'fast', got {cfg.norm!r}"
         )
     _check_panel_impl(cfg)
-    _check_sched_knobs(cfg)
+    _check_sched_knobs(cfg, mesh)
     if cfg.engine not in LSTSQ_ENGINES:
         raise ValueError(
             f"unknown engine {cfg.engine!r}: expected one of {LSTSQ_ENGINES}"

@@ -786,8 +786,10 @@ def blocked_householder_qr(
                          "None means per-panel updates")
     if agg_panels and lookahead:
         raise ValueError(
-            "agg_panels and lookahead are mutually exclusive (the grouped "
-            "schedule has no pending-panel reorder yet)"
+            "agg_panels and lookahead are mutually exclusive on the "
+            "single-device engine (both only add flops here); the mesh "
+            "tier composes them as grouped lookahead — use qr()/lstsq() "
+            "with mesh= (parallel/sharded_qr._blocked_shard_agg)"
         )
     # (complex + panel_impl='reconstruct' is rejected at the _panel_factor
     # chokepoint — every XLA-path route converges there, and the Pallas

@@ -195,18 +195,20 @@ def _blocked_shard_body(
     def _psum_owner(x, mine):
         return lax.psum(jnp.where(mine, x, jnp.zeros_like(x)), axis)
 
+    if agg_panels and agg_panels > 1 and num_panels > 1:
+        # With lookahead too, this is the GROUPED-lookahead composition
+        # (mesh-only — see _blocked_shard_agg).
+        return _blocked_shard_agg(
+            Al, n=n, nb=nb, k=agg_panels, axis=axis, precision=precision,
+            layout=layout, factor=_factor, done_cols=_done_cols, tprec=tprec,
+            gidx_base=gidx_base, p=p, nproc=nproc, lookahead=lookahead,
+        )
+
     if lookahead and num_panels > 1:
         return _blocked_shard_lookahead(
             Al, n=n, nb=nb, axis=axis, precision=precision, layout=layout,
             factor=_factor, psum_owner=_psum_owner, done_cols=_done_cols,
             tprec=tprec, gidx_base=gidx_base, p=p, nproc=nproc,
-        )
-
-    if agg_panels and agg_panels > 1 and num_panels > 1:
-        return _blocked_shard_agg(
-            Al, n=n, nb=nb, k=agg_panels, axis=axis, precision=precision,
-            layout=layout, factor=_factor, done_cols=_done_cols, tprec=tprec,
-            gidx_base=gidx_base, p=p, nproc=nproc,
         )
 
     if num_panels <= MAX_UNROLLED_PANELS:
@@ -422,7 +424,7 @@ def _blocked_shard_lookahead(
 
 def _blocked_shard_agg(
     Al, *, n, nb, k, axis, precision, layout, factor, done_cols,
-    tprec, gidx_base, p, nproc,
+    tprec, gidx_base, p, nproc, lookahead=False,
 ):
     """Aggregated-trailing-update order for the sharded compact-WY body.
 
@@ -440,17 +442,64 @@ def _blocked_shard_agg(
     transform (``shifted_tril`` of the k packed panels side by side), so
     wide passes drop k-fold exactly as on the single-device tier.
 
+    ``lookahead=True`` composes GROUPED lookahead on top (mesh-only —
+    the single-device tiers keep rejecting the combination, where both
+    knobs only add flops): group g+1's gather psum is issued, its
+    replicated copy updated by group g's aggregated transform, and its
+    factorization completed BEFORE group g's wide local trailing GEMM,
+    whose inputs deliberately do not depend on that psum — 1/k the
+    collective launches AND a full wide-GEMM overlap window per
+    collective. Per-column arithmetic is order-identical to the plain
+    aggregated schedule.
+
     Program-size strategy matches the default body: groups statically
-    unrolled below MAX_UNROLLED_PANELS panels, else super-blocks with an
+    unrolled below MAX_UNROLLED_PANELS panels (plain schedule; the
+    lookahead composition always uses the super-block machinery — its
+    pending-group carry wants uniform frames), else super-blocks with an
     inner ``lax.scan`` over groups (the super-block size is rounded up to
     a multiple of k so aggregation always engages; a final sub-k panel
     remainder runs as ONE ragged aggregated group — single gather psum —
     unlike ops/blocked's single-device remainder, which falls back to the
-    per-panel scan).
+    per-panel scan). Under lookahead each super-block boundary is a
+    one-group bubble, exactly like the panel-lookahead scan's.
     """
     m, nloc = Al.shape
     num_panels = n // nb
     alpha = jnp.zeros((n,), dtype=Al.dtype)
+    W = k * nb
+
+    def _norm(owners):
+        return [(mine, jnp.asarray(kl, jnp.int32)) for mine, kl in owners]
+
+    def gather(Sl, owners, width):
+        """One psum: owners contribute their panels one-hot, replicated."""
+        ms = Sl.shape[0]
+        with jax.named_scope("group_gather"):
+            contrib = jnp.zeros((ms, width), dtype=Sl.dtype)
+            for j, (mine, kl) in enumerate(owners):
+                loc = lax.dynamic_slice(Sl, (jnp.int32(0), kl), (ms, nb))
+                contrib = lax.dynamic_update_slice(
+                    contrib, jnp.where(mine, loc, jnp.zeros_like(loc)),
+                    (jnp.int32(0), jnp.int32(j * nb)))
+            return lax.psum(contrib, axis)
+
+    def scatter(Sl, G, owners):
+        """Owners write their factored panels back into the local slice."""
+        ms = Sl.shape[0]
+        for j, (mine, kl) in enumerate(owners):
+            pfj = lax.slice(G, (0, j * nb), (ms, (j + 1) * nb))
+            Sl_upd = lax.dynamic_update_slice(Sl, pfj, (jnp.int32(0), kl))
+            Sl = jnp.where(mine, Sl_upd, Sl)
+        return Sl
+
+    def wide_apply(Sl, G, c0, gidx_live, end_col):
+        """Aggregated trailing transform on local columns >= end_col."""
+        with jax.named_scope("trailing_update_agg"):
+            Yg = shifted_tril(G, c0)
+            C_new = apply_block_reflector_h(Yg, Sl, precision,
+                                            gemm_precision=tprec)
+            cmask = (gidx_live >= end_col)[None, :]
+            return jnp.where(cmask, C_new, Sl)
 
     def group(Sl, c0, gsize, owners, gidx_live, end_col):
         """Factor one gsize-panel group on the live slice Sl (ms, ncols).
@@ -460,32 +509,16 @@ def _blocked_shard_agg(
         ``end_col``: global column index just past the group (mask bound).
         Returns the updated slice and the group's stacked alpha block.
         """
-        ms = Sl.shape[0]
-        W = gsize * nb
-        owners = [(mine, jnp.asarray(kl, jnp.int32)) for mine, kl in owners]
-        with jax.named_scope("group_gather"):
-            contrib = jnp.zeros((ms, W), dtype=Sl.dtype)
-            for j, (mine, kl) in enumerate(owners):
-                loc = lax.dynamic_slice(Sl, (jnp.int32(0), kl), (ms, nb))
-                contrib = lax.dynamic_update_slice(
-                    contrib, jnp.where(mine, loc, jnp.zeros_like(loc)),
-                    (jnp.int32(0), jnp.int32(j * nb)))
-            G = lax.psum(contrib, axis)
+        owners = _norm(owners)
+        G = gather(Sl, owners, gsize * nb)
         G, alphas = _factor_group(G, c0, gsize, nb, factor, precision,
                                   tprec)
-        for j, (mine, kl) in enumerate(owners):
-            pfj = lax.slice(G, (0, j * nb), (ms, (j + 1) * nb))
-            Sl_upd = lax.dynamic_update_slice(Sl, pfj, (jnp.int32(0), kl))
-            Sl = jnp.where(mine, Sl_upd, Sl)
-        with jax.named_scope("trailing_update_agg"):
-            Yg = shifted_tril(G, c0)
-            C_new = apply_block_reflector_h(Yg, Sl, precision,
-                                            gemm_precision=tprec)
-            cmask = (gidx_live >= end_col)[None, :]
-            Sl = jnp.where(cmask, C_new, Sl)
+        Sl = scatter(Sl, G, owners)
+        Sl = wide_apply(Sl, G, c0, gidx_live, end_col)
         return Sl, alphas
 
-    if num_panels <= MAX_UNROLLED_PANELS:
+    if num_panels <= MAX_UNROLLED_PANELS and not (lookahead
+                                                  and num_panels > k):
         for g0 in range(0, num_panels, k):
             gsize = min(k, num_panels - g0)
             k0 = g0 * nb
@@ -504,8 +537,13 @@ def _blocked_shard_agg(
     _, _, ppo = _panels_schedule(n, nb)
     # Round the super-block UP to a multiple of k so every super-block
     # holds whole groups and aggregation genuinely engages (same guard as
-    # the single-device dispatch, ops/blocked._blocked_qr_impl).
+    # the single-device dispatch, ops/blocked._blocked_qr_impl); under
+    # lookahead, to at least TWO groups, or no super-block ever holds a
+    # pending/next pair and the composition silently degenerates to the
+    # plain aggregated order.
     ppo = -(-ppo // k) * k
+    if lookahead:
+        ppo = max(ppo, 2 * k)
     for ob in range(0, num_panels, ppo):
         pcount = min(ppo, num_panels - ob)
         K = ob * nb
@@ -515,16 +553,62 @@ def _blocked_shard_agg(
         gidx_live = gidx_base[drop:]
         ngroups, rem = pcount // k, pcount % k
 
-        def body(Sl, g, ob=ob, K=K, drop=drop):
-            kb0 = ob + g * k
+        def _owners_traced(kb0):
             owners = []
             for j in range(k):
                 ow, kl = _panel_owner_traced(kb0 + j, nproc, nloc, nb, layout)
                 owners.append((p == ow, kl - drop))
-            return group(Sl, kb0 * nb - K, k, owners, gidx_live,
-                         (kb0 + k) * nb)
+            return owners
 
-        if ngroups:
+        def body(Sl, g, ob=ob, K=K):
+            kb0 = ob + g * k
+            return group(Sl, kb0 * nb - K, k, _owners_traced(kb0),
+                         gidx_live, (kb0 + k) * nb)
+
+        if lookahead and ngroups >= 2:
+            # Grouped lookahead: group 0 factors up front (wide apply
+            # deferred); each scan step gathers+factors group g BEFORE
+            # group g-1's wide GEMM; a fix-up applies the last group.
+            owners0 = _norm(_owners_traced(jnp.int32(ob)))
+            with jax.named_scope("panel_factor"):
+                G0 = gather(Sl, owners0, W)
+                G0, a0 = _factor_group(G0, ob * nb - K, k, nb, factor,
+                                       precision, tprec)
+            Sl = scatter(Sl, G0, owners0)
+            alpha = alpha.at[K : K + W].set(a0)
+
+            def la_body(carry, g, ob=ob, K=K):
+                Sl, Gp = carry  # previous group's factored block (ms, W)
+                kb0 = ob + g * k
+                c0 = kb0 * nb - K
+                owners = _norm(_owners_traced(kb0))
+                Gr = gather(Sl, owners, W)  # psum issued EARLY
+                with jax.named_scope("lookahead_update"):
+                    Yp = shifted_tril(Gp, c0 - W)
+                    Gr = apply_block_reflector_h(Yp, Gr, precision,
+                                                 gemm_precision=tprec)
+                with jax.named_scope("panel_factor"):
+                    G, a_g = _factor_group(Gr, c0, k, nb, factor,
+                                           precision, tprec)
+                with jax.named_scope("trailing_update"):
+                    # Pre-scatter Sl: the wide GEMM must not depend on
+                    # THIS group's psum (the mask excludes this group's
+                    # columns, which the scatter below writes).
+                    C_new = apply_block_reflector_h(Yp, Sl, precision,
+                                                    gemm_precision=tprec)
+                    cmask = (gidx_live >= (kb0 + k) * nb)[None, :]
+                    Sl = jnp.where(cmask, C_new, Sl)
+                Sl = scatter(Sl, G, owners)
+                return (Sl, G), a_g
+
+            (Sl, G_last), a_rest = lax.scan(
+                la_body, (Sl, G0),
+                jnp.arange(1, ngroups, dtype=jnp.int32))
+            Sl = wide_apply(Sl, G_last, (ob + (ngroups - 1) * k) * nb - K,
+                            gidx_live, (ob + ngroups * k) * nb)
+            alpha = alpha.at[K + W : K + ngroups * W].set(
+                a_rest.reshape((ngroups - 1) * W))
+        elif ngroups:
             Sl, a_grp = lax.scan(body, Sl,
                                  jnp.arange(ngroups, dtype=jnp.int32))
             alpha = alpha.at[K : K + ngroups * k * nb].set(
@@ -745,20 +829,20 @@ def sharded_blocked_qr(
     ``agg_panels=k`` (k > 1) gathers each k-panel group with ONE psum,
     factors the group replicated, and applies the aggregated compact-WY
     trailing update once per group — 1/k the collective launches and wide
-    passes for the same words (see :func:`_blocked_shard_agg`). Mutually
-    exclusive with ``lookahead``.
+    passes for the same words (see :func:`_blocked_shard_agg`). Combined
+    with ``lookahead=True`` it becomes the grouped-lookahead composition
+    (each group's single psum issued before the previous group's wide
+    GEMM) — allowed HERE, on the mesh, where the overlap has a collective
+    to hide; the single-device tiers keep rejecting the pair.
     """
     m, n = A.shape
     nproc = mesh.shape[axis_name]
     if agg_panels is not None and agg_panels < 2:
         raise ValueError(f"agg_panels must be >= 2 (got {agg_panels}); "
                          "use None to disable aggregation")
-    if agg_panels and lookahead:
-        raise ValueError(
-            "agg_panels and lookahead are mutually exclusive (the grouped "
-            "schedule already defers the wide update; combining them has "
-            "no defined order)"
-        )
+    # agg_panels + lookahead together = the grouped-lookahead composition
+    # (1/k the collectives AND overlap per collective) — mesh-only; the
+    # single-device tiers keep rejecting the pair (no collective to hide).
     from dhqr_tpu.parallel.layout import plan_padding
 
     nb, n_pad = plan_padding(n, nproc, block_size)

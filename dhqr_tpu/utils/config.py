@@ -95,7 +95,11 @@ class DHQRConfig:
         gathered with ONE psum instead of k per-panel psums — same words
         over ICI, 1/k the collective launches (see
         parallel/sharded_qr._blocked_shard_agg). None (default) =
-        per-panel updates; mutually exclusive with ``lookahead``. The
+        per-panel updates. With ``lookahead=True`` on a MESH the pair
+        composes as grouped lookahead — each group's single gather psum
+        issued before the previous group's wide trailing GEMM (1/k the
+        collectives AND overlap per collective); single-device the pair
+        stays mutually exclusive (both only add flops there). The
         single-device fully-unrolled path (num_panels <=
         DHQR_MAX_PANELS) silently ignores it — aggregation is a
         scanned-path lever there; the SHARDED unrolled path does

@@ -573,3 +573,126 @@ def test_sharded_agg_composes_with_panel_engines(fresh_compile_state):
                                atol=5e-5)
     np.testing.assert_allclose(np.asarray(a1), np.asarray(a0), rtol=5e-5,
                                atol=5e-5)
+
+
+@pytest.mark.parametrize("layout", ["block", "cyclic"])
+def test_sharded_agg_lookahead_matches_default(mesh, layout):
+    """Grouped lookahead (agg_panels + lookahead, mesh-only): each group's
+    single gather psum is issued and its replicated factorization done
+    BEFORE the previous group's wide trailing GEMM — per-column
+    arithmetic is order-identical to the plain aggregated schedule, so
+    results must match the default schedule to roundoff. (160, 96, 4)
+    with k=2 puts >= 2 groups in each super-block, so the pending-group
+    scan genuinely engages; (96, 64, 8) exercises the ppo bump that
+    gives small matrices a 2-group super-block."""
+    for (m, n, nb) in [(96, 64, 8), (160, 96, 4)]:
+        A, _ = random_problem(m, n, np.float64, seed=63)
+        H0, a0 = sharded_blocked_qr(jnp.asarray(A), mesh, block_size=nb,
+                                    layout=layout)
+        H1, a1 = sharded_blocked_qr(jnp.asarray(A), mesh, block_size=nb,
+                                    layout=layout, agg_panels=2,
+                                    lookahead=True)
+        np.testing.assert_allclose(np.asarray(H1), np.asarray(H0),
+                                   rtol=1e-10, atol=1e-10)
+        np.testing.assert_allclose(np.asarray(a1), np.asarray(a0),
+                                   rtol=1e-10, atol=1e-10)
+
+
+def test_sharded_agg_lookahead_remainder_and_public_api():
+    """The composition through the public surface with a ragged tail:
+    40 panels, k=3 -> super-blocks of 6 (two groups, lookahead engages)
+    with a final pcount=4 block (one group + remainder panel, plain
+    path); plus the single-device rejection stays."""
+    import dhqr_tpu
+
+    mesh8 = column_mesh(8)
+    A, b = random_problem(192, 160, np.float64, seed=64)
+    x0 = dhqr_tpu.lstsq(jnp.asarray(A), jnp.asarray(b), mesh=mesh8,
+                        block_size=4, layout="cyclic")
+    x1 = dhqr_tpu.lstsq(jnp.asarray(A), jnp.asarray(b), mesh=mesh8,
+                        block_size=4, layout="cyclic", agg_panels=3,
+                        lookahead=True)
+    np.testing.assert_allclose(np.asarray(x1), np.asarray(x0), rtol=1e-8,
+                               atol=1e-10)
+    with pytest.raises(ValueError, match="single-device"):
+        dhqr_tpu.lstsq(jnp.asarray(A), jnp.asarray(b), block_size=4,
+                       agg_panels=3, lookahead=True)
+    with pytest.raises(ValueError, match="single-device"):
+        blocked_householder_qr(jnp.asarray(A), block_size=4, agg_panels=3,
+                               lookahead=True)
+
+
+def test_agg_lookahead_wide_gemm_independent_of_group_psum():
+    """Pin the overlap structurally (the grouped twin of the panel
+    lookahead pin): in the composed schedule's scan body, no wide
+    dot_general may transitively depend on the current iteration's
+    gather psum — otherwise the schedule silently degenerates to
+    psum -> GEMM -> psum serialization."""
+    from functools import partial
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from dhqr_tpu.parallel import sharded_qr as SQ
+
+    mesh4 = column_mesh(4)
+    body = partial(SQ._blocked_shard_body, n=64, nb=4, axis="cols",
+                   layout="cyclic", agg_panels=2, lookahead=True)
+    f = shard_map(lambda a: body(a), mesh=mesh4, in_specs=P(None, "cols"),
+                  out_specs=(P(None, "cols"), P()), check_vma=False)
+    jaxpr = jax.make_jaxpr(f)(jnp.zeros((96, 64)))
+    JaxprT = type(jaxpr.jaxpr)
+
+    scan_bodies = []
+
+    def find_scans(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "scan":
+                scan_bodies.append(eqn.params["jaxpr"].jaxpr)
+            for prm in eqn.params.values():
+                inner = getattr(prm, "jaxpr", prm)
+                if isinstance(inner, JaxprT):
+                    find_scans(inner)
+
+    find_scans(jaxpr.jaxpr)
+    la_bodies = [s for s in scan_bodies
+                 if any(e.primitive.name == "psum" for e in s.eqns)]
+    assert la_bodies, "no psum-bearing scan body found"
+    from jax.extend.core import Var as var_t
+
+    for sb in la_bodies:
+        producers = {}
+        for eqn in sb.eqns:
+            for ov in eqn.outvars:
+                producers[ov] = eqn
+        psum_ids = {id(e) for e in sb.eqns if e.primitive.name == "psum"}
+
+        def depends_on_psum(eqn, seen):
+            for iv in eqn.invars:
+                if not isinstance(iv, var_t) or iv in seen:
+                    continue
+                seen.add(iv)
+                prod = producers.get(iv)
+                if prod is None:
+                    continue
+                if id(prod) in psum_ids or depends_on_psum(prod, seen):
+                    return True
+            return False
+
+        # The wide trailing apply is the LAST GEMM work in the body
+        # (its two dots follow the group's interior factorization in
+        # program order; the live width shrinks per super-block, so size
+        # cannot identify them). The overlap property: the body ENDS in
+        # psum-independent GEMMs — the scheduler can run them while the
+        # gather psum (whose consumers all sit earlier, feeding only the
+        # carry) is in flight.
+        dots = [e for e in sb.eqns if e.primitive.name == "dot_general"]
+        assert len(dots) >= 4, "unexpectedly few dots in the scan body"
+        tail_clean = [d for d in dots if not depends_on_psum(d, set())]
+        assert len(tail_clean) >= 2, (
+            "fewer than two psum-independent GEMMs — wide trailing apply "
+            "entangled with the gather")
+        assert not depends_on_psum(dots[-1], set()), (
+            f"final dot_general {dots[-1].outvars[0].aval.shape} depends "
+            "on this iteration's gather psum — grouped-lookahead overlap "
+            "broken")
