@@ -96,9 +96,14 @@ for s in $STAGES; do
       # window (2800) + CPU fallback can legitimately reach ~3400 s, and
       # the outer kill is the one bound that can land as SIGKILL
       # mid-claim — it must only fire on a truly hung supervisor.
+      # The TPU child budget honors an inherited DHQR_BENCH_TPU_TIMEOUT:
+      # a watcher that recovers close to its deadline shrinks it so the
+      # bench cannot overrun into the driver's round-end window (a
+      # two-process TPU collision can wedge the relay for both).
+      _bt="${DHQR_BENCH_TPU_TIMEOUT:-2800}"
       run bench "$RES/bench_${R}_run.jsonl" \
-        timeout -k 30 4500 \
-        env DHQR_BENCH_TPU_TIMEOUT=2800 DHQR_BENCH_WATCHDOG_SCALE=3 \
+        timeout -k 30 $(( _bt + 1700 )) \
+        env DHQR_BENCH_TPU_TIMEOUT="$_bt" DHQR_BENCH_WATCHDOG_SCALE=3 \
             DHQR_BENCH_SKIP_BANKED=1 \
         python bench.py ;;
     agg)

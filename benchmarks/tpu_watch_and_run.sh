@@ -64,7 +64,12 @@ while :; do
     # both (bench ~28 min + agg ~20 + reconstruct ~20 + cembed ~10 fills
     # the 90-min tier; split still runs in the full tier above).
     elif [ "$rem" -ge 5400 ]; then stages="bench agg reconstruct cembed"
-    elif [ "$rem" -ge 1800 ]; then stages="bench"
+    elif [ "$rem" -ge 1800 ]; then
+      stages="bench"
+      # Late recovery: size the bench child to the time left (minus the
+      # CPU fallback + exit margin) so it cannot overrun the deadline
+      # into the driver's own TPU window.
+      export DHQR_BENCH_TPU_TIMEOUT=$(( rem - 900 ))
     else
       echo "=== relay recovered with only $rem s left; leaving the window" >&2
       exit 2
