@@ -680,6 +680,11 @@ def test_agg_lookahead_wide_gemm_independent_of_group_psum():
             for ov in eqn.outvars:
                 producers[ov] = eqn
         psum_ids = {id(e) for e in sb.eqns if e.primitive.name == "psum"}
+        # The collective economics: ONE gather psum per group step (the
+        # default body would issue 2k = 4 here).
+        assert len(psum_ids) == 1, (
+            f"expected exactly one gather psum per group step, found "
+            f"{len(psum_ids)}")
 
         def depends_on_psum(eqn, seen):
             for iv in eqn.invars:
