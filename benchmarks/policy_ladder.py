@@ -1,0 +1,179 @@
+"""Precision-policy A/B ladder: trailing precision x refine, any backend.
+
+The decision table for the round-6 tentpole (VERDICT r5 item 2 — "the
+obvious 2-3x lever"): for every trailing-GEMM precision in the ladder
+(highest / high / default), factor once at the error-anchor size and
+measure
+
+* the FACTOR backward error ||QR - A||_F / ||A||_F (refine-independent:
+  it is a property of the factorization itself);
+* the SOLVE backward error eta(x) = ||A x - b|| / (||A||_F ||x|| + ||b||)
+  at refine = 0 and refine = 1, REUSING the factorization — the pair that
+  shows one refinement sweep buying a cheap factor's error back;
+* wall seconds per factorization (chain-timed on TPU where the tunnel RTT
+  would otherwise dominate; direct elsewhere).
+
+Emits one JSONL row per trailing precision (stdout + the results file).
+On CPU the MXU pass count collapses to native f32, so the CPU artifact
+pins the PLUMBING and the refinement mechanics (errors must sit at f32
+roundoff for every cell, <= 1e-5 after refine=1 per the acceptance bar);
+the TPU run of the same script (or bench.py's ladder stages, which share
+the stage configs) decides the adopted default.
+
+Usage:  python benchmarks/policy_ladder.py [n]     (default n=1024)
+Writes: benchmarks/results/policy_ladder_<platform>.jsonl (append).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _stage(name: str) -> None:
+    print(f"::stage {name} t={time.time():.1f}", file=sys.stderr, flush=True)
+
+
+def main(n: int = 1024) -> None:
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(3))
+    from bench import ROUND, _Watchdog, _chained_qr
+
+    _stage("import")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(_REPO, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+
+    from dhqr_tpu.ops.blocked import (_apply_q_impl, _apply_qt_impl,
+                                      _blocked_qr_impl)
+    from dhqr_tpu.ops.solve import back_substitute, r_matrix
+    from dhqr_tpu.precision import TRAILING_PRECISIONS
+    from dhqr_tpu.utils.profiling import sync
+    from dhqr_tpu.utils.testing import solve_backward_error
+
+    _stage("backend_init")
+    with _Watchdog("backend_init", 240):
+        dev = jax.devices()[0]
+        platform = dev.platform
+        kind = getattr(dev, "device_kind", "?")
+        sync(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    _stage(f"backend_ready_{platform}")
+    on_tpu = platform == "tpu"
+    nb = 256 if on_tpu else 128
+    chain = 5 if on_tpu else 0
+    out_path = os.path.join(_REPO, "benchmarks", "results",
+                            f"policy_ladder_{platform}.jsonl")
+
+    rng = np.random.default_rng(0)
+    A = jnp.asarray(rng.random((n, n)), jnp.float32)
+    b = jnp.asarray(rng.random((n,)), jnp.float32)
+    sync(A)
+
+    def emit(rec):
+        rec.update(platform=platform, device_kind=kind, round=ROUND)
+        line = json.dumps(rec)
+        print(line, flush=True)
+        with open(out_path, "a") as f:
+            f.write(line + "\n")
+
+    def cell(tprec):
+        name = f"policy_{n}_tp-{tprec}"
+        _stage(name)
+        split = None if tprec == "highest" else tprec
+        kw = dict(precision="highest", pallas=on_tpu, norm="fast",
+                  panel_impl="loop", trailing_precision=split)
+        with _Watchdog(name, 560 if on_tpu else 240):
+            t0 = time.perf_counter()
+            single = _blocked_qr_impl.lower(A, nb, **kw).compile()
+            compile_s = time.perf_counter() - t0
+            H, al = single(A)
+            sync(al)
+            ts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                H, al = single(A)
+                sync(al)
+                ts.append(time.perf_counter() - t0)
+            t = t1 = min(ts)
+            unreliable = False
+            if chain:
+                # Chain-timed on TPU: the tunnel RTT is present once in
+                # both measurements and cancels in the delta (bench.py's
+                # protocol, same shared program builder).
+                ck = jax.jit(_chained_qr(_blocked_qr_impl, lax, nb, kw,
+                                         chain)).lower(A).compile()
+                _, sc = ck(A)
+                sync(sc)
+                ts = []
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    _, sc = ck(A)
+                    sync(sc)
+                    ts.append(time.perf_counter() - t0)
+                tk = min(ts)
+                delta = (tk - t1) / (chain - 1)
+                if tk > t1 * 1.05 and delta > 0:
+                    t = delta
+                else:
+                    unreliable = True
+            # Factor backward error (refine cannot change it).
+            QR = _apply_q_impl(H, r_matrix(H, al), nb, precision="highest")
+            ferr = float(jnp.linalg.norm(QR - A) / jnp.linalg.norm(A))
+
+            # Solve backward error at refine 0/1, reusing (H, al).
+            def qr_solve(rhs):
+                return back_substitute(
+                    H, al, _apply_qt_impl(H, rhs, nb, precision="highest"))
+
+            def eta(xv):
+                return solve_backward_error(A, xv, b)
+
+            x0 = qr_solve(b)
+            r_ = b - jnp.matmul(A, x0, precision="highest")
+            x1 = x0 + qr_solve(r_)
+            flops = (4.0 / 3.0) * n**3
+            rec = {
+                "metric": f"qr_policy_ladder_{n}x{n}",
+                "trailing_precision": tprec,
+                "value": round(flops / t / 1e9, 2), "unit": "GFLOP/s",
+                "seconds": round(t, 4), "block_size": nb,
+                "precision": "highest",
+                "compile_seconds": round(compile_s, 2),
+                f"backward_error_{n}": ferr,
+                "solve_backward_error_refine0": eta(x0),
+                "solve_backward_error_refine1": eta(x1),
+                "error_target": 1e-5,
+                "pallas_panels": on_tpu,
+            }
+            if chain:
+                rec["chain_length"] = chain
+                if unreliable:
+                    rec["chain_unreliable"] = True
+            emit(rec)
+            return rec
+
+    rows = [cell(t) for t in TRAILING_PRECISIONS]
+    _stage("done")
+    # One-line verdict for the session log: does every cell meet the
+    # acceptance bar (<= 1e-5 solve backward error after one refinement)?
+    ok = all(r["solve_backward_error_refine1"] <= 1e-5 for r in rows)
+    print(json.dumps({"metric": "policy_ladder_verdict", "n": n,
+                      "all_cells_refine1_below_1e-5": ok,
+                      "platform": platform, "round": ROUND}), flush=True)
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 1024)

@@ -100,11 +100,18 @@ for s in $STAGES; do
       # a watcher that recovers close to its deadline shrinks it so the
       # bench cannot overrun into the driver's round-end window (a
       # two-process TPU collision can wedge the relay for both).
+      # Round-6: pre-warm the persistent compile cache in a throwaway
+      # child before any stage watchdog arms (DHQR_BENCH_PREWARM_TIMEOUT;
+      # the prewarm child self-budgets and never dies mid-compile), so
+      # the armed escalation meets only warm compiles — the round-5
+      # mid-compile-watchdog wedge cannot recur. Its budget rides INSIDE
+      # the widened window: the outer bound grows by the same amount.
       _bt="${DHQR_BENCH_TPU_TIMEOUT:-2800}"
+      _pw="${DHQR_BENCH_PREWARM_TIMEOUT:-900}"
       run bench "$RES/bench_${R}_run.jsonl" \
-        timeout -k 30 $(( _bt + 1700 )) \
+        timeout -k 30 $(( _bt + _pw + 1700 )) \
         env DHQR_BENCH_TPU_TIMEOUT="$_bt" DHQR_BENCH_WATCHDOG_SCALE=3 \
-            DHQR_BENCH_SKIP_BANKED=1 \
+            DHQR_BENCH_SKIP_BANKED=1 DHQR_BENCH_PREWARM_TIMEOUT="$_pw" \
         python bench.py ;;
     agg)
       probe agg "$RES/tpu_${R}_agg.jsonl" \

@@ -38,6 +38,12 @@ from dhqr_tpu.ops.solve import apply_q, apply_qt, back_substitute, solve_least_s
 from dhqr_tpu.ops.differentiable import lstsq_diff
 from dhqr_tpu.ops.tsqr import tsqr_lstsq, tsqr_r
 from dhqr_tpu.ops.cholqr import cholesky_qr2, cholesky_qr_lstsq
+from dhqr_tpu.precision import (
+    PRECISION_POLICIES,
+    POLICY_LADDER,
+    PrecisionPolicy,
+    resolve_policy,
+)
 from dhqr_tpu.utils.config import DHQRConfig
 
 __version__ = "0.2.0"
@@ -61,5 +67,9 @@ __all__ = [
     "lstsq_diff",
     "alphafactor",
     "DHQRConfig",
+    "PrecisionPolicy",
+    "PRECISION_POLICIES",
+    "POLICY_LADDER",
+    "resolve_policy",
     "__version__",
 ]

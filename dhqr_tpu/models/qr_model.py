@@ -52,6 +52,50 @@ def _check_sched_knobs(cfg: DHQRConfig, mesh=None) -> None:
         )
 
 
+def _resolve_policy_cfg(cfg: DHQRConfig):
+    """Resolve ``cfg.policy`` into the classic precision knobs (shared by
+    ``qr`` and ``lstsq``).
+
+    Returns ``(cfg', policy-or-None)``: the returned config carries
+    ``precision``/``trailing_precision`` from the policy and
+    ``policy=None``; the solve-stage fields (``apply``, ``refine``) ride
+    back on the policy object for the caller to place — ``qr`` records
+    them on the factorization, ``lstsq`` maps refine into ``cfg.refine``
+    and apply into the solve impls. A policy is mutually exclusive with
+    setting the knobs it resolves (a call naming both spellings is
+    ambiguous and refuses loudly rather than letting one silently win).
+    """
+    if cfg.policy is None:
+        return cfg, None
+    from dhqr_tpu.precision import (apply_policy_to_factor_args,
+                                    resolve_policy)
+
+    pol = resolve_policy(cfg.policy)
+    # precision/trailing exclusivity lives in the shared factor-args
+    # merge (the same contract every ops-level entry point applies); the
+    # solve-stage fields are config-only, so their checks live here.
+    precision, trailing = apply_policy_to_factor_args(
+        pol, cfg.precision, cfg.trailing_precision,
+        default_precision=DHQRConfig.precision)
+    if cfg.refine:
+        raise ValueError(
+            "pass either policy= or refine=, not both "
+            f"(policy sets refine={pol.refine})"
+        )
+    if cfg.apply_precision is not None:
+        raise ValueError(
+            "pass either policy= or apply_precision=, not both "
+            f"(policy resolves apply to {pol.resolved_apply()!r})"
+        )
+    apply = pol.resolved_apply()
+    cfg = dataclasses.replace(
+        cfg, precision=precision, trailing_precision=trailing,
+        apply_precision=None if apply == pol.panel else apply,
+        policy=None,
+    )
+    return cfg, pol
+
+
 def _check_panel_impl(cfg: DHQRConfig) -> None:
     """Shared panel_impl validation for qr() and lstsq()."""
     if cfg.panel_impl.startswith("reconstruct"):
@@ -84,9 +128,22 @@ class QRFactorization:
       mesh: optional — when set, H is column-sharded over this mesh and
         solves run the distributed engines (the DArray tier of reference
         src:115-120, selected here by placement rather than array type).
-      precision: matmul precision used when applying Q/Q^H in solves.
+      precision: matmul precision used when applying Q/Q^H in solves (the
+        precision policy's ``apply`` field when built via
+        ``qr(A, policy=...)``).
       layout: distributed column layout used for mesh solves ("block" or
         "cyclic"); H itself is always stored in natural column order.
+      refine: iterative-refinement sweeps :meth:`solve` runs by default —
+        each reuses this factorization (``r = b - A x; x += solve(r)``,
+        residual matvec at full precision), which is what lets a
+        low-precision factor (``policy.trailing``) buy its backward error
+        back at a few percent of the factorization cost.
+      matrix: the original A, kept ONLY when refinement was requested at
+        factor time (``qr(A, policy=...)`` with ``policy.refine > 0``) —
+        the residual must be measured against the true A, not against the
+        factor's own Q R (whose defect is exactly the error being
+        corrected). A pytree leaf when present; None otherwise (arrays
+        are immutable, so keeping the reference costs nothing).
     """
 
     H: jax.Array
@@ -95,19 +152,25 @@ class QRFactorization:
     mesh: object = None
     precision: str = _hh.DEFAULT_PRECISION
     layout: str = "block"
+    refine: int = 0
+    matrix: Optional[jax.Array] = None
 
     # -- pytree plumbing ---------------------------------------------------
     def tree_flatten(self):
-        return (self.H, self.alpha), (
+        # ``matrix`` rides as a child: None flattens to an empty subtree,
+        # so presence lives in the treedef and jit caching stays correct.
+        return (self.H, self.alpha, self.matrix), (
             self.block_size, self.mesh, self.precision, self.layout,
+            self.refine,
         )
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
-        H, alpha = leaves
+        H, alpha, matrix = leaves
         return cls(
             H, alpha,
             block_size=aux[0], mesh=aux[1], precision=aux[2], layout=aux[3],
+            refine=aux[4], matrix=matrix,
         )
 
     # -- derived quantities ------------------------------------------------
@@ -163,10 +226,8 @@ class QRFactorization:
         return jnp.sum(d > rtol * jnp.max(d))
 
     # -- solves ------------------------------------------------------------
-    def solve(self, b: jax.Array) -> jax.Array:
-        """Least-squares solve ``x = argmin ||A x - b||`` — reference ``H \\ b``
-        (src:317-321): apply Q^H, back-substitute R, truncate to n. Routes to
-        the distributed engines when the factorization is mesh-sharded."""
+    def _solve_once(self, b: jax.Array) -> jax.Array:
+        """One raw solve pass (no refinement) on the recorded tier."""
         if self.mesh is not None:
             from dhqr_tpu.parallel.sharded_solve import sharded_solve
 
@@ -179,6 +240,33 @@ class QRFactorization:
             self.H, self.alpha, b, self.block_size, precision=self.precision
         )
         return _solve.back_substitute(self.H, self.alpha, c)
+
+    def solve(self, b: jax.Array, refine: Optional[int] = None) -> jax.Array:
+        """Least-squares solve ``x = argmin ||A x - b||`` — reference ``H \\ b``
+        (src:317-321): apply Q^H, back-substitute R, truncate to n. Routes to
+        the distributed engines when the factorization is mesh-sharded.
+
+        ``refine`` (default: the factorization's recorded ``refine``
+        count) runs that many iterative-refinement sweeps reusing this
+        factorization — the solve-side half of a precision policy: a
+        factor built with a cheap trailing precision plus one sweep here
+        recovers the full-precision backward error. Requires the
+        factorization to carry the original ``matrix`` (``qr`` keeps it
+        whenever the resolved policy refines).
+        """
+        steps = self.refine if refine is None else int(refine)
+        x = self._solve_once(b)
+        if steps:
+            if self.matrix is None:
+                raise ValueError(
+                    "refinement needs the original matrix: factor with "
+                    "qr(A, policy=...) (policy.refine > 0 keeps A on the "
+                    "factorization), or pass refine=0"
+                )
+            for _ in range(steps):
+                r = b - jnp.matmul(self.matrix, x, precision="highest")
+                x = x + self._solve_once(r)
+        return x
 
     def matmul_q(self, b: jax.Array) -> jax.Array:
         """Q @ b (b of length m, or (m, k))."""
@@ -207,10 +295,19 @@ def qr(
     >>> fact = qr(A, donate=True)          # true in-place: A's buffer is reused
     ...                                    # (and invalidated), like qr!'s overwrite
     >>> fact = qr(A, mesh=column_mesh(8))  # distributed: the DArray tier
+
+    ``policy=`` (a :class:`dhqr_tpu.precision.PrecisionPolicy`, preset
+    name or spec string) names the whole precision tuple at once: panel
+    and trailing precision go to the factor engines, ``apply`` becomes
+    the factorization's solve precision, and ``refine > 0`` arms
+    solve-side iterative refinement — the factorization keeps a reference
+    to A (free; arrays are immutable) so every later ``.solve(b)`` can
+    buy a cheap factor's backward error back against the true matrix.
     """
     from dhqr_tpu.utils.platform import ensure_complex_supported
 
     cfg = dataclasses.replace(config or DHQRConfig(), **overrides)
+    cfg, pol = _resolve_policy_cfg(cfg)
     if cfg.engine != "householder":
         if cfg.engine not in LSTSQ_ENGINES:
             raise ValueError(
@@ -226,8 +323,17 @@ def qr(
     if cfg.refine:
         raise ValueError(
             "refine applies to lstsq() only — qr() returns the raw "
-            "factorization; call fact.solve and refine around it, or use "
-            "lstsq(A, b, refine=...)"
+            "factorization; call fact.solve and refine around it, use "
+            "lstsq(A, b, refine=...), or pass a policy= with refine > 0 "
+            "(which arms refinement on the factorization's solves)"
+        )
+    solve_refine = pol.refine if pol is not None else 0
+    apply_prec = cfg.apply_precision or cfg.precision
+    if solve_refine and donate:
+        raise ValueError(
+            "donate=True cannot be combined with a refining policy: "
+            "refinement must keep the original A, which donation "
+            "invalidates"
         )
     ensure_complex_supported(A.dtype)
     # Resolve the auto panel width once, up front: the factorization object
@@ -271,8 +377,9 @@ def qr(
                 layout=cfg.layout, norm=cfg.norm,
             )
         return QRFactorization(
-            H, alpha, block_size=nb, mesh=mesh, precision=cfg.precision,
-            layout=cfg.layout,
+            H, alpha, block_size=nb, mesh=mesh, precision=apply_prec,
+            layout=cfg.layout, refine=solve_refine,
+            matrix=A if solve_refine else None,
         )
     if cfg.blocked:
         H, alpha = _blocked.blocked_householder_qr(
@@ -289,7 +396,8 @@ def qr(
                                  cfg.lookahead, cfg.agg_panels)
         H, alpha = _hh.householder_qr(A, precision=cfg.precision, norm=cfg.norm)
     return QRFactorization(
-        H, alpha, block_size=cfg.block_size, precision=cfg.precision
+        H, alpha, block_size=cfg.block_size, precision=apply_prec,
+        refine=solve_refine, matrix=A if solve_refine else None,
     )
 
 
@@ -364,7 +472,13 @@ def _validate_alt_engine_cfg(cfg: DHQRConfig) -> None:
     if cfg.trailing_precision is not None:
         raise ValueError(
             "trailing_precision applies to the blocked householder engines "
-            f"only (engine={cfg.engine!r})"
+            f"only (engine={cfg.engine!r}; the ops-level entry points "
+            "accept a policy= directly — tsqr_lstsq, cholesky_qr_lstsq)"
+        )
+    if cfg.apply_precision is not None:
+        raise ValueError(
+            "apply_precision applies to the householder engines only "
+            f"(engine={cfg.engine!r})"
         )
     if cfg.lookahead:
         raise ValueError(
@@ -416,7 +530,10 @@ def _lstsq_refined(A, b, cfg: DHQRConfig, mesh):
                 refine=cfg.refine, pallas_flat=_blocked.PALLAS_FLAT_WIDTH,
                 trailing_precision=cfg.trailing_precision,
                 lookahead=cfg.lookahead, agg_panels=cfg.agg_panels,
+                apply_precision=cfg.apply_precision,
             )
+    # qr() already records cfg.apply_precision as the factorization's
+    # solve precision, so the refinement loop inherits it.
     fact = qr(A, config=dataclasses.replace(cfg, refine=0), mesh=mesh)
     x = fact.solve(b)
     for _ in range(cfg.refine):
@@ -511,11 +628,12 @@ def _lstsq_interp(A, cfg) -> bool:
 
 @partial(jax.jit, static_argnames=(
     "block_size", "blocked", "precision", "use_pallas", "norm", "panel_impl",
-    "refine", "pallas_flat", "trailing_precision", "lookahead", "agg_panels"))
+    "refine", "pallas_flat", "trailing_precision", "lookahead", "agg_panels",
+    "apply_precision"))
 def _lstsq_impl(A, b, block_size, blocked, precision, use_pallas,
                 norm="accurate", panel_impl="loop", refine=0,
                 pallas_flat=None, trailing_precision=None, lookahead=False,
-                agg_panels=None):
+                agg_panels=None, apply_precision=None):
     if blocked:
         from dhqr_tpu.ops.differentiable import lstsq_diff
 
@@ -527,14 +645,15 @@ def _lstsq_impl(A, b, block_size, blocked, precision, use_pallas,
         # public lstsq at every refine level
         return lstsq_diff(A, b, block_size, precision, pallas, interp, norm,
                           panel_impl, refine, pallas_flat, trailing_precision,
-                          lookahead, agg_panels)
+                          lookahead, agg_panels, apply_precision)
     _reject_nonblocked_knobs(use_pallas, trailing_precision, lookahead,
                              agg_panels)
     H, alpha = _hh.householder_qr(A, precision=precision, norm=norm)
+    ap = precision if apply_precision is None else apply_precision
 
     def qr_solve(rhs):
         return _solve.back_substitute(
-            H, alpha, _solve.apply_qt(H, alpha, rhs, precision=precision)
+            H, alpha, _solve.apply_qt(H, alpha, rhs, precision=ap)
         )
 
     x = qr_solve(b)
@@ -667,10 +786,20 @@ def lstsq(
     ``DHQR.qr!(A3) \\ b`` DArray path, runtests.jl:77-78). For m < n the
     result is the minimum-norm solution of the underdetermined system
     (single-device householder engine only).
+
+    ``policy=`` names the whole precision tuple at once (see
+    :class:`dhqr_tpu.precision.PrecisionPolicy`): panel/trailing go to
+    the factor stage, ``apply`` to the Q^H-apply of the solve stage, and
+    ``refine`` into the iterative-refinement loop — the pairing that
+    lets a cheap trailing precision keep the full-precision backward
+    error.
     """
     from dhqr_tpu.utils.platform import ensure_complex_supported
 
     cfg = dataclasses.replace(config or DHQRConfig(), **overrides)
+    cfg, pol = _resolve_policy_cfg(cfg)
+    if pol is not None and pol.refine:
+        cfg = dataclasses.replace(cfg, refine=pol.refine)
     if cfg.norm not in ("accurate", "fast"):
         raise ValueError(
             f"norm must be 'accurate' or 'fast', got {cfg.norm!r}"
@@ -707,12 +836,13 @@ def lstsq(
             )
         if not cfg.blocked or cfg.use_pallas != "auto" \
                 or cfg.trailing_precision is not None or cfg.lookahead \
-                or cfg.agg_panels:
+                or cfg.agg_panels or cfg.apply_precision is not None:
             raise ValueError(
                 "m < n supports only the default blocked XLA path "
                 f"(got blocked={cfg.blocked}, use_pallas={cfg.use_pallas!r}, "
                 f"trailing_precision={cfg.trailing_precision!r}, "
-                f"lookahead={cfg.lookahead}, agg_panels={cfg.agg_panels})"
+                f"lookahead={cfg.lookahead}, agg_panels={cfg.agg_panels}, "
+                f"apply_precision={cfg.apply_precision!r})"
             )
         if cfg.refine:
             raise ValueError(
@@ -755,7 +885,8 @@ def lstsq(
             )
             x = sharded_solve(
                 H, alpha, b, mesh,
-                block_size=nb, axis_name=col_axis, precision=cfg.precision,
+                block_size=nb, axis_name=col_axis,
+                precision=cfg.apply_precision or cfg.precision,
                 layout=cfg.layout, _H_in_store_layout=True,
             )
             return x[:n]
@@ -766,6 +897,7 @@ def lstsq(
             use_pallas=cfg.use_pallas, panel_impl=cfg.panel_impl,
             trailing_precision=cfg.trailing_precision,
             lookahead=cfg.lookahead, agg_panels=cfg.agg_panels,
+            apply_precision=cfg.apply_precision,
         )
     with _blocked._pallas_cache_guard(_lstsq_interp(A, cfg)):
         return _lstsq_impl(
@@ -774,4 +906,5 @@ def lstsq(
             pallas_flat=_blocked.PALLAS_FLAT_WIDTH,
             trailing_precision=cfg.trailing_precision,
             lookahead=cfg.lookahead, agg_panels=cfg.agg_panels,
+            apply_precision=cfg.apply_precision,
         )

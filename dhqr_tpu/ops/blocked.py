@@ -605,6 +605,9 @@ def _pallas_lowers_on_this_backend(dtype_name: str) -> bool:
         return False
 
 
+_CACHE_GUARD_WARNED = []
+
+
 def _pallas_cache_guard(interpret: bool):
     """Keep interpret-mode Pallas programs OUT of the persistent
     compilation cache (wrap the jit CALL, where the compile happens).
@@ -619,13 +622,42 @@ def _pallas_cache_guard(interpret: bool):
     passing in isolation). Interpret mode is a CPU test vehicle, so the
     cost is only a per-process recompile of the interpret programs; the
     hardware path (``interpret=False``) keeps full caching.
+
+    Concurrency note: the guard toggles a PROCESS-GLOBAL config flag, so
+    it assumes single-threaded compilation — a non-interpret compile on
+    another thread during the guard window is silently kept out of the
+    persistent cache too (numerically harmless; it only loses that
+    compile's caching). Every current caller compiles from the main
+    thread; revisit with a thread-local config context if that changes.
+
+    The flag toggle lives behind a PRIVATE jax import
+    (``jax._src.config.enable_compilation_cache`` — there is no public
+    per-scope disable). A jax upgrade removing it must degrade to "cache
+    not suppressed" (a fresh process may then segfault reading a stale
+    interpret-mode entry — clear the cache dir if so), never to an
+    ImportError on every CPU test path (ADVICE r5 items 1-2).
     """
+    from contextlib import nullcontext
+
     if not interpret:
-        from contextlib import nullcontext
-
         return nullcontext()
-    from jax._src.config import enable_compilation_cache
+    try:
+        from jax._src.config import enable_compilation_cache
+    except ImportError:
+        if not _CACHE_GUARD_WARNED:
+            _CACHE_GUARD_WARNED.append(True)
+            import warnings
 
+            warnings.warn(
+                "jax._src.config.enable_compilation_cache is gone in this "
+                "jax version: interpret-mode Pallas programs can no longer "
+                "be kept out of the persistent compilation cache. Their "
+                "host-callback executables are not safely deserializable "
+                "across processes — if another process segfaults reading "
+                "the cache, clear the cache directory.",
+                stacklevel=2,
+            )
+        return nullcontext()
     return enable_compilation_cache(False)
 
 
@@ -730,6 +762,7 @@ def blocked_householder_qr(
     trailing_precision: "str | None" = None,
     lookahead: bool = False,
     agg_panels: "int | None" = None,
+    policy=None,
 ):
     """Factor ``A`` (m x n, m >= n): returns ``(H, alpha)`` in packed storage.
 
@@ -773,9 +806,21 @@ def blocked_householder_qr(
     passes, at ~O(m (k nb)^2) extra aggregate-T flops per group. Takes
     effect on the scanned (two-level) path — small problems on the
     fully-unrolled path ignore it; mutually exclusive with ``lookahead``.
+
+    ``policy`` (a :class:`dhqr_tpu.precision.PrecisionPolicy`, preset name
+    or spec string) is the one-object spelling of the precision pair:
+    it sets ``precision`` from ``policy.panel`` and ``trailing_precision``
+    from ``policy.trailing`` (mutually exclusive with passing those
+    explicitly). The solve-stage fields (``apply``, ``refine``) do not
+    apply to a factor-only entry point and are ignored by contract —
+    use ``qr()``/``lstsq()`` for a refined solve under the same policy.
     """
+    from dhqr_tpu.precision import apply_policy_to_factor_args
     from dhqr_tpu.utils.platform import ensure_complex_supported
 
+    precision, trailing_precision = apply_policy_to_factor_args(
+        policy, precision, trailing_precision,
+        default_precision=DEFAULT_PRECISION)
     m, n = A.shape
     if m < n:
         raise ValueError(f"blocked_householder_qr requires m >= n, got {A.shape}")

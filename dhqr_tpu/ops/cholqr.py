@@ -83,9 +83,13 @@ def _cholqr_passes(A, gram, precision, shift):
     return Q, R
 
 
-@partial(jax.jit, static_argnames=("precision", "shift"))
-def _cholesky_qr2_impl(A, precision, shift):
-    gram = lambda X: jnp.matmul(jnp.conj(X.T), X, precision=precision)
+@partial(jax.jit, static_argnames=("precision", "shift", "gram_precision"))
+def _cholesky_qr2_impl(A, precision, shift, gram_precision=None):
+    # The Gram syrk holds ~all the flops (the "trailing" analogue of the
+    # householder engines); its precision may be split away from the
+    # n x n composition math. None = no split.
+    gp = precision if gram_precision is None else gram_precision
+    gram = lambda X: jnp.matmul(jnp.conj(X.T), X, precision=gp)
     return _cholqr_passes(A, gram, precision, shift)
 
 
@@ -93,6 +97,8 @@ def cholesky_qr2(
     A: jax.Array,
     precision: str = DEFAULT_PRECISION,
     shift: bool = False,
+    gram_precision: "str | None" = None,
+    policy=None,
 ):
     """Thin QR of a tall matrix via Cholesky passes: ``A = Q R``.
 
@@ -110,19 +116,36 @@ def cholesky_qr2(
     CholeskyQR3 (three passes, ~1.5x the flops): the stabilizing shift
     widens the window toward cond(A) ~ 1/eps and the extra pass restores
     O(eps) orthogonality that the shift alone would forfeit.
+
+    ``gram_precision`` / ``policy`` split the A^H A syrk's MXU precision
+    away from the composition math (``policy.trailing`` maps onto the
+    syrk — it is where ~all the flops are). Gram rounding is SQUARED
+    through Cholesky, so a cheaper syrk narrows the conditioning window
+    accordingly; the solve surface's ``refine`` buys the residual back
+    (see :func:`cholesky_qr_lstsq`). The solve-stage policy fields
+    (``apply``, ``refine``) do not apply to this factor-only entry point
+    and are ignored by contract.
     """
+    from dhqr_tpu.precision import apply_policy_to_factor_args
     from dhqr_tpu.utils.platform import ensure_complex_supported
 
+    precision, gram_precision = apply_policy_to_factor_args(
+        policy, precision, gram_precision,
+        default_precision=DEFAULT_PRECISION)
     m, n = A.shape
     if m < n:
         raise ValueError(f"cholesky_qr2 requires m >= n, got {A.shape}")
     ensure_complex_supported(A.dtype)
-    return _cholesky_qr2_impl(A, precision, bool(shift))
+    return _cholesky_qr2_impl(A, precision, bool(shift),
+                              gram_precision=gram_precision)
 
 
-@partial(jax.jit, static_argnames=("precision", "shift", "refine"))
-def _cholqr_lstsq_impl(A, b, precision, shift, refine=0):
-    Q, R = _cholesky_qr2_impl(A, precision, shift)
+@partial(jax.jit, static_argnames=("precision", "shift", "refine",
+                                   "gram_precision"))
+def _cholqr_lstsq_impl(A, b, precision, shift, refine=0,
+                       gram_precision=None):
+    Q, R = _cholesky_qr2_impl(A, precision, shift,
+                              gram_precision=gram_precision)
     B, restore = as_matrix_rhs(b)
 
     def qr_solve(C):
@@ -144,6 +167,8 @@ def cholesky_qr_lstsq(
     precision: str = DEFAULT_PRECISION,
     shift: bool = False,
     refine: int = 0,
+    gram_precision: "str | None" = None,
+    policy=None,
 ) -> jax.Array:
     """Least squares via CholeskyQR2 — the all-GEMM fast path for m >> n.
 
@@ -153,12 +178,32 @@ def cholesky_qr_lstsq(
     conditioning window at a few percent of the cost. It does NOT move
     the window's NaN boundary itself — a failed Cholesky stays failed;
     route those problems to the Householder engines.
+
+    ``gram_precision`` / ``policy`` as in :func:`cholesky_qr2`; a policy
+    additionally supplies ``refine`` (mutually exclusive with passing it
+    explicitly) — the pairing that makes a cheap Gram syrk a candidate
+    rather than an accuracy regression. ``policy.apply`` is not split
+    here: the solve's Q^H GEMMs stay at the panel precision.
     """
+    from dhqr_tpu.precision import (apply_policy_to_factor_args,
+                                    resolve_policy)
     from dhqr_tpu.utils.platform import ensure_complex_supported
 
+    if policy is not None:
+        pol = resolve_policy(policy)
+        if refine:
+            raise ValueError(
+                "pass either policy= or refine=, not both "
+                f"(policy sets refine={pol.refine})"
+            )
+        refine = pol.refine
+    precision, gram_precision = apply_policy_to_factor_args(
+        policy, precision, gram_precision,
+        default_precision=DEFAULT_PRECISION)
     if A.shape[0] < A.shape[1]:
         raise ValueError(f"lstsq requires m >= n, got {A.shape}")
     if int(refine) < 0:
         raise ValueError(f"refine must be >= 0, got {refine}")
     ensure_complex_supported(A.dtype)
-    return _cholqr_lstsq_impl(A, b, precision, bool(shift), int(refine))
+    return _cholqr_lstsq_impl(A, b, precision, bool(shift), int(refine),
+                              gram_precision=gram_precision)

@@ -41,12 +41,12 @@ from dhqr_tpu.ops.solve import back_substitute, r_matrix
 
 
 @partial(jax.custom_jvp,
-         nondiff_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12))
+         nondiff_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13))
 def lstsq_diff(
     A, b, block_size=DEFAULT_BLOCK_SIZE, precision=DEFAULT_PRECISION,
     pallas=False, pallas_interpret=False, norm="accurate",
     panel_impl="loop", refine=0, pallas_flat=None, trailing_precision=None,
-    lookahead=False, agg_panels=None,
+    lookahead=False, agg_panels=None, apply_precision=None,
 ):
     """``x = argmin ||A x - b||`` with closed-form O(1)-memory derivatives.
 
@@ -58,17 +58,23 @@ def lstsq_diff(
     factorization (``x += A+ (b - A x)``, residual at full precision). The
     JVP rule is untouched by it: the rule is the differential of the exact
     minimizer, which refinement approaches rather than changes.
+
+    ``apply_precision`` (default: ``precision``) is the solve stage's
+    matmul precision — the Q^H applies feeding the triangular solves
+    (the policy subsystem's ``apply`` field; factorization precision is
+    unchanged by it).
     """
     x, _ = _lstsq_fwd(A, b, block_size, precision, pallas, pallas_interpret,
                       norm, panel_impl, refine, pallas_flat,
-                      trailing_precision, lookahead, agg_panels)
+                      trailing_precision, lookahead, agg_panels,
+                      apply_precision)
     return x
 
 
 def _lstsq_fwd(A, b, block_size, precision, pallas=False,
                pallas_interpret=False, norm="accurate", panel_impl="loop",
                refine=0, pallas_flat=None, trailing_precision=None,
-               lookahead=False, agg_panels=None):
+               lookahead=False, agg_panels=None, apply_precision=None):
     if pallas_flat is None:
         # Resolve the module global HERE (call time), not via
         # _blocked_qr_impl's in-trace default — the explicit static arg
@@ -85,9 +91,11 @@ def _lstsq_fwd(A, b, block_size, precision, pallas=False,
         agg_panels=agg_panels,
     )
 
+    ap = precision if apply_precision is None else apply_precision
+
     def qr_solve(rhs):
         return back_substitute(
-            H, alpha, _apply_qt_impl(H, rhs, block_size, precision=precision)
+            H, alpha, _apply_qt_impl(H, rhs, block_size, precision=ap)
         )
 
     x = qr_solve(b)
@@ -100,13 +108,13 @@ def _lstsq_fwd(A, b, block_size, precision, pallas=False,
 @lstsq_diff.defjvp
 def _lstsq_jvp(block_size, precision, pallas, pallas_interpret, norm,
                panel_impl, refine, pallas_flat, trailing_precision,
-               lookahead, agg_panels, primals, tangents):
+               lookahead, agg_panels, apply_precision, primals, tangents):
     A, b = primals
     dA, db = tangents
     x, (_, _, H, alpha, _) = _lstsq_fwd(
         A, b, block_size, precision, pallas, pallas_interpret, norm,
         panel_impl, refine, pallas_flat, trailing_precision, lookahead,
-        agg_panels
+        agg_panels, apply_precision
     )
     m, n = A.shape
     vec = x.ndim == 1

@@ -37,7 +37,7 @@ from dhqr_tpu.ops.solve import as_matrix_rhs, back_substitute, r_matrix
 
 
 def _leaf_factor(Ai, bi, nb, precision, pallas=False, interpret=False,
-                 pallas_flat=None):
+                 pallas_flat=None, trailing_precision=None):
     """One row block: packed QR + Q^H b, reduced to the (n, n) / (n, k) heads.
 
     ``pallas`` routes the leaf's panel factorizations through the fused
@@ -49,26 +49,31 @@ def _leaf_factor(Ai, bi, nb, precision, pallas=False, interpret=False,
     n = Ai.shape[1]
     H, alpha = _blocked_qr_impl(Ai, nb, precision=precision, pallas=pallas,
                                 pallas_interpret=interpret,
-                                pallas_flat=pallas_flat)
+                                pallas_flat=pallas_flat,
+                                trailing_precision=trailing_precision)
     R = r_matrix(H, alpha)
     c = _apply_qt_impl(H, bi, nb, precision=precision)[:n]
     return R, c
 
 
 def _combine_solve(Rstack, cstack, nb, precision, pallas=False,
-                   interpret=False, pallas_flat=None):
+                   interpret=False, pallas_flat=None,
+                   trailing_precision=None):
     """Combine stage: QR the stacked heads, then solve R x = (Q^H c)[:n]."""
     H2, alpha2 = _blocked_qr_impl(Rstack, nb, precision=precision,
                                   pallas=pallas, pallas_interpret=interpret,
-                                  pallas_flat=pallas_flat)
+                                  pallas_flat=pallas_flat,
+                                  trailing_precision=trailing_precision)
     c2 = _apply_qt_impl(H2, cstack, nb, precision=precision)
     return back_substitute(H2, alpha2, c2)
 
 
 @partial(jax.jit, static_argnames=("n_blocks", "block_size", "precision",
-                                   "pallas", "interpret", "pallas_flat"))
+                                   "pallas", "interpret", "pallas_flat",
+                                   "trailing_precision"))
 def _tsqr_lstsq_impl(A, b, n_blocks, block_size, precision, pallas=False,
-                     interpret=False, pallas_flat=None):
+                     interpret=False, pallas_flat=None,
+                     trailing_precision=None):
     m, n = A.shape
     rows = m // n_blocks
     nb = min(block_size, n)
@@ -79,13 +84,14 @@ def _tsqr_lstsq_impl(A, b, n_blocks, block_size, precision, pallas=False,
     bb = B.reshape(n_blocks, rows, k)
     Rs, cs = jax.vmap(
         lambda Ai, bi: _leaf_factor(Ai, bi, nb, precision, pallas, interpret,
-                                    pallas_flat)
+                                    pallas_flat, trailing_precision)
     )(Ab, bb)
     # Combine: one QR of the stacked R factors (n_blocks*n x n — tiny).
     Rstack = Rs.reshape(n_blocks * n, n)
     cstack = cs.reshape(n_blocks * n, k)
     return restore(_combine_solve(Rstack, cstack, nb, precision, pallas,
-                                  interpret, pallas_flat))
+                                  interpret, pallas_flat,
+                                  trailing_precision))
 
 
 def tsqr_lstsq(
@@ -95,6 +101,8 @@ def tsqr_lstsq(
     block_size: int = DEFAULT_BLOCK_SIZE,
     precision: str = DEFAULT_PRECISION,
     use_pallas: str = "auto",
+    trailing_precision: "str | None" = None,
+    policy=None,
 ) -> jax.Array:
     """Least squares via TSQR: ``x = argmin ||A x - b||`` for m >> n.
 
@@ -107,9 +115,28 @@ def tsqr_lstsq(
     fused VMEM kernel (same semantics as
     :func:`dhqr_tpu.ops.blocked.blocked_householder_qr`): "auto" resolves
     to the kernel on TPU for supported leaf shapes.
+
+    ``trailing_precision`` / ``policy`` split the leaf and combine QRs'
+    trailing-update GEMM precision exactly as on the blocked engine
+    (``policy.panel`` -> ``precision``, ``policy.trailing`` -> this knob).
+    ``policy.refine`` must be 0: the TSQR tree never materializes a
+    reusable factorization, so refinement would repeat the full
+    factorization cost per sweep — route refined solves to the
+    householder or cholqr engines.
     """
+    from dhqr_tpu.precision import (apply_policy_to_factor_args,
+                                    resolve_policy)
     from dhqr_tpu.utils.platform import ensure_complex_supported
 
+    if policy is not None and resolve_policy(policy).refine:
+        raise ValueError(
+            "policy.refine > 0 is not supported with TSQR (no reusable "
+            "factorization in the tree); use the householder or cholqr "
+            "engines, or a refine=0 policy"
+        )
+    precision, trailing_precision = apply_policy_to_factor_args(
+        policy, precision, trailing_precision,
+        default_precision=DEFAULT_PRECISION)
     m, n = A.shape
     _check_tsqr_shape(m, n, n_blocks)
     ensure_complex_supported(A.dtype)
@@ -122,7 +149,8 @@ def tsqr_lstsq(
         return _tsqr_lstsq_impl(A, b, int(n_blocks), int(block_size),
                                 precision, pallas=pallas,
                                 interpret=interpret,
-                                pallas_flat=PALLAS_FLAT_WIDTH)
+                                pallas_flat=PALLAS_FLAT_WIDTH,
+                                trailing_precision=trailing_precision)
 
 
 def _resolve_tsqr_pallas(mode, leaf_rows, n, block_size, dtype):
@@ -137,9 +165,10 @@ def _resolve_tsqr_pallas(mode, leaf_rows, n, block_size, dtype):
 
 
 @partial(jax.jit, static_argnames=("n_blocks", "block_size", "precision",
-                                   "pallas", "interpret", "pallas_flat"))
+                                   "pallas", "interpret", "pallas_flat",
+                                   "trailing_precision"))
 def _tsqr_r_impl(A, n_blocks, block_size, precision, pallas=False,
-                 interpret=False, pallas_flat=None):
+                 interpret=False, pallas_flat=None, trailing_precision=None):
     m, n = A.shape
     rows = m // n_blocks
     nb = min(block_size, n)
@@ -147,12 +176,14 @@ def _tsqr_r_impl(A, n_blocks, block_size, precision, pallas=False,
     Rs = jax.vmap(
         lambda Ai: r_matrix(*_blocked_qr_impl(
             Ai, nb, precision=precision, pallas=pallas,
-            pallas_interpret=interpret, pallas_flat=pallas_flat))
+            pallas_interpret=interpret, pallas_flat=pallas_flat,
+            trailing_precision=trailing_precision))
     )(Ab)
     H2, alpha2 = _blocked_qr_impl(Rs.reshape(n_blocks * n, n), nb,
                                   precision=precision, pallas=pallas,
                                   pallas_interpret=interpret,
-                                  pallas_flat=pallas_flat)
+                                  pallas_flat=pallas_flat,
+                                  trailing_precision=trailing_precision)
     return r_matrix(H2, alpha2)
 
 
@@ -162,15 +193,25 @@ def tsqr_r(
     block_size: int = DEFAULT_BLOCK_SIZE,
     precision: str = DEFAULT_PRECISION,
     use_pallas: str = "auto",
+    trailing_precision: "str | None" = None,
+    policy=None,
 ) -> jax.Array:
     """The n x n triangular factor of A via TSQR (R up to row signs).
 
     Note: Householder QR fixes R's diagonal signs by the alpha rule
     (src:8-9), so R here may differ from another QR's R by a diagonal +-1
     factor — ``R^H R = A^H A`` holds regardless.
+
+    ``trailing_precision`` / ``policy`` as in :func:`tsqr_lstsq`; the
+    solve-stage policy fields (``apply``, ``refine``) do not apply to a
+    factor-only entry point and are ignored by contract.
     """
+    from dhqr_tpu.precision import apply_policy_to_factor_args
     from dhqr_tpu.utils.platform import ensure_complex_supported
 
+    precision, trailing_precision = apply_policy_to_factor_args(
+        policy, precision, trailing_precision,
+        default_precision=DEFAULT_PRECISION)
     m, n = A.shape
     _check_tsqr_shape(m, n, n_blocks)
     ensure_complex_supported(A.dtype)
@@ -182,7 +223,8 @@ def tsqr_r(
     with _pallas_cache_guard(interpret):
         return _tsqr_r_impl(A, int(n_blocks), int(block_size), precision,
                             pallas=pallas, interpret=interpret,
-                            pallas_flat=PALLAS_FLAT_WIDTH)
+                            pallas_flat=PALLAS_FLAT_WIDTH,
+                            trailing_precision=trailing_precision)
 
 
 def _check_tsqr_shape(m: int, n: int, n_blocks: int) -> None:

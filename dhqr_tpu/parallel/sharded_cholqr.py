@@ -17,8 +17,9 @@ from functools import lru_cache, partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dhqr_tpu.utils.compat import shard_map
 
 from dhqr_tpu.ops.cholqr import _cholqr_passes
 from dhqr_tpu.ops.solve import as_matrix_rhs

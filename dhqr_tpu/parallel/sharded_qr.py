@@ -33,8 +33,9 @@ from functools import lru_cache, partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from dhqr_tpu.utils.compat import shard_map
 
 from dhqr_tpu.ops.blocked import (
     MAX_UNROLLED_PANELS,
@@ -812,6 +813,7 @@ def sharded_blocked_qr(
     trailing_precision: "str | None" = None,
     lookahead: bool = False,
     agg_panels: "int | None" = None,
+    policy=None,
 ):
     """Compact-WY distributed QR: one psum per panel, GEMM trailing updates.
 
@@ -834,12 +836,38 @@ def sharded_blocked_qr(
     (each group's single psum issued before the previous group's wide
     GEMM) — allowed HERE, on the mesh, where the overlap has a collective
     to hide; the single-device tiers keep rejecting the pair.
+
+    ``policy`` (a :class:`dhqr_tpu.precision.PrecisionPolicy`, preset name
+    or spec string) sets ``precision``/``trailing_precision`` together,
+    mutually exclusive with passing them explicitly; the solve-stage
+    fields (``apply``, ``refine``) do not apply to a factor-only entry
+    point and are ignored by contract.
     """
+    from dhqr_tpu.precision import apply_policy_to_factor_args
+
+    precision, trailing_precision = apply_policy_to_factor_args(
+        policy, precision, trailing_precision,
+        default_precision=DEFAULT_PRECISION)
     m, n = A.shape
     nproc = mesh.shape[axis_name]
     if agg_panels is not None and agg_panels < 2:
         raise ValueError(f"agg_panels must be >= 2 (got {agg_panels}); "
                          "use None to disable aggregation")
+    if agg_panels and lookahead and nproc == 1:
+        # The composition's entire win is hiding the gather psum behind
+        # the wide trailing GEMM; a 1-device mesh has no collective to
+        # hide, so the pair only adds flops there — the same degenerate
+        # case the harness refuses at ndev == 1 (ADVICE r5 item 4). Warn
+        # rather than reject: a 1-element mesh is a legitimate test/debug
+        # tier, and the result is still correct.
+        import warnings
+
+        warnings.warn(
+            "agg_panels + lookahead on a 1-device mesh: no collective to "
+            "hide, the composition only adds flops (the harness rejects "
+            "this pair at ndev == 1); proceeding as the mesh tier",
+            stacklevel=2,
+        )
     # agg_panels + lookahead together = the grouped-lookahead composition
     # (1/k the collectives AND overlap per collective) — mesh-only; the
     # single-device tiers keep rejecting the pair (no collective to hide).

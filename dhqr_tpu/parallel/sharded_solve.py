@@ -26,8 +26,9 @@ from functools import lru_cache, partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from dhqr_tpu.utils.compat import shard_map
 
 from dhqr_tpu.ops.blocked import (
     MAX_UNROLLED_PANELS,
@@ -299,6 +300,8 @@ def sharded_lstsq(
     trailing_precision: "str | None" = None,
     lookahead: bool = False,
     agg_panels: "int | None" = None,
+    apply_precision: "str | None" = None,
+    policy=None,
 ) -> jax.Array:
     """One-shot distributed least squares: factor + solve on the mesh.
 
@@ -308,13 +311,45 @@ def sharded_lstsq(
     Arbitrary n is padded ONCE here (the orthogonal extension, see
     ``sharded_qr._pad_cols_orthogonal``) so the store-layout chaining between
     the stages stays intact; x is sliced back to n.
+
+    ``apply_precision`` (default: ``precision``) sets the solve stage's
+    matmul precision — the Q^H apply and back-substitution GEMMs.
+    ``policy`` sets the whole precision tuple at once (panel -> factor
+    ``precision``, trailing -> ``trailing_precision``, apply -> this
+    knob). ``policy.refine`` must be 0 here: this function returns x
+    straight from one factor+solve pass, so a refining policy's defining
+    accuracy-recovery step would be silently skipped — mesh-path
+    refinement lives in ``models.qr_model`` (``lstsq(..., mesh=,
+    policy=...)``), which reuses this pipeline's factorization via
+    ``qr()``.
     """
     from dhqr_tpu.parallel.layout import plan_padding
     from dhqr_tpu.parallel.sharded_qr import (
         _pad_cols_orthogonal,
         sharded_blocked_qr,
     )
+    from dhqr_tpu.precision import (apply_policy_to_factor_args,
+                                    resolve_policy)
 
+    if policy is not None:
+        if apply_precision is not None:
+            raise ValueError(
+                "pass either policy= or apply_precision=, not both")
+        pol = resolve_policy(policy)
+        if pol.refine:
+            raise ValueError(
+                "policy.refine > 0 is not supported by sharded_lstsq "
+                "(one factor+solve pass; the refinement would be "
+                "silently skipped) — use models.qr_model.lstsq(..., "
+                "mesh=, policy=...), which loops the sharded solve, or "
+                "a refine=0 policy"
+            )
+        apply_precision = pol.resolved_apply()
+    precision, trailing_precision = apply_policy_to_factor_args(
+        policy, precision, trailing_precision,
+        default_precision=DEFAULT_PRECISION)
+    if apply_precision is None:
+        apply_precision = precision
     m, n = A.shape
     nproc = mesh.shape[axis_name]
     nb, n_pad = plan_padding(n, nproc, block_size)
@@ -331,7 +366,7 @@ def sharded_lstsq(
     )
     x = sharded_solve(
         H, alpha, b, mesh,
-        block_size=nb, axis_name=axis_name, precision=precision,
+        block_size=nb, axis_name=axis_name, precision=apply_precision,
         layout=layout, _H_in_store_layout=True,
     )
     return x[:n]

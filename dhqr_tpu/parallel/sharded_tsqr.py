@@ -20,8 +20,9 @@ from typing import Optional, Sequence
 
 import jax
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dhqr_tpu.utils.compat import shard_map
 
 from dhqr_tpu.ops.householder import DEFAULT_PRECISION
 from dhqr_tpu.ops.solve import as_matrix_rhs

@@ -1,0 +1,183 @@
+"""Precision policy — one object naming every MXU-precision knob at once.
+
+The framework's accuracy/throughput trade lives in four places that must be
+chosen together to mean anything (docs/DESIGN.md "Precision policy"):
+
+* the PANEL precision — the dependent chains (reflector norms/dots, the
+  compact-WY T-factor recurrence) whose rounding every later column
+  inherits;
+* the TRAILING precision — the wide trailing-update GEMMs holding ~all the
+  flops, whose rounding is NOT amplified (each output element is touched
+  once);
+* the APPLY precision — the Q/Q^H applies and triangular solves of the
+  solve stage;
+* the REFINEMENT count — iterative-refinement sweeps that reuse the stored
+  factorization (``r = b - A x; x += solve(r)``, residual matvec at full
+  precision) and buy back the backward error a cheaper factor gave up.
+
+On TPU the MXU's native pass is bf16xbf16->f32: ``precision="highest"``
+emulates full f32 with 6 passes, ``"high"`` with 3, ``"default"`` runs the
+single native pass. Splitting the trailing precision away from the panel
+precision therefore trades 2-6x of the bulk MXU work against a measured
+backward-error cost (2.7e-5 at 4096^2 with trailing="high",
+benchmarks/tpu_trailing_precision_probe.py) — which ``refine`` recovers at
+a few percent of the factorization cost. A :class:`PrecisionPolicy` names
+one point in that space; the named presets in :data:`PRECISION_POLICIES`
+are the grid the bench ladder A/Bs (bench.py policy stages,
+benchmarks/policy_ladder.py).
+
+Every engine tier accepts ``policy=``: the factor-only entry points
+(``blocked_householder_qr``, ``sharded_blocked_qr``, ``tsqr_r``,
+``cholesky_qr2``) consume the precision fields and document that the
+solve-stage fields (``apply``, ``refine``) do not apply to them; the solve
+surfaces (``qr``/``lstsq``, ``tsqr_lstsq``, ``cholesky_qr_lstsq``) consume
+all four.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# MXU precisions orderable by pass count on TPU (f32 inputs):
+# highest = 6 passes, high = 3, default = 1 native bf16 pass. On CPU/GPU
+# backends the names still parse but the passes collapse to native f32 —
+# which is why the CPU ladder artifact shows flat errors and the TPU ladder
+# is the decisive one.
+TRAILING_PRECISIONS = ("highest", "high", "default")
+
+# Effective MXU passes per f32 GEMM at each precision name — the
+# effective-FLOP-ceiling model of docs/DESIGN.md (peak_bf16 / passes).
+MXU_PASSES = {"highest": 6, "high": 3, "default": 1, "float32": 6}
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionPolicy:
+    """One named point in the precision/refinement trade space.
+
+    Attributes:
+      panel: precision of the accuracy-critical dependent chains — panel
+        factorization (reflector norms/dots) and the compact-WY T-factor.
+        These errors are inherited by every later column, so the presets
+        never lower this field.
+      trailing: precision of the trailing-update GEMMs only (and, for the
+        row engines, the bulk GEMM analogue: TSQR leaf trailing updates,
+        the CholeskyQR Gram syrk). ``None`` means "same as panel" — no
+        split.
+      apply: precision of the solve stage's Q/Q^H applies and the
+        refinement residual reuse. ``None`` means "same as panel".
+      refine: iterative-refinement sweeps for the solve surfaces. Each
+        sweep reuses the stored factorization (one full-precision residual
+        matvec + one extra solve); the factor-only entry points ignore it
+        by contract (a factorization has nothing to refine).
+    """
+
+    panel: str = "highest"
+    trailing: "str | None" = None
+    apply: "str | None" = None
+    refine: int = 0
+
+    def __post_init__(self):
+        for field, value in (("panel", self.panel),
+                             ("trailing", self.trailing),
+                             ("apply", self.apply)):
+            if value is not None and value not in MXU_PASSES:
+                raise ValueError(
+                    f"PrecisionPolicy.{field} must be one of "
+                    f"{sorted(MXU_PASSES)} or None, got {value!r}"
+                )
+        if self.refine < 0:
+            raise ValueError(f"refine must be >= 0, got {self.refine}")
+
+    # -- resolution helpers -------------------------------------------------
+    def resolved_trailing(self) -> str:
+        return self.panel if self.trailing is None else self.trailing
+
+    def resolved_apply(self) -> str:
+        return self.panel if self.apply is None else self.apply
+
+    def split_trailing(self) -> "str | None":
+        """The ``trailing_precision`` engine argument: None when the policy
+        does not actually split (engines treat None as "no split", keeping
+        jit cache keys identical to the pre-policy spelling)."""
+        t = self.resolved_trailing()
+        return None if t == self.panel else t
+
+
+# The named grid. "accurate" is the library default (6-pass f32 everywhere,
+# no refinement — the committed <1e-5 backward-error tier). The split
+# presets pair a cheaper trailing precision with ONE refinement sweep: the
+# refine step is what makes them candidates rather than accuracy
+# regressions (VERDICT r5 item 2 — the untested 2-3x lever).
+PRECISION_POLICIES = {
+    "accurate": PrecisionPolicy(),
+    "balanced": PrecisionPolicy(trailing="high", refine=1),
+    "fast": PrecisionPolicy(trailing="default", refine=1),
+}
+
+# The A/B ladder the bench + tests sweep: every trailing precision, with
+# and without one refinement sweep (6 cells).
+POLICY_LADDER = tuple(
+    PrecisionPolicy(trailing=None if t == "highest" else t, refine=r)
+    for t in TRAILING_PRECISIONS
+    for r in (0, 1)
+)
+
+
+def resolve_policy(policy) -> PrecisionPolicy:
+    """Accept a policy name, a :class:`PrecisionPolicy`, or a spec string.
+
+    Spec strings name the fields positionally, slash-separated:
+    ``"panel"``, ``"panel/trailing"``, ``"panel/trailing/rN"`` — e.g.
+    ``"highest/default/r1"`` is the bf16-trailing + one-refine point. This
+    is the ``DHQR_POLICY`` environment spelling (utils/config.py).
+    """
+    if isinstance(policy, PrecisionPolicy):
+        return policy
+    if not isinstance(policy, str):
+        raise TypeError(
+            f"policy must be a PrecisionPolicy, a preset name "
+            f"{sorted(PRECISION_POLICIES)}, or a spec string, got "
+            f"{type(policy).__name__}"
+        )
+    if policy in PRECISION_POLICIES:
+        return PRECISION_POLICIES[policy]
+    parts = policy.split("/")
+    refine = 0
+    if parts and parts[-1][:1] == "r" and parts[-1][1:].isdigit():
+        refine = int(parts.pop()[1:])
+    if not parts or len(parts) > 2 or not all(parts):
+        raise ValueError(
+            f"unknown policy {policy!r}: expected a preset name "
+            f"{sorted(PRECISION_POLICIES)} or 'panel[/trailing][/rN]'"
+        )
+    panel = parts[0]
+    trailing = parts[1] if len(parts) == 2 else None
+    if trailing == panel:
+        trailing = None
+    return PrecisionPolicy(panel=panel, trailing=trailing, refine=refine)
+
+
+def apply_policy_to_factor_args(policy, precision, trailing_precision,
+                                default_precision: str = "highest"):
+    """Shared factor-tier merge: map ``policy`` onto the classic
+    ``(precision, trailing_precision)`` argument pair.
+
+    ``policy=None`` passes the classic arguments through untouched. With a
+    policy, the classic knobs must keep their defaults (the caller's
+    ``default_precision`` / None) — a call naming both spellings is
+    ambiguous and refuses loudly rather than letting one silently win.
+    """
+    if policy is None:
+        return precision, trailing_precision
+    pol = resolve_policy(policy)
+    if trailing_precision is not None:
+        raise ValueError(
+            "pass either policy= or trailing_precision=, not both "
+            f"(policy resolves trailing to {pol.resolved_trailing()!r})"
+        )
+    if precision != default_precision:
+        raise ValueError(
+            "pass either policy= or precision=, not both "
+            f"(policy sets the panel precision to {pol.panel!r})"
+        )
+    return pol.panel, pol.split_trailing()

@@ -29,6 +29,13 @@ def save_factorization(path: str | os.PathLike, fact) -> None:
     All static fields ride along (block_size, precision, layout) — H is
     stored in natural column order, so the layout is pure metadata, but a
     cyclic-layout factorization must reload as one.
+
+    The refinement fields of a policy-built factorization (``refine``,
+    ``matrix``) are deliberately NOT persisted: ``matrix`` is the full
+    input A (checkpointing it would double the artifact for data that is
+    usually still on disk as the problem itself), so a reloaded
+    factorization solves unrefined — re-arm with
+    ``dataclasses.replace(fact, refine=1, matrix=A)`` if needed.
     """
     np.savez(
         path,

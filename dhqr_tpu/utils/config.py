@@ -105,6 +105,19 @@ class DHQRConfig:
         scanned-path lever there; the SHARDED unrolled path does
         aggregate (its win, one gather psum per group, exists at every
         panel count).
+      apply_precision: matmul precision of the solve stage's Q/Q^H
+        applies (the blocked householder engines' solve paths). None
+        (the default) follows ``precision``. Usually set via ``policy``
+        rather than directly.
+      policy: a :class:`dhqr_tpu.precision.PrecisionPolicy`, preset name
+        ("accurate", "balanced", "fast") or spec string
+        ("panel[/trailing][/rN]", e.g. "highest/default/r1") naming the
+        whole precision tuple at once — panel precision, trailing-GEMM
+        precision, solve-apply precision, and refinement count. Resolved
+        by ``qr()``/``lstsq()`` into the individual knobs below, so it is
+        mutually exclusive with setting ``trailing_precision`` or
+        ``refine`` (and with a non-default ``precision``) explicitly.
+        None (the default) leaves the classic knobs in charge.
       refine: iterative-refinement steps for ``lstsq`` (0 = off). Each
         step reuses the factorization: ``r = b - A x; x += solve(r)`` —
         one matvec plus one extra solve, a few percent of the
@@ -131,6 +144,8 @@ class DHQRConfig:
     trailing_precision: "str | None" = None
     lookahead: bool = False
     agg_panels: "int | None" = None
+    apply_precision: "str | None" = None
+    policy: object = None
 
     @staticmethod
     def from_env(**overrides) -> "DHQRConfig":
@@ -166,5 +181,10 @@ class DHQRConfig:
         if "DHQR_AGG_PANELS" in os.environ:
             raw = os.environ["DHQR_AGG_PANELS"].strip()
             env["agg_panels"] = int(raw) if raw and raw != "0" else None
+        if "DHQR_APPLY_PRECISION" in os.environ:
+            env["apply_precision"] = os.environ["DHQR_APPLY_PRECISION"]
+        if "DHQR_POLICY" in os.environ:
+            raw = os.environ["DHQR_POLICY"].strip()
+            env["policy"] = raw or None
         env.update(overrides)
         return DHQRConfig(**env)

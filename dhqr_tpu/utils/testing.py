@@ -54,3 +54,22 @@ def random_problem(m: int, n: int, dtype, seed: int = 0):
         A = rng.random((m, n)).astype(dtype)
         b = rng.random(m).astype(dtype)
     return A, b
+
+
+def solve_backward_error(A, x, b) -> float:
+    """Normwise solve backward error eta(x) = ||Ax-b|| / (||A||_F ||x|| + ||b||).
+
+    THE acceptance-bar metric of the precision-policy ladder (<= 1e-5
+    after one refinement sweep at 1024^2 f32) — defined once so the bench
+    ladder stages, benchmarks/policy_ladder.py and the tier-1 error-anchor
+    tests all measure the same quantity. The residual matvec runs at full
+    precision: its accuracy is the point.
+    """
+    import jax.numpy as jnp
+
+    r = jnp.matmul(jnp.asarray(A), jnp.asarray(x), precision="highest") \
+        - jnp.asarray(b)
+    return float(jnp.linalg.norm(r)) / (
+        float(jnp.linalg.norm(jnp.asarray(A)))
+        * float(jnp.linalg.norm(jnp.asarray(x)))
+        + float(jnp.linalg.norm(jnp.asarray(b))))

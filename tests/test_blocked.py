@@ -529,3 +529,42 @@ def test_donating_engine_invalidates_input_buffer():
     np.testing.assert_array_equal(np.asarray(H1), np.asarray(H0))
     np.testing.assert_array_equal(np.asarray(a1), np.asarray(a0))
     assert A.is_deleted(), "donated input still alive — aliasing lost"
+
+
+def test_policy_error_ladder_1024_blocked():
+    """The CPU anchor of the precision-policy A/B ladder (acceptance bar
+    of the round-6 tentpole): for EVERY trailing precision, the 1024^2 f32
+    factor's backward error and the solve's normwise backward error — with
+    and without one refinement sweep reusing the factorization — must sit
+    under the 1e-5 target (after refine=1 for the solve). On CPU the MXU
+    pass count collapses to native f32 so every cell lands at roundoff;
+    the committed artifact (benchmarks/results/policy_ladder_cpu.jsonl)
+    and bench.py's TPU ladder stages carry the same cells where the split
+    is real. Pins the plumbing end to end: a silently-dropped
+    trailing_precision or a refinement step that resolves against QR
+    instead of A would move these numbers."""
+    from dhqr_tpu.models.qr_model import qr
+    from dhqr_tpu.precision import TRAILING_PRECISIONS, PrecisionPolicy
+    from dhqr_tpu.utils.testing import solve_backward_error
+
+    n = 1024
+    rng = np.random.default_rng(90)
+    A = jnp.asarray(rng.random((n, n)), jnp.float32)
+    b = jnp.asarray(rng.random((n,)), jnp.float32)
+
+    def eta(x):
+        return solve_backward_error(A, x, b)
+
+    for tprec in TRAILING_PRECISIONS:
+        pol = PrecisionPolicy(
+            trailing=None if tprec == "highest" else tprec, refine=1)
+        fact = qr(A, block_size=128, policy=pol)
+        # factor backward error ||QR - A|| / ||A|| (refine-independent)
+        QR = fact.matmul_q(fact.r_matrix())
+        ferr = float(jnp.linalg.norm(QR - A) / jnp.linalg.norm(A))
+        assert ferr < 1e-5, (tprec, ferr)
+        e0 = eta(fact.solve(b, refine=0))
+        e1 = eta(fact.solve(b))  # the policy's refine=1
+        assert e1 <= 1e-5, (tprec, e1)
+        # refinement must not make the solve worse (it converges on CPU)
+        assert e1 <= 2.0 * e0, (tprec, e0, e1)

@@ -1,0 +1,152 @@
+"""PrecisionPolicy unit surface: parsing, presets, conflicts, threading.
+
+The error-ladder anchors (backward error per trailing precision with and
+without refinement, at 1024) live in tests/test_blocked.py (single-device)
+and tests/test_sharded.py (mesh) next to the engines they pin.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dhqr_tpu.precision import (
+    MXU_PASSES,
+    POLICY_LADDER,
+    PRECISION_POLICIES,
+    TRAILING_PRECISIONS,
+    PrecisionPolicy,
+    apply_policy_to_factor_args,
+    resolve_policy,
+)
+from dhqr_tpu.utils.testing import random_problem
+
+
+def test_presets_and_ladder_shape():
+    assert set(PRECISION_POLICIES) == {"accurate", "balanced", "fast"}
+    assert PRECISION_POLICIES["accurate"] == PrecisionPolicy()
+    assert PRECISION_POLICIES["fast"].resolved_trailing() == "default"
+    assert PRECISION_POLICIES["fast"].refine == 1
+    # the A/B grid: every trailing precision x refine in {0, 1}
+    assert len(POLICY_LADDER) == 2 * len(TRAILING_PRECISIONS)
+    cells = {(p.resolved_trailing(), p.refine) for p in POLICY_LADDER}
+    assert cells == {(t, r) for t in TRAILING_PRECISIONS for r in (0, 1)}
+    # the presets never lower the panel precision (dependent chains)
+    assert all(p.panel == "highest" for p in PRECISION_POLICIES.values())
+
+
+def test_resolve_policy_spellings():
+    assert resolve_policy("balanced") is PRECISION_POLICIES["balanced"]
+    p = resolve_policy("highest/default/r2")
+    assert (p.panel, p.resolved_trailing(), p.refine) == (
+        "highest", "default", 2)
+    # trailing equal to panel normalizes to "no split"
+    assert resolve_policy("highest/highest").split_trailing() is None
+    assert resolve_policy("high").panel == "high"
+    pol = PrecisionPolicy(trailing="high")
+    assert resolve_policy(pol) is pol
+    # a bad single token parses as a panel name and fails field validation;
+    # a malformed multi-part spec fails the spec parse
+    with pytest.raises(ValueError, match="must be one of"):
+        resolve_policy("warp9")
+    with pytest.raises(ValueError, match="unknown policy"):
+        resolve_policy("highest/high/default/r1")
+    with pytest.raises(TypeError, match="policy must be"):
+        resolve_policy(3)
+    with pytest.raises(ValueError, match="PrecisionPolicy.trailing"):
+        PrecisionPolicy(trailing="bf16")
+    with pytest.raises(ValueError, match="refine must be"):
+        PrecisionPolicy(refine=-1)
+    assert set(MXU_PASSES) >= set(TRAILING_PRECISIONS)
+
+
+def test_factor_args_merge_and_conflicts():
+    # no policy: classic args pass through untouched
+    assert apply_policy_to_factor_args(None, "high", "default") == (
+        "high", "default")
+    # policy resolves both; no-split policies hand back None trailing
+    assert apply_policy_to_factor_args("fast", "highest", None) == (
+        "highest", "default")
+    assert apply_policy_to_factor_args("accurate", "highest", None) == (
+        "highest", None)
+    with pytest.raises(ValueError, match="not both"):
+        apply_policy_to_factor_args("fast", "highest", "high")
+    with pytest.raises(ValueError, match="not both"):
+        apply_policy_to_factor_args("fast", "high", None)
+
+
+def test_policy_config_exclusivity_and_env(monkeypatch):
+    from dhqr_tpu import DHQRConfig, lstsq, qr
+
+    A, b = random_problem(48, 32, np.float64, seed=7)
+    Aj, bj = jnp.asarray(A), jnp.asarray(b)
+    for bad in (dict(trailing_precision="high"), dict(refine=1),
+                dict(precision="high"), dict(apply_precision="high")):
+        with pytest.raises(ValueError, match="not both"):
+            lstsq(Aj, bj, block_size=16, policy="fast", **bad)
+        with pytest.raises(ValueError, match="not both"):
+            qr(Aj, block_size=16, policy="fast", **bad)
+    # DHQR_POLICY env reaches the config and the engines
+    monkeypatch.setenv("DHQR_POLICY", "highest/high/r1")
+    cfg = DHQRConfig.from_env()
+    assert cfg.policy == "highest/high/r1"
+    x = lstsq(Aj, bj, config=cfg, block_size=16)
+    assert x.shape == (32,)
+    # qr() with a refining policy cannot donate (A must survive)
+    with pytest.raises(ValueError, match="donate"):
+        qr(jnp.asarray(A), block_size=16, policy="fast", donate=True)
+
+
+def test_qr_policy_records_solve_fields():
+    from dhqr_tpu import qr
+
+    A, b = random_problem(64, 48, np.float64, seed=8)
+    Aj, bj = jnp.asarray(A), jnp.asarray(b)
+    fact = qr(Aj, block_size=16, policy="balanced")
+    assert fact.refine == 1 and fact.matrix is not None
+    # solve refines by default; refine=0 opts out; both agree to roundoff
+    # in f64 (every precision name is the same math on CPU f64)
+    x1 = np.asarray(fact.solve(bj))
+    x0 = np.asarray(fact.solve(bj, refine=0))
+    np.testing.assert_allclose(x1, x0, rtol=1e-9, atol=1e-12)
+    # a non-refining factorization refuses a refine request (no matrix)
+    plain = qr(Aj, block_size=16)
+    assert plain.refine == 0 and plain.matrix is None
+    with pytest.raises(ValueError, match="refinement needs the original"):
+        plain.solve(bj, refine=1)
+
+
+def test_policy_apply_precision_threads_to_solves():
+    """policy.apply reaches the factorization's solve precision and the
+    one-shot lstsq path (f64: every precision is the same math, so the
+    results must be exactly equal — the point is the plumbing)."""
+    from dhqr_tpu import lstsq, qr
+
+    A, b = random_problem(64, 48, np.float64, seed=9)
+    Aj, bj = jnp.asarray(A), jnp.asarray(b)
+    pol = PrecisionPolicy(apply="high")
+    fact = qr(Aj, block_size=16, policy=pol)
+    assert fact.precision == "high"
+    x0 = np.asarray(qr(Aj, block_size=16).solve(bj))
+    np.testing.assert_allclose(np.asarray(fact.solve(bj)), x0,
+                               rtol=1e-12, atol=1e-14)
+    np.testing.assert_allclose(
+        np.asarray(lstsq(Aj, bj, block_size=16, policy=pol)), x0,
+        rtol=1e-12, atol=1e-14)
+
+
+def test_tsqr_cholqr_policy_surface():
+    from dhqr_tpu import cholesky_qr_lstsq, tsqr_lstsq
+
+    A, b = random_problem(128, 16, np.float64, seed=10)
+    Aj, bj = jnp.asarray(A), jnp.asarray(b)
+    x0 = np.asarray(tsqr_lstsq(Aj, bj, n_blocks=4, block_size=8))
+    x1 = np.asarray(tsqr_lstsq(Aj, bj, n_blocks=4, block_size=8,
+                               policy=PrecisionPolicy(trailing="high")))
+    np.testing.assert_allclose(x1, x0, rtol=1e-12, atol=1e-14)
+    with pytest.raises(ValueError, match="refine"):
+        tsqr_lstsq(Aj, bj, n_blocks=4, policy="fast")
+    xc = np.asarray(cholesky_qr_lstsq(Aj, bj, policy="fast"))
+    np.testing.assert_allclose(xc, x0, rtol=1e-9, atol=1e-12)
+    with pytest.raises(ValueError, match="not both"):
+        cholesky_qr_lstsq(Aj, bj, policy="fast", refine=1)

@@ -87,9 +87,16 @@ def test_tree_sum_beats_plain_sum_on_cancellation():
     x = np.array([big, 1.0, -big, 1.0] * 64, dtype=np.float32)
     exact = 128.0
     got_tree = float(tree_sum(jnp.asarray(x)))
-    got_plain = float(jnp.sum(jnp.asarray(x)))
     assert got_tree == exact
-    assert got_plain != exact  # documents why the tree exists
+    # The PLAIN sum's failure on this input documents why the tree
+    # exists, but whether it actually fails depends on XLA's internal
+    # reduce order (left-to-right and simple pairwise both lose the tiny
+    # terms; some jaxlib versions' CPU reduce happens to pair big with
+    # -big and land exactly) — so the naive float64-free NUMPY orders
+    # carry that half of the story deterministically instead.
+    assert float(np.sum(x, dtype=np.float32)) != exact  # left-to-right
+    # still exercise the XLA reduce so a dtype/shape regression surfaces
+    assert np.isfinite(float(jnp.sum(jnp.asarray(x))))
 
 
 def test_vdot_zero_length_masked():
