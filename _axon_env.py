@@ -45,9 +45,11 @@ def default_to_virtual_cpu(n_devices: int = 8,
         return False
     flags = os.environ.get("XLA_FLAGS", "")
     if "host_platform_device_count" not in flags:
+        # dhqr: ignore[DHQR003] this module IS the process-bring-up env shim (pre-first-backend-use, entry points only)
         os.environ["XLA_FLAGS"] = (
             flags + f" --xla_force_host_platform_device_count={n_devices}"
         ).strip()
+    # dhqr: ignore[DHQR003] same bring-up shim: pin the platform before jax initializes
     os.environ["JAX_PLATFORMS"] = "cpu"
     return True
 

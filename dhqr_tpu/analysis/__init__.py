@@ -1,0 +1,45 @@
+"""dhqr-lint — static analysis enforcing the framework's TPU/JAX discipline.
+
+Two passes over two program representations (docs/DESIGN.md "Static
+invariants"):
+
+* **Pass 1 (AST)** — :mod:`dhqr_tpu.analysis.ast_rules` walks the source
+  tree with rule classes DHQR001-DHQR005: private-jax import hygiene, MXU
+  precision annotations on every contraction, config/env mutation
+  containment, host syncs inside traced bodies, and collective axis-name
+  discipline inside ``shard_map`` bodies.
+* **Pass 2 (jaxpr)** — :mod:`dhqr_tpu.analysis.jaxpr_pass` abstractly
+  traces the public entry points under every precision-policy preset (and
+  the sharded engines under a 1-device mesh) and sanitizes the jaxpr:
+  no f64 intermediates from f32 inputs, no host callbacks, every
+  collective's axis name resolvable against the mesh (DHQR101-DHQR104).
+
+Plus an API-consistency check (DHQR201/DHQR202): everything in
+``dhqr_tpu.__all__`` imports cleanly and is documented in docs/DESIGN.md.
+
+Findings support inline suppressions
+(``# dhqr: ignore[DHQR002] <reason>``) and a committed baseline file; the
+CLI is ``python -m dhqr_tpu.analysis check [paths] [--json] [--baseline
+FILE]`` and a tier-1 test (tests/test_analysis.py) self-scans the package
+so a new violation fails the suite.
+"""
+
+from dhqr_tpu.analysis.findings import (
+    Finding,
+    load_baseline,
+    write_baseline,
+)
+from dhqr_tpu.analysis.ast_rules import (
+    AST_RULES,
+    scan_paths,
+    scan_source,
+)
+
+__all__ = [
+    "Finding",
+    "AST_RULES",
+    "scan_paths",
+    "scan_source",
+    "load_baseline",
+    "write_baseline",
+]

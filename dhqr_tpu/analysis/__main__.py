@@ -1,0 +1,8 @@
+"""``python -m dhqr_tpu.analysis`` entry point."""
+
+import sys
+
+from dhqr_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,71 @@
+"""Public-API consistency check (DHQR201/DHQR202).
+
+Everything ``dhqr_tpu.__all__`` promises must (a) import cleanly —
+``getattr`` succeeds on a fresh import — and (b) appear in
+docs/DESIGN.md, which carries the public-API table. A name that fails
+(a) is a broken export (the import graph moved under the facade); a name
+that fails (b) is an undocumented surface users will find only by
+reading source.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+from dhqr_tpu.analysis.findings import Finding
+
+_INIT_PATH = "dhqr_tpu/__init__.py"
+
+
+def check_api(design_md: "str | None" = None) -> "list[Finding]":
+    """Validate ``dhqr_tpu.__all__`` against the import surface and the
+    design doc. ``design_md`` defaults to docs/DESIGN.md next to the
+    package's repo root."""
+    import dhqr_tpu
+
+    if design_md is None:
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(dhqr_tpu.__file__)))
+        design_md = os.path.join(repo, "docs", "DESIGN.md")
+    findings = []
+    try:
+        with open(design_md, "r", encoding="utf-8") as fh:
+            doc = fh.read()
+    except OSError as e:
+        return [Finding("DHQR202", _INIT_PATH, 0,
+                        f"cannot read design doc {design_md}: {e}")]
+    # Search the "## Public API" section only: common names (qr, solve)
+    # occur all over the prose, so a whole-document match would accept a
+    # table with the entry deleted. No section at all -> everything is
+    # undocumented.
+    m = re.search(r"^## Public API\s*$(.*?)(?=^## |\Z)", doc,
+                  re.MULTILINE | re.DOTALL)
+    doc = m.group(1) if m else ""
+    if not m:
+        findings.append(Finding(
+            "DHQR202", _INIT_PATH, 0,
+            "docs/DESIGN.md has no '## Public API' section — the API "
+            "table the consistency check validates against is missing",
+        ))
+    for name in dhqr_tpu.__all__:
+        if name.startswith("__"):  # dunders (__version__) are metadata
+            continue
+        try:
+            getattr(dhqr_tpu, name)
+        except Exception as e:
+            findings.append(Finding(
+                "DHQR201", _INIT_PATH, 0,
+                f"__all__ entry {name!r} does not import cleanly: "
+                f"{type(e).__name__}: {e}",
+                snippet=name,
+            ))
+            continue
+        if not re.search(rf"\b{re.escape(name)}\b", doc):
+            findings.append(Finding(
+                "DHQR202", _INIT_PATH, 0,
+                f"__all__ entry {name!r} is absent from the "
+                "'## Public API' table in docs/DESIGN.md — add it",
+                snippet=name,
+            ))
+    return findings

@@ -1,0 +1,121 @@
+"""dhqr-lint CLI: ``python -m dhqr_tpu.analysis check [paths] ...``.
+
+Exit status 0 iff no unsuppressed, un-baselined findings. The AST pass
+runs on every named path; the jaxpr sanitizer and the API-consistency
+check run whenever the dhqr_tpu package itself is among the scan targets
+(they validate the package, not arbitrary files), unless disabled with
+``--no-jaxpr`` / ``--no-api``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _scans_package(paths) -> bool:
+    """Do the scan targets cover the installed dhqr_tpu package — by
+    name, or as an ancestor directory ('.', the repo root)? The jaxpr
+    and API passes validate the package itself, so they must run for
+    any target that contains it."""
+    import dhqr_tpu
+
+    pkg = os.path.realpath(os.path.dirname(os.path.abspath(
+        dhqr_tpu.__file__)))
+    for p in paths:
+        rp = os.path.realpath(p)
+        if rp == pkg or (os.path.isdir(rp)
+                         and pkg.startswith(rp + os.sep)):
+            return True
+    return False
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m dhqr_tpu.analysis",
+        description="dhqr-lint: AST + jaxpr static analysis enforcing the "
+        "framework's TPU/JAX discipline (docs/DESIGN.md 'Static "
+        "invariants').",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+    check = sub.add_parser("check", help="run the lint passes")
+    check.add_argument(
+        "paths", nargs="*", default=None,
+        help="files/directories to scan (default: dhqr_tpu tests)",
+    )
+    check.add_argument("--json", action="store_true",
+                       help="emit findings as JSON")
+    check.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help="accepted-findings file: matching fingerprints do not fail "
+        "the run (shipped baseline: tools/lint_baseline.json, empty)",
+    )
+    check.add_argument(
+        "--write-baseline", default=None, metavar="FILE",
+        help="write the current unsuppressed findings as a new baseline "
+        "and exit 0 (docs/OPERATIONS.md: regenerating the baseline)",
+    )
+    check.add_argument("--no-jaxpr", action="store_true",
+                       help="skip the jaxpr sanitizer pass")
+    check.add_argument("--no-api", action="store_true",
+                       help="skip the public-API consistency check")
+    check.add_argument(
+        "--preset", action="append", default=None,
+        help="restrict the jaxpr pass to these policy presets "
+        "(repeatable; default: all)",
+    )
+    args = parser.parse_args(argv)
+
+    from dhqr_tpu.analysis.ast_rules import scan_paths
+    from dhqr_tpu.analysis.findings import load_baseline, write_baseline
+
+    paths = args.paths or ["dhqr_tpu", "tests"]
+    try:
+        findings = scan_paths(paths)
+    except FileNotFoundError as e:
+        print(f"dhqr-lint: {e}", file=sys.stderr)
+        return 2
+
+    if _scans_package(paths) and not args.no_jaxpr:
+        from dhqr_tpu.analysis.jaxpr_pass import run_jaxpr_pass
+
+        findings.extend(run_jaxpr_pass(presets=args.preset))
+    if _scans_package(paths) and not args.no_api:
+        from dhqr_tpu.analysis.api_check import check_api
+
+        findings.extend(check_api())
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline, findings)
+        print(f"baseline written: {args.write_baseline} "
+              f"({sum(1 for f in findings if not f.suppressed)} findings)")
+        return 0
+
+    baseline = dict(load_baseline(args.baseline)) if args.baseline else {}
+    active, baselined = [], []
+    suppressed = [f for f in findings if f.suppressed]
+    for f in findings:
+        if f.suppressed:
+            continue
+        fp = f.fingerprint()
+        if baseline.get(fp, 0) > 0:  # multiset: each accepted occurrence
+            baseline[fp] -= 1        # absorbs exactly one finding
+            baselined.append(f)
+        else:
+            active.append(f)
+
+    if args.json:
+        print(json.dumps({
+            "findings": [f.to_json() for f in active],
+            "suppressed": [f.to_json() for f in suppressed],
+            "baselined": [f.to_json() for f in baselined],
+        }, indent=2))
+    else:
+        for f in active:
+            print(f.render())
+        print(f"dhqr-lint: {len(active)} finding(s), "
+              f"{len(suppressed)} suppressed, {len(baselined)} baselined",
+              file=sys.stderr)
+    return 1 if active else 0

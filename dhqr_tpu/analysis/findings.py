@@ -1,0 +1,134 @@
+"""Finding records, inline suppressions, and the committed baseline.
+
+One Finding type serves both passes (AST rules and the jaxpr sanitizer)
+so the CLI, the baseline file and the tier-1 self-scan all speak the same
+shape. Fingerprints are line-number-independent (rule + path + source
+snippet) so a baseline survives unrelated edits above a finding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import re
+
+# ``# dhqr: ignore[DHQR002] reason`` — one or more rule IDs, comma
+# separated; the reason is free text (required by policy, see
+# docs/DESIGN.md "Static invariants", but the parser tolerates its
+# absence so a missing reason reads as an empty string rather than an
+# unsuppressed finding with a confusing cause).
+_SUPPRESS_RE = re.compile(
+    r"#\s*dhqr:\s*ignore\[([A-Za-z0-9_,\s]+)\]\s*(.*?)\s*$"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location (or traced entry point).
+
+    ``path`` is the display path (posix, repo-relative where possible);
+    ``line`` is 1-based (0 for whole-file / traced-program findings);
+    ``snippet`` is the stripped source line, used for the baseline
+    fingerprint; ``suppressed``/``reason`` record an inline
+    ``# dhqr: ignore[...]`` that matched this finding.
+    """
+
+    rule: str
+    path: str
+    line: int
+    message: str
+    snippet: str = ""
+    suppressed: bool = False
+    reason: str = ""
+
+    def fingerprint(self) -> str:
+        key = f"{self.rule}|{self.path}|{self.snippet or self.message}"
+        return hashlib.sha1(key.encode("utf-8")).hexdigest()[:16]
+
+    def render(self) -> str:
+        sup = f"  [suppressed: {self.reason or 'no reason given'}]" \
+            if self.suppressed else ""
+        return f"{self.path}:{self.line}: {self.rule} {self.message}{sup}"
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "snippet": self.snippet,
+            "suppressed": self.suppressed,
+            "reason": self.reason,
+            "fingerprint": self.fingerprint(),
+        }
+
+
+def parse_suppressions(lines: "list[str]") -> "dict[int, tuple[set, str]]":
+    """Map 1-based line number -> (rule ids, reason) for every inline
+    ``# dhqr: ignore[...]`` directive in ``lines``."""
+    out = {}
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            rules = {r.strip().upper() for r in m.group(1).split(",")
+                     if r.strip()}
+            out[i] = (rules, m.group(2).strip())
+    return out
+
+
+def apply_suppressions(findings, suppressions) -> "list[Finding]":
+    """Mark findings suppressed when the directive sits on the finding's
+    line or the line immediately above (multi-line calls report the call's
+    first line, so a directive above the statement also matches)."""
+    out = []
+    for f in findings:
+        sup = None
+        for ln in (f.line, f.line - 1):
+            entry = suppressions.get(ln)
+            if entry and f.rule in entry[0]:
+                sup = entry
+                break
+        if sup is not None:
+            f = dataclasses.replace(f, suppressed=True, reason=sup[1])
+        out.append(f)
+    return out
+
+
+def load_baseline(path) -> "dict[str, int]":
+    """Accepted fingerprints -> occurrence count. A multiset, not a set:
+    two identical violation lines in one file share a fingerprint, and
+    baselining one must not silently accept a later second one."""
+    import collections
+
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return collections.Counter(
+        entry["fingerprint"] for entry in data.get("findings", []))
+
+
+def write_baseline(path, findings) -> None:
+    """Write the unsuppressed findings as the new accepted baseline."""
+    payload = {
+        "comment": (
+            "dhqr-lint baseline: accepted pre-existing findings, keyed by "
+            "line-independent fingerprint. Regenerate with "
+            "`python -m dhqr_tpu.analysis check ... --write-baseline "
+            "<file>` (docs/OPERATIONS.md). The shipped baseline is EMPTY "
+            "by policy: new findings are fixed or inline-suppressed with "
+            "a reason, not baselined."
+        ),
+        "findings": [
+            {
+                "fingerprint": f.fingerprint(),
+                "rule": f.rule,
+                "path": f.path,
+                "snippet": f.snippet or f.message,
+            }
+            for f in findings
+            if not f.suppressed
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")

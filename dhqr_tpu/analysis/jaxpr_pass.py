@@ -1,0 +1,237 @@
+"""Pass 2 — jaxpr sanitizer over the public entry points.
+
+Abstractly traces every public entry point (single-device tiers, plus
+the sharded engines under a 1-device mesh) for each precision-policy
+preset, and walks the closed jaxpr — including every sub-jaxpr riding in
+eqn params (pjit, scan, shard_map, custom_jvp, ...) — asserting three
+program-representation invariants:
+
+* **DHQR101** — no float64/complex128 intermediate from float32 inputs.
+  Traced under ``jax.experimental.enable_x64()`` (a thread-local
+  context, not process-global mutation) so an accidental promotion —
+  a bare python-float ladder, an np scalar, an explicit astype — is
+  visible even in processes that run with x64 off, where jax would mask
+  the leak by clamping. On TPU an f64 intermediate is emulated at >10x
+  cost; on CPU it silently doubles memory traffic.
+* **DHQR102** — no ``pure_callback`` / ``io_callback`` / other host
+  callbacks: a callback is a host round-trip per execution, and its
+  executable is not safely deserializable across processes (the
+  interpret-mode Pallas cache incident, ops/blocked._pallas_cache_guard).
+* **DHQR103** — every collective's axis name resolves against the mesh
+  the entry point was traced under (and no collective at all in
+  mesh-free programs).
+
+Trace failures are findings too (**DHQR104**), not crashes: a policy
+preset that no longer traces is exactly the regression this pass exists
+to catch. Tracing is abstract — nothing compiles, nothing executes, so
+the pass is safe to run even where backend bring-up is fragile (the
+CLI forces the CPU backend first; see ``_ensure_cpu_backend``).
+"""
+
+from __future__ import annotations
+
+from dhqr_tpu.analysis.findings import Finding
+
+# Shapes small enough to trace in milliseconds but large enough to
+# exercise the blocked/panelled paths (two 4-wide panels per 8 columns).
+_M, _N, _NB = 16, 8, 4
+
+_F64_DTYPES = ("float64", "complex128")
+
+
+def _ensure_cpu_backend() -> None:
+    """Pin the CPU backend before any device touch. Some hosts pin a
+    remote TPU plugin via sitecustomize (JAX_PLATFORMS in the env LOSES —
+    tests/conftest.py has the story), and a wedged relay hangs at
+    backend_init; an abstract-tracing lint gate must never take that
+    risk. Set DHQR_LINT_KEEP_PLATFORM=1 to trace on the ambient backend.
+    """
+    import os
+
+    if os.environ.get("DHQR_LINT_KEEP_PLATFORM") == "1":
+        return
+    import jax
+
+    # dhqr: ignore[DHQR003] lint CLI/test entry owns its process: abstract tracing must not init a remote TPU backend
+    jax.config.update("jax_platforms", "cpu")
+
+
+def iter_jaxprs(jaxpr):
+    """Yield ``jaxpr`` and every sub-jaxpr reachable through eqn params."""
+    from jax import core
+
+    def subs(val):
+        if isinstance(val, core.ClosedJaxpr):
+            yield val.jaxpr
+        elif isinstance(val, core.Jaxpr):
+            yield val
+        elif isinstance(val, (list, tuple)):
+            for v in val:
+                yield from subs(v)
+        elif isinstance(val, dict):
+            for v in val.values():
+                yield from subs(v)
+
+    stack = [jaxpr]
+    seen = set()
+    while stack:
+        j = stack.pop()
+        if id(j) in seen:
+            continue
+        seen.add(id(j))
+        yield j
+        for eqn in j.eqns:
+            for val in eqn.params.values():
+                stack.extend(subs(val))
+
+
+def _collect_axis_names(params) -> "set[str]":
+    """Axis names named by a collective eqn's params (axes/axis_name,
+    string or tuple-of-strings)."""
+    out = set()
+    for key in ("axes", "axis_name"):
+        val = params.get(key)
+        if val is None:
+            continue
+        if isinstance(val, (tuple, list)):
+            out.update(str(v) for v in val)
+        else:
+            out.add(str(val))
+    return out
+
+
+_COLLECTIVE_PRIMS = {
+    "psum", "pmin", "pmax", "ppermute", "pshuffle", "all_gather",
+    "all_to_all", "reduce_scatter", "psum_scatter", "axis_index",
+    "pbroadcast",
+}
+
+
+def check_jaxpr(closed_jaxpr, label: str, mesh_axes=()) -> "list[Finding]":
+    """Sanitize one traced program; ``label`` names the entry point in
+    findings (rendered as the finding's path)."""
+    findings = []
+    mesh_axes = set(mesh_axes)
+    seen_f64 = set()
+    for jaxpr in iter_jaxprs(closed_jaxpr.jaxpr):
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            for var in eqn.outvars:
+                aval = getattr(var, "aval", None)
+                dtype = str(getattr(aval, "dtype", ""))
+                if dtype in _F64_DTYPES and (prim, dtype) not in seen_f64:
+                    seen_f64.add((prim, dtype))
+                    findings.append(Finding(
+                        "DHQR101", label, 0,
+                        f"{dtype} intermediate from f32 inputs "
+                        f"(primitive '{prim}'): f64 is emulated >10x slow "
+                        "on TPU — find and remove the promotion",
+                        snippet=f"{prim}->{dtype}",
+                    ))
+            if "callback" in prim:
+                findings.append(Finding(
+                    "DHQR102", label, 0,
+                    f"host callback primitive '{prim}' in the traced "
+                    "program: one host round-trip per execution, and the "
+                    "executable cannot be cached across processes",
+                    snippet=prim,
+                ))
+            if prim in _COLLECTIVE_PRIMS:
+                for axis in _collect_axis_names(eqn.params):
+                    if axis not in mesh_axes:
+                        findings.append(Finding(
+                            "DHQR103", label, 0,
+                            f"collective '{prim}' over axis {axis!r} "
+                            f"which the mesh does not declare "
+                            f"(mesh axes: {sorted(mesh_axes) or 'none'})",
+                            snippet=f"{prim}[{axis}]",
+                        ))
+    return findings
+
+
+def _entry_points(preset: str, pol):
+    """(label, thunk, mesh_axes) triples: thunk returns a closed jaxpr.
+
+    Inputs are f32 and tiny; every thunk traces abstractly (make_jaxpr) —
+    no compile, no execution, no device transfer of real data.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    import dhqr_tpu
+    from dhqr_tpu.parallel.mesh import column_mesh
+    from dhqr_tpu.parallel.sharded_cholqr import sharded_cholqr_lstsq
+    from dhqr_tpu.parallel.sharded_qr import (
+        sharded_blocked_qr,
+        sharded_householder_qr,
+    )
+    from dhqr_tpu.parallel.sharded_tsqr import row_mesh, sharded_tsqr_lstsq
+
+    A = jnp.zeros((_M, _N), jnp.float32)
+    b = jnp.zeros((_M,), jnp.float32)
+    cmesh = column_mesh(1)
+    rmesh = row_mesh(1)
+
+    def jx(fn, *args):
+        return lambda: jax.make_jaxpr(fn)(*args)
+
+    yield (f"qr[{preset}]",
+           jx(lambda A: dhqr_tpu.qr(A, policy=preset), A), ())
+    yield (f"lstsq[{preset}]",
+           jx(lambda A, b: dhqr_tpu.lstsq(A, b, policy=preset), A, b), ())
+    yield (f"tsqr_r[{preset}]",
+           jx(lambda A: dhqr_tpu.tsqr_r(A, n_blocks=2, policy=preset), A),
+           ())
+    yield (f"cholesky_qr2[{preset}]",
+           jx(lambda A: dhqr_tpu.cholesky_qr2(A, policy=preset), A), ())
+    yield (f"sharded_blocked_qr[{preset}]",
+           jx(lambda A: sharded_blocked_qr(A, cmesh, block_size=_NB,
+                                           policy=preset), A),
+           ("cols",))
+    # The remaining sharded engines take the classic precision knobs, not
+    # a policy object — trace them at the preset's panel precision.
+    yield (f"sharded_householder_qr[{preset}]",
+           jx(lambda A: sharded_householder_qr(A, cmesh,
+                                               precision=pol.panel), A),
+           ("cols",))
+    yield (f"lstsq_mesh[{preset}]",
+           jx(lambda A, b: dhqr_tpu.lstsq(A, b, mesh=cmesh,
+                                          block_size=_NB, policy=preset),
+              A, b),
+           ("cols",))
+    yield (f"sharded_tsqr_lstsq[{preset}]",
+           jx(lambda A, b: sharded_tsqr_lstsq(A, b, rmesh, block_size=_NB,
+                                              precision=pol.panel), A, b),
+           ("rows",))
+    yield (f"sharded_cholqr_lstsq[{preset}]",
+           jx(lambda A, b: sharded_cholqr_lstsq(A, b, rmesh,
+                                                precision=pol.panel),
+              A, b),
+           ("rows",))
+
+
+def run_jaxpr_pass(presets=None) -> "list[Finding]":
+    """Trace and sanitize every entry point for every policy preset."""
+    _ensure_cpu_backend()
+    import jax
+
+    from dhqr_tpu.precision import PRECISION_POLICIES
+
+    names = list(presets) if presets is not None \
+        else list(PRECISION_POLICIES)
+    findings = []
+    with jax.experimental.enable_x64():
+        for preset in names:
+            pol = PRECISION_POLICIES[preset]
+            for label, thunk, mesh_axes in _entry_points(preset, pol):
+                try:
+                    closed = thunk()
+                except Exception as e:  # a preset that fails to trace IS
+                    findings.append(Finding(   # the regression (DHQR104)
+                        "DHQR104", label, 0,
+                        f"entry point failed to trace: "
+                        f"{type(e).__name__}: {e}",
+                    ))
+                    continue
+                findings.extend(check_jaxpr(closed, label, mesh_axes))
+    return findings

@@ -141,6 +141,7 @@ def main(argv=None) -> int:
     if force_cpu:
         flags = os.environ.get("XLA_FLAGS", "")
         if "host_platform_device_count" not in flags:
+            # dhqr: ignore[DHQR003] CLI entry point owns its process; XLA_FLAGS is only read at first backend init, which is still ahead
             os.environ["XLA_FLAGS"] = (
                 flags + f" --xla_force_host_platform_device_count={args.n_devices}"
             ).strip()
@@ -156,6 +157,7 @@ def main(argv=None) -> int:
     enable_compile_cache()
 
     if jax.default_backend() == "cpu":
+        # dhqr: ignore[DHQR003] CLI entry point owns its process; x64 gives the reference's Float64/ComplexF64 parity sweep
         jax.config.update("jax_enable_x64", True)
 
     import dhqr_tpu

@@ -608,6 +608,22 @@ def _pallas_lowers_on_this_backend(dtype_name: str) -> bool:
 _CACHE_GUARD_WARNED = []
 
 
+def _cache_guard_is_thread_local() -> bool:
+    """Does this jax scope ``enable_compilation_cache(False)`` to the
+    calling thread? jax >= 0.4.35's config ``State`` context manager
+    swaps a thread-local value (``State.swap_local``/``set_local``), so
+    entering the guard on one thread leaves compiles on other threads
+    fully cached. On older jax (or if the holder API changes) the
+    context may fall back to process-global semantics — see the
+    concurrency note on :func:`_pallas_cache_guard`."""
+    try:
+        from jax._src.config import enable_compilation_cache
+    except ImportError:
+        return False
+    return (hasattr(enable_compilation_cache, "swap_local")
+            and hasattr(enable_compilation_cache, "set_local"))
+
+
 def _pallas_cache_guard(interpret: bool):
     """Keep interpret-mode Pallas programs OUT of the persistent
     compilation cache (wrap the jit CALL, where the compile happens).
@@ -623,12 +639,17 @@ def _pallas_cache_guard(interpret: bool):
     cost is only a per-process recompile of the interpret programs; the
     hardware path (``interpret=False``) keeps full caching.
 
-    Concurrency note: the guard toggles a PROCESS-GLOBAL config flag, so
-    it assumes single-threaded compilation — a non-interpret compile on
-    another thread during the guard window is silently kept out of the
-    persistent cache too (numerically harmless; it only loses that
-    compile's caching). Every current caller compiles from the main
-    thread; revisit with a thread-local config context if that changes.
+    Concurrency note (ADVICE r5 item 2, closed round 7): on the pinned
+    jax (0.4.37) the ``enable_compilation_cache(False)`` context swaps a
+    THREAD-LOCAL config value (``State.swap_local``/``set_local`` —
+    verified by :func:`_cache_guard_is_thread_local` and pinned by
+    ``tests/test_analysis.py::test_cache_guard_scope_is_thread_local``),
+    so a non-interpret compile on another thread during the guard window
+    keeps full persistent caching. On a jax whose config holder predates
+    thread-local scoping, the probe returns False and the guard degrades
+    to the old process-global semantics: single-threaded compilation is
+    then assumed — a concurrent compile on another thread would silently
+    lose that one compile's caching (numerically harmless).
 
     The flag toggle lives behind a PRIVATE jax import
     (``jax._src.config.enable_compilation_cache`` — there is no public

@@ -19,6 +19,7 @@ def normal_equations_residual(A, x, b) -> float:
     x = np.asarray(x)
     b = np.asarray(b)
     Ah = A.conj().T
+    # dhqr: ignore[DHQR002] host-side numpy oracle math (LAPACK-backed f64) — no MXU precision to name
     return float(np.linalg.norm(Ah @ A @ x - Ah @ b))
 
 
@@ -34,6 +35,7 @@ def lapack_lstsq(A, b):
     Q, R = np.linalg.qr(A, mode="reduced")
     import scipy.linalg
 
+    # dhqr: ignore[DHQR002] host-side numpy oracle math — no MXU precision to name
     return scipy.linalg.solve_triangular(R, Q.conj().T @ b, lower=False)
 
 

@@ -1,0 +1,11 @@
+"""DHQR001 fixture: unguarded private-jax imports."""
+
+from jax._src.config import enable_compilation_cache  # line 3: finding
+
+import jax._src.lax.linalg  # line 5: finding
+
+
+def use():
+    from jax._src.interpreters import mlir  # line 9: finding
+
+    return mlir, enable_compilation_cache, jax

@@ -1,0 +1,10 @@
+"""DHQR001 fixture: the sanctioned guarded spelling."""
+
+try:
+    from jax._src.config import enable_compilation_cache
+except ImportError:
+    enable_compilation_cache = None
+
+import jax.numpy as jnp  # public API: never flagged
+
+__all__ = ["enable_compilation_cache", "jnp"]

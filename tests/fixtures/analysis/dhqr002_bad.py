@@ -1,0 +1,12 @@
+"""DHQR002 fixture: contractions without precision annotations."""
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def f(a, b):
+    c = jnp.matmul(a, b)  # line 8: finding (no precision=)
+    d = a @ b  # line 9: finding (@ cannot carry precision)
+    e = jnp.einsum("ij,jk->ik", a, b)  # line 10: finding
+    g = lax.dot_general(a, b, (((1,), (0,)), ((), ())))  # line 11: finding
+    return c + d + e + g

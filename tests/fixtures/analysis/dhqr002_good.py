@@ -1,0 +1,12 @@
+"""DHQR002 fixture: annotated contractions (no findings)."""
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def f(a, b):
+    c = jnp.matmul(a, b, precision="highest")
+    e = jnp.einsum("ij,jk->ik", a, b, precision="highest")
+    g = lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+    return c + e + g

@@ -1,0 +1,11 @@
+"""DHQR002 fixture: inline-suppression behavior."""
+
+import numpy as np
+
+
+def oracle(a, b):
+    c = a @ b  # dhqr: ignore[DHQR002] host-side numpy oracle math
+    # dhqr: ignore[DHQR002] directive on the line above the statement
+    d = np.matmul(a, b)
+    e = a @ b  # dhqr: ignore[DHQR004] wrong rule id: does NOT suppress
+    return c + d + e

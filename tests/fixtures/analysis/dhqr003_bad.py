@@ -1,0 +1,12 @@
+"""DHQR003 fixture: process-global config/env mutation."""
+
+import os
+
+import jax
+
+
+def setup():
+    jax.config.update("jax_enable_x64", True)  # line 9: finding
+    os.environ["XLA_FLAGS"] = "--foo"  # line 10: finding
+    os.environ.setdefault("DHQR_X", "1")  # line 11: finding
+    del os.environ["DHQR_X"]  # line 12: finding

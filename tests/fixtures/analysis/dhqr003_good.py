@@ -1,0 +1,10 @@
+"""DHQR003 fixture: reads are fine; mutating a COPY is fine."""
+
+import os
+
+
+def setup():
+    flags = os.environ.get("XLA_FLAGS", "")
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = flags + " --child-only"  # copy, not the process env
+    return env

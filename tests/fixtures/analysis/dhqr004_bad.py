@@ -1,0 +1,30 @@
+"""DHQR004 fixture: host syncs inside traced bodies."""
+
+from functools import partial
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+from dhqr_tpu.utils.compat import shard_map
+
+
+@jax.jit
+def f(x):
+    return float(jnp.sum(x))  # line 14: finding (float() in jit)
+
+
+@partial(jax.jit, static_argnames=("n",))
+def g(x, n):
+    y = np.asarray(x)  # line 19: finding (np.asarray in jit)
+    return x.sum().item() + y.mean() + n  # line 20: finding (.item())
+
+
+def _body(xl, *, axis):
+    xl.block_until_ready()  # line 24: finding (host sync in shard body)
+    return xl
+
+
+def build(mesh, P):
+    return shard_map(partial(_body, axis="cols"), mesh=mesh,
+                     in_specs=P("cols"), out_specs=P("cols"))

@@ -1,0 +1,14 @@
+"""DHQR004 fixture: host syncs OUTSIDE traced bodies are fine."""
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+
+@jax.jit
+def f(x):
+    return jnp.sum(x)  # stays on device
+
+
+def wrapper(x):
+    return float(f(x)), np.asarray(x), x.sum().item()  # host side: fine

@@ -1,0 +1,22 @@
+"""DHQR005 fixture: hard-coded axis name matching no declared axis."""
+
+from functools import partial
+
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dhqr_tpu.utils.compat import shard_map
+
+COL_AXIS = "cols"  # the module's one declared axis name
+
+
+def _body(xl):
+    s = lax.psum(xl, "rows")  # line 14: finding ("rows" never declared)
+    i = lax.axis_index("rows")  # line 15: finding
+    t = lax.psum(xl, COL_AXIS)  # Name (not a literal): fine
+    return s + i + t
+
+
+def build(mesh: Mesh):
+    return shard_map(_body, mesh=mesh, in_specs=P(None, "cols"),
+                     out_specs=P(None, "cols"))

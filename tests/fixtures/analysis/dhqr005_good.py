@@ -1,0 +1,22 @@
+"""DHQR005 fixture: axis threaded as a parameter, or declared literals."""
+
+from functools import partial
+
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dhqr_tpu.utils.compat import shard_map
+
+ROW_AXIS = "rows"
+
+
+def _body(xl, *, axis):
+    s = lax.psum(xl, axis)  # parameter: fine
+    i = lax.axis_index(axis)
+    t = lax.all_gather(xl, "rows")  # literal, but declared above: fine
+    return s + i + t
+
+
+def build(mesh: Mesh, axis_name: str = ROW_AXIS):
+    return shard_map(partial(_body, axis=axis_name), mesh=mesh,
+                     in_specs=P(axis_name), out_specs=P(axis_name))

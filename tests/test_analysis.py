@@ -1,0 +1,318 @@
+"""dhqr-lint: rule units against the paired fixtures, suppression and
+baseline behavior, the jaxpr sanitizer (incl. a planted f64 leak), the
+API-consistency check — and the tier-1 gate itself: the self-scan that
+fails this suite on any new unsuppressed finding in the package.
+
+``pytest -m lint`` runs exactly this module (the fast alias
+tools/lint.sh mirrors; marker registered in pyproject.toml).
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dhqr_tpu.analysis.ast_rules import scan_paths, scan_source
+from dhqr_tpu.analysis.findings import load_baseline, write_baseline
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "analysis")
+
+
+def _scan_fixture(name, virtual_path="dhqr_tpu/ops/_fixture.py"):
+    """Scan a fixture under a virtual in-package path so package-scoped
+    rules (DHQR002) apply to it."""
+    with open(os.path.join(FIXTURES, name), "r", encoding="utf-8") as fh:
+        text = fh.read()
+    return scan_source(text, virtual_path)
+
+
+def _hits(findings, rule):
+    return sorted((f.line for f in findings
+                   if f.rule == rule and not f.suppressed))
+
+
+# -- pass 1: the AST rules, exact IDs and line numbers ----------------------
+
+def test_dhqr001_unguarded_private_imports():
+    findings = _scan_fixture("dhqr001_bad.py")
+    assert _hits(findings, "DHQR001") == [3, 5, 9]
+    assert _scan_fixture("dhqr001_good.py") == []
+
+
+def test_dhqr001_compat_module_is_exempt():
+    with open(os.path.join(FIXTURES, "dhqr001_bad.py")) as fh:
+        text = fh.read()
+    assert scan_source(text, "dhqr_tpu/utils/compat.py") == []
+
+
+def test_dhqr002_unannotated_contractions():
+    findings = _scan_fixture("dhqr002_bad.py")
+    assert _hits(findings, "DHQR002") == [8, 9, 10, 11]
+    assert _scan_fixture("dhqr002_good.py") == []
+
+
+def test_dhqr002_covers_dot_family():
+    # jnp.dot / tensordot / vdot are MXU contractions with the same
+    # bf16-default hazard as matmul (code-review round 7).
+    src = ("import jax.numpy as jnp\n"
+           "def f(a, b):\n"
+           "    return jnp.dot(a, b) + jnp.tensordot(a, b, 1) "
+           "+ jnp.vdot(a, b)\n")
+    findings = scan_source(src, "dhqr_tpu/ops/_x.py")
+    assert len(_hits(findings, "DHQR002")) == 3
+    ok = ("import jax.numpy as jnp\n"
+          "def f(a, b):\n"
+          "    return jnp.dot(a, b, precision='highest')\n")
+    assert scan_source(ok, "dhqr_tpu/ops/_x.py") == []
+
+
+def test_dhqr002_scope_is_the_package():
+    with open(os.path.join(FIXTURES, "dhqr002_bad.py")) as fh:
+        text = fh.read()
+    # Outside dhqr_tpu/ (oracle/test code) the rule does not apply.
+    assert scan_source(text, "tests/test_something.py") == []
+
+
+def test_dhqr003_config_env_mutation():
+    findings = _scan_fixture("dhqr003_bad.py")
+    assert _hits(findings, "DHQR003") == [9, 10, 11, 12]
+    assert _scan_fixture("dhqr003_good.py") == []
+
+
+def test_dhqr003_sanctioned_modules_are_exempt():
+    with open(os.path.join(FIXTURES, "dhqr003_bad.py")) as fh:
+        text = fh.read()
+    for sanctioned in ("tests/conftest.py", "bench.py",
+                       "benchmarks/tpu_probe.py",
+                       "dhqr_tpu/utils/platform.py"):
+        assert scan_source(text, sanctioned) == [], sanctioned
+    # Anchored matching: a NAME that merely ends like a sanctioned one
+    # (test_bench.py, my_benchmarks/) must not inherit the sanction.
+    for unsanctioned in ("tests/test_bench.py", "dhqr_tpu/microbench.py",
+                         "my_benchmarks/util.py"):
+        assert _hits(scan_source(text, unsanctioned),
+                     "DHQR003"), unsanctioned
+
+
+def test_dhqr004_host_sync_in_traced_bodies():
+    findings = _scan_fixture("dhqr004_bad.py")
+    assert _hits(findings, "DHQR004") == [14, 19, 20, 24]
+    assert _scan_fixture("dhqr004_good.py") == []
+
+
+def test_dhqr005_collective_axis_names():
+    findings = _scan_fixture("dhqr005_bad.py")
+    assert _hits(findings, "DHQR005") == [14, 15]
+    assert _scan_fixture("dhqr005_good.py") == []
+
+
+def test_suppression_same_line_line_above_and_wrong_rule():
+    findings = _scan_fixture("dhqr002_suppressed.py")
+    by_line = {f.line: f for f in findings if f.rule == "DHQR002"}
+    assert by_line[7].suppressed and "oracle" in by_line[7].reason
+    assert by_line[9].suppressed  # directive on the line above
+    assert not by_line[10].suppressed  # ignore[DHQR004] names another rule
+    assert _hits(findings, "DHQR002") == [10]
+
+
+# -- baseline round-trip ----------------------------------------------------
+
+def test_baseline_roundtrip(tmp_path):
+    findings = _scan_fixture("dhqr002_bad.py")
+    assert findings
+    baseline_path = tmp_path / "baseline.json"
+    write_baseline(baseline_path, findings)
+    accepted = load_baseline(baseline_path)
+    assert all(f.fingerprint() in accepted for f in findings)
+    # A NEW violation (different snippet) is not masked by the baseline.
+    fresh = scan_source("import jax.numpy as jnp\n"
+                        "x = jnp.matmul(1, 2)\n",
+                        "dhqr_tpu/ops/_new.py")
+    assert [f for f in fresh if f.fingerprint() not in accepted]
+
+
+def test_baseline_is_a_multiset(tmp_path):
+    """Two identical violation lines share a fingerprint; baselining one
+    occurrence must not absorb a second (code-review round 7)."""
+    from dhqr_tpu.analysis.cli import main
+
+    one = tmp_path / "one.py"
+    one.write_text("import numpy as np\nc = a @ b\n")
+    two = tmp_path / "two.py"
+    two.write_text("import numpy as np\nc = a @ b\nd = a @ b\n")
+    # Use a virtual package path via scan_source for fingerprints, but
+    # drive the real CLI on real files for the subtraction logic: DHQR003
+    # applies everywhere, so use config mutations instead.
+    one.write_text("import os\nos.environ['A'] = '1'\n")
+    two.write_text("import os\nos.environ['A'] = '1'\n"
+                   "os.environ['A'] = '1'\n")
+    baseline = tmp_path / "base.json"
+    assert main(["check", str(one), "--write-baseline", str(baseline)]) == 0
+    # One accepted occurrence: the single-hit file passes...
+    assert main(["check", str(one), "--baseline", str(baseline)]) == 0
+    # ...but a second identical line is NOT silently absorbed.
+    assert main(["check", str(two), "--baseline", str(baseline)]) == 1
+
+
+def test_shipped_baseline_is_empty():
+    accepted = load_baseline(os.path.join(REPO, "tools",
+                                          "lint_baseline.json"))
+    assert not accepted, (
+        "the shipped baseline must stay empty for the library proper "
+        "(docs/DESIGN.md 'Static invariants': fix or suppress, never "
+        "baseline)")
+
+
+# -- the gate: self-scan of the package + tests -----------------------------
+
+def test_self_scan_package_and_tests_clean():
+    findings = scan_paths([os.path.join(REPO, "dhqr_tpu"),
+                           os.path.join(REPO, "tests")], rel_to=REPO)
+    active = [f for f in findings if not f.suppressed]
+    assert active == [], "new lint findings:\n" + "\n".join(
+        f.render() for f in active)
+    # The known, reasoned suppressions stay visible (not silently lost).
+    assert all(f.reason for f in findings if f.suppressed), (
+        "every suppression must carry a reason")
+
+
+def test_cli_smoke_json_and_exit_codes(capsys):
+    from dhqr_tpu.analysis.cli import main
+
+    bad = os.path.join(FIXTURES, "dhqr003_bad.py")
+    rc = main(["check", bad, "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and len(out["findings"]) == 4
+    good = os.path.join(FIXTURES, "dhqr003_good.py")
+    assert main(["check", good]) == 0
+
+
+def test_cli_nonexistent_path_fails_loudly(capsys):
+    """A typo'd CI target must not scan zero files and report green
+    (code-review round 7)."""
+    from dhqr_tpu.analysis.cli import main
+
+    assert main(["check", "dhqr_tppu_typo", "--no-jaxpr",
+                 "--no-api"]) == 2
+    assert "dhqr_tppu_typo" in capsys.readouterr().err
+
+
+def test_scans_package_detects_ancestor_dirs():
+    """`check .` (or the repo root) contains the package, so the jaxpr
+    and API passes must engage for it (code-review round 7)."""
+    from dhqr_tpu.analysis.cli import _scans_package
+
+    assert _scans_package([os.path.join(REPO, "dhqr_tpu")])
+    assert _scans_package([REPO])
+    assert not _scans_package([os.path.join(REPO, "tests")])
+
+
+# -- pass 2: the jaxpr sanitizer --------------------------------------------
+
+def test_jaxpr_pass_all_presets_clean():
+    """THE acceptance invariant: no f64 intermediates from f32 inputs, no
+    callbacks, resolvable collective axes — for every public entry point
+    under every policy preset (sharded engines under a 1-device mesh)."""
+    from dhqr_tpu.analysis.jaxpr_pass import run_jaxpr_pass
+
+    findings = run_jaxpr_pass()
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_jaxpr_planted_f64_leak_detected():
+    from dhqr_tpu.analysis.jaxpr_pass import check_jaxpr
+
+    def leak(a):  # the exact bug class DHQR101 exists for: a silent
+        scale = np.float64(2.0)  # numpy-scalar promotion to f64
+        return jnp.matmul(a * scale, a.T, precision="highest")
+
+    closed = jax.make_jaxpr(leak)(jnp.zeros((4, 4), jnp.float32))
+    findings = check_jaxpr(closed, "leak")
+    assert any(f.rule == "DHQR101" for f in findings)
+
+
+def test_jaxpr_planted_callback_detected():
+    from dhqr_tpu.analysis.jaxpr_pass import check_jaxpr
+
+    def with_callback(a):
+        return jax.pure_callback(
+            lambda x: np.asarray(x) * 2,
+            jax.ShapeDtypeStruct(a.shape, a.dtype), a)
+
+    closed = jax.make_jaxpr(with_callback)(jnp.zeros((4,), jnp.float32))
+    findings = check_jaxpr(closed, "cb")
+    assert any(f.rule == "DHQR102" for f in findings)
+
+
+def test_jaxpr_axis_mismatch_detected():
+    from dhqr_tpu.analysis.jaxpr_pass import check_jaxpr
+    from dhqr_tpu.parallel.mesh import column_mesh
+    from dhqr_tpu.parallel.sharded_qr import sharded_blocked_qr
+
+    mesh = column_mesh(1)
+    closed = jax.make_jaxpr(
+        lambda A: sharded_blocked_qr(A, mesh, block_size=4))(
+            jnp.zeros((16, 8), jnp.float32))
+    # Correct mesh axes: clean. Wrong declared axes: every psum flagged.
+    assert check_jaxpr(closed, "ok", mesh_axes=("cols",)) == []
+    findings = check_jaxpr(closed, "bad", mesh_axes=("rows",))
+    assert any(f.rule == "DHQR103" for f in findings)
+
+
+# -- API consistency --------------------------------------------------------
+
+def test_api_surface_consistent_with_docs():
+    from dhqr_tpu.analysis.api_check import check_api
+
+    findings = check_api()
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# -- satellite: the cache guard's concurrency scope (ADVICE r5 item 2) ------
+
+def test_cache_guard_scope_is_thread_local():
+    """On the pinned jax the interpret-mode compilation-cache disable is
+    scoped to the entering thread: another thread still sees caching
+    enabled during the guard window (ops/blocked._pallas_cache_guard's
+    concurrency note)."""
+    from dhqr_tpu.ops.blocked import (
+        _cache_guard_is_thread_local,
+        _pallas_cache_guard,
+    )
+
+    try:
+        from jax._src.config import enable_compilation_cache
+    except ImportError:
+        pytest.skip("no private cache toggle on this jax: the guard "
+                    "degrades to a warning (covered elsewhere)")
+    assert _cache_guard_is_thread_local(), (
+        "pinned jax lost thread-local config scoping: restore the "
+        "documented single-threaded assumption in _pallas_cache_guard")
+
+    def read_from_thread():
+        seen = []
+        t = threading.Thread(
+            target=lambda: seen.append(enable_compilation_cache.value))
+        t.start()
+        t.join()
+        return seen[0]
+
+    # The ambient value is environment-dependent (JAX_ENABLE_COMPILATION_
+    # CACHE=false is legitimate); assert the guard CHANGES nothing for
+    # other threads and restores this one, whatever the ambient is.
+    ambient_other = read_from_thread()
+    before_here = enable_compilation_cache.value
+    with _pallas_cache_guard(True):
+        assert enable_compilation_cache.value is False  # this thread
+        assert read_from_thread() == ambient_other, (
+            "another thread observed the guard window — the toggle went "
+            "process-global")
+    assert enable_compilation_cache.value == before_here  # restored

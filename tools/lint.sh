@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Lint gate: ruff (style/correctness, pinned config in pyproject.toml
+# [tool.ruff]) + dhqr-lint (the AST + jaxpr static-analysis subsystem,
+# docs/DESIGN.md "Static invariants"). Same checks as `pytest -m lint`;
+# exit nonzero on any unsuppressed finding.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if command -v ruff >/dev/null 2>&1; then
+    ruff check dhqr_tpu tests bench.py
+else
+    # The container image does not ship ruff; the dhqr-lint pass below
+    # still gates. CI images with ruff installed get both.
+    echo "lint.sh: ruff not found — skipping ruff (config stays pinned" \
+         "in pyproject.toml [tool.ruff])" >&2
+fi
+
+# JAX_PLATFORMS for subprocesses that respect it; the jaxpr pass also
+# pins the backend itself (sitecustomize-pinned hosts ignore the env).
+JAX_PLATFORMS=cpu python -m dhqr_tpu.analysis check dhqr_tpu tests \
+    --baseline tools/lint_baseline.json
