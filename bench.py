@@ -689,7 +689,17 @@ def _prewarm() -> None:
         pass
 
     from dhqr_tpu.ops.blocked import _blocked_qr_impl
+    from dhqr_tpu.serve.cache import ExecutableCache
     from dhqr_tpu.utils.profiling import sync
+
+    # Every prewarm compile goes through the serving tier's AOT cache
+    # machinery (one code path with serve dispatch): the lower().compile()
+    # it performs is exactly what populates the persistent jax
+    # compilation cache the measuring child will read, and the cache's
+    # hit/miss/compile-seconds counters ride into the prewarm summary.
+    # Unbounded here — a prewarm child compiles each program once and
+    # exits; eviction would only lie about the compile count.
+    cache = ExecutableCache(max_size=1 << 20)
 
     _stage("prewarm_backend_init")
     platform = jax.devices()[0].platform
@@ -736,10 +746,15 @@ def _prewarm() -> None:
         try:
             t1 = time.perf_counter()
             A = jnp.zeros((n_, n_), dtype=jnp.float32)
-            _blocked_qr_impl.lower(A, nb, **kwargs).compile()
+            kw_key = tuple(sorted(kwargs.items()))
+            cache.get_or_compile(
+                ("qr_single", n_, nb, kw_key),
+                lambda: _blocked_qr_impl.lower(A, nb, **kwargs))
             if chain and chain > 1:
-                jax.jit(_chained_qr(_blocked_qr_impl, lax, nb, kwargs,
-                                    chain)).lower(A).compile()
+                cache.get_or_compile(
+                    ("qr_chain", n_, nb, chain, kw_key),
+                    lambda: jax.jit(_chained_qr(_blocked_qr_impl, lax, nb,
+                                                kwargs, chain)).lower(A))
             if st.get("backward_error") or st.get("solve_errors"):
                 # The error-anchor stages also compile the Q-apply /
                 # Q^H-apply programs (the heavy extras; the residual
@@ -749,12 +764,16 @@ def _prewarm() -> None:
                 from dhqr_tpu.ops.blocked import (_apply_q_impl,
                                                   _apply_qt_impl)
 
-                _apply_q_impl.lower(A, A, nb,
-                                    precision=PRECISION).compile()
+                cache.get_or_compile(
+                    ("apply_q", n_, nb, PRECISION),
+                    lambda: _apply_q_impl.lower(A, A, nb,
+                                                precision=PRECISION))
                 if st.get("solve_errors"):
                     bvec = jnp.zeros((n_,), dtype=jnp.float32)
-                    _apply_qt_impl.lower(A, bvec, nb,
-                                         precision=PRECISION).compile()
+                    cache.get_or_compile(
+                        ("apply_qt", n_, nb, PRECISION),
+                        lambda: _apply_qt_impl.lower(A, bvec, nb,
+                                                     precision=PRECISION))
             last_pair = time.perf_counter() - t1
             last_n = n_
             done.append({"stage": name, "compile_seconds":
@@ -780,15 +799,18 @@ def _prewarm() -> None:
                 C, sr = lax.scan(body, A, None, length=k)
                 return C, sr
 
-            jax.jit(lambda A: gchained(A, 1)).lower(A).compile()
-            jax.jit(lambda A: gchained(A, 25)).lower(A).compile()
+            for k in (1, 25):
+                cache.get_or_compile(
+                    ("geqrf_chain", N, k),
+                    lambda k=k: jax.jit(lambda A: gchained(A, k)).lower(A))
             done.append({"stage": "prewarm_geqrf"})
         except Exception as e:
             print(f"::prewarm_stage_failed prewarm_geqrf "
                   f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
     _stage("prewarm_done")
     print(json.dumps({"prewarm": "done", "stages": done,
-                      "seconds": round(time.time() - t0, 1)}))
+                      "seconds": round(time.time() - t0, 1),
+                      "cache": cache.stats()}))
 
 
 class _Watchdog:

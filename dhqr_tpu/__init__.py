@@ -44,9 +44,10 @@ from dhqr_tpu.precision import (
     PrecisionPolicy,
     resolve_policy,
 )
-from dhqr_tpu.utils.config import DHQRConfig
+from dhqr_tpu.serve import batched_lstsq, batched_qr
+from dhqr_tpu.utils.config import DHQRConfig, ServeConfig
 
-__version__ = "0.2.0"
+__version__ = "0.3.0"
 
 __all__ = [
     "QRFactorization",
@@ -66,7 +67,10 @@ __all__ = [
     "cholesky_qr_lstsq",
     "lstsq_diff",
     "alphafactor",
+    "batched_qr",
+    "batched_lstsq",
     "DHQRConfig",
+    "ServeConfig",
     "PrecisionPolicy",
     "PRECISION_POLICIES",
     "POLICY_LADDER",

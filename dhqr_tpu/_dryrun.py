@@ -100,6 +100,44 @@ def run(n_devices: int) -> None:
     assert bool(jnp.all(jnp.isfinite(x))), "non-finite x (policy on mesh)"
     print("dryrun: sharded lstsq policy=fast ok", flush=True)
 
+    # Serving tier (round 8): a mixed-shape request list through
+    # batched_lstsq — bucketing, exact padding, AOT cache, out-of-order
+    # scatter — with every request's residual held to the reference's 8x
+    # LAPACK criterion (not just finiteness), and a repeat pass pinned to
+    # ZERO recompiles (the cache contract the tier exists to provide).
+    from dhqr_tpu.serve import batched_lstsq, cache_stats
+    from dhqr_tpu.utils.testing import (
+        TOLERANCE_FACTOR,
+        normal_equations_residual,
+        oracle_residual,
+    )
+
+    req_shapes = [(48, 16), (30, 24), (48, 16), (72, 40), (24, 24),
+                  (60, 10), (40, 28)]
+    As = [jnp.asarray(rng.random(s), jnp.float32) for s in req_shapes]
+    rhs = [jnp.asarray(rng.random(s[0]), jnp.float32) for s in req_shapes]
+    xs = batched_lstsq(As, rhs, block_size=8)
+    for i, (Ai, bi, xi) in enumerate(zip(As, rhs, xs)):
+        assert xi.shape == (req_shapes[i][1],), (i, xi.shape)
+        res = normal_equations_residual(Ai, np.asarray(xi), bi)
+        ref = oracle_residual(np.asarray(Ai), np.asarray(bi))
+        assert res < TOLERANCE_FACTOR * ref, (i, req_shapes[i], res, ref)
+    s0 = cache_stats()
+    xs = batched_lstsq(As, rhs, block_size=8)
+    s1 = cache_stats()
+    assert s1["misses"] == s0["misses"], (
+        "repeat request stream recompiled", s0, s1)
+    # Policy composition through the batched path (trailing split + one
+    # in-program refinement sweep per request).
+    xs = batched_lstsq(As, rhs, block_size=8, policy="fast")
+    for i, (Ai, bi, xi) in enumerate(zip(As, rhs, xs)):
+        res = normal_equations_residual(Ai, np.asarray(xi), bi)
+        ref = oracle_residual(np.asarray(Ai), np.asarray(bi))
+        assert res < TOLERANCE_FACTOR * ref, ("policy", i, res, ref)
+    print(f"dryrun: serve batched_lstsq ok ({len(As)} mixed-shape requests, "
+          f"{s1['size']} resident executables, repeat pass 0 recompiles)",
+          flush=True)
+
     # TSQR wants a genuinely tall problem: local row blocks must stay tall
     nt = 8
     mt = 2 * nt * n_devices

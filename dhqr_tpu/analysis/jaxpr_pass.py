@@ -184,6 +184,17 @@ def _entry_points(preset: str, pol):
            ())
     yield (f"cholesky_qr2[{preset}]",
            jx(lambda A: dhqr_tpu.cholesky_qr2(A, policy=preset), A), ())
+    # The serving tier's bucket dispatch unit (serve/engine.py): the same
+    # traced program batched_lstsq compiles per bucket, via the engine's
+    # own config/policy resolution — a policy preset that stops tracing
+    # through the vmapped path is a DHQR104 regression like any other.
+    from dhqr_tpu.serve.engine import bucket_program
+
+    As = jnp.zeros((2, _M, _N), jnp.float32)
+    bs = jnp.zeros((2, _M), jnp.float32)
+    yield (f"batched_lstsq[{preset}]",
+           jx(bucket_program("lstsq", block_size=_NB, policy=preset),
+              As, bs), ())
     yield (f"sharded_blocked_qr[{preset}]",
            jx(lambda A: sharded_blocked_qr(A, cmesh, block_size=_NB,
                                            policy=preset), A),

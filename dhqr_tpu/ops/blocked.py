@@ -582,6 +582,36 @@ _blocked_qr_impl_donate = partial(
 )(_blocked_qr_impl.__wrapped__)
 
 
+@partial(
+    jax.jit,
+    static_argnames=("block_size", "precision", "norm", "panel_impl",
+                     "trailing_precision"),
+    donate_argnums=(0,),
+)
+def _batched_qr_impl_donate(A, block_size, precision=DEFAULT_PRECISION,
+                            norm="accurate", panel_impl="loop",
+                            trailing_precision=None):
+    """Serve-tier batched dispatch unit: vmap of the blocked engine over a
+    stacked ``(B, m, n)`` input, with the stack DONATED.
+
+    The packed output H is exactly input-shaped, so XLA aliases the
+    donated buffer (pinned on CPU via ``unsafe_buffer_pointer`` in
+    tests/test_serve.py) — one matrix stack of HBM for the whole batch,
+    the batched analogue of :data:`_blocked_qr_impl_donate`. The fused
+    Pallas panel kernel is deliberately never engaged here
+    (``pallas=False``): it is a single-problem VMEM tier, while batched
+    throughput at small n lives on the vmapped XLA MXU path (the point of
+    the serving tier — see ``dhqr_tpu.serve``).
+    """
+    def one(a):
+        return _blocked_qr_impl(
+            a, block_size, precision=precision, pallas=False, norm=norm,
+            panel_impl=panel_impl, trailing_precision=trailing_precision,
+        )
+
+    return jax.vmap(one)(A)
+
+
 from functools import lru_cache as _lru_cache
 
 

@@ -1,0 +1,54 @@
+"""Batched serving tier: shape buckets + AOT executable cache + vmapped
+throughput engine.
+
+The reference factors one matrix at a time; this subsystem is the
+framework's answer to the serving workload — many small/medium problems
+with heterogeneous shapes, where throughput comes from (a) keeping
+compiled programs resident (``serve.cache``) and (b) feeding them
+stacked work (``serve.engine``), with shapes rounded onto a small
+padded-bucket lattice so both stay finite (``serve.buckets``).
+
+    >>> from dhqr_tpu.serve import batched_lstsq, prewarm, cache_stats
+    >>> xs = batched_lstsq(As, bs)             # list in, list out, exact
+    >>> prewarm([(32, 512, 256)])              # compile before traffic
+    >>> cache_stats()                          # hits/misses/compile s
+
+See docs/DESIGN.md "Serving tier" for the bucket-lattice rationale and
+docs/OPERATIONS.md for the cache runbook.
+"""
+
+from dhqr_tpu.serve.buckets import (
+    Bucket,
+    bucket_batch,
+    bucket_dim,
+    plan_bucket,
+)
+from dhqr_tpu.serve.cache import (
+    CacheKey,
+    ExecutableCache,
+    cache_stats,
+    clear_cache,
+    default_cache,
+)
+from dhqr_tpu.serve.engine import (
+    batched_lstsq,
+    batched_qr,
+    bucket_program,
+    prewarm,
+)
+
+__all__ = [
+    "Bucket",
+    "CacheKey",
+    "ExecutableCache",
+    "default_cache",
+    "batched_lstsq",
+    "batched_qr",
+    "bucket_batch",
+    "bucket_dim",
+    "bucket_program",
+    "cache_stats",
+    "clear_cache",
+    "plan_bucket",
+    "prewarm",
+]

@@ -1,0 +1,175 @@
+"""Shape-bucketing planner for the batched serving tier.
+
+A serving workload is many small/medium least-squares problems arriving
+with heterogeneous shapes. Compiling one program per novel ``(m, n)``
+is the throughput killer (every miss is a fresh trace+compile, seconds
+on TPU), and dispatching them one by one leaves the MXU idle at small n.
+The planner rounds every incoming ``(m, n, dtype)`` request UP onto a
+small static lattice of padded bucket shapes so that
+
+* the number of distinct compiled programs is O(log^2) in the served
+  shape range (geometric grid per dimension, ratio
+  ``ServeConfig.ratio``), and
+* every request in a bucket can be stacked and factored by ONE vmapped
+  dispatch of the blocked engine (``dhqr_tpu.serve.engine``).
+
+Padding is exact, not approximate: a request ``A`` (m, n) is embedded in
+the bucket shape (M, N) as
+
+    [[A, 0 ], [0, I_k], [0, 0]]        k = N - n,  rows m+k..M-1 zero
+
+— the orthogonal-column extension of ``sharded_qr._pad_cols_orthogonal``
+(the padded columns live entirely in their own rows, so they are exactly
+orthogonal to the originals and decouple from them in R), plus trailing
+zero ROWS, which change neither the normal equations nor the reflectors
+(a zero row contributes nothing to any column norm or inner product).
+Hence the padded factorization contains the true one as its leading
+``[:m, :n]`` block, and the padded least-squares solution restricted to
+``x[:n]`` is the true solution with ``x[n:] = 0`` — exactly in exact
+arithmetic, to ~ulp in floats (padding only reshapes reduction trees).
+The bucket row count is planned with headroom for the identity block
+(``M >= m + (N - n)``), so the embedding always fits.
+
+Lattice alignment: every lattice point is snapped up to the granularity
+the engines want — the 8-row sublane below 128, then 64, then the
+128-lane / ``DEFAULT_BLOCK_SIZE`` granularity from 512 up — so large
+buckets hold whole compact-WY panels (the ``auto_block_size`` family)
+while small buckets don't overshoot a 20-column problem to 128.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import numpy as np
+
+from dhqr_tpu.utils.config import ServeConfig
+
+
+def _align_for(v: int) -> int:
+    """Lattice snap granularity around ``v`` (see module docstring)."""
+    if v < 128:
+        return 8
+    if v < 512:
+        return 64
+    return 128
+
+
+def _snap_up(v: int) -> int:
+    a = _align_for(v)
+    return -(-v // a) * a
+
+
+def bucket_dim(x: int, config: "ServeConfig | None" = None) -> int:
+    """Round one request dimension UP onto the geometric lattice.
+
+    The lattice is the UNSNAPPED geometric sequence
+    ``min_dim * ratio^k``, each point snapped up to the alignment tier
+    independently — snapping a point must not feed the next ratio step,
+    or the 64/128-snap compounds with the ratio and tears ~2x holes in
+    the ladder exactly where serving shapes live (measured: (384, 128)
+    requests landing in a 3x-flops bucket). With the default
+    ``ratio = sqrt(2)`` the snapped lattice is the half-octave ladder
+    ``..., 64, 96, 128, 192, 256, 384, 512, 768, ...`` — every power of
+    two and its 3/2 midpoint — so the common MXU-friendly request sizes
+    land exactly and the worst-case padding overshoot stays ~sqrt(2)
+    per dimension.
+    """
+    cfg = config or ServeConfig.from_env()
+    if x < 1:
+        raise ValueError(f"dimension must be positive, got {x}")
+    raw = float(cfg.min_dim)
+    v = _snap_up(cfg.min_dim)
+    while v < x:
+        raw *= cfg.ratio
+        # The relative epsilon keeps float accumulation from pushing an
+        # exact lattice point past itself (16 * sqrt(2)^2 computes as
+        # 32.000000000000004; a bare ceil would turn the whole power-of-
+        # two ladder into 33-40-65-72-...).
+        nxt = _snap_up(int(math.ceil(raw * (1.0 - 1e-9))))
+        # Snapping can swallow a ratio step at small dims; keep the
+        # ladder strictly increasing either way.
+        v = nxt if nxt > v else v + _align_for(v)
+    return v
+
+
+def bucket_batch(count: int, config: "ServeConfig | None" = None) -> int:
+    """Batch-axis bucket: next power of two >= count, capped at
+    ``config.max_batch`` (groups beyond the cap are chunked by the
+    engine, so a request burst can't mint an unbounded family of batch
+    shapes)."""
+    cfg = config or ServeConfig.from_env()
+    if count < 1:
+        raise ValueError(f"batch count must be positive, got {count}")
+    # min() with the cap even on the pow2 branch: a non-power-of-two
+    # max_batch (48, say) must still bound the stacked buffer — 33
+    # requests round to 64 by the pow2 rule but dispatch at 48.
+    return min(1 << (count - 1).bit_length(), cfg.max_batch)
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Bucket:
+    """One padded bucket shape: requests mapped here stack into a
+    ``(batch, m, n)`` dispatch of dtype ``dtype`` (a canonical numpy
+    dtype name — part of the cache key)."""
+
+    m: int
+    n: int
+    dtype: str
+
+
+def plan_bucket(m: int, n: int, dtype,
+                config: "ServeConfig | None" = None) -> Bucket:
+    """Map a raw request shape onto its bucket.
+
+    ``n`` is rounded up first; ``m`` is then rounded with the identity
+    block's ``k = N - n`` extra rows already included, so the exact
+    embedding (module docstring) always fits: ``M >= m + k``.
+    """
+    cfg = config or ServeConfig.from_env()
+    if n < 1 or m < n:
+        raise ValueError(
+            f"the serving tier factors tall problems (m >= n >= 1), "
+            f"got shape ({m}, {n})"
+        )
+    N = bucket_dim(n, cfg)
+    M = bucket_dim(m + (N - n), cfg)
+    return Bucket(M, N, np.dtype(dtype).name)
+
+
+def pad_group(requests, bucket: Bucket, batch: int):
+    """Stack a bucket group into host buffers ready for one dispatch.
+
+    ``requests``: list of ``(A, b)`` pairs (numpy-convertible; ``b`` may
+    be None for factor-only groups). Returns ``(A_buf, b_buf)`` numpy
+    arrays of shapes ``(batch, M, N)`` / ``(batch, M)`` (``b_buf`` is
+    None when every ``b`` is). Each request is embedded exactly (module
+    docstring); batch rows beyond ``len(requests)`` are filled with the
+    identity embedding of an empty request, which factors trivially and
+    keeps the back-substitution finite (an all-zero filler would put
+    zeros on R's diagonal and pump NaNs through the padded lanes).
+
+    Host-side by design: one ``np`` buffer fill + ONE device transfer
+    per group beats per-request device-side pad/stack dispatches, and
+    none of this runs under jit (the jitted program starts at the
+    stacked arrays).
+    """
+    M, N = bucket.m, bucket.n
+    dtype = np.dtype(bucket.dtype)
+    A_buf = np.zeros((batch, M, N), dtype=dtype)
+    b_buf = np.zeros((batch, M), dtype=dtype)
+    have_b = False
+    for i, (A, b) in enumerate(requests):
+        A = np.asarray(A)
+        m, n = A.shape
+        k = N - n
+        A_buf[i, :m, :n] = A
+        if k:
+            A_buf[i, m:m + k, n:] = np.eye(k, dtype=dtype)
+        if b is not None:
+            have_b = True
+            b_buf[i, :m] = np.asarray(b)
+    for i in range(len(requests), batch):
+        A_buf[i, :N, :N] = np.eye(N, dtype=dtype)
+    return A_buf, (b_buf if have_b else None)

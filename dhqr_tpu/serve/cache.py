@@ -1,0 +1,166 @@
+"""AOT executable cache — keep compiled programs resident, count everything.
+
+The serving tier's throughput contract is "a repeated request stream
+never recompiles": every bucket dispatch goes through this cache, which
+does ``jit(...).lower(shapes).compile()`` ONCE per key and then hands
+back the resident executable. The same machinery is the one code path
+bench.py's compile-cache prewarm child uses (its keys are bench stage
+names), so "prewarm compiles what measuring runs" is enforced by
+construction rather than by two call sites staying in sync.
+
+Accounting rides the shared profiling utilities
+(:class:`dhqr_tpu.utils.profiling.Counters` for hit/miss/eviction
+counts, :class:`~dhqr_tpu.utils.profiling.PhaseTimer` for per-compile
+wall seconds), so benchmarks, the dry run and operators all read the
+numbers the engine itself maintains (``cache_stats()``; OPERATIONS.md
+has the runbook).
+
+Eviction is LRU with a bound from ``ServeConfig.cache_size``
+(``DHQR_SERVE_CACHE_SIZE``). Evicting drops only the in-process
+executable handle; when a persistent jax compilation cache is enabled
+(utils/platform.enable_compile_cache) a re-miss recompiles cheaply from
+the serialized artifact instead of from scratch.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable, NamedTuple
+
+from dhqr_tpu.utils.config import ServeConfig
+from dhqr_tpu.utils.profiling import Counters, PhaseTimer
+
+
+class CacheKey(NamedTuple):
+    """Everything that selects a distinct serve program.
+
+    ``kind`` is the program family ("lstsq" | "qr"); ``batch``/``m``/
+    ``n``/``dtype`` the bucketed stacked shape; the rest the engine
+    knobs that are static arguments of the underlying jit (a knob that
+    changed the traced program but not the key would silently serve
+    stale executables — keep this in sync with ``engine._lower_for_key``).
+    """
+
+    kind: str
+    batch: int
+    m: int
+    n: int
+    dtype: str
+    block_size: int
+    precision: str
+    trailing_precision: "str | None"
+    apply_precision: "str | None"
+    refine: int
+    norm: str
+    panel_impl: str
+
+
+class ExecutableCache:
+    """LRU-bounded map from hashable keys to compiled executables.
+
+    ``get_or_compile(key, lower_fn)`` is the only entry point:
+    ``lower_fn`` must return a ``jax.stages.Lowered`` (or any object
+    with ``.compile()``); the cache owns the compile, its timing, and
+    the counters. Keys are usually :class:`CacheKey`, but any hashable
+    works (bench.py's prewarm stages use plain tuples).
+    """
+
+    def __init__(self, max_size: "int | None" = None) -> None:
+        if max_size is None:
+            max_size = ServeConfig.from_env().cache_size
+        if max_size < 1:
+            raise ValueError(f"max_size must be >= 1, got {max_size}")
+        self.max_size = int(max_size)
+        self._entries: "OrderedDict[object, object]" = OrderedDict()
+        self.counters = Counters()
+        self.timer = PhaseTimer()
+        # One lock for lookup + insert + evict + counters: a serving tier
+        # is driven from concurrent request threads, and an unlocked
+        # hit/evict interleaving can KeyError a request that should have
+        # been a hit. Compiles hold the lock too — serializing concurrent
+        # compiles of the SAME key is the point (one compile, N waiters),
+        # and concurrent compiles of different keys would contend on
+        # XLA's own compilation locks anyway.
+        self._lock = threading.RLock()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def __contains__(self, key) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def get_or_compile(self, key, lower_fn: Callable[[], object]):
+        """Return the executable for ``key``, compiling on first miss."""
+        with self._lock:
+            if key in self._entries:
+                self.counters.bump("hits")
+                self._entries.move_to_end(key)
+                return self._entries[key]
+            self.counters.bump("misses")
+            before = self.timer.total("aot_compile")
+            with self.timer.measure("aot_compile"):
+                exe = lower_fn().compile()
+            # The timer is the ONE source of compile wall time; the
+            # counter mirrors it so stats() stays a flat JSON dict.
+            self.counters.bump("compile_seconds",
+                               self.timer.total("aot_compile") - before)
+            self._entries[key] = exe
+            while len(self._entries) > self.max_size:
+                self._entries.popitem(last=False)
+                self.counters.bump("evictions")
+            return exe
+
+    def stats(self) -> dict:
+        """Counter snapshot + occupancy, JSON-ready (the benchmark
+        artifact and the dry run embed this verbatim)."""
+        with self._lock:
+            snap = self.counters.snapshot()
+            size = len(self._entries)
+        return {
+            "size": size,
+            "max_size": self.max_size,
+            "hits": int(snap.get("hits", 0)),
+            "misses": int(snap.get("misses", 0)),
+            "evictions": int(snap.get("evictions", 0)),
+            "compile_seconds": round(float(snap.get("compile_seconds", 0)), 3),
+        }
+
+    def clear(self) -> None:
+        """Drop every resident executable (counters keep accumulating —
+        they are lifetime telemetry, not occupancy)."""
+        with self._lock:
+            self._entries.clear()
+
+
+# The process-default cache every public serve entry point dispatches
+# through — created LAZILY on first serve use, not at import: a
+# malformed DHQR_SERVE_* variable must fail the serve call that reads
+# it, never `import dhqr_tpu` for users who don't touch the tier, and
+# DHQR_SERVE_CACHE_SIZE set programmatically before first use must
+# still take effect. Tests that need isolation construct their own
+# ExecutableCache and pass it in.
+_DEFAULT_CACHE: "ExecutableCache | None" = None
+_DEFAULT_CACHE_LOCK = threading.Lock()
+
+
+def default_cache() -> ExecutableCache:
+    """The process-default serve cache (created on first use)."""
+    global _DEFAULT_CACHE
+    if _DEFAULT_CACHE is None:
+        with _DEFAULT_CACHE_LOCK:
+            if _DEFAULT_CACHE is None:
+                _DEFAULT_CACHE = ExecutableCache()
+    return _DEFAULT_CACHE
+
+
+def cache_stats() -> dict:
+    """Stats of the process-default serve cache."""
+    return default_cache().stats()
+
+
+def clear_cache() -> None:
+    """Clear the process-default serve cache."""
+    default_cache().clear()

@@ -1,0 +1,451 @@
+"""Batched serving entry points: group -> stack -> one dispatch -> scatter.
+
+``batched_qr`` / ``batched_lstsq`` accept a LIST of heterogeneous
+requests and turn them into a handful of vmapped dispatches of the
+blocked engine:
+
+1. every request's ``(m, n, dtype)`` is rounded onto the bucket lattice
+   (``serve.buckets.plan_bucket`` — exact orthogonal-column + zero-row
+   padding, so truncated answers are exact);
+2. each bucket group is stacked into one host buffer (one device
+   transfer) and dispatched through the AOT executable cache
+   (``serve.cache`` — ``lower().compile()`` once per
+   (bucket, dtype, engine-knobs) key, LRU-bounded, counted);
+3. per-request results are sliced back out IN INPUT ORDER, truncated to
+   the request's own shape.
+
+This is the first tier that optimizes *throughput* rather than
+single-factorization latency: at small n the MXU only stays busy when
+factorizations are batched (tests/test_batched.py pins the
+transformability; arXiv:2112.09017 makes the same argument for TPU
+dense linear algebra), and a heterogeneous stream only stays compiled
+when shapes are bucketed.
+
+Engine scope: the blocked Householder XLA path only (``pallas=False`` —
+the fused panel kernel is a single-problem VMEM tier; under vmap the
+XLA path is the MXU one), single device. Precision policies and
+iterative refinement compose exactly as on ``lstsq``: the policy's
+panel/trailing go to the factor stage, ``apply`` to the Q^H-apply,
+``refine`` into in-program refinement sweeps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from dhqr_tpu.ops import blocked as _blocked
+from dhqr_tpu.ops import solve as _solve
+from dhqr_tpu.serve.buckets import (
+    Bucket,
+    bucket_batch,
+    pad_group,
+    plan_bucket,
+)
+from dhqr_tpu.serve.cache import CacheKey, ExecutableCache, default_cache
+from dhqr_tpu.utils.config import DHQRConfig, ServeConfig
+
+# Default compact-WY panel width for BATCHED dispatches (block_size=None).
+# Deliberately narrower than the single-problem auto_block_size tier: in a
+# vmapped factorization the trailing updates already aggregate B problems
+# per GEMM, so MXU/SIMD occupancy does not need wide panels — and the
+# panel interior is the batch's sequential critical path, so narrow
+# panels shorten it. Measured on the CPU vmapped ladder (round 8):
+# nb=32 beats nb=128 by 4.5x at B=16 384x128 (54 vs 245 ms), 2.7x at
+# B=16 768x256, 2.4x at B=16 512x192, and never loses at small shapes.
+# Override per call with block_size= (the TPU ladder may prefer wider).
+SERVE_DEFAULT_BLOCK = 32
+
+
+@partial(
+    jax.jit,
+    static_argnames=("block_size", "precision", "trailing_precision",
+                     "apply_precision", "refine", "norm", "panel_impl"),
+)
+def _batched_lstsq_impl(A, b, block_size, precision="highest",
+                        trailing_precision=None, apply_precision=None,
+                        refine=0, norm="accurate", panel_impl="loop"):
+    """One bucket's least-squares program: vmapped blocked factor +
+    two-stage solve, with ``refine`` in-program refinement sweeps
+    (residual matvec at full precision, reusing the factorization).
+
+    NOT donated, deliberately: the output x is (B, n) while the stacked
+    input is (B, m, n), so no output can alias the donated buffer and
+    jax would warn "donated buffers were not usable" on every lowering;
+    XLA already frees the stack after its last in-program use. The
+    factor-only dispatch (:func:`dhqr_tpu.ops.blocked._batched_qr_impl_donate`)
+    is the one whose output is input-shaped, and it does donate.
+    """
+    ap = precision if apply_precision is None else apply_precision
+
+    def one(a, rhs):
+        H, alpha = _blocked._blocked_qr_impl(
+            a, block_size, precision=precision, pallas=False, norm=norm,
+            panel_impl=panel_impl, trailing_precision=trailing_precision,
+        )
+
+        def qr_solve(r):
+            c = _blocked._apply_qt_impl(H, r, block_size, precision=ap)
+            return _solve.back_substitute(H, alpha, c)
+
+        x = qr_solve(rhs)
+        for _ in range(refine):
+            resid = rhs - jnp.matmul(a, x, precision="highest")
+            x = x + qr_solve(resid)
+        return x
+
+    return jax.vmap(one)(A, b)
+
+
+def _resolve_serve_cfg(config: Optional[DHQRConfig],
+                       overrides) -> "tuple[DHQRConfig, object]":
+    """Shared config/policy resolution for the serve entry points —
+    the same validation chain the single-request API runs
+    (models.qr_model), so a config error is reported identically whether
+    a request is served singly or batched. Returns ``(cfg, policy)``
+    with the policy's precision fields folded into the classic knobs.
+    """
+    from dhqr_tpu.models.qr_model import (_check_panel_impl,
+                                          _resolve_policy_cfg)
+
+    cfg = dataclasses.replace(config or DHQRConfig(), **overrides)
+    cfg, pol = _resolve_policy_cfg(cfg)
+    # NOTE: a policy's refine is NOT folded into cfg.refine here — the
+    # lstsq family wants it as in-program sweeps, while batched_qr arms
+    # it on the returned factorizations' solves (and must still reject
+    # an EXPLICIT refine=). Each entry point places it.
+    if cfg.engine != "householder":
+        raise ValueError(
+            f"the serving tier batches the blocked householder engine only "
+            f"(got engine={cfg.engine!r}); the tsqr/cholqr families are "
+            "single-problem fast paths"
+        )
+    if not cfg.blocked:
+        raise ValueError(
+            "the serving tier batches the blocked engine only "
+            "(got blocked=False)"
+        )
+    if cfg.use_pallas == "always":
+        raise ValueError(
+            "use_pallas='always' is not supported on the serving tier: "
+            "the fused panel kernel is a single-problem VMEM tier; "
+            "batched dispatches run the vmapped XLA path"
+        )
+    if cfg.lookahead or cfg.agg_panels:
+        raise ValueError(
+            "lookahead/agg_panels are panel-schedule levers for large "
+            "single factorizations; the serving tier's buckets are small "
+            f"(got lookahead={cfg.lookahead}, agg_panels={cfg.agg_panels})"
+        )
+    if cfg.norm not in ("accurate", "fast"):
+        raise ValueError(
+            f"norm must be 'accurate' or 'fast', got {cfg.norm!r}"
+        )
+    if cfg.refine < 0:
+        raise ValueError(f"refine must be >= 0, got {cfg.refine}")
+    _check_panel_impl(cfg)
+    return cfg, pol
+
+
+def _plan_key(kind: str, count: int, m: int, n: int, dtype,
+              cfg: DHQRConfig, scfg: ServeConfig) -> "tuple[CacheKey, Bucket]":
+    """The ONE place a request shape + config becomes a cache key —
+    shared by live dispatch and :func:`prewarm`, so a prewarmed key is
+    guaranteed to be the key serving hits."""
+    bucket = plan_bucket(m, n, dtype, scfg)
+    batch = bucket_batch(count, scfg)
+    nb = min(cfg.block_size or SERVE_DEFAULT_BLOCK, bucket.n)
+    if kind == "qr":
+        # refine/apply live in the solve stage; a factor-only program is
+        # identical across them — keep them out of the key so policy
+        # variants share one executable.
+        key = CacheKey(kind, batch, bucket.m, bucket.n, bucket.dtype, nb,
+                       cfg.precision, cfg.trailing_precision, None, 0,
+                       cfg.norm, cfg.panel_impl)
+    else:
+        key = CacheKey(kind, batch, bucket.m, bucket.n, bucket.dtype, nb,
+                       cfg.precision, cfg.trailing_precision,
+                       cfg.apply_precision, cfg.refine, cfg.norm,
+                       cfg.panel_impl)
+    return key, bucket
+
+
+def _lower_for_key(key: CacheKey):
+    """Build the Lowered program for a serve cache key (the cache owns
+    the ``.compile()``)."""
+    dtype = jnp.dtype(key.dtype)
+    A = jax.ShapeDtypeStruct((key.batch, key.m, key.n), dtype)
+    if key.kind == "qr":
+        return _blocked._batched_qr_impl_donate.lower(
+            A, key.block_size, precision=key.precision, norm=key.norm,
+            panel_impl=key.panel_impl,
+            trailing_precision=key.trailing_precision,
+        )
+    b = jax.ShapeDtypeStruct((key.batch, key.m), dtype)
+    return _batched_lstsq_impl.lower(
+        A, b, key.block_size, precision=key.precision,
+        trailing_precision=key.trailing_precision,
+        apply_precision=key.apply_precision, refine=key.refine,
+        norm=key.norm, panel_impl=key.panel_impl,
+    )
+
+
+def bucket_program(kind: str, config: Optional[DHQRConfig] = None,
+                   **overrides):
+    """The exact traced callable a serve bucket dispatch compiles, as a
+    plain function of the stacked arrays — the lint jaxpr pass traces
+    ``batched_lstsq`` through this under every policy preset
+    (analysis/jaxpr_pass), so program-representation regressions in the
+    serving tier surface without a compile."""
+    cfg, pol = _resolve_serve_cfg(config, overrides)
+    if pol is not None and pol.refine:
+        cfg = dataclasses.replace(cfg, refine=pol.refine)
+
+    def lstsq_fn(A, b):
+        nb = min(cfg.block_size or SERVE_DEFAULT_BLOCK, A.shape[2])
+        return _batched_lstsq_impl(
+            A, b, nb, precision=cfg.precision,
+            trailing_precision=cfg.trailing_precision,
+            apply_precision=cfg.apply_precision, refine=cfg.refine,
+            norm=cfg.norm, panel_impl=cfg.panel_impl,
+        )
+
+    def qr_fn(A):
+        nb = min(cfg.block_size or SERVE_DEFAULT_BLOCK, A.shape[2])
+        return _blocked._batched_qr_impl_donate(
+            A, nb, precision=cfg.precision, norm=cfg.norm,
+            panel_impl=cfg.panel_impl,
+            trailing_precision=cfg.trailing_precision,
+        )
+
+    if kind == "lstsq":
+        return lstsq_fn
+    if kind == "qr":
+        return qr_fn
+    raise ValueError(f"kind must be 'lstsq' or 'qr', got {kind!r}")
+
+
+def _validate_requests(As: Sequence, bs: "Sequence | None"):
+    if bs is not None and len(As) != len(bs):
+        raise ValueError(
+            f"got {len(As)} matrices but {len(bs)} right-hand sides"
+        )
+    for i, A in enumerate(As):
+        shape = getattr(A, "shape", None)
+        if shape is None or len(shape) != 2:
+            raise ValueError(
+                f"request {i}: expected a 2-D matrix, got shape {shape}"
+            )
+        m, n = shape
+        if m < n or n < 1:
+            raise ValueError(
+                f"request {i}: the serving tier factors tall problems "
+                f"(m >= n >= 1), got shape ({m}, {n})"
+            )
+        if bs is not None:
+            b = bs[i]
+            bshape = getattr(b, "shape", None)
+            if bshape != (m,):
+                raise ValueError(
+                    f"request {i}: b must be a length-m vector matching A "
+                    f"(A is ({m}, {n}), b has shape {bshape}); block "
+                    "right-hand sides are not batched yet — stack them as "
+                    "separate requests"
+                )
+            import numpy as np
+
+            if np.dtype(getattr(b, "dtype", None)) != np.dtype(A.dtype):
+                # The stacked buffer takes A's bucket dtype; a wider b
+                # would be downcast SILENTLY there, diverging from what
+                # lstsq(A, b) (which promotes) returns — refuse instead.
+                raise ValueError(
+                    f"request {i}: b dtype {getattr(b, 'dtype', None)} does "
+                    f"not match A dtype {A.dtype}; cast explicitly (the "
+                    "stacked dispatch runs entirely in A's dtype)"
+                )
+
+
+def _group_by_bucket(As: Sequence, scfg: ServeConfig):
+    """bucket -> list of request indices, insertion-ordered."""
+    groups: "dict[Bucket, list[int]]" = {}
+    for i, A in enumerate(As):
+        m, n = A.shape
+        bucket = plan_bucket(m, n, A.dtype, scfg)
+        groups.setdefault(bucket, []).append(i)
+    return groups
+
+
+def _dispatch_groups(kind, As, bs, cfg, scfg, cache, consume):
+    """The one group -> chunk -> key -> compile -> pad -> dispatch loop
+    shared by ``batched_lstsq`` and ``batched_qr`` (a chunking or key
+    fix must not have to land twice). ``consume(chunk, key, outs)`` is
+    called once per dispatched chunk with the request indices, the cache
+    key, and the stacked program outputs."""
+    for bucket, idxs in _group_by_bucket(As, scfg).items():
+        for lo in range(0, len(idxs), scfg.max_batch):
+            chunk = idxs[lo:lo + scfg.max_batch]
+            key, _ = _plan_key(kind, len(chunk), bucket.m, bucket.n,
+                               bucket.dtype, cfg, scfg)
+            # plan_bucket is idempotent (bucket dims are lattice points),
+            # so re-planning from the bucket's own shape returns it.
+            compiled = cache.get_or_compile(key, partial(_lower_for_key, key))
+            A_buf, b_buf = pad_group(
+                [(As[i], None if bs is None else bs[i]) for i in chunk],
+                bucket, key.batch)
+            if kind == "lstsq":
+                outs = compiled(jnp.asarray(A_buf), jnp.asarray(b_buf))
+            else:
+                outs = compiled(jnp.asarray(A_buf))
+            consume(chunk, key, outs)
+
+
+def batched_lstsq(
+    As: Sequence,
+    bs: Sequence,
+    config: Optional[DHQRConfig] = None,
+    serve_config: Optional[ServeConfig] = None,
+    cache: Optional[ExecutableCache] = None,
+    **overrides,
+) -> List[jax.Array]:
+    """Least squares for a heterogeneous batch of requests.
+
+    ``As``/``bs``: equal-length sequences of tall matrices (m_i, n_i)
+    and vectors (m_i,). Returns the per-request solutions ``x_i``
+    (n_i,), in input order — each exactly (to roundoff) what
+    ``lstsq(As[i], bs[i])`` on the same engine settings returns, but
+    computed by one vmapped dispatch per shape bucket through the AOT
+    executable cache.
+
+    ``config``/``**overrides`` are the usual :class:`DHQRConfig` knobs
+    (``policy=`` composes exactly as on ``lstsq``: trailing precision to
+    the factor, apply precision to the solve, ``refine`` sweeps
+    in-program). ``serve_config`` shapes the bucket lattice and batch
+    cap; ``cache`` overrides the process-default executable cache.
+    """
+    scfg = serve_config or ServeConfig.from_env()
+    cache = cache if cache is not None else default_cache()
+    cfg, pol = _resolve_serve_cfg(config, overrides)
+    if pol is not None and pol.refine:
+        cfg = dataclasses.replace(cfg, refine=pol.refine)
+    _validate_requests(As, bs)
+    out: "list[jax.Array | None]" = [None] * len(As)
+
+    def consume(chunk, key, xs):
+        for row, i in enumerate(chunk):
+            out[i] = xs[row, :As[i].shape[1]]
+
+    _dispatch_groups("lstsq", As, bs, cfg, scfg, cache, consume)
+    return out
+
+
+def batched_qr(
+    As: Sequence,
+    config: Optional[DHQRConfig] = None,
+    serve_config: Optional[ServeConfig] = None,
+    cache: Optional[ExecutableCache] = None,
+    **overrides,
+) -> List:
+    """Factor a heterogeneous batch: per-request ``QRFactorization``\\ s,
+    in input order, each the exact (to roundoff) packed factorization of
+    its request — computed by one donated vmapped dispatch per bucket
+    (the stacked buffer is consumed and aliased into the stacked H).
+
+    A ``policy=`` with ``refine > 0`` arms solve-side refinement on each
+    returned factorization, exactly like ``qr(A, policy=...)`` (the
+    original matrix rides along for the residual matvec).
+    """
+    from dhqr_tpu.models.qr_model import QRFactorization
+
+    scfg = serve_config or ServeConfig.from_env()
+    cache = cache if cache is not None else default_cache()
+    cfg, pol = _resolve_serve_cfg(config, overrides)
+    if cfg.refine:
+        raise ValueError(
+            "refine applies to batched_lstsq only — batched_qr returns raw "
+            "factorizations; pass a policy= with refine > 0 to arm "
+            "refinement on the factorizations' solves"
+        )
+    solve_refine = pol.refine if pol is not None else 0
+    apply_prec = cfg.apply_precision or cfg.precision
+    _validate_requests(As, None)
+    out: "list | None" = [None] * len(As)
+
+    def consume(chunk, key, outs):
+        Hs, alphas = outs
+        for row, i in enumerate(chunk):
+            m, n = As[i].shape
+            out[i] = QRFactorization(
+                Hs[row, :m, :n], alphas[row, :n],
+                block_size=key.block_size, precision=apply_prec,
+                refine=solve_refine,
+                matrix=jnp.asarray(As[i]) if solve_refine else None,
+            )
+
+    _dispatch_groups("qr", As, None, cfg, scfg, cache, consume)
+    return out
+
+
+def prewarm(
+    shapes: Sequence,
+    kind: str = "lstsq",
+    config: Optional[DHQRConfig] = None,
+    serve_config: Optional[ServeConfig] = None,
+    cache: Optional[ExecutableCache] = None,
+    **overrides,
+) -> List[CacheKey]:
+    """Compile the executables a request mix will need, ahead of traffic.
+
+    ``shapes``: iterable of raw request-shape specs ``(count, m, n)`` or
+    ``(count, m, n, dtype)`` (dtype defaults to float32). A spec's
+    ``count`` means "this many same-shape requests arriving in one
+    batched call". Shapes are bucketed by the same planner live dispatch
+    uses, and the compiled key set is the UNION of
+
+    * each spec's own arrival (chunked past ``serve_config.max_batch``
+      exactly as live dispatch chunks — the remainder chunk has its own
+      batch bucket), and
+    * for specs whose shapes share a bucket, their combined arrival
+      (live ``_group_by_bucket`` merges same-bucket requests from one
+      call, which plans a larger batch key than either spec alone),
+
+    so a mix served as declared — specs separately or together — hits
+    only prewarmed keys. Returns the (deduplicated) keys in compile
+    order; stats land on the cache's counters like any other compile.
+    """
+    scfg = serve_config or ServeConfig.from_env()
+    cache = cache if cache is not None else default_cache()
+    cfg, pol = _resolve_serve_cfg(config, overrides)
+    if kind == "lstsq" and pol is not None and pol.refine:
+        # Same fold batched_lstsq performs — prewarmed keys must be the
+        # keys live dispatch hits, policy presets included.
+        cfg = dataclasses.replace(cfg, refine=pol.refine)
+    per_arrival: "list[tuple[Bucket, int]]" = []
+    merged: "dict[Bucket, int]" = {}
+    for spec in shapes:
+        spec = tuple(spec)
+        if len(spec) == 3:
+            count, m, n = spec
+            dtype = "float32"
+        elif len(spec) == 4:
+            count, m, n, dtype = spec
+        else:
+            raise ValueError(
+                f"prewarm spec must be (count, m, n[, dtype]), got {spec!r}"
+            )
+        bucket = plan_bucket(int(m), int(n), dtype, scfg)
+        per_arrival.append((bucket, int(count)))
+        merged[bucket] = merged.get(bucket, 0) + int(count)
+    keys: "list[CacheKey]" = []
+    for bucket, count in per_arrival + list(merged.items()):
+        for lo in range(0, count, scfg.max_batch):
+            chunk_count = min(scfg.max_batch, count - lo)
+            key, _ = _plan_key(kind, chunk_count, bucket.m, bucket.n,
+                               bucket.dtype, cfg, scfg)
+            if key not in keys:
+                keys.append(key)
+                cache.get_or_compile(key, partial(_lower_for_key, key))
+    return keys

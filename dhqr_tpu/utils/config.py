@@ -9,6 +9,7 @@ overridable from the environment, passed to the API entry points.
 from __future__ import annotations
 
 import dataclasses
+import math
 import os
 
 
@@ -188,3 +189,65 @@ class DHQRConfig:
             env["policy"] = raw or None
         env.update(overrides)
         return DHQRConfig(**env)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Knobs for the batched serving tier (``dhqr_tpu.serve``).
+
+    These shape the *bucket lattice* and the AOT executable cache, not the
+    factorization numerics (those stay on :class:`DHQRConfig`). All are
+    overridable from ``DHQR_SERVE_*`` environment variables.
+
+    Attributes:
+      ratio: geometric growth factor of the bucket lattice (> 1). Each
+        request dimension is rounded UP onto the lattice
+        ``min_dim, ~min_dim*ratio, ~min_dim*ratio^2, ...`` (every point
+        snapped to the TPU-friendly alignment — see
+        ``serve.buckets.bucket_dim``), so the number of distinct compiled
+        programs grows logarithmically with the shape range while padded
+        flops overshoot by at most ~ratio per dimension. The default
+        ``sqrt(2)`` yields the half-octave ladder (every power of two
+        and its 3/2 midpoint: 64, 96, 128, 192, 256, ...), on which the
+        common MXU-friendly request sizes land exactly.
+      min_dim: smallest lattice dimension (>= 8). Requests below it share
+        the smallest bucket.
+      max_batch: largest stacked batch per dispatch; bigger request groups
+        are chunked. Batch sizes are bucketed to powers of two up to this
+        cap so the batch axis, like the shape axes, draws from a small
+        static lattice.
+      cache_size: LRU bound on resident compiled executables
+        (``serve.cache.ExecutableCache``). Eviction only drops the
+        in-process handle; a persistent jax compilation cache, when
+        enabled, still makes the recompile cheap.
+    """
+
+    ratio: float = math.sqrt(2.0)
+    min_dim: int = 16
+    max_batch: int = 256
+    cache_size: int = 64
+
+    def __post_init__(self):
+        if not self.ratio > 1.0:
+            raise ValueError(f"ratio must be > 1, got {self.ratio}")
+        if self.min_dim < 8:
+            raise ValueError(f"min_dim must be >= 8, got {self.min_dim}")
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
+        if self.cache_size < 1:
+            raise ValueError(f"cache_size must be >= 1, got {self.cache_size}")
+
+    @staticmethod
+    def from_env(**overrides) -> "ServeConfig":
+        """Build a serve config from ``DHQR_SERVE_*`` variables + overrides."""
+        env = {}
+        if "DHQR_SERVE_RATIO" in os.environ:
+            env["ratio"] = float(os.environ["DHQR_SERVE_RATIO"])
+        if "DHQR_SERVE_MIN_DIM" in os.environ:
+            env["min_dim"] = int(os.environ["DHQR_SERVE_MIN_DIM"])
+        if "DHQR_SERVE_MAX_BATCH" in os.environ:
+            env["max_batch"] = int(os.environ["DHQR_SERVE_MAX_BATCH"])
+        if "DHQR_SERVE_CACHE_SIZE" in os.environ:
+            env["cache_size"] = int(os.environ["DHQR_SERVE_CACHE_SIZE"])
+        env.update(overrides)
+        return ServeConfig(**env)

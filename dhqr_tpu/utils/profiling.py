@@ -103,6 +103,32 @@ class PhaseTimer:
         self._records.clear()
 
 
+class Counters:
+    """Monotonic named counters (int or float increments).
+
+    The serving tier's cache accounting rides here (hits / misses /
+    evictions / compile seconds — see ``dhqr_tpu.serve.cache``): one
+    shared spelling so benchmarks and the dry run read the same numbers
+    the engine maintains, instead of each keeping private tallies.
+    """
+
+    def __init__(self) -> None:
+        self._counts: Dict[str, float] = {}
+
+    def bump(self, name: str, value: float = 1) -> None:
+        self._counts[name] = self._counts.get(name, 0) + value
+
+    def get(self, name: str) -> float:
+        return self._counts.get(name, 0)
+
+    def snapshot(self) -> Dict[str, float]:
+        """A point-in-time copy — subtract two snapshots for a delta."""
+        return dict(self._counts)
+
+    def reset(self) -> None:
+        self._counts.clear()
+
+
 @contextlib.contextmanager
 def trace(log_dir: str) -> Iterator[None]:
     """Write a profiler trace for the region — the ``@profilehtml`` analogue.

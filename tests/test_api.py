@@ -87,6 +87,25 @@ def test_donate_unblocked_rejected():
         qr(jnp.ones((4, 3)), blocked=False, donate=True)
 
 
+def test_public_qr_donate_consumes_buffer_end_to_end():
+    """The donation coverage gap (round 8): tests pinned the ops-level
+    donating jit, but nothing pinned that the PUBLIC ``qr(A, donate=True)``
+    actually reaches it — a wrapper regression (e.g. a defensive copy or
+    a non-donating impl pick) would silently restore copy semantics while
+    every numeric assertion kept passing. On CPU the donated buffer is
+    aliased into H, so pointer equality is the end-to-end proof."""
+    A = jnp.asarray(np.random.default_rng(71).standard_normal((48, 32)),
+                    jnp.float32)
+    fact_ref = qr(jnp.array(A), block_size=16)  # fresh copy, undonated
+    ptr = A.unsafe_buffer_pointer()
+    fact = qr(A, donate=True, block_size=16)
+    assert fact.H.unsafe_buffer_pointer() == ptr, "donated input not aliased"
+    assert A.is_deleted(), "qr(donate=True) left the input buffer alive"
+    np.testing.assert_array_equal(np.asarray(fact.H), np.asarray(fact_ref.H))
+    np.testing.assert_array_equal(np.asarray(fact.alpha),
+                                  np.asarray(fact_ref.alpha))
+
+
 def test_version_and_exports():
     assert dhqr_tpu.__version__
     for name in dhqr_tpu.__all__:

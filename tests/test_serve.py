@@ -1,0 +1,386 @@
+"""Serving tier (dhqr_tpu/serve): bucket lattice math, AOT executable
+cache accounting, exact padding, out-of-order scatter, donation, and the
+policy/refine composition through the batched dispatch path.
+
+Every engine test here uses a PRIVATE ExecutableCache so counter
+assertions cannot race other modules through the process-default cache.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dhqr_tpu.serve import (
+    batched_lstsq,
+    batched_qr,
+    bucket_batch,
+    bucket_dim,
+    plan_bucket,
+    prewarm,
+)
+from dhqr_tpu.serve.buckets import _align_for, pad_group
+from dhqr_tpu.serve.cache import ExecutableCache
+from dhqr_tpu.utils.config import ServeConfig
+from dhqr_tpu.utils.testing import (
+    TOLERANCE_FACTOR,
+    normal_equations_residual,
+    oracle_residual,
+)
+
+SCFG = ServeConfig(min_dim=16, ratio=1.5, max_batch=64, cache_size=8)
+
+
+@pytest.fixture()
+def cache():
+    return ExecutableCache(max_size=32)
+
+
+# ---------------------------------------------------------------- buckets
+
+
+def test_bucket_dim_lattice_properties():
+    """Round-up, alignment, idempotence, monotonicity — the four facts
+    the cache-key bound rests on (idempotence is what makes re-planning
+    from a bucket's own shape return the same bucket)."""
+    prev = 0
+    for x in range(1, 900, 7):
+        v = bucket_dim(x, SCFG)
+        assert v >= x
+        assert v % _align_for(v) == 0
+        assert bucket_dim(v, SCFG) == v
+        assert v >= prev
+        prev = v
+
+
+def test_bucket_lattice_is_small():
+    """The point of the lattice: the whole serveable range up to 4096
+    collapses onto a handful of distinct dims (log, not linear)."""
+    dims = {bucket_dim(x, SCFG) for x in range(1, 4097)}
+    assert len(dims) <= 24, sorted(dims)
+
+
+def test_plan_bucket_headroom_and_validation():
+    for m, n in [(16, 16), (40, 12), (100, 33), (700, 600), (8, 1)]:
+        b = plan_bucket(m, n, np.float32, SCFG)
+        assert b.n >= n
+        # Exact-embedding headroom: identity block always fits.
+        assert b.m >= m + (b.n - n)
+        assert b.dtype == "float32"
+    assert plan_bucket(40, 12, np.float64, SCFG).dtype == "float64"
+    with pytest.raises(ValueError, match="tall"):
+        plan_bucket(8, 16, np.float32, SCFG)
+
+
+def test_bucket_batch_powers_of_two_capped():
+    assert [bucket_batch(c, SCFG) for c in (1, 2, 3, 5, 33, 64, 900)] == \
+        [1, 2, 4, 8, 64, 64, 64]
+    # A non-power-of-two cap still bounds the stacked buffer: 33 rounds
+    # to 64 by the pow2 rule but must dispatch at the 48 cap.
+    odd = ServeConfig(min_dim=16, max_batch=48, cache_size=8)
+    assert bucket_batch(33, odd) == 48
+    assert bucket_batch(16, odd) == 16
+
+
+def test_pad_group_exact_embedding_float64():
+    """The bucket embedding [[A,0],[0,I],[0,0]] must reproduce the
+    UNpadded least-squares solution exactly (x[:n] matches, x[n:] = 0) —
+    f64 so the comparison is at roundoff, not engine tolerance."""
+    rng = np.random.default_rng(3)
+    m, n = 37, 21
+    A = rng.standard_normal((m, n))
+    b = rng.standard_normal(m)
+    bucket = plan_bucket(m, n, np.float64, SCFG)
+    A_buf, b_buf = pad_group([(A, b)], bucket, 2)
+    x_pad = np.linalg.lstsq(A_buf[0], b_buf[0], rcond=None)[0]
+    x_ref = np.linalg.lstsq(A, b, rcond=None)[0]
+    np.testing.assert_allclose(x_pad[:n], x_ref, atol=1e-12)
+    np.testing.assert_allclose(x_pad[n:], 0.0, atol=1e-12)
+    # Filler row (beyond the request count) is the identity embedding —
+    # full column rank, so the batched back-substitution stays finite.
+    assert np.linalg.matrix_rank(A_buf[1]) == bucket.n
+
+
+# ------------------------------------------------------------------ cache
+
+
+def test_cache_hit_miss_lru_accounting():
+    c = ExecutableCache(max_size=3)
+    f = jax.jit(lambda x, k: x + k, static_argnums=(1,))
+    arg = jnp.zeros((4,))
+
+    def lower(k):
+        return lambda: f.lower(arg, k)
+
+    for k in range(3):
+        c.get_or_compile(("k", k), lower(k))
+    assert c.stats()["misses"] == 3 and len(c) == 3
+    c.get_or_compile(("k", 0), lower(0))          # hit, refreshes LRU rank
+    assert c.stats()["hits"] == 1
+    c.get_or_compile(("k", 3), lower(3))          # evicts ("k", 1) — LRU
+    s = c.stats()
+    assert s["evictions"] == 1 and s["size"] == 3
+    assert ("k", 1) not in c and ("k", 0) in c
+    c.get_or_compile(("k", 1), lower(1))          # re-miss after eviction
+    assert c.stats()["misses"] == 5
+    assert c.stats()["compile_seconds"] > 0
+    c.clear()
+    assert len(c) == 0 and c.stats()["misses"] == 5  # counters are lifetime
+
+
+def test_cache_failed_compile_not_inserted():
+    c = ExecutableCache(max_size=4)
+
+    def boom():
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError):
+        c.get_or_compile(("bad",), boom)
+    assert ("bad",) not in c and c.stats()["misses"] == 1
+
+
+# ----------------------------------------------------------------- engine
+
+
+def _mixed_requests(seed=11):
+    """Mixed shapes, duplicates included, deliberately NOT sorted by
+    size — the scatter must restore input order."""
+    rng = np.random.default_rng(seed)
+    shapes = [(64, 33), (19, 19), (40, 12), (40, 12), (50, 8), (33, 20),
+              (40, 12), (72, 40)]
+    As = [jnp.asarray(rng.random(s), jnp.float32) for s in shapes]
+    bs = [jnp.asarray(rng.random(s[0]), jnp.float32) for s in shapes]
+    return As, bs
+
+
+def test_batched_lstsq_out_of_order_scatter(cache):
+    As, bs = _mixed_requests()
+    xs = batched_lstsq(As, bs, block_size=8, serve_config=SCFG, cache=cache)
+    assert len(xs) == len(As)
+    for i, (A, b, x) in enumerate(zip(As, bs, xs)):
+        assert x.shape == (A.shape[1],)
+        res = normal_equations_residual(A, np.asarray(x), b)
+        ref = oracle_residual(np.asarray(A), np.asarray(b))
+        assert res < TOLERANCE_FACTOR * ref, (i, A.shape, res, ref)
+    # Far fewer programs than requests: that is the tier's reason to be.
+    assert cache.stats()["misses"] < len(As)
+
+
+def test_batched_lstsq_second_pass_zero_recompiles(cache):
+    As, bs = _mixed_requests()
+    batched_lstsq(As, bs, block_size=8, serve_config=SCFG, cache=cache)
+    misses = cache.stats()["misses"]
+    xs = batched_lstsq(As, bs, block_size=8, serve_config=SCFG, cache=cache)
+    s = cache.stats()
+    assert s["misses"] == misses, "repeated stream recompiled"
+    assert s["hits"] >= misses
+    assert all(x.shape == (A.shape[1],) for A, x in zip(As, xs))
+
+
+def test_batched_lstsq_mixed_dtypes_bucket_separately(cache):
+    rng = np.random.default_rng(7)
+    A32 = jnp.asarray(rng.random((24, 10)), jnp.float32)
+    A64 = jnp.asarray(rng.random((24, 10)), jnp.float64)
+    b = rng.random(24)
+    xs = batched_lstsq([A32, A64], [jnp.asarray(b, jnp.float32),
+                                    jnp.asarray(b, jnp.float64)],
+                       block_size=8, serve_config=SCFG, cache=cache)
+    assert xs[0].dtype == jnp.float32 and xs[1].dtype == jnp.float64
+    assert cache.stats()["misses"] == 2  # one program per dtype bucket
+    x_ref = np.linalg.lstsq(np.asarray(A64), b, rcond=None)[0]
+    np.testing.assert_allclose(np.asarray(xs[1]), x_ref, atol=1e-10)
+
+
+def test_batched_lstsq_policy_and_refine(cache):
+    As, bs = _mixed_requests(seed=23)
+    xs = batched_lstsq(As, bs, block_size=8, policy="fast",
+                       serve_config=SCFG, cache=cache)
+    for A, b, x in zip(As, bs, xs):
+        res = normal_equations_residual(A, np.asarray(x), b)
+        ref = oracle_residual(np.asarray(A), np.asarray(b))
+        assert res < TOLERANCE_FACTOR * ref
+    # An explicit refine count is a DIFFERENT program family (refine is
+    # in the cache key) and must also serve.
+    misses = cache.stats()["misses"]
+    batched_lstsq(As[:2], bs[:2], block_size=8, refine=1,
+                  serve_config=SCFG, cache=cache)
+    assert cache.stats()["misses"] > misses
+    # Naming both spellings is ambiguous — same refusal as lstsq().
+    with pytest.raises(ValueError, match="policy"):
+        batched_lstsq(As[:1], bs[:1], policy="fast", refine=1,
+                      serve_config=SCFG, cache=cache)
+
+
+def test_batched_qr_matches_single_engine(cache):
+    from dhqr_tpu.ops.blocked import blocked_householder_qr
+
+    As, _ = _mixed_requests(seed=31)
+    facts = batched_qr(As, block_size=8, serve_config=SCFG, cache=cache)
+    for A, f in zip(As, facts):
+        H0, a0 = blocked_householder_qr(A, 8)
+        np.testing.assert_allclose(np.asarray(f.H), np.asarray(H0),
+                                   atol=3e-5)
+        np.testing.assert_allclose(np.asarray(f.alpha), np.asarray(a0),
+                                   atol=3e-5)
+
+
+def test_batched_qr_policy_arms_refining_solves(cache):
+    As, bs = _mixed_requests(seed=47)
+    facts = batched_qr(As, block_size=8, policy="balanced",
+                       serve_config=SCFG, cache=cache)
+    for A, b, f in zip(As, bs, facts):
+        assert f.refine == 1 and f.matrix is not None
+        x = f.solve(b)
+        res = normal_equations_residual(A, np.asarray(x), b)
+        ref = oracle_residual(np.asarray(A), np.asarray(b))
+        assert res < TOLERANCE_FACTOR * ref
+    with pytest.raises(ValueError, match="batched_lstsq only"):
+        batched_qr(As[:1], refine=1, serve_config=SCFG, cache=cache)
+
+
+def test_batched_dispatch_donation_aliases_stack():
+    """The satellite donation pin: the serve tier's factor dispatch
+    really consumes its stacked input — on CPU the output H occupies the
+    SAME buffer (unsafe_buffer_pointer equality), and the donated array
+    is invalidated. A silent regression to copy semantics would double
+    the tier's peak memory while returning identical numbers."""
+    from dhqr_tpu.ops.blocked import _batched_qr_impl_donate
+
+    A = jnp.asarray(np.random.default_rng(5).standard_normal((4, 32, 16)),
+                    jnp.float32)
+    ptr = A.unsafe_buffer_pointer()
+    H, alpha = _batched_qr_impl_donate(A, 8)
+    assert H.shape == (4, 32, 16) and alpha.shape == (4, 16)
+    assert H.unsafe_buffer_pointer() == ptr, "donated stack not aliased"
+    assert A.is_deleted(), "donated stack still alive"
+
+
+def test_prewarm_compiles_what_serving_runs(cache):
+    """The one-code-path invariant: keys minted by prewarm are the keys
+    live dispatch hits (shared _plan_key), so a prewarmed mix serves its
+    first pass with zero compiles."""
+    keys = prewarm([(5, 40, 20), (5, 40, 20), (2, 19, 19)], block_size=8,
+                   serve_config=SCFG, cache=cache)
+    assert len(keys) == len(set(keys))
+    misses = cache.stats()["misses"]
+    assert misses == len(keys)
+    rng = np.random.default_rng(9)
+    As = [jnp.asarray(rng.random((40, 20)), jnp.float32) for _ in range(5)]
+    bs = [jnp.asarray(rng.random(40), jnp.float32) for _ in range(5)]
+    batched_lstsq(As, bs, block_size=8, serve_config=SCFG, cache=cache)
+    s = cache.stats()
+    assert s["misses"] == misses and s["hits"] >= 1
+
+
+def test_prewarm_covers_merged_same_bucket_arrival(cache):
+    """Distinct shapes sharing a bucket: live dispatch merges them into
+    ONE group whose batch bucket exceeds either spec's own — prewarm
+    must mint that merged key too, or the first joint arrival compiles
+    during traffic (code-review r8)."""
+    assert plan_bucket(40, 20, np.float32, SCFG) == \
+        plan_bucket(38, 18, np.float32, SCFG)
+    prewarm([(5, 40, 20), (5, 38, 18)], block_size=8, serve_config=SCFG,
+            cache=cache)
+    misses = cache.stats()["misses"]
+    rng = np.random.default_rng(17)
+    As = [jnp.asarray(rng.random((40, 20)), jnp.float32) for _ in range(5)] \
+        + [jnp.asarray(rng.random((38, 18)), jnp.float32) for _ in range(5)]
+    bs = [jnp.asarray(rng.random(A.shape[0]), jnp.float32) for A in As]
+    batched_lstsq(As, bs, block_size=8, serve_config=SCFG, cache=cache)
+    assert cache.stats()["misses"] == misses, "joint arrival recompiled"
+    # ... and each spec served alone hits its per-arrival key.
+    batched_lstsq(As[:5], bs[:5], block_size=8, serve_config=SCFG,
+                  cache=cache)
+    assert cache.stats()["misses"] == misses
+
+
+def test_cache_thread_safety_hit_evict_race():
+    """Concurrent hits + evicting misses on one cache: the serving tier
+    is driven from request threads, and an unlocked hit/evict
+    interleaving KeyErrors a request that should have been a hit."""
+    import threading
+
+    c = ExecutableCache(max_size=2)
+    f = jax.jit(lambda x, k: x * k, static_argnums=(1,))
+    arg = jnp.zeros((4,))
+    errs = []
+
+    def worker(base):
+        try:
+            for k in range(base, base + 40):
+                c.get_or_compile(("t", k % 5), lambda: f.lower(arg, k % 5))
+        except Exception as e:  # pragma: no cover - the failure under test
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    s = c.stats()
+    assert s["size"] <= 2 and s["hits"] + s["misses"] == 160
+
+
+def test_serve_rejections(cache):
+    rng = np.random.default_rng(1)
+    A = jnp.asarray(rng.random((24, 10)), jnp.float32)
+    b = jnp.asarray(rng.random(24), jnp.float32)
+    for kwargs, match in [
+        (dict(engine="tsqr"), "householder"),
+        (dict(blocked=False), "blocked"),
+        (dict(use_pallas="always"), "VMEM"),
+        (dict(lookahead=True), "panel-schedule"),
+        (dict(agg_panels=2), "panel-schedule"),
+    ]:
+        with pytest.raises(ValueError, match=match):
+            batched_lstsq([A], [b], serve_config=SCFG, cache=cache, **kwargs)
+    with pytest.raises(ValueError, match="length-m"):
+        batched_lstsq([A], [b[:-1]], serve_config=SCFG, cache=cache)
+    with pytest.raises(ValueError, match="dtype"):
+        # A wider b would be silently downcast into the f32 stack.
+        batched_lstsq([A], [b.astype(jnp.float64)],
+                      serve_config=SCFG, cache=cache)
+    with pytest.raises(ValueError, match="tall"):
+        batched_lstsq([A.T], [jnp.zeros((10,), jnp.float32)],
+                      serve_config=SCFG, cache=cache)
+    with pytest.raises(ValueError, match="right-hand sides"):
+        batched_lstsq([A], [b, b], serve_config=SCFG, cache=cache)
+
+
+def test_serve_config_from_env(monkeypatch):
+    monkeypatch.setenv("DHQR_SERVE_RATIO", "2.0")
+    monkeypatch.setenv("DHQR_SERVE_MIN_DIM", "32")
+    monkeypatch.setenv("DHQR_SERVE_MAX_BATCH", "16")
+    monkeypatch.setenv("DHQR_SERVE_CACHE_SIZE", "4")
+    cfg = ServeConfig.from_env(max_batch=8)  # explicit override wins
+    assert (cfg.ratio, cfg.min_dim, cfg.max_batch, cfg.cache_size) == \
+        (2.0, 32, 8, 4)
+    with pytest.raises(ValueError, match="ratio"):
+        ServeConfig(ratio=1.0)
+
+
+def test_max_batch_chunks_large_groups(cache):
+    """A burst past max_batch is chunked; results stay in input order."""
+    scfg = ServeConfig(min_dim=16, ratio=1.5, max_batch=4, cache_size=8)
+    rng = np.random.default_rng(13)
+    As = [jnp.asarray(rng.random((24, 10)), jnp.float32) for _ in range(7)]
+    bs = [jnp.asarray(rng.random(24), jnp.float32) for _ in range(7)]
+    xs = batched_lstsq(As, bs, block_size=8, serve_config=scfg, cache=cache)
+    for A, b, x in zip(As, bs, xs):
+        x_ref = np.linalg.lstsq(np.asarray(A), np.asarray(b), rcond=None)[0]
+        np.testing.assert_allclose(np.asarray(x), x_ref, atol=5e-4)
+    # 7 requests at max_batch=4 -> chunks of 4 and 3 -> batch buckets 4
+    # and 4 (next pow2 of 3) -> ONE executable serves both chunks.
+    assert cache.stats()["misses"] == 1
+    # prewarm must chunk past-the-cap counts exactly like live dispatch:
+    # (6, ...) at max_batch=4 -> chunks 4 and 2 -> TWO keys, and the
+    # live pass over 6 such requests then compiles nothing.
+    keys = prewarm([(6, 24, 10)], block_size=8, serve_config=scfg,
+                   cache=cache)
+    assert sorted(k.batch for k in keys) == [2, 4]
+    misses = cache.stats()["misses"]
+    batched_lstsq(As[:6], bs[:6], block_size=8, serve_config=scfg,
+                  cache=cache)
+    assert cache.stats()["misses"] == misses
