@@ -544,7 +544,7 @@ def _supervise() -> int:
 
 
 def _qr_stage_name(n_, pallas=False, nb=None, panel="loop", flat=None,
-                   lookahead=False, agg=None, tprec=None):
+                   lookahead=False, agg=None, tprec=None, plan_auto=False):
     """The one stage-name builder: the measuring stages' ::stage markers,
     banked-row keys, AND the prewarm child's markers all come from here,
     so a failure in either child names the exact program config."""
@@ -554,7 +554,40 @@ def _qr_stage_name(n_, pallas=False, nb=None, panel="loop", flat=None,
         (f"_{panel.replace(':', '-')}" if panel != "loop" else "") + \
         ("_lookahead" if lookahead else "") + \
         (f"_agg{agg}" if agg else "") + \
-        (f"_t{tprec}" if tprec else "")
+        (f"_t{tprec}" if tprec else "") + \
+        ("_planauto" if plan_auto else "")
+
+
+def _resolve_stage_plan(n_):
+    """plan="auto" stage resolution: LOOKUP-ONLY against the plan
+    database (committed seeds + any local tuning) — ``on_miss="default"``
+    because a surprise candidate grid search inside an armed hardware
+    window is exactly the unbudgeted compile burst the watchdog/relay
+    machinery exists to prevent. Deterministic (pure file read), so the
+    measuring child and the prewarm child resolve identical knobs and
+    the prewarm guarantee holds for tuned stages too. Returns a
+    :class:`dhqr_tpu.tune.Plan` or None (stay on the stage's static
+    knobs)."""
+    try:
+        from dhqr_tpu.tune import resolve_plan
+
+        return resolve_plan("qr", n_, n_, "float32", on_miss="default")
+    except Exception as e:  # a broken DB must cost the datum, not the run
+        print(f"::plan_resolve_failed qr_{n_} {type(e).__name__}: {e}",
+              file=sys.stderr, flush=True)
+        return None
+
+
+def _apply_stage_plan(plan, nb, panel, lookahead, agg, tprec):
+    """Overlay a resolved plan's knobs on a stage's static ones (the
+    stage keeps its own value wherever the plan holds the default —
+    including panel_impl, whose default is "loop", not falsy)."""
+    if plan is None:
+        return nb, panel, lookahead, agg, tprec
+    return (plan.block_size or nb,
+            plan.panel_impl if plan.panel_impl != "loop" else panel,
+            plan.lookahead or lookahead, plan.agg_panels or agg,
+            plan.trailing_precision or tprec)
 
 
 def _chained_qr(blocked_qr_impl, lax, nb, kwargs, chain):
@@ -648,6 +681,13 @@ _TPU_STAGES = [
     dict(n=N, watchdog=420, chain=25, nb=256, panel="reconstruct"),
     dict(n=3 * N, watchdog=460, chain=3, nb=512, repeats=2,
          panel="reconstruct"),
+    # Plan-autotuner stage (round 9): the knobs come from the plan
+    # database (committed seeds + any local tuning; lookup-only — see
+    # _resolve_stage_plan), and the emitted row stamps the chosen plan.
+    # Usually dedupes against an earlier static stage's programs via the
+    # persistent cache (the seeds ARE the measured optima), so its
+    # marginal window cost is one warm compile.
+    dict(n=N, pallas=True, watchdog=300, chain=25, plan="auto"),
 ]
 
 
@@ -718,12 +758,23 @@ def _prewarm() -> None:
     done, last_pair, last_n = [], 30.0, 512
     for st in _TPU_STAGES:
         n_ = st["n"]
-        nb = st.get("nb") or BLOCK
+        st_nb, st_panel = st.get("nb"), st.get("panel", "loop")
+        st_la, st_agg, st_tp = (st.get("lookahead"), st.get("agg"),
+                                st.get("tprec"))
+        if st.get("plan") == "auto":
+            # Same deterministic lookup-only resolution the measuring
+            # child performs — prewarm must compile the PROGRAM the
+            # tuned stage will run, or the prewarm guarantee is void
+            # for exactly the stage the autotuner added.
+            st_nb, st_panel, st_la, st_agg, st_tp = _apply_stage_plan(
+                _resolve_stage_plan(n_), st_nb, st_panel, st_la, st_agg,
+                st_tp)
+        nb = st_nb or BLOCK
         chain = st.get("chain", 0)
         name = "prewarm_" + _qr_stage_name(
-            n_, st.get("pallas", False), st.get("nb"),
-            st.get("panel", "loop"), st.get("flat"), st.get("lookahead"),
-            st.get("agg"), st.get("tprec"))
+            n_, st.get("pallas", False), st_nb,
+            st_panel, st.get("flat"), st_la,
+            st_agg, st_tp, plan_auto=st.get("plan") == "auto")
         remaining = budget - (time.time() - t0)
         # Size-aware worst-case estimate, not a flat 2x: compile time
         # scales ~linearly with n (round-5 measured 13/26/57 s at
@@ -739,10 +790,9 @@ def _prewarm() -> None:
                   file=sys.stderr, flush=True)
             break
         _stage(name)
-        extra = _stage_extra(st.get("flat"), st.get("lookahead"),
-                             st.get("agg"), st.get("tprec"))
+        extra = _stage_extra(st.get("flat"), st_la, st_agg, st_tp)
         kwargs = dict(precision=PRECISION, pallas=st.get("pallas", False),
-                      norm=NORM, panel_impl=st.get("panel", "loop"), **extra)
+                      norm=NORM, panel_impl=st_panel, **extra)
         try:
             t1 = time.perf_counter()
             A = jnp.zeros((n_, n_), dtype=jnp.float32)
@@ -932,7 +982,7 @@ def main() -> None:
     def qr_bench(n_, pallas=False, watchdog=120, repeats=REPEATS,
                  backward_error=False, chain=0, nb=None, panel="loop",
                  flat=None, lookahead=False, agg=None, tprec=None,
-                 solve_errors=False):
+                 solve_errors=False, plan=None):
         """Measure blocked QR at n_ x n_ and print a COMPLETE headline JSON
         line for it — later (larger) stages supersede it; the supervisor
         keeps the last parseable line (so a wedge mid-escalation still
@@ -941,9 +991,16 @@ def main() -> None:
         (see module docstring); 0 = single-dispatch timing (CPU fallback).
         ``flat`` overrides the Pallas flat-panel width — flat < nb factors
         each panel as flat-wide kernel calls + compact-WY applies (the
-        split-panel configuration, VERDICT r3 #2)."""
+        split-panel configuration, VERDICT r3 #2). ``plan="auto"``
+        overlays the plan database's tuned knobs for this size
+        (lookup-only, see :func:`_resolve_stage_plan`) and stamps the
+        chosen plan into the emitted row."""
+        stage_plan = _resolve_stage_plan(n_) if plan == "auto" else None
+        if plan == "auto":
+            nb, panel, lookahead, agg, tprec = _apply_stage_plan(
+                stage_plan, nb, panel, lookahead, agg, tprec)
         name = _qr_stage_name(n_, pallas, nb, panel, flat, lookahead, agg,
-                              tprec)
+                              tprec, plan_auto=plan == "auto")
         _stage(name)
         # Banked rows are platform=tpu: only the TPU child may skip on
         # them — the CPU fallback must keep measuring (its honesty
@@ -970,7 +1027,9 @@ def main() -> None:
             return _qr_bench_guarded(name, n_, pallas, watchdog, repeats,
                                      backward_error, chain, nb or BLOCK,
                                      panel, flat, lookahead, agg, tprec,
-                                     solve_errors)
+                                     solve_errors,
+                                     plan_auto=plan == "auto",
+                                     stage_plan=stage_plan)
         except Exception as e:  # a failed stage must not kill later stages
             print(f"::stage_failed {name} {type(e).__name__}: {e}",
                   file=sys.stderr, flush=True)
@@ -978,7 +1037,8 @@ def main() -> None:
 
     def _qr_bench_guarded(name, n_, pallas, watchdog, repeats, backward_error,
                           chain, nb, panel, flat=None, lookahead=False,
-                          agg=None, tprec=None, solve_errors=False):
+                          agg=None, tprec=None, solve_errors=False,
+                          plan_auto=False, stage_plan=None):
         from jax import lax
 
         extra = _stage_extra(flat, lookahead, agg, tprec)
@@ -1050,6 +1110,14 @@ def main() -> None:
                 "pallas_panels": pallas,
                 "panel_impl": panel,
             }
+            if plan_auto:
+                # Stamp the resolved plan so the JSONL row records WHY
+                # these knobs ran — a tuned row is only analyzable if it
+                # names its provenance (DB hit vs. static fallback).
+                result["plan"] = (stage_plan.to_dict()
+                                  if stage_plan is not None else None)
+                result["plan_source"] = ("db" if stage_plan is not None
+                                         else "static_default")
             if flat is not None:
                 result["pallas_flat"] = flat
             if lookahead:

@@ -1,0 +1,188 @@
+"""Plan-autotuner A/B grid: static defaults vs. tuned plans, any backend.
+
+The round-9 decision artifact (benchmarks/README "Round-9 decision
+rules"): for every shape in a grid spanning the regimes the engine
+family was built for — square, tall-skinny (m/n >= 32), and small-n —
+run the full ``dhqr_tpu.tune`` search and emit one JSONL row with the
+static-default time, the tuned-plan time, the measured speedup, the
+winning plan, and the verified residual ratio (every timed candidate
+already had to pass the 8x LAPACK normal-equations criterion inside the
+search, so a row in this file IS an accuracy-qualified measurement).
+
+After the grid, two warm-path proofs:
+
+* a repeat pass through the PUBLIC ``lstsq(plan="auto")`` for every
+  grid shape, pinned to zero recompiles (the DB resolves to programs
+  the tune already compiled);
+* a serve prewarm (``plan="auto"``) + live ``batched_lstsq`` dispatch +
+  repeat, pinned to zero cache misses after prewarm.
+
+Ends with a ``plan_autotune_verdict`` row: geometric-mean speedup over
+the grid (the >= 1.3x acceptance bar), whether at least one tall-skinny
+shape routed off the householder family, and the zero-recompile flags.
+
+Usage:  python benchmarks/plan_autotune.py
+Writes: benchmarks/results/plan_autotune_<platform>.jsonl (append)
+        and the tuned plan DB at DHQR_TUNE_DB (or its default path).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import signal
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# Grid: (label, m, n). Small-n and tall-skinny rows are where shape-
+# sensitivity lives; the square rows keep the tuner honest at the sizes
+# the static defaults were chosen for.
+SHAPES = [
+    ("square", 512, 512),
+    ("square", 1024, 1024),
+    ("mid", 1024, 256),
+    ("small_n", 256, 16),
+    ("small_n", 512, 32),
+    ("tall_skinny", 2048, 64),
+    ("tall_skinny", 4096, 64),
+    ("tall_skinny", 8192, 128),
+]
+
+
+def _stage(name: str) -> None:
+    print(f"::stage {name} t={time.time():.1f}", file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(3))
+    rnd = int(os.environ.get("DHQR_ROUND", "9"))
+    _stage("import")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(_REPO, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+
+    from bench import _Watchdog
+
+    from dhqr_tpu.models.qr_model import _lstsq_impl, lstsq
+    from dhqr_tpu.ops.cholqr import _cholqr_lstsq_impl
+    from dhqr_tpu.ops.tsqr import _tsqr_lstsq_impl
+    from dhqr_tpu.tune import default_db, tune
+    from dhqr_tpu.tune.search import _problem
+    from dhqr_tpu.utils.profiling import sync
+
+    _stage("backend_init")
+    with _Watchdog("backend_init", 240):
+        dev = jax.devices()[0]
+        platform = dev.platform
+        kind = getattr(dev, "device_kind", "?")
+        sync(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    _stage(f"backend_ready_{platform}")
+    on_tpu = platform == "tpu"
+    out_path = os.path.join(_REPO, "benchmarks", "results",
+                            f"plan_autotune_{platform}.jsonl")
+    db = default_db()
+
+    def emit(rec):
+        rec.update(platform=platform, device_kind=kind, round=rnd)
+        line = json.dumps(rec)
+        print(line, flush=True)
+        with open(out_path, "a") as f:
+            f.write(line + "\n")
+
+    def _compiles():
+        return sum(f._cache_size() for f in
+                   (_lstsq_impl, _cholqr_lstsq_impl, _tsqr_lstsq_impl))
+
+    speedups = []
+    routed_off_householder = False
+    rows = []
+    for label, m, n in SHAPES:
+        name = f"tune_lstsq_{m}x{n}"
+        _stage(name)
+        with _Watchdog(name, 560 if on_tpu else 300):
+            res = tune("lstsq", m, n, repeats=3, db=db)
+        winner = next(r for r in res.measurements if r.plan == res.plan)
+        disq = [(r.plan.describe(), r.reason or "accuracy")
+                for r in res.measurements if r.seconds is None]
+        if res.plan.engine != "householder" and label == "tall_skinny":
+            routed_off_householder = True
+        speedups.append(res.speedup)
+        row = {
+            "metric": f"plan_autotune_lstsq_{m}x{n}",
+            "regime": label,
+            "value": round(res.speedup, 4), "unit": "x vs static default",
+            "seconds": round(res.seconds, 6),
+            "baseline_seconds": round(res.baseline_seconds, 6),
+            "plan": res.plan.to_dict(),
+            "plan_desc": res.plan.describe(),
+            "residual_ratio_vs_lapack": winner.residual,
+            "residual_criterion": 8.0,
+            "candidates_timed": sum(
+                1 for r in res.measurements if r.seconds is not None),
+            "candidates_disqualified": disq,
+            "db_key": res.key,
+        }
+        rows.append(row)
+        emit(row)
+
+    # Warm repeat through the PUBLIC tuned path: every shape, twice,
+    # zero recompiles (the DB must resolve to already-compiled programs).
+    _stage("warm_repeat")
+    n_compiled = _compiles()
+    for _, m, n in SHAPES:
+        A, b = _problem("lstsq", m, n, "float32", seed=0)
+        for _ in range(2):
+            sync(lstsq(A, b, plan="auto"))
+    warm_recompiles = _compiles() - n_compiled
+
+    # Tuned serving: prewarm resolves + compiles per bucket, live
+    # dispatch and its repeat must be pure cache hits.
+    _stage("serve_warm")
+    from dhqr_tpu.serve import batched_lstsq, prewarm
+    from dhqr_tpu.serve.cache import ExecutableCache
+
+    rng = np.random.default_rng(0)
+    cache = ExecutableCache(max_size=32)
+    keys = prewarm([(4, 384, 128), (8, 96, 24)], kind="lstsq",
+                   plan="auto", cache=cache)
+    misses_after_prewarm = cache.stats()["misses"]
+    reqs = [(384, 128)] * 4 + [(96, 24)] * 8
+    As = [jnp.asarray(rng.random(s), jnp.float32) for s in reqs]
+    bs = [jnp.asarray(rng.random(s[0]), jnp.float32) for s in reqs]
+    for _ in range(2):
+        xs = batched_lstsq(As, bs, plan="auto", cache=cache)
+    sync(xs)
+    serve_recompiles = cache.stats()["misses"] - misses_after_prewarm
+
+    geomean = math.exp(sum(math.log(s) for s in speedups) / len(speedups))
+    emit({
+        "metric": "plan_autotune_verdict",
+        "value": round(geomean, 4), "unit": "geomean x vs static default",
+        "shapes": len(SHAPES),
+        "per_shape_speedups": {f"{m}x{n}": round(s, 3) for (_, m, n), s
+                               in zip(SHAPES, speedups)},
+        "geomean_meets_1p3x": geomean >= 1.3,
+        "tall_skinny_routed_to_alt_engine": routed_off_householder,
+        "warm_repeat_recompiles": warm_recompiles,
+        "serve_prewarmed_keys": len(keys),
+        "serve_dispatch_recompiles": serve_recompiles,
+        "all_rows_within_8x_lapack": all(
+            (r["residual_ratio_vs_lapack"] or 0) <= 8.0 for r in rows),
+        "plan_db": db.path,
+    })
+    _stage("done")
+
+
+if __name__ == "__main__":
+    main()

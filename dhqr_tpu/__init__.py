@@ -45,9 +45,13 @@ from dhqr_tpu.precision import (
     resolve_policy,
 )
 from dhqr_tpu.serve import batched_lstsq, batched_qr
-from dhqr_tpu.utils.config import DHQRConfig, ServeConfig
+# NOTE: the tune() search function itself stays at dhqr_tpu.tune.tune —
+# re-exporting it here would shadow the `dhqr_tpu.tune` submodule
+# attribute with a function (breaking `import dhqr_tpu.tune as t`).
+from dhqr_tpu.tune import Plan, PlanDB, resolve_plan
+from dhqr_tpu.utils.config import DHQRConfig, ServeConfig, TuneConfig
 
-__version__ = "0.3.0"
+__version__ = "0.4.0"
 
 __all__ = [
     "QRFactorization",
@@ -71,6 +75,10 @@ __all__ = [
     "batched_lstsq",
     "DHQRConfig",
     "ServeConfig",
+    "TuneConfig",
+    "Plan",
+    "PlanDB",
+    "resolve_plan",
     "PrecisionPolicy",
     "PRECISION_POLICIES",
     "POLICY_LADDER",

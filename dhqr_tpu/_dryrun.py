@@ -138,6 +138,51 @@ def run(n_devices: int) -> None:
           f"{s1['size']} resident executables, repeat pass 0 recompiles)",
           flush=True)
 
+    # Plan autotuner (round 9): a tiny-grid on-device search must run end
+    # to end on CPU — tune, persist, resolve through the PUBLIC lstsq
+    # plan="auto" path — with the tuned answer held to the same 8x LAPACK
+    # criterion as every other engine, and a warm second call pinned to
+    # ZERO recompiles (the plan DB resolves to the already-compiled
+    # program; an autotuner that recompiles per call would undo the
+    # serving tier's contract).
+    import tempfile
+
+    from dhqr_tpu.models.qr_model import _lstsq_impl
+    from dhqr_tpu.ops.cholqr import _cholqr_lstsq_impl
+    from dhqr_tpu.ops.tsqr import _tsqr_lstsq_impl
+    from dhqr_tpu.tune import PlanDB, resolve_plan, tune as tune_search
+
+    def _lstsq_compiles():
+        # Whatever engine the tuner picked, its jitted impl is one of
+        # these three — a stable sum means the warm call recompiled
+        # nothing.
+        return sum(f._cache_size() for f in
+                   (_lstsq_impl, _cholqr_lstsq_impl, _tsqr_lstsq_impl))
+
+    tune_dir = tempfile.mkdtemp(prefix="dhqr_dryrun_tune_")
+    tdb = PlanDB(os.path.join(tune_dir, "plans.json"))
+    mt_, nt_ = 256, 16
+    tres = tune_search("lstsq", mt_, nt_, db=tdb, budget=5, repeats=1)
+    At_ = jnp.asarray(rng.random((mt_, nt_)), jnp.float32)
+    bt_ = jnp.asarray(rng.random(mt_), jnp.float32)
+    # resolve_plan must hit the entry tune() just persisted; threading it
+    # through apply_plan_to_config mirrors what lstsq(plan=...) does but
+    # keeps the dry run pinned to THIS db rather than the process default.
+    plan = resolve_plan("lstsq", mt_, nt_, db=tdb, on_miss="default")
+    assert plan is not None, "tuned plan did not persist to the DB"
+    assert plan == tres.plan, (plan, tres.plan)
+    xt_ = _lstsq(At_, bt_, plan=plan)
+    res = normal_equations_residual(At_, np.asarray(xt_), bt_)
+    ref = oracle_residual(np.asarray(At_), np.asarray(bt_))
+    assert res < TOLERANCE_FACTOR * ref, ("tuned lstsq", res, ref)
+    n_compiled = _lstsq_compiles()
+    xt2 = _lstsq(At_, bt_, plan=plan)
+    assert _lstsq_compiles() == n_compiled, "warm tuned lstsq recompiled"
+    assert bool(jnp.all(xt2 == xt_)), "warm tuned lstsq diverged"
+    print(f"dryrun: tune ok (winner {tres.plan.describe()}, "
+          f"{tres.speedup:.2f}x vs static default, residual within 8x, "
+          "warm repeat 0 recompiles)", flush=True)
+
     # TSQR wants a genuinely tall problem: local row blocks must stay tall
     nt = 8
     mt = 2 * nt * n_devices

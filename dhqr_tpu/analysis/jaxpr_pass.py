@@ -179,6 +179,30 @@ def _entry_points(preset: str, pol):
            jx(lambda A: dhqr_tpu.qr(A, policy=preset), A), ())
     yield (f"lstsq[{preset}]",
            jx(lambda A, b: dhqr_tpu.lstsq(A, b, policy=preset), A, b), ())
+    # The tuned dispatch path (round 9): lstsq with an explicit Plan
+    # exercises plan resolution + apply_plan_to_config under every
+    # policy preset — the exact code the plan DB routes production calls
+    # through. An explicit Plan (not "auto") keeps the trace abstract:
+    # no DB read, no timing, deterministic across hosts. The recursive
+    # panel interior is the plan-only knob with the most distinct
+    # program structure, so regressions in the tuned route surface here.
+    from dhqr_tpu.tune import Plan
+
+    yield (f"lstsq_plan[{preset}]",
+           jx(lambda A, b: dhqr_tpu.lstsq(
+               A, b, plan=Plan(block_size=_NB, panel_impl="recursive"),
+               policy=preset), A, b), ())
+    if preset == "accurate":
+        # Alt-engine plan routing is policy-free by pruning rule 5 —
+        # trace it once, on the tall problem the gates admit.
+        At = jnp.zeros((64 * _N, _N), jnp.float32)
+        bt = jnp.zeros((64 * _N,), jnp.float32)
+        yield ("lstsq_plan_tsqr",
+               jx(lambda A, b: dhqr_tpu.lstsq(
+                   A, b, plan=Plan(engine="tsqr")), At, bt), ())
+        yield ("lstsq_plan_cholqr2",
+               jx(lambda A, b: dhqr_tpu.lstsq(
+                   A, b, plan=Plan(engine="cholqr2")), At, bt), ())
     yield (f"tsqr_r[{preset}]",
            jx(lambda A: dhqr_tpu.tsqr_r(A, n_blocks=2, policy=preset), A),
            ())

@@ -96,6 +96,73 @@ def _resolve_policy_cfg(cfg: DHQRConfig):
     return cfg, pol
 
 
+def _resolve_plan_cfg(cfg: DHQRConfig, kind: str, shape, dtype, mesh,
+                      pol) -> DHQRConfig:
+    """Resolve ``cfg.plan`` into the classic engine-selection knobs
+    (shared by ``qr`` and ``lstsq``; the serve tier has its own
+    per-bucket twin in ``serve.engine``).
+
+    ``"auto"`` looks the (kind, shape, dtype, mesh, policy) key up in
+    the plan database — tuning on a miss per ``TuneConfig.on_miss`` — a
+    :class:`dhqr_tpu.tune.Plan` applies verbatim, and ``"default"``
+    (or None) keeps the static knobs. A plan names the whole
+    engine-selection tuple at once, so it is mutually exclusive with
+    setting any of those knobs explicitly (same refuse-loudly contract
+    as ``policy=``). Runs AFTER policy resolution: plans are keyed
+    under the policy, and a policy-set ``trailing_precision`` always
+    wins over the plan's (``tune.apply_plan_to_config``).
+    """
+    spec = cfg.plan
+    if spec is None:
+        return cfg
+    if isinstance(spec, str) and spec == "default":
+        return dataclasses.replace(cfg, plan=None)
+    from dhqr_tpu.tune import Plan, apply_plan_to_config, resolve_plan
+
+    m, n = shape
+    if m < n:
+        # The minimum-norm path supports exactly one configuration —
+        # there is nothing for a plan to select.
+        return dataclasses.replace(cfg, plan=None)
+    if not cfg.blocked:
+        raise ValueError(
+            "plan= applies to the blocked/alt engines only: the "
+            "unblocked reference-parity engine (blocked=False) has no "
+            "plan knobs to select"
+        )
+    defaults = DHQRConfig()
+    # use_pallas is in the list although it is not a Plan field: plans
+    # are measured under the "auto" resolution, so pinning the kernel
+    # choice while asking for a tuned plan would apply knobs to a
+    # program family the tuner never timed — refuse loudly instead.
+    for knob in ("engine", "block_size", "panel_impl", "lookahead",
+                 "agg_panels", "use_pallas"):
+        if getattr(cfg, knob) != getattr(defaults, knob):
+            raise ValueError(
+                f"pass either plan= or {knob}=, not both (a plan names "
+                f"the engine-selection knobs at once; got "
+                f"{knob}={getattr(cfg, knob)!r} with plan={spec!r})"
+            )
+    if isinstance(spec, Plan):
+        if spec.trailing_precision and cfg.trailing_precision is not None:
+            raise ValueError(
+                "the plan carries trailing_precision="
+                f"{spec.trailing_precision!r} but the policy/config "
+                f"already set {cfg.trailing_precision!r} — drop one"
+            )
+        plan = spec
+    elif isinstance(spec, str) and spec == "auto":
+        plan = resolve_plan(kind, m, n, dtype, mesh=mesh, policy=pol)
+        if plan is None:  # DB miss with on_miss="default"
+            return dataclasses.replace(cfg, plan=None)
+    else:
+        raise ValueError(
+            f"plan must be 'auto', 'default', None or a dhqr_tpu.tune.Plan,"
+            f" got {spec!r}"
+        )
+    return apply_plan_to_config(cfg, plan)
+
+
 def _check_panel_impl(cfg: DHQRConfig) -> None:
     """Shared panel_impl validation for qr() and lstsq()."""
     if cfg.panel_impl.startswith("reconstruct"):
@@ -308,6 +375,7 @@ def qr(
 
     cfg = dataclasses.replace(config or DHQRConfig(), **overrides)
     cfg, pol = _resolve_policy_cfg(cfg)
+    cfg = _resolve_plan_cfg(cfg, "qr", A.shape, A.dtype, mesh, pol)
     if cfg.engine != "householder":
         if cfg.engine not in LSTSQ_ENGINES:
             raise ValueError(
@@ -800,6 +868,7 @@ def lstsq(
     cfg, pol = _resolve_policy_cfg(cfg)
     if pol is not None and pol.refine:
         cfg = dataclasses.replace(cfg, refine=pol.refine)
+    cfg = _resolve_plan_cfg(cfg, "lstsq", A.shape, A.dtype, mesh, pol)
     if cfg.norm not in ("accurate", "fast"):
         raise ValueError(
             f"norm must be 'accurate' or 'fast', got {cfg.norm!r}"

@@ -151,6 +151,54 @@ def _resolve_serve_cfg(config: Optional[DHQRConfig],
     return cfg, pol
 
 
+def _resolve_bucket_plan(kind: str, cfg: DHQRConfig, bucket: Bucket, pol):
+    """Per-bucket twin of ``models.qr_model._resolve_plan_cfg``: the
+    serve tier's plan key is the BUCKET shape (what actually compiles
+    and dispatches), so ``plan="auto"`` resolves here, inside the group
+    loop, once per bucket. Tuned knobs land on the config BEFORE
+    ``_plan_key`` builds the cache key, so a tuned dispatch and its
+    prewarm hit the same executable — zero-recompile serving holds with
+    plans exactly as without."""
+    spec = cfg.plan
+    if spec is None:
+        return cfg
+    if isinstance(spec, str) and spec == "default":
+        return dataclasses.replace(cfg, plan=None)
+    from dhqr_tpu.tune import Plan, apply_plan_to_config, resolve_plan
+
+    if cfg.block_size is not None or cfg.panel_impl != "loop":
+        raise ValueError(
+            "pass either plan= or block_size=/panel_impl=, not both "
+            f"(got block_size={cfg.block_size}, "
+            f"panel_impl={cfg.panel_impl!r} with plan={spec!r})"
+        )
+    if isinstance(spec, Plan):
+        plan = spec
+    elif isinstance(spec, str) and spec == "auto":
+        plan = resolve_plan(f"serve_{kind}", bucket.m, bucket.n,
+                            bucket.dtype, policy=pol)
+        if plan is None:  # DB miss with on_miss="default"
+            return dataclasses.replace(cfg, plan=None)
+    else:
+        raise ValueError(
+            f"plan must be 'auto', 'default', None or a dhqr_tpu.tune.Plan,"
+            f" got {spec!r}"
+        )
+    if plan.engine != "householder" or plan.lookahead or plan.agg_panels:
+        raise ValueError(
+            "serve plans carry block_size/panel_impl/trailing_precision "
+            "only (the serving tier batches the blocked householder "
+            f"engine, no schedule levers); got {plan.describe()!r}"
+        )
+    if plan.trailing_precision and cfg.trailing_precision is not None:
+        raise ValueError(
+            f"the plan carries trailing_precision="
+            f"{plan.trailing_precision!r} but the policy/config already "
+            f"set {cfg.trailing_precision!r} — drop one"
+        )
+    return apply_plan_to_config(cfg, plan)
+
+
 def _plan_key(kind: str, count: int, m: int, n: int, dtype,
               cfg: DHQRConfig, scfg: ServeConfig) -> "tuple[CacheKey, Bucket]":
     """The ONE place a request shape + config becomes a cache key —
@@ -202,6 +250,11 @@ def bucket_program(kind: str, config: Optional[DHQRConfig] = None,
     (analysis/jaxpr_pass), so program-representation regressions in the
     serving tier surface without a compile."""
     cfg, pol = _resolve_serve_cfg(config, overrides)
+    if cfg.plan is not None:
+        raise ValueError(
+            "bucket_program takes resolved knobs (block_size=, ...): "
+            "plan= is resolved per bucket by the serve entry points"
+        )
     if pol is not None and pol.refine:
         cfg = dataclasses.replace(cfg, refine=pol.refine)
 
@@ -279,17 +332,19 @@ def _group_by_bucket(As: Sequence, scfg: ServeConfig):
     return groups
 
 
-def _dispatch_groups(kind, As, bs, cfg, scfg, cache, consume):
+def _dispatch_groups(kind, As, bs, cfg, scfg, cache, consume, pol=None):
     """The one group -> chunk -> key -> compile -> pad -> dispatch loop
     shared by ``batched_lstsq`` and ``batched_qr`` (a chunking or key
     fix must not have to land twice). ``consume(chunk, key, outs)`` is
     called once per dispatched chunk with the request indices, the cache
-    key, and the stacked program outputs."""
+    key, and the stacked program outputs. ``pol`` (the resolved policy,
+    if any) keys per-bucket plan resolution."""
     for bucket, idxs in _group_by_bucket(As, scfg).items():
+        cfg_b = _resolve_bucket_plan(kind, cfg, bucket, pol)
         for lo in range(0, len(idxs), scfg.max_batch):
             chunk = idxs[lo:lo + scfg.max_batch]
             key, _ = _plan_key(kind, len(chunk), bucket.m, bucket.n,
-                               bucket.dtype, cfg, scfg)
+                               bucket.dtype, cfg_b, scfg)
             # plan_bucket is idempotent (bucket dims are lattice points),
             # so re-planning from the bucket's own shape returns it.
             compiled = cache.get_or_compile(key, partial(_lower_for_key, key))
@@ -338,7 +393,7 @@ def batched_lstsq(
         for row, i in enumerate(chunk):
             out[i] = xs[row, :As[i].shape[1]]
 
-    _dispatch_groups("lstsq", As, bs, cfg, scfg, cache, consume)
+    _dispatch_groups("lstsq", As, bs, cfg, scfg, cache, consume, pol=pol)
     return out
 
 
@@ -385,7 +440,7 @@ def batched_qr(
                 matrix=jnp.asarray(As[i]) if solve_refine else None,
             )
 
-    _dispatch_groups("qr", As, None, cfg, scfg, cache, consume)
+    _dispatch_groups("qr", As, None, cfg, scfg, cache, consume, pol=pol)
     return out
 
 
@@ -440,11 +495,19 @@ def prewarm(
         per_arrival.append((bucket, int(count)))
         merged[bucket] = merged.get(bucket, 0) + int(count)
     keys: "list[CacheKey]" = []
+    bucket_cfgs: "dict[Bucket, DHQRConfig]" = {}
     for bucket, count in per_arrival + list(merged.items()):
+        # One plan resolution per bucket (``plan="auto"`` TUNES here on
+        # a DB miss — prewarm is exactly where that cost belongs), via
+        # the same resolver live dispatch uses, so prewarmed keys stay
+        # the keys serving hits.
+        if bucket not in bucket_cfgs:
+            bucket_cfgs[bucket] = _resolve_bucket_plan(kind, cfg, bucket, pol)
+        cfg_b = bucket_cfgs[bucket]
         for lo in range(0, count, scfg.max_batch):
             chunk_count = min(scfg.max_batch, count - lo)
             key, _ = _plan_key(kind, chunk_count, bucket.m, bucket.n,
-                               bucket.dtype, cfg, scfg)
+                               bucket.dtype, cfg_b, scfg)
             if key not in keys:
                 keys.append(key)
                 cache.get_or_compile(key, partial(_lower_for_key, key))

@@ -1,0 +1,56 @@
+"""dhqr-tune — measurement-driven execution-plan autotuning.
+
+The engine knobs (engine family, panel width ``nb``, panel interior,
+trailing precision, mesh schedule) dominate wall time and their optimum
+moves with problem shape (the serve ladder's nb=32-vs-128 4.5x; the TPU
+ladder's nb=256/512 escalation; tall-skinny problems belonging on
+TSQR/CholQR2). This package stops guessing:
+
+* :class:`Plan` — one executable configuration (tune/plan.py);
+* :class:`PlanDB` — persistent, versioned, corrupt-tolerant JSON plan
+  database with last-write-wins merging, seeded by the shipped
+  ``default_plans.json`` (tune/db.py);
+* :func:`tune` / :func:`resolve_plan` / :func:`candidate_plans` — the
+  pruned on-device timing search and the ``plan="auto"`` lookup the
+  public API threads through (tune/search.py).
+
+Entry points: ``qr(A, plan="auto")``, ``lstsq(A, b, plan="auto")``,
+``serve.prewarm(..., plan="auto")``, ``DHQR_TUNE_*`` env knobs
+(:class:`dhqr_tpu.utils.config.TuneConfig`).
+"""
+
+from dhqr_tpu.tune.db import (
+    PlanDB,
+    SEED_PATH,
+    default_db,
+    plan_key,
+    policy_tag,
+    reset_default_db,
+)
+from dhqr_tpu.tune.plan import DEFAULT_PLAN, PLAN_ENGINES, Plan
+from dhqr_tpu.tune.search import (
+    Measurement,
+    TuneResult,
+    apply_plan_to_config,
+    candidate_plans,
+    resolve_plan,
+    tune,
+)
+
+__all__ = [
+    "Plan",
+    "DEFAULT_PLAN",
+    "PLAN_ENGINES",
+    "PlanDB",
+    "SEED_PATH",
+    "default_db",
+    "reset_default_db",
+    "plan_key",
+    "policy_tag",
+    "candidate_plans",
+    "apply_plan_to_config",
+    "tune",
+    "resolve_plan",
+    "Measurement",
+    "TuneResult",
+]

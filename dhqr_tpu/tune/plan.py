@@ -1,0 +1,148 @@
+"""Execution plans — the unit the autotuner searches over and caches.
+
+A :class:`Plan` names the engine-selection knobs that dominate wall time
+for one problem shape: the engine family, the compact-WY panel width
+``nb``, the panel-interior algorithm, the trailing-GEMM precision split,
+and (on meshes) the schedule levers ``agg_panels``/``lookahead``. It is
+exactly the subset of :class:`dhqr_tpu.utils.config.DHQRConfig` the
+serve-tier ladder proved shape-sensitive (round 8: ``nb=32`` beat the
+static ``nb=128`` by 4.5x for vmapped 384x128 problems), made
+first-class so a measurement can be recorded once and replayed on every
+later call.
+
+Accuracy knobs (``precision``, ``norm``, ``refine``, policies) are NOT
+plan fields: a plan must never silently change the answer's error bar —
+it is keyed UNDER the caller's policy instead (see
+:func:`dhqr_tpu.tune.db.plan_key`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+# Engine families a plan may name. cholqr3 is deliberately absent: the
+# shifted window exists for near-rank-deficient problems, which a timing
+# search cannot detect — routing there is an accuracy decision the
+# caller must make via engine=.
+PLAN_ENGINES = ("householder", "tsqr", "cholqr2")
+
+_PANEL_IMPLS = ("loop", "recursive", "reconstruct")
+
+_TRAILING = (None, "highest", "high", "default")
+
+
+@dataclasses.dataclass(frozen=True)
+class Plan:
+    """One executable configuration for a (shape, dtype, mesh) problem.
+
+    Attributes:
+      engine: "householder" (the packed-reflector default; the only
+        engine ``qr()`` accepts), "tsqr" or "cholqr2" (lstsq-only fast
+        paths for tall-skinny problems).
+      block_size: compact-WY panel width nb; None keeps the engine's
+        auto resolution (``ops.blocked.auto_block_size`` single-device).
+      panel_impl: panel-interior algorithm on the blocked XLA path
+        ("loop" / "recursive" / "reconstruct[:chunk]").
+      trailing_precision: trailing-GEMM precision split (None = no
+        split). Only tuned when the caller did not already fix precision
+        via a policy — see ``search.candidate_plans``.
+      lookahead / agg_panels: mesh schedule levers (1-device plans keep
+        the defaults; the pair composes only on multi-device meshes).
+    """
+
+    engine: str = "householder"
+    block_size: Optional[int] = None
+    panel_impl: str = "loop"
+    trailing_precision: Optional[str] = None
+    lookahead: bool = False
+    agg_panels: Optional[int] = None
+
+    def __post_init__(self):
+        if self.engine not in PLAN_ENGINES:
+            raise ValueError(
+                f"Plan.engine must be one of {PLAN_ENGINES}, "
+                f"got {self.engine!r}"
+            )
+        if self.block_size is not None and self.block_size < 1:
+            raise ValueError(
+                f"Plan.block_size must be >= 1 or None, got {self.block_size}"
+            )
+        base = self.panel_impl.split(":", 1)[0]
+        if base not in _PANEL_IMPLS:
+            raise ValueError(
+                f"Plan.panel_impl must be one of {_PANEL_IMPLS} "
+                f"(optionally 'reconstruct:<chunk>'), got {self.panel_impl!r}"
+            )
+        if self.trailing_precision not in _TRAILING:
+            raise ValueError(
+                f"Plan.trailing_precision must be one of {_TRAILING}, "
+                f"got {self.trailing_precision!r}"
+            )
+        if self.agg_panels is not None and self.agg_panels < 2:
+            raise ValueError(
+                f"Plan.agg_panels must be >= 2 or None, got {self.agg_panels}"
+            )
+        if self.engine != "householder":
+            # The alt engines have no panel loop / trailing split /
+            # schedule to steer; a plan carrying those knobs anyway would
+            # be rejected downstream with a confusing per-knob error.
+            if (self.panel_impl != "loop" or self.trailing_precision
+                    or self.lookahead or self.agg_panels):
+                raise ValueError(
+                    f"engine={self.engine!r} plans carry only block_size "
+                    "(panel_impl/trailing_precision/lookahead/agg_panels "
+                    "are blocked-householder knobs)"
+                )
+
+    # -- serialization -----------------------------------------------------
+    def to_dict(self) -> dict:
+        """JSON-ready dict (the plan-DB entry payload)."""
+        return {
+            "engine": self.engine,
+            "block_size": self.block_size,
+            "panel_impl": self.panel_impl,
+            "trailing_precision": self.trailing_precision,
+            "lookahead": self.lookahead,
+            "agg_panels": self.agg_panels,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Plan":
+        """Inverse of :meth:`to_dict`; validates via ``__post_init__``.
+        Unknown keys are rejected — a future-versioned entry must fail
+        the per-entry schema check (and be skipped by the DB loader),
+        not half-load."""
+        if not isinstance(d, dict):
+            raise ValueError(f"plan payload must be a dict, got {type(d)}")
+        known = {f.name for f in dataclasses.fields(cls)}
+        extra = set(d) - known
+        if extra:
+            raise ValueError(f"unknown plan fields {sorted(extra)}")
+        kwargs = dict(d)
+        for int_field in ("block_size", "agg_panels"):
+            if kwargs.get(int_field) is not None:
+                kwargs[int_field] = int(kwargs[int_field])
+        if "lookahead" in kwargs:
+            kwargs["lookahead"] = bool(kwargs["lookahead"])
+        return cls(**kwargs)
+
+    def describe(self) -> str:
+        """Compact human-readable spelling for logs/JSONL rows."""
+        parts = [self.engine]
+        if self.block_size is not None:
+            parts.append(f"nb{self.block_size}")
+        if self.panel_impl != "loop":
+            parts.append(self.panel_impl)
+        if self.trailing_precision:
+            parts.append(f"tp-{self.trailing_precision}")
+        if self.lookahead:
+            parts.append("la")
+        if self.agg_panels:
+            parts.append(f"agg{self.agg_panels}")
+        return "+".join(parts)
+
+
+#: The static default every tier runs without a plan — spelled out so
+#: benchmarks and the DB can record "the baseline" as a real Plan.
+DEFAULT_PLAN = Plan()

@@ -1,0 +1,477 @@
+"""Measurement-driven execution-plan search.
+
+``tune()`` times a PRUNED candidate grid of :class:`Plan`\\ s for one
+(kind, shape, dtype, mesh, policy) key on the actual backend and records
+the winner in the plan database; ``resolve_plan()`` is the lookup (+
+tune-on-miss) the ``plan="auto"`` API paths call.
+
+Pruning rules (the grid, in deterministic order — docs/DESIGN.md "Plan
+autotuner" carries the same table):
+
+1. The static default ``Plan()`` is always candidate 0 — every tune
+   measures the baseline it claims to beat, and the recorded entry
+   carries the measured speedup.
+2. nb ladder: powers of two from 8 to 256 with ``nb <= n`` (a panel
+   wider than the matrix is the same program as ``nb = n``), on the
+   blocked-householder engine.
+3. Panel-interior variants (``recursive``, ``reconstruct``) only at
+   ``n >= 64`` and only for ``nb >= 64`` — they restructure the panel
+   interior, which is negligible under narrow panels.
+   ``reconstruct`` additionally requires a real dtype (the no-pivot-LU
+   reconstruction identity is real-only here).
+4. ``trailing_precision="high"`` only on TPU (on CPU every precision
+   collapses to native f32 — a split is pure key noise) and only when
+   the caller did NOT fix precision via a policy (a plan must not
+   silently move the error bar a policy pinned).
+5. Alt engines (``tsqr``, ``cholqr2``) are lstsq-only, policy-free
+   candidates, gated on aspect ratio: ``cholqr2`` at ``m/n >= 8``
+   (all-GEMM wins once the trailing update dominates; its conditioning
+   window is the caller's responsibility — see DESIGN), ``tsqr`` at
+   ``m/n >= 32`` (the communication-avoiding tree needs genuinely tall
+   blocks). The serve kinds never route engines (the serving tier
+   batches the blocked householder engine only).
+6. Mesh schedule levers (``lookahead``, ``agg_panels``, their grouped
+   composition) only when the mesh axis has ``nproc > 1`` devices — on
+   one device there is no collective to hide (the same degenerate case
+   ``sharded_blocked_qr`` warns about).
+7. The grid is truncated at ``TuneConfig.budget`` candidates — from the
+   END (defaults and the nb ladder come first, so a tight budget still
+   measures the highest-value axis).
+
+Every timed lstsq candidate is VERIFIED against the reference acceptance
+rule — normal-equations residual within 8x the LAPACK oracle — and a
+failing candidate is disqualified no matter how fast it ran (qr
+candidates gate on factor backward error vs. the default plan instead).
+A plan database can therefore only ever route callers to configurations
+that met the repo's accuracy bar on this very backend.
+
+``use_pallas`` is deliberately NOT a plan axis: candidates run through
+the public entry points with the "auto" resolution, which on TPU routes
+supported panels through the fused kernel — i.e. the tuner measures the
+program family the public API (and bench.py's pallas stages, at those
+sizes) actually dispatch, and the platform prefix in the DB key keeps
+those measurements from answering for any other backend. Callers who
+pin ``use_pallas`` explicitly are off the tuned path by construction
+(``plan=`` is mutually exclusive with the knobs it selects, and the
+kernel silently bypasses ``panel_impl`` — plans never encode it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, List, Optional
+
+from dhqr_tpu.tune.db import PlanDB, default_db, plan_key, policy_tag
+from dhqr_tpu.tune.plan import DEFAULT_PLAN, Plan
+
+TUNE_KINDS = ("qr", "lstsq", "serve_qr", "serve_lstsq")
+
+#: Batch the serve kinds are timed at. The round-8 vmapped nb ladder was
+#: flat in B (nb=32 won at B=16 and B=4 alike): the batch axis reshapes
+#: every candidate's GEMMs identically, so one nominal batch ranks them.
+TUNE_SERVE_BATCH = 8
+
+_NB_LADDER = (8, 16, 32, 64, 128, 256)
+
+#: Aspect-ratio gates for the alt-engine candidates (rule 5).
+CHOLQR_MIN_ASPECT = 8
+TSQR_MIN_ASPECT = 32
+
+
+@dataclasses.dataclass(frozen=True)
+class Measurement:
+    """One timed candidate (``seconds=None`` -> disqualified)."""
+
+    plan: Plan
+    seconds: "float | None"
+    residual: "float | None" = None
+    reason: "str | None" = None
+
+
+@dataclasses.dataclass(frozen=True)
+class TuneResult:
+    """Outcome of one ``tune()`` call."""
+
+    key: str
+    plan: Plan
+    seconds: float
+    baseline_seconds: float
+    measurements: "tuple[Measurement, ...]"
+
+    @property
+    def speedup(self) -> float:
+        """Measured default-plan time / winner time (>= 1 by
+        construction: the default is always a candidate)."""
+        return self.baseline_seconds / self.seconds
+
+
+def _is_real(dtype) -> bool:
+    import numpy as np
+
+    return not np.issubdtype(np.dtype(dtype), np.complexfloating)
+
+
+def candidate_plans(kind: str, m: int, n: int, dtype="float32",
+                    nproc: int = 1, policy=None,
+                    platform: "str | None" = None,
+                    budget: "int | None" = None) -> List[Plan]:
+    """The pruned, deterministically-ordered candidate grid (module
+    docstring rules 1-7). Pure — no timing, no device access (pass
+    ``platform`` explicitly to keep it that way; None asks jax)."""
+    if kind not in TUNE_KINDS:
+        raise ValueError(f"kind must be one of {TUNE_KINDS}, got {kind!r}")
+    if n < 1 or m < n:
+        raise ValueError(
+            f"tuning covers tall problems (m >= n >= 1), got ({m}, {n})"
+        )
+    if platform is None:
+        import jax
+
+        platform = jax.default_backend()
+    if budget is None:
+        from dhqr_tpu.utils.config import TuneConfig
+
+        budget = TuneConfig.from_env().budget
+    out: List[Plan] = [DEFAULT_PLAN]
+    serve = kind.startswith("serve_")
+    # Rule 2 — nb ladder. The serve tier's measured optimum lives at the
+    # narrow end (round 8), so its ladder starts at 8; the single-problem
+    # tiers start at 32 (sub-sublane panels only add panel-loop trips).
+    ladder = [v for v in _NB_LADDER if v <= n and (serve or v >= 32)]
+    out.extend(Plan(block_size=v) for v in ladder)
+    # Rule 3 — panel-interior variants at GEMM-sized widths.
+    if not serve and n >= 64:
+        impls = ["recursive"]
+        if _is_real(dtype):
+            impls.append("reconstruct")
+        for impl in impls:
+            out.extend(Plan(block_size=v, panel_impl=impl)
+                       for v in ladder if v >= 64)
+    # Rule 4 — trailing split, TPU only, never under a policy.
+    if not serve and platform == "tpu" and policy is None:
+        out.extend(Plan(block_size=v, trailing_precision="high")
+                   for v in ladder if v >= 64)
+    # Rule 5 — alt engines, lstsq-only, policy-free, aspect-gated.
+    if kind == "lstsq" and policy is None:
+        aspect = m / n
+        if aspect >= CHOLQR_MIN_ASPECT:
+            out.append(Plan(engine="cholqr2"))
+        if aspect >= TSQR_MIN_ASPECT:
+            out.append(Plan(engine="tsqr"))
+    # Rule 6 — mesh schedule levers.
+    if not serve and nproc > 1:
+        base_nb = ladder[-1] if ladder else None
+        out.extend([
+            Plan(block_size=base_nb, lookahead=True),
+            Plan(block_size=base_nb, agg_panels=2),
+            Plan(block_size=base_nb, agg_panels=4),
+            Plan(block_size=base_nb, agg_panels=2, lookahead=True),
+        ])
+    # Dedupe preserving order (Plan() and the ladder can collide at tiny
+    # n), then rule 7 — budget truncation from the end.
+    seen = set()
+    unique = []
+    for p in out:
+        if p not in seen:
+            seen.add(p)
+            unique.append(p)
+    return unique[:max(1, int(budget))]
+
+
+def apply_plan_to_config(cfg, plan: Plan):
+    """Fold a plan's knobs into a :class:`DHQRConfig` (``plan`` cleared).
+
+    ``trailing_precision`` already set on the config (a resolved policy)
+    wins over the plan's — candidate grids never pair the two (rule 4),
+    and a stored plan replayed under a new policy must not override it.
+    """
+    trailing = (cfg.trailing_precision
+                if cfg.trailing_precision is not None
+                else plan.trailing_precision)
+    return dataclasses.replace(
+        cfg, engine=plan.engine, block_size=plan.block_size,
+        panel_impl=plan.panel_impl, trailing_precision=trailing,
+        lookahead=plan.lookahead, agg_panels=plan.agg_panels, plan=None,
+    )
+
+
+def _build_runner(kind: str, plan: Plan, policy, mesh) -> Callable:
+    """A ``runner(*arrays) -> output-pytree`` executing ``kind`` under
+    ``plan`` through the same impls the public API dispatches."""
+    from dhqr_tpu.utils.config import DHQRConfig
+
+    if kind in ("qr", "lstsq"):
+        from dhqr_tpu.models import qr_model
+
+        cfg = apply_plan_to_config(DHQRConfig(policy=policy), plan)
+        if kind == "qr":
+            def runner(A):
+                fact = qr_model.qr(A, config=cfg, mesh=mesh)
+                return (fact.H, fact.alpha)
+        else:
+            def runner(A, b):
+                return qr_model.lstsq(A, b, config=cfg, mesh=mesh)
+        return runner
+    # Serve kinds: the bucket-dispatch unit (the very program the serve
+    # cache compiles per bucket), timed NON-donating — donation only
+    # aliases buffers, it does not reorder the math, so it cannot change
+    # the candidate ranking, while a donated timing loop would have to
+    # re-stage its input every repeat. The policy's program knobs
+    # (precision split, in-program refinement) ride along so a tuned
+    # entry keyed under a policy measured the program that policy runs.
+    import jax
+
+    from dhqr_tpu.ops import blocked as _blocked
+    from dhqr_tpu.precision import resolve_policy
+    from dhqr_tpu.serve.engine import SERVE_DEFAULT_BLOCK, _batched_lstsq_impl
+
+    pol = resolve_policy(policy) if policy is not None else None
+    panel_prec = pol.panel if pol is not None else "highest"
+    trailing = pol.split_trailing() if pol is not None else None
+    # block_size=None must resolve EXACTLY as the serving tier resolves
+    # it (engine._plan_key: min(SERVE_DEFAULT_BLOCK, n)) — otherwise the
+    # baseline candidate times a program serving never runs, and a
+    # None-block winner would replay as a never-measured program.
+    nb = plan.block_size if plan.block_size is not None \
+        else SERVE_DEFAULT_BLOCK
+    if kind == "serve_lstsq":
+        refine = pol.refine if pol is not None else 0
+        # Same None-when-unsplit resolution the serve config performs,
+        # so the timed program's static args match the served ones.
+        apply_prec = (None if pol is None
+                      or pol.resolved_apply() == pol.panel
+                      else pol.resolved_apply())
+
+        def runner(A, b):
+            w = min(nb or A.shape[2], A.shape[2])
+            return _batched_lstsq_impl(A, b, w, precision=panel_prec,
+                                       trailing_precision=trailing,
+                                       apply_precision=apply_prec,
+                                       refine=refine,
+                                       panel_impl=plan.panel_impl)
+    else:
+        def runner(A):
+            w = min(nb or A.shape[2], A.shape[2])
+            return jax.vmap(
+                lambda a: _blocked._blocked_qr_impl(
+                    a, w, precision=panel_prec,
+                    trailing_precision=trailing,
+                    panel_impl=plan.panel_impl)
+            )(A)
+        runner = jax.jit(runner)
+    return runner
+
+
+def _problem(kind: str, m: int, n: int, dtype, seed: int):
+    """Deterministic tune inputs for ``kind`` at (m, n)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+
+    def draw(shape):
+        a = rng.standard_normal(shape)
+        if not _is_real(dtype):
+            a = a + 1j * rng.standard_normal(shape)
+        return jnp.asarray(a.astype(np.dtype(dtype)))
+
+    if kind == "qr":
+        return (draw((m, n)),)
+    if kind == "lstsq":
+        return draw((m, n)), draw((m,))
+    if kind == "serve_qr":
+        return (draw((TUNE_SERVE_BATCH, m, n)),)
+    return draw((TUNE_SERVE_BATCH, m, n)), draw((TUNE_SERVE_BATCH, m))
+
+
+def _measure_wall(plan: Plan, runner: Callable, args, repeats: int) -> float:
+    """Min wall seconds over ``repeats`` timed calls (after the
+    warmup/compile call), fenced with the shared value-dependent sync.
+    ``plan`` rides along for signature parity with injected stubs (a
+    test stub keys its fixed timings on it)."""
+    from dhqr_tpu.utils.profiling import sync
+
+    sync(runner(*args))  # compile + warm
+    best = float("inf")
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        sync(runner(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _verify(kind: str, out, args, baseline_err: "float | None"):
+    """(ok, err) accuracy gate for one candidate's warmup output.
+
+    lstsq kinds: normal-equations residual within 8x the LAPACK oracle
+    (the reference acceptance rule, per batch row for serve). qr kinds:
+    factor backward error within 8x the default plan's own (passed as
+    ``baseline_err``; the default itself gates only on finiteness).
+    """
+    import numpy as np
+
+    from dhqr_tpu.utils.testing import (
+        TOLERANCE_FACTOR,
+        normal_equations_residual,
+        oracle_residual,
+    )
+
+    if kind in ("lstsq", "serve_lstsq"):
+        if kind == "lstsq":
+            rows = [(args[0], args[1], out)]
+        else:
+            rows = [(args[0][i], args[1][i], out[i])
+                    for i in range(args[0].shape[0])]
+        worst = 0.0
+        for A, b, x in rows:
+            if not np.all(np.isfinite(np.asarray(x))):
+                return False, float("inf")
+            res = normal_equations_residual(A, np.asarray(x), b)
+            ref = oracle_residual(np.asarray(A), np.asarray(b))
+            ratio = res / ref if ref > 0 else float(res > 0)
+            worst = max(worst, ratio)
+            if res > TOLERANCE_FACTOR * ref:
+                return False, worst
+        return True, worst
+    # qr kinds: reassemble QR and compare to A.
+    H, alpha = out
+    Hn, an = np.asarray(H), np.asarray(alpha)
+    if not (np.all(np.isfinite(Hn)) and np.all(np.isfinite(an))):
+        return False, float("inf")
+    if Hn.ndim == 3:  # serve_qr: gate on the first stacked problem
+        Hn, an, A = Hn[0], an[0], np.asarray(args[0][0])
+    else:
+        A = np.asarray(args[0])
+    n = Hn.shape[1]
+    R = np.triu(Hn[:n, :n], 1) + np.diag(an)
+    # Cheap backward-error proxy that needs no packed-Q apply:
+    # ||A^H A - R^H R|| / ||A^H A|| — Q-orthogonality makes the two Gram
+    # matrices equal, so a broken or precision-degraded R (the
+    # plan-sensitive output) shows up here at f64 working precision.
+    gram_a = np.matmul(A.conj().T, A)  # dhqr: ignore[DHQR002] host-side f64 numpy oracle, no MXU involved
+    gram_r = np.matmul(R.conj().T, R)  # dhqr: ignore[DHQR002] host-side f64 numpy oracle, no MXU involved
+    gram_err = np.linalg.norm(gram_a - gram_r) / max(
+        np.linalg.norm(gram_a), 1e-30)
+    if baseline_err is None:
+        # No measured baseline yet (this IS the default candidate, or
+        # the default failed to run): gate on an absolute bar instead of
+        # passing unconditionally — 8x the max(m,n)*eps healthy-QR level
+        # (the rank() tolerance convention). A broken R sits at O(1).
+        eps = float(np.finfo(R.dtype).eps)
+        bar = 8.0 * max(A.shape) * eps
+        return gram_err <= max(bar, 1e-6), float(gram_err)
+    return gram_err <= max(8.0 * baseline_err, 1e-5), float(gram_err)
+
+
+def tune(kind: str, m: int, n: int, dtype="float32", *,
+         mesh=None, policy=None, db: "PlanDB | None" = None,
+         budget: "int | None" = None, repeats: "int | None" = None,
+         measure: "Callable | None" = None, seed: int = 0,
+         save: bool = True) -> TuneResult:
+    """Time the candidate grid for one key; record + persist the winner.
+
+    ``measure(plan, runner, args, repeats) -> seconds`` is injectable
+    (tests use a deterministic stub keyed on ``plan``; stubbed searches
+    skip the accuracy gate, which needs real outputs). ``save=False``
+    records in memory only.
+    """
+    import numpy as np
+
+    from dhqr_tpu.precision import resolve_policy
+    from dhqr_tpu.utils.config import TuneConfig
+
+    tcfg = TuneConfig.from_env()
+    budget = tcfg.budget if budget is None else budget
+    repeats = tcfg.repeats if repeats is None else repeats
+    pol = resolve_policy(policy) if policy is not None else None
+    nproc = 1
+    if mesh is not None:
+        nproc = int(np.prod(list(mesh.shape.values())))
+    key = plan_key(kind, m, n, dtype, nproc=nproc, policy_tag=policy_tag(pol))
+    candidates = candidate_plans(kind, m, n, dtype, nproc=nproc, policy=pol,
+                                 budget=budget)
+    stubbed = measure is not None
+    timer = measure or _measure_wall
+    args = None if stubbed else _problem(kind, m, n, dtype, seed)
+    rows: "list[Measurement]" = []
+    baseline_err = None
+    baseline_seconds = None
+    for plan in candidates:
+        runner = _build_runner(kind, plan, policy, mesh)
+        try:
+            if not stubbed:
+                out = runner(*args)
+                ok, err = _verify(kind, out, args, baseline_err)
+                if plan == DEFAULT_PLAN and kind in ("qr", "serve_qr"):
+                    baseline_err = err
+                if not ok:
+                    rows.append(Measurement(plan, None, residual=err,
+                                            reason="accuracy"))
+                    continue
+            else:
+                err = None
+            seconds = timer(plan, runner, args, repeats)
+            rows.append(Measurement(plan, float(seconds), residual=err))
+        except Exception as e:  # a candidate that cannot run is skipped,
+            rows.append(Measurement(  # never fatal to the search
+                plan, None, reason=f"{type(e).__name__}: {e}"))
+        if plan == DEFAULT_PLAN and rows and rows[-1].seconds is not None:
+            baseline_seconds = rows[-1].seconds
+    timed = [r for r in rows if r.seconds is not None]
+    if not timed:
+        raise RuntimeError(
+            f"tune({key}): no candidate survived "
+            f"({[(r.plan.describe(), r.reason) for r in rows]})"
+        )
+    if baseline_seconds is None:
+        # Default plan failed to time (rare — e.g. stub raising): the
+        # speedup is meaningless, so anchor at the winner (speedup 1).
+        baseline_seconds = min(r.seconds for r in timed)
+    winner = min(timed, key=lambda r: (r.seconds, candidates.index(r.plan)))
+    if db is None:
+        db = default_db()
+    db.record(
+        key, winner.plan,
+        seconds=round(winner.seconds, 6),
+        baseline_seconds=round(baseline_seconds, 6),
+        speedup=round(baseline_seconds / winner.seconds, 4),
+        candidates=len(candidates),
+        source="stub" if stubbed else "measured",
+    )
+    if save and db.path:
+        db.save()
+    return TuneResult(key=key, plan=winner.plan, seconds=winner.seconds,
+                      baseline_seconds=baseline_seconds,
+                      measurements=tuple(rows))
+
+
+def resolve_plan(kind: str, m: int, n: int, dtype="float32", *,
+                 nproc: int = 1, mesh=None, policy=None,
+                 db: "PlanDB | None" = None,
+                 on_miss: "str | None" = None,
+                 **tune_kwargs) -> "Plan | None":
+    """The ``plan="auto"`` resolution: DB hit -> stored plan; miss ->
+    tune now (``on_miss="tune"``) or None (``on_miss="default"``, the
+    caller keeps its static knobs). ``nproc`` is inferred from ``mesh``
+    when one is passed."""
+    import numpy as np
+
+    from dhqr_tpu.precision import resolve_policy
+    from dhqr_tpu.utils.config import TuneConfig
+
+    pol = resolve_policy(policy) if policy is not None else None
+    if mesh is not None:
+        nproc = int(np.prod(list(mesh.shape.values())))
+    if db is None:
+        db = default_db()
+    key = plan_key(kind, m, n, dtype, nproc=nproc, policy_tag=policy_tag(pol))
+    hit = db.get(key)
+    if hit is not None:
+        return hit
+    if on_miss is None:
+        on_miss = TuneConfig.from_env().on_miss
+    if on_miss == "default":
+        return None
+    return tune(kind, m, n, dtype, mesh=mesh, policy=policy, db=db,
+                **tune_kwargs).plan

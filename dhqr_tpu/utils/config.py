@@ -130,6 +130,19 @@ class DHQRConfig:
         edge — the NaN boundary itself is unchanged); rejected for tsqr
         (its tree never materializes a reusable factorization —
         refactoring per step would double its cost).
+      plan: execution-plan selection (the dhqr-tune autotuner,
+        ``dhqr_tpu.tune``). None or "default" = the classic static
+        knobs; "auto" = resolve the measured-best plan for this
+        (shape, dtype, mesh, policy) key from the plan database (tuning
+        on a miss per ``TuneConfig.on_miss``); a
+        :class:`dhqr_tpu.tune.Plan` = apply exactly that plan. A plan
+        names the engine-selection knobs (``engine``, ``block_size``,
+        ``panel_impl``, ``trailing_precision``, ``lookahead``,
+        ``agg_panels``) at once, so it is mutually exclusive with
+        setting any of them explicitly. Accuracy knobs (``precision``,
+        ``norm``, ``refine``, ``policy``) stay the caller's: plans are
+        keyed UNDER the policy and never change the error bar on their
+        own.
     """
 
     block_size: "int | None" = None
@@ -147,6 +160,7 @@ class DHQRConfig:
     agg_panels: "int | None" = None
     apply_precision: "str | None" = None
     policy: object = None
+    plan: object = None
 
     @staticmethod
     def from_env(**overrides) -> "DHQRConfig":
@@ -187,6 +201,13 @@ class DHQRConfig:
         if "DHQR_POLICY" in os.environ:
             raw = os.environ["DHQR_POLICY"].strip()
             env["policy"] = raw or None
+        if "DHQR_TUNE_PLAN" in os.environ:
+            raw = os.environ["DHQR_TUNE_PLAN"].strip().lower()
+            if raw not in ("", "auto", "default"):
+                raise ValueError(
+                    f"DHQR_TUNE_PLAN must be 'auto' or 'default', got {raw!r}"
+                )
+            env["plan"] = raw or None
         env.update(overrides)
         return DHQRConfig(**env)
 
@@ -251,3 +272,73 @@ class ServeConfig:
             env["cache_size"] = int(os.environ["DHQR_SERVE_CACHE_SIZE"])
         env.update(overrides)
         return ServeConfig(**env)
+
+
+@dataclasses.dataclass(frozen=True)
+class TuneConfig:
+    """Knobs for the dhqr-tune autotuner (``dhqr_tpu.tune``), all
+    overridable from ``DHQR_TUNE_*`` environment variables.
+
+    These shape the SEARCH (candidate budget, timing repeats) and the
+    persistence (database path, shipped seeds), not the numerics — a
+    tuned plan only ever names the engine-selection knobs
+    (:class:`dhqr_tpu.tune.Plan`).
+
+    Attributes:
+      db_path: writable plan-database file (``DHQR_TUNE_DB``). Loaded
+        tolerantly (corrupt/stale files degrade to "no stored plans"
+        with a one-time warning) and written merge-atomically
+        (last-write-wins across concurrent tuners).
+      use_seeds: layer the packaged ``tune/default_plans.json`` (the
+        committed r1–r8 CPU/TPU ladder measurements) under the local DB
+        (``DHQR_TUNE_SEEDS``, default on). Local entries always shadow.
+      budget: maximum candidates one ``tune()`` call measures
+        (``DHQR_TUNE_BUDGET``); the pruned grid is truncated
+        deterministically (defaults-first ordering), never sampled.
+      repeats: timed repetitions per candidate after the warmup/compile
+        call (``DHQR_TUNE_REPEATS``); the minimum is kept.
+      on_miss: what ``plan="auto"`` does when the database has no entry
+        for the key — "tune" (measure now, record, persist; the default)
+        or "default" (fall back to the static plan without measuring —
+        the mode for latency-sensitive paths like bench stages, where a
+        surprise grid search mid-measurement is worse than a static
+        plan). ``DHQR_TUNE_ON_MISS``.
+    """
+
+    db_path: str = os.path.join("~", ".cache", "dhqr_tpu", "plans.json")
+    use_seeds: bool = True
+    budget: int = 16
+    repeats: int = 3
+    on_miss: str = "tune"
+
+    def __post_init__(self):
+        # expanduser here (not in the default) so an env-provided "~/x"
+        # path expands identically to the built-in default.
+        object.__setattr__(self, "db_path",
+                           os.path.expanduser(self.db_path))
+        if self.budget < 1:
+            raise ValueError(f"budget must be >= 1, got {self.budget}")
+        if self.repeats < 1:
+            raise ValueError(f"repeats must be >= 1, got {self.repeats}")
+        if self.on_miss not in ("tune", "default"):
+            raise ValueError(
+                f"on_miss must be 'tune' or 'default', got {self.on_miss!r}"
+            )
+
+    @staticmethod
+    def from_env(**overrides) -> "TuneConfig":
+        """Build a tune config from ``DHQR_TUNE_*`` variables + overrides."""
+        env = {}
+        if "DHQR_TUNE_DB" in os.environ:
+            env["db_path"] = os.environ["DHQR_TUNE_DB"]
+        if "DHQR_TUNE_SEEDS" in os.environ:
+            env["use_seeds"] = os.environ["DHQR_TUNE_SEEDS"].strip().lower() \
+                not in ("0", "false", "no", "off", "n", "")
+        if "DHQR_TUNE_BUDGET" in os.environ:
+            env["budget"] = int(os.environ["DHQR_TUNE_BUDGET"])
+        if "DHQR_TUNE_REPEATS" in os.environ:
+            env["repeats"] = int(os.environ["DHQR_TUNE_REPEATS"])
+        if "DHQR_TUNE_ON_MISS" in os.environ:
+            env["on_miss"] = os.environ["DHQR_TUNE_ON_MISS"].strip().lower()
+        env.update(overrides)
+        return TuneConfig(**env)

@@ -1,0 +1,516 @@
+"""dhqr-tune: plans, the persistent plan database, the pruned search,
+and the plan="auto" threading through lstsq/qr/serve (round 9).
+
+Timing-dependent behavior is tested through an injected deterministic
+measure stub (no compiles, no wall-clock flakiness); the few end-to-end
+searches run on deliberately tiny grids.
+"""
+
+import json
+import os
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import dhqr_tpu
+from dhqr_tpu.tune import (
+    DEFAULT_PLAN,
+    Plan,
+    PlanDB,
+    SEED_PATH,
+    apply_plan_to_config,
+    candidate_plans,
+    plan_key,
+    policy_tag,
+    resolve_plan,
+    reset_default_db,
+    tune,
+)
+from dhqr_tpu.utils.config import DHQRConfig, TuneConfig
+from dhqr_tpu.utils.testing import (
+    TOLERANCE_FACTOR,
+    normal_equations_residual,
+    oracle_residual,
+    random_problem,
+)
+
+
+# ---------------------------------------------------------------- plans
+def test_plan_roundtrip():
+    p = Plan(block_size=64, panel_impl="recursive",
+             trailing_precision="high", lookahead=True, agg_panels=2)
+    assert Plan.from_dict(p.to_dict()) == p
+    assert Plan.from_dict(DEFAULT_PLAN.to_dict()) == DEFAULT_PLAN
+
+
+def test_plan_from_dict_rejects_unknown_fields():
+    d = DEFAULT_PLAN.to_dict()
+    d["use_pallas"] = "always"
+    with pytest.raises(ValueError, match="unknown plan fields"):
+        Plan.from_dict(d)
+
+
+@pytest.mark.parametrize("kwargs", [
+    dict(engine="cholqr3"),
+    dict(engine="nope"),
+    dict(block_size=0),
+    dict(panel_impl="fused"),
+    dict(trailing_precision="bf16"),
+    dict(agg_panels=1),
+    # alt engines carry block_size only
+    dict(engine="tsqr", panel_impl="recursive"),
+    dict(engine="cholqr2", trailing_precision="high"),
+    dict(engine="tsqr", lookahead=True),
+])
+def test_plan_validation(kwargs):
+    with pytest.raises(ValueError):
+        Plan(**kwargs)
+
+
+def test_plan_key_and_policy_tag():
+    key = plan_key("lstsq", 512, 64, "float32", platform="cpu")
+    assert key == "cpu:lstsq:512x64:float32:p1:-"
+    pol = dhqr_tpu.PRECISION_POLICIES["fast"]
+    assert policy_tag(pol) == "highest/default/-/r1"
+    assert policy_tag(None) == "-"
+    assert "highest/default/-/r1" in plan_key(
+        "qr", 8, 8, jnp.float32, policy_tag=policy_tag(pol), platform="cpu")
+
+
+# ------------------------------------------------------------- database
+def test_db_roundtrip(tmp_path):
+    path = str(tmp_path / "plans.json")
+    db = PlanDB(path)
+    key = plan_key("lstsq", 256, 32, "float32", platform="cpu")
+    db.record(key, Plan(engine="cholqr2"), speedup=2.5, source="test")
+    db.save()
+    reloaded = PlanDB(path)
+    assert reloaded.get(key) == Plan(engine="cholqr2")
+    assert reloaded.get_entry(key)["speedup"] == 2.5
+    assert reloaded.get("cpu:lstsq:1x1:float32:p1:-") is None
+
+
+def test_db_corrupt_file_degrades_with_one_warning(tmp_path):
+    path = str(tmp_path / "corrupt.json")
+    with open(path, "w") as fh:
+        fh.write("{ not json !!!")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        db = PlanDB(path)
+        db2 = PlanDB(path)  # second load of the same path: no re-warning
+    assert len(db) == 0 and len(db2) == 0
+    msgs = [x for x in w if "plan DB" in str(x.message)]
+    assert len(msgs) == 1, [str(x.message) for x in msgs]
+    # a corrupt file is still writable-over (save replaces it atomically)
+    key = plan_key("qr", 64, 16, "float32", platform="cpu")
+    db.record(key, Plan(block_size=16))
+    db.save()
+    assert PlanDB(path).get(key) == Plan(block_size=16)
+
+
+def test_db_stale_version_degrades(tmp_path):
+    path = str(tmp_path / "stale.json")
+    with open(path, "w") as fh:
+        json.dump({"schema": "dhqr-plan-db", "version": 999,
+                   "plans": {"cpu:qr:8x8:float32:p1:-":
+                             {"plan": DEFAULT_PLAN.to_dict()}}}, fh)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        db = PlanDB(path)
+    assert len(db) == 0
+    assert any("version" in str(x.message) for x in w)
+
+
+def test_db_foreign_schema_degrades(tmp_path):
+    path = str(tmp_path / "foreign.json")
+    with open(path, "w") as fh:
+        json.dump({"whatever": 1}, fh)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert len(PlanDB(path)) == 0
+    assert any("schema" in str(x.message) for x in w)
+
+
+def test_db_malformed_entry_dropped_others_kept(tmp_path):
+    path = str(tmp_path / "mixed.json")
+    good_key = plan_key("lstsq", 128, 16, "float32", platform="cpu")
+    with open(path, "w") as fh:
+        json.dump({"schema": "dhqr-plan-db", "version": 1, "plans": {
+            good_key: {"plan": Plan(engine="tsqr").to_dict()},
+            "cpu:bad:1": {"plan": {"engine": "warp-drive"}},
+            "cpu:bad:2": ["not", "a", "dict"],
+        }}, fh)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        db = PlanDB(path)
+    assert db.get(good_key) == Plan(engine="tsqr")
+    assert len(db) == 1
+    assert sum("malformed entry" in str(x.message) for x in w) == 2
+
+
+def test_db_concurrent_writers_merge_last_write_wins(tmp_path):
+    path = str(tmp_path / "plans.json")
+    shared = plan_key("lstsq", 512, 64, "float32", platform="cpu")
+    only1 = plan_key("lstsq", 128, 8, "float32", platform="cpu")
+    only2 = plan_key("qr", 256, 64, "float32", platform="cpu")
+    db1 = PlanDB(path)
+    db2 = PlanDB(path)  # opened before db1 writes: knows nothing of it
+    db1.record(shared, Plan(block_size=32))
+    db1.record(only1, Plan(engine="cholqr2"))
+    db1.save()
+    db2.record(shared, Plan(block_size=128))
+    db2.record(only2, Plan(block_size=64))
+    db2.save()
+    final = PlanDB(path)
+    # union of keys; the later writer wins the contended one
+    assert final.get(shared) == Plan(block_size=128)
+    assert final.get(only1) == Plan(engine="cholqr2")
+    assert final.get(only2) == Plan(block_size=64)
+
+
+def test_db_record_rejects_what_load_would_drop(tmp_path):
+    db = PlanDB(str(tmp_path / "p.json"))
+    with pytest.raises(ValueError):
+        db.record("k", "not-a-plan")
+
+
+def test_shipped_seed_db_loads_clean():
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any load warning fails the test
+        seeds = PlanDB(seed_path=SEED_PATH)
+    keys = seeds.keys()
+    assert keys, "shipped default_plans.json is empty"
+    for key in keys:
+        plan = seeds.get(key)
+        assert isinstance(plan, Plan), key
+    # the committed r8 serve ladder measurement is machine-usable
+    seeded = seeds.get("cpu:serve_lstsq:384x128:float32:p1:-")
+    assert seeded == Plan(block_size=32)
+
+
+def test_seed_db_entry_shadowed_by_local(tmp_path):
+    path = str(tmp_path / "local.json")
+    db = PlanDB(path, seed_path=SEED_PATH)
+    key = "cpu:serve_lstsq:384x128:float32:p1:-"
+    assert db.get(key) == Plan(block_size=32)  # from seeds
+    db.record(key, Plan(block_size=64))
+    assert db.get(key) == Plan(block_size=64)  # local shadows
+
+
+# ------------------------------------------------- candidate grid pruning
+def test_candidates_deterministic_and_default_first():
+    a = candidate_plans("lstsq", 2048, 64, platform="cpu")
+    b = candidate_plans("lstsq", 2048, 64, platform="cpu")
+    assert a == b
+    assert a[0] == DEFAULT_PLAN
+
+
+def test_candidates_aspect_gates():
+    tall = candidate_plans("lstsq", 4096, 64, platform="cpu")
+    engines = {p.engine for p in tall}
+    assert {"tsqr", "cholqr2"} <= engines
+    mid = candidate_plans("lstsq", 1024, 64, platform="cpu")  # aspect 16
+    assert "cholqr2" in {p.engine for p in mid}
+    assert "tsqr" not in {p.engine for p in mid}
+    square = candidate_plans("lstsq", 256, 256, platform="cpu")
+    assert {p.engine for p in square} == {"householder"}
+
+
+def test_candidates_policy_prunes_alt_engines_and_trailing():
+    pol = dhqr_tpu.PRECISION_POLICIES["fast"]
+    cands = candidate_plans("lstsq", 4096, 64, policy=pol, platform="tpu")
+    assert {p.engine for p in cands} == {"householder"}
+    assert all(p.trailing_precision is None for p in cands)
+    # without a policy, TPU grids do include the trailing split
+    cands = candidate_plans("lstsq", 4096, 64, platform="tpu")
+    assert any(p.trailing_precision == "high" for p in cands)
+
+
+def test_candidates_cpu_never_splits_trailing():
+    cands = candidate_plans("lstsq", 4096, 64, platform="cpu")
+    assert all(p.trailing_precision is None for p in cands)
+
+
+def test_candidates_qr_and_serve_never_route_engines():
+    for kind in ("qr", "serve_qr", "serve_lstsq"):
+        cands = candidate_plans(kind, 4096, 64, platform="cpu")
+        assert {p.engine for p in cands} == {"householder"}, kind
+
+
+def test_candidates_mesh_levers_gated_on_nproc():
+    one = candidate_plans("lstsq", 1024, 256, nproc=1, platform="cpu")
+    assert not any(p.lookahead or p.agg_panels for p in one)
+    eight = candidate_plans("lstsq", 1024, 256, nproc=8, platform="cpu")
+    assert any(p.lookahead for p in eight)
+    assert any(p.agg_panels for p in eight)
+    assert any(p.agg_panels and p.lookahead for p in eight)
+
+
+def test_candidates_budget_truncates_from_the_end():
+    full = candidate_plans("lstsq", 1024, 256, platform="cpu")
+    cut = candidate_plans("lstsq", 1024, 256, platform="cpu", budget=4)
+    assert cut == full[:4]
+
+
+def test_candidates_reconstruct_real_only():
+    real = candidate_plans("lstsq", 512, 128, platform="cpu")
+    cplx = candidate_plans("lstsq", 512, 128, dtype="complex64",
+                           platform="cpu")
+    assert any(p.panel_impl == "reconstruct" for p in real)
+    assert not any(p.panel_impl == "reconstruct" for p in cplx)
+
+
+# ------------------------------------------------------- stubbed search
+def _stub_timer(table, default=1.0):
+    """measure(plan, runner, args, repeats) returning fixed seconds."""
+    def measure(plan, runner, args, repeats):
+        return table.get(plan, default)
+    return measure
+
+
+def test_tune_stub_deterministic_winner(tmp_path):
+    db = PlanDB(str(tmp_path / "p.json"))
+    fast = Plan(engine="cholqr2")
+    timer = _stub_timer({fast: 0.125, DEFAULT_PLAN: 1.0})
+    results = [tune("lstsq", 4096, 64, db=db, measure=timer)
+               for _ in range(3)]
+    assert all(r.plan == fast for r in results)
+    assert results[0].speedup == pytest.approx(8.0)
+    entry = db.get_entry(results[0].key)
+    assert entry["source"] == "stub"
+    assert entry["speedup"] == pytest.approx(8.0, rel=1e-3)
+    # persisted across a reload
+    assert PlanDB(str(tmp_path / "p.json")).get(results[0].key) == fast
+
+
+def test_tune_stub_tie_breaks_by_candidate_order(tmp_path):
+    db = PlanDB(str(tmp_path / "p.json"))
+    timer = _stub_timer({}, default=0.5)  # all candidates identical
+    res = tune("lstsq", 4096, 64, db=db, measure=timer)
+    assert res.plan == DEFAULT_PLAN  # candidate 0 wins ties
+
+
+def test_tune_stub_candidate_exception_skipped(tmp_path):
+    db = PlanDB(str(tmp_path / "p.json"))
+    boom = Plan(engine="tsqr")
+
+    def measure(plan, runner, args, repeats):
+        if plan == boom:
+            raise RuntimeError("no device")
+        return 1.0 if plan == DEFAULT_PLAN else 2.0
+
+    res = tune("lstsq", 4096, 64, db=db, measure=measure)
+    assert res.plan == DEFAULT_PLAN
+    skipped = [m for m in res.measurements if m.seconds is None]
+    assert any(m.plan == boom and "no device" in m.reason for m in skipped)
+
+
+def test_resolve_plan_hit_miss_modes(tmp_path):
+    db = PlanDB(str(tmp_path / "p.json"))
+    # miss + on_miss="default" -> None, nothing recorded
+    assert resolve_plan("lstsq", 333, 11, db=db, on_miss="default") is None
+    assert len(db) == 0
+    # miss + on_miss="tune" -> tunes (stub) and records
+    timer = _stub_timer({Plan(engine="cholqr2"): 0.1})
+    p = resolve_plan("lstsq", 4096, 64, db=db, on_miss="tune",
+                     measure=timer)
+    assert p == Plan(engine="cholqr2")
+    # now a hit, no re-tune (a raising stub would fail otherwise)
+    def bomb(plan, runner, args, repeats):
+        raise AssertionError("re-tuned a DB hit")
+    assert resolve_plan("lstsq", 4096, 64, db=db, measure=bomb) == p
+
+
+# ------------------------------------------------ real (tiny) searches
+@pytest.fixture
+def tune_env(tmp_path, monkeypatch):
+    """Point the process-default DB at a temp file with a tiny budget."""
+    monkeypatch.setenv("DHQR_TUNE_DB", str(tmp_path / "plans.json"))
+    monkeypatch.setenv("DHQR_TUNE_SEEDS", "0")
+    monkeypatch.setenv("DHQR_TUNE_BUDGET", "6")
+    monkeypatch.setenv("DHQR_TUNE_REPEATS", "1")
+    reset_default_db()
+    yield tmp_path
+    reset_default_db()
+
+
+def test_lstsq_plan_auto_end_to_end(tune_env):
+    A, b = random_problem(192, 12, jnp.float32, seed=3)
+    x = dhqr_tpu.lstsq(A, b, plan="auto")
+    res = normal_equations_residual(A, np.asarray(x), b)
+    ref = oracle_residual(np.asarray(A), np.asarray(b))
+    assert res <= TOLERANCE_FACTOR * ref
+    # the tune persisted: a second resolution is a pure DB hit
+    stored = resolve_plan("lstsq", 192, 12, on_miss="default")
+    assert stored is not None
+    # warm repeat matches exactly (same plan -> same compiled program)
+    x2 = dhqr_tpu.lstsq(A, b, plan="auto")
+    np.testing.assert_array_equal(np.asarray(x), np.asarray(x2))
+
+
+def test_tall_skinny_routes_to_alt_engine(tune_env):
+    # aspect 2048/32 = 64: both alt engines are candidates, and on CPU
+    # the all-GEMM / tree paths beat the 32-column panel loop by integer
+    # factors — the measured winner must leave the householder family.
+    # (Large enough that real work, not dispatch overhead, decides.)
+    res = tune("lstsq", 2048, 32, repeats=2)
+    assert res.plan.engine in ("tsqr", "cholqr2"), res.plan
+    assert res.speedup >= 1.0
+
+
+def test_qr_plan_auto_records_and_applies(tune_env):
+    A, _ = random_problem(128, 32, jnp.float32, seed=5)
+    fact = dhqr_tpu.qr(A, plan="auto")
+    stored = resolve_plan("qr", 128, 32, on_miss="default")
+    assert stored is not None
+    assert stored.engine == "householder"
+    if stored.block_size is not None:
+        assert fact.block_size == stored.block_size
+    # the factorization is a real one
+    QR = np.asarray(fact.q_columns()) @ np.asarray(fact.r_matrix())
+    np.testing.assert_allclose(QR, np.asarray(A), atol=1e-3)
+
+
+def test_verify_gate_rejects_inaccurate_output():
+    # The accuracy gate itself: a candidate whose output misses the 8x
+    # LAPACK criterion is disqualified no matter how fast it ran.
+    from dhqr_tpu.tune.search import _verify
+
+    A, b = random_problem(96, 8, jnp.float32, seed=7)
+    good = jnp.asarray(np.linalg.lstsq(np.asarray(A, np.float64),
+                                       np.asarray(b, np.float64),
+                                       rcond=None)[0], jnp.float32)
+    ok, ratio = _verify("lstsq", good, (A, b), None)
+    assert ok and ratio <= TOLERANCE_FACTOR
+    bad = jnp.zeros_like(good)  # "instant" but wrong
+    ok, _ = _verify("lstsq", bad, (A, b), None)
+    assert not ok
+    nan = jnp.full_like(good, jnp.nan)
+    ok, _ = _verify("lstsq", nan, (A, b), None)
+    assert not ok
+
+
+def test_tune_measurements_record_residual_gate(tune_env):
+    # Every real-timed lstsq candidate carries its verified ratio <= 8x.
+    res = tune("lstsq", 128, 8, repeats=1,
+               db=PlanDB(str(tune_env / "gate.json")))
+    timed = [m for m in res.measurements if m.seconds is not None]
+    assert timed
+    for meas in timed:
+        assert meas.residual is not None
+        assert meas.residual <= TOLERANCE_FACTOR
+
+
+# --------------------------------------------------- config & exclusivity
+def test_plan_exclusive_with_engine_knobs():
+    A, b = random_problem(64, 16, jnp.float32, seed=0)
+    for kw in (dict(block_size=32), dict(engine="cholqr2"),
+               dict(panel_impl="recursive"), dict(lookahead=True),
+               dict(agg_panels=2), dict(use_pallas="never")):
+        with pytest.raises(ValueError, match="pass either plan="):
+            dhqr_tpu.lstsq(A, b, plan=Plan(), **kw)
+    with pytest.raises(ValueError, match="plan must be"):
+        dhqr_tpu.lstsq(A, b, plan="fastest")
+
+
+def test_plan_trailing_conflicts_with_policy():
+    A, b = random_problem(64, 16, jnp.float32, seed=0)
+    with pytest.raises(ValueError, match="trailing_precision"):
+        dhqr_tpu.lstsq(A, b, plan=Plan(trailing_precision="high"),
+                       policy="fast")
+
+
+def test_apply_plan_policy_trailing_wins():
+    cfg = DHQRConfig(trailing_precision="default")
+    out = apply_plan_to_config(cfg, Plan(block_size=64,
+                                         trailing_precision="high"))
+    assert out.trailing_precision == "default"
+    assert out.block_size == 64
+    assert out.plan is None
+
+
+def test_plan_default_spelling_is_noop():
+    A, b = random_problem(64, 16, jnp.float32, seed=0)
+    x0 = dhqr_tpu.lstsq(A, b)
+    x1 = dhqr_tpu.lstsq(A, b, plan="default")
+    np.testing.assert_array_equal(np.asarray(x0), np.asarray(x1))
+
+
+def test_tune_config_from_env(monkeypatch):
+    monkeypatch.setenv("DHQR_TUNE_DB", "/tmp/x.json")
+    monkeypatch.setenv("DHQR_TUNE_BUDGET", "9")
+    monkeypatch.setenv("DHQR_TUNE_REPEATS", "2")
+    monkeypatch.setenv("DHQR_TUNE_ON_MISS", "default")
+    monkeypatch.setenv("DHQR_TUNE_SEEDS", "0")
+    cfg = TuneConfig.from_env()
+    assert cfg.db_path == "/tmp/x.json"
+    assert (cfg.budget, cfg.repeats, cfg.on_miss, cfg.use_seeds) == \
+        (9, 2, "default", False)
+    with pytest.raises(ValueError):
+        TuneConfig(on_miss="maybe")
+    with pytest.raises(ValueError):
+        TuneConfig(budget=0)
+
+
+def test_dhqr_config_plan_from_env(monkeypatch):
+    monkeypatch.setenv("DHQR_TUNE_PLAN", "auto")
+    assert DHQRConfig.from_env().plan == "auto"
+    monkeypatch.setenv("DHQR_TUNE_PLAN", "default")
+    assert DHQRConfig.from_env().plan == "default"
+    monkeypatch.setenv("DHQR_TUNE_PLAN", "fastest")
+    with pytest.raises(ValueError):
+        DHQRConfig.from_env()
+
+
+# ---------------------------------------------------------------- serve
+def test_serve_prewarm_plan_auto_zero_recompile_dispatch(tune_env):
+    from dhqr_tpu.serve import batched_lstsq, prewarm
+    from dhqr_tpu.serve.cache import ExecutableCache
+
+    cache = ExecutableCache(max_size=16)
+    keys = prewarm([(3, 60, 12)], kind="lstsq", plan="auto", cache=cache)
+    assert keys
+    # the tuned nb landed in the cache key (and in the DB)
+    stored = resolve_plan("serve_lstsq", keys[0].m, keys[0].n,
+                          on_miss="default")
+    assert stored is not None
+    if stored.block_size is not None:
+        assert keys[0].block_size == min(stored.block_size, keys[0].n)
+    rng = np.random.default_rng(0)
+    As = [jnp.asarray(rng.random((60, 12)), jnp.float32)
+          for _ in range(3)]
+    bs = [jnp.asarray(rng.random(60), jnp.float32) for _ in As]
+    before = cache.stats()["misses"]
+    xs = batched_lstsq(As, bs, plan="auto", cache=cache)
+    assert cache.stats()["misses"] == before, "tuned dispatch recompiled"
+    for A, b, x in zip(As, bs, xs):
+        res = normal_equations_residual(A, np.asarray(x), b)
+        ref = oracle_residual(np.asarray(A), np.asarray(b))
+        assert res <= TOLERANCE_FACTOR * ref
+
+
+def test_serve_plan_exclusive_with_block_size(tune_env):
+    from dhqr_tpu.serve import batched_lstsq
+
+    A = jnp.ones((16, 4), jnp.float32)
+    b = jnp.ones((16,), jnp.float32)
+    with pytest.raises(ValueError, match="pass either plan="):
+        batched_lstsq([A], [b], plan=Plan(), block_size=8)
+
+
+def test_serve_plan_rejects_alt_engines_and_levers(tune_env):
+    from dhqr_tpu.serve import batched_lstsq
+
+    A = jnp.ones((16, 4), jnp.float32)
+    b = jnp.ones((16,), jnp.float32)
+    with pytest.raises(ValueError, match="serve plans carry"):
+        batched_lstsq([A], [b], plan=Plan(engine="cholqr2"))
+
+
+def test_bucket_program_rejects_plan():
+    from dhqr_tpu.serve.engine import bucket_program
+
+    with pytest.raises(ValueError, match="resolved knobs"):
+        bucket_program("lstsq", plan="auto")
