@@ -183,6 +183,29 @@ def run(n_devices: int) -> None:
           f"{tres.speedup:.2f}x vs static default, residual within 8x, "
           "warm repeat 0 recompiles)", flush=True)
 
+    # Comms-contract audit (dhqr-audit, analysis/comms_pass): the same
+    # multi-device virtual CPU topology the dry run already runs under is
+    # exactly what the audit needs, so a collective-shaped regression
+    # (an accidental gather, a lost donation alias, a cache-key
+    # instability) fails the dry run before any TPU session sees it.
+    # One mesh size and one preset keep the stage inside the dryrun
+    # window; the full P x preset matrix runs in tools/lint.sh.
+    if n_devices >= 2:
+        from dhqr_tpu.analysis.comms_pass import run_comms_pass
+
+        comms_findings = run_comms_pass(presets=["fast"],
+                                        device_counts=(2,))
+        assert not comms_findings, "comms audit findings:\n" + "\n".join(
+            f.render() for f in comms_findings)
+        print("dryrun: comms audit ok (contracts green at P=2, "
+              "donation aliasing verified)", flush=True)
+    else:
+        # A 1-device mesh is the pass's documented blind spot (a gather
+        # of the trailing matrix is volume-indistinguishable at P=1) —
+        # say so rather than print a false green.
+        print("dryrun: comms audit SKIPPED (needs >= 2 devices; "
+              "run tools/lint.sh for the audited gate)", flush=True)
+
     # TSQR wants a genuinely tall problem: local row blocks must stay tall
     nt = 8
     mt = 2 * nt * n_devices

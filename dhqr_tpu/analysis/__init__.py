@@ -13,6 +13,13 @@ invariants"):
   the sharded engines under a 1-device mesh) and sanitizes the jaxpr:
   no f64 intermediates from f32 inputs, no host callbacks, every
   collective's axis name resolvable against the mesh (DHQR101-DHQR104).
+* **Pass 3 (comms, "dhqr-audit")** — :mod:`dhqr_tpu.analysis.comms_pass`
+  forces multi-device CPU topologies (P ∈ {2, 4, 8}), traces every
+  sharded engine, and enforces the committed per-engine communication
+  contracts (``comms_contracts.json`` + the analytic budgets in
+  :mod:`dhqr_tpu.analysis.cost_model`): collective families, byte
+  volume, replicated-intermediate bounds, donation aliasing, and
+  trace-stability (DHQR301-DHQR305).
 
 Plus an API-consistency check (DHQR201/DHQR202): everything in
 ``dhqr_tpu.__all__`` imports cleanly and is documented in docs/DESIGN.md.
@@ -27,6 +34,7 @@ so a new violation fails the suite.
 from dhqr_tpu.analysis.findings import (
     Finding,
     load_baseline,
+    prune_baseline,
     write_baseline,
 )
 from dhqr_tpu.analysis.ast_rules import (
@@ -41,5 +49,6 @@ __all__ = [
     "scan_paths",
     "scan_source",
     "load_baseline",
+    "prune_baseline",
     "write_baseline",
 ]

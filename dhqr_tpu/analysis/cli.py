@@ -1,10 +1,16 @@
 """dhqr-lint CLI: ``python -m dhqr_tpu.analysis check [paths] ...``.
 
 Exit status 0 iff no unsuppressed, un-baselined findings. The AST pass
-runs on every named path; the jaxpr sanitizer and the API-consistency
-check run whenever the dhqr_tpu package itself is among the scan targets
-(they validate the package, not arbitrary files), unless disabled with
-``--no-jaxpr`` / ``--no-api``.
+runs on every named path; the jaxpr sanitizer, the API-consistency
+check, and the multi-device comms-contract audit (dhqr-audit,
+``analysis/comms_pass.py``) run whenever the dhqr_tpu package itself is
+among the scan targets (they validate the package, not arbitrary
+files), unless disabled with ``--no-jaxpr`` / ``--no-api`` /
+``--no-comms``. ``comms`` is the audit alone (the subprocess vehicle
+``check`` uses when the backend initialized before the multi-device CPU
+topology could be forced). ``--list-rules`` prints the full DHQR rule
+catalogue so the docs table cannot drift from the code
+(tests/test_analysis.py asserts parity with docs/DESIGN.md).
 """
 
 from __future__ import annotations
@@ -32,14 +38,67 @@ def _scans_package(paths) -> bool:
     return False
 
 
+def rule_catalogue() -> "list[tuple[str, str, str]]":
+    """(rule id, one-line summary, pass) for every DHQR rule — THE
+    registry ``--list-rules`` prints and the docs-parity test checks, so
+    a rule cannot ship without a catalogue row."""
+    from dhqr_tpu.analysis.ast_rules import AST_RULES
+
+    rows = [("DHQR000", "source file failed to parse (syntax error)",
+             "ast")]
+    rows += [(r.id, r.title, "ast") for r in AST_RULES]
+    rows += [
+        ("DHQR101", "f64/c128 intermediate traced from f32 inputs",
+         "jaxpr"),
+        ("DHQR102", "host callback primitive in a traced program",
+         "jaxpr"),
+        ("DHQR103", "collective axis name unresolvable against the mesh",
+         "jaxpr"),
+        ("DHQR104", "entry point failed to trace under a policy preset",
+         "jaxpr"),
+        ("DHQR201", "__all__ export does not import cleanly", "api"),
+        ("DHQR202", "public name undocumented in docs/DESIGN.md", "api"),
+        ("DHQR301", "collective family outside the engine's comms "
+         "contract", "comms"),
+        ("DHQR302", "traced collective volume exceeds the analytic "
+         "budget", "comms"),
+        ("DHQR303", "shard_map intermediate exceeds the per-shard "
+         "working set", "comms"),
+        ("DHQR304", "donated entry point compiled without input-output "
+         "aliasing", "comms"),
+        ("DHQR305", "jaxpr differs across two traces of one cache key",
+         "comms"),
+    ]
+    return rows
+
+
+def _force_multidevice_env(count: int) -> None:
+    """Arm the multi-device CPU topology the comms audit traces under.
+    XLA_FLAGS is only read at first backend init, so setting it here —
+    before any device touch — makes the in-process path work; if some
+    caller already initialized the backend narrower, the audit falls
+    back to a subprocess (comms_pass.run_comms_pass_auto)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        # dhqr: ignore[DHQR003] lint CLI entry owns its process: the comms audit needs a multi-device CPU topology and XLA_FLAGS is read exactly once, at backend init
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={count}"
+        ).strip()
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m dhqr_tpu.analysis",
-        description="dhqr-lint: AST + jaxpr static analysis enforcing the "
-        "framework's TPU/JAX discipline (docs/DESIGN.md 'Static "
-        "invariants').",
+        description="dhqr-lint: AST + jaxpr + comms-contract static "
+        "analysis enforcing the framework's TPU/JAX discipline "
+        "(docs/DESIGN.md 'Static invariants' and 'Comms contracts').",
     )
-    sub = parser.add_subparsers(dest="command", required=True)
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the full DHQR rule catalogue (ID, summary, pass) "
+        "and exit",
+    )
+    sub = parser.add_subparsers(dest="command")
     check = sub.add_parser("check", help="run the lint passes")
     check.add_argument(
         "paths", nargs="*", default=None,
@@ -57,21 +116,95 @@ def main(argv=None) -> int:
         help="write the current unsuppressed findings as a new baseline "
         "and exit 0 (docs/OPERATIONS.md: regenerating the baseline)",
     )
+    check.add_argument(
+        "--prune-baseline", action="store_true",
+        help="rewrite --baseline dropping fingerprints that no longer "
+        "match any current finding, then gate against the pruned file",
+    )
     check.add_argument("--no-jaxpr", action="store_true",
                        help="skip the jaxpr sanitizer pass")
     check.add_argument("--no-api", action="store_true",
                        help="skip the public-API consistency check")
+    check.add_argument("--no-comms", action="store_true",
+                       help="skip the multi-device comms-contract audit")
     check.add_argument(
         "--preset", action="append", default=None,
-        help="restrict the jaxpr pass to these policy presets "
+        help="restrict the jaxpr/comms passes to these policy presets "
         "(repeatable; default: all)",
     )
+    check.add_argument(
+        "--devices", action="append", type=int, default=None,
+        metavar="P",
+        help="comms-audit mesh sizes (repeatable; default: 2 4 8)",
+    )
+    check.add_argument(
+        "--contracts", default=None, metavar="FILE",
+        help="comms-contract file (default: the committed "
+        "analysis/comms_contracts.json)",
+    )
+    comms = sub.add_parser(
+        "comms",
+        help="run only the comms-contract audit (dhqr-audit) — also the "
+        "subprocess vehicle `check` uses when the jax backend "
+        "initialized before the multi-device topology could be forced",
+    )
+    comms.add_argument("--json", action="store_true",
+                       help="emit findings as JSON")
+    comms.add_argument("--preset", action="append", default=None)
+    comms.add_argument("--devices", action="append", type=int,
+                       default=None, metavar="P")
+    comms.add_argument("--contracts", default=None, metavar="FILE")
     args = parser.parse_args(argv)
 
+    if args.list_rules:
+        for rule, summary, pass_name in rule_catalogue():
+            print(f"{rule}  {pass_name:<5}  {summary}")
+        return 0
+    if not args.command:
+        parser.error("a command is required (check, comms) "
+                     "unless --list-rules is given")
+
+    from dhqr_tpu.analysis.comms_pass import DEFAULT_DEVICE_COUNTS
+
+    device_counts = tuple(args.devices) if args.devices \
+        else DEFAULT_DEVICE_COUNTS
+
+    if args.command == "comms":
+        _force_multidevice_env(max(device_counts))
+        from dhqr_tpu.analysis.comms_pass import (
+            InsufficientDevices,
+            run_comms_pass,
+        )
+
+        try:
+            findings = run_comms_pass(presets=args.preset,
+                                      device_counts=device_counts,
+                                      contracts_path=args.contracts)
+        except InsufficientDevices as e:
+            print(f"dhqr-audit: {e}", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps({"findings": [f.to_json() for f in findings]},
+                             indent=2))
+        else:
+            for f in findings:
+                print(f.render())
+            print(f"dhqr-audit: {len(findings)} finding(s)",
+                  file=sys.stderr)
+        return 1 if findings else 0
+
     from dhqr_tpu.analysis.ast_rules import scan_paths
-    from dhqr_tpu.analysis.findings import load_baseline, write_baseline
+    from dhqr_tpu.analysis.findings import (
+        load_baseline,
+        prune_baseline,
+        write_baseline,
+    )
 
     paths = args.paths or ["dhqr_tpu", "tests"]
+    if _scans_package(paths) and not args.no_comms:
+        # Before ANY jax device touch (the jaxpr pass initializes the
+        # backend), so the comms audit can run in-process.
+        _force_multidevice_env(max(device_counts))
     try:
         findings = scan_paths(paths)
     except FileNotFoundError as e:
@@ -86,12 +219,28 @@ def main(argv=None) -> int:
         from dhqr_tpu.analysis.api_check import check_api
 
         findings.extend(check_api())
+    if _scans_package(paths) and not args.no_comms:
+        from dhqr_tpu.analysis.comms_pass import run_comms_pass_auto
+
+        findings.extend(run_comms_pass_auto(presets=args.preset,
+                                            device_counts=device_counts,
+                                            contracts_path=args.contracts))
 
     if args.write_baseline:
         write_baseline(args.write_baseline, findings)
         print(f"baseline written: {args.write_baseline} "
               f"({sum(1 for f in findings if not f.suppressed)} findings)")
         return 0
+
+    if args.prune_baseline:
+        if not args.baseline:
+            print("dhqr-lint: --prune-baseline requires --baseline FILE",
+                  file=sys.stderr)
+            return 2
+        kept, removed = prune_baseline(args.baseline, findings)
+        print(f"dhqr-lint: baseline pruned — {removed} stale "
+              f"entr{'y' if removed == 1 else 'ies'} removed, "
+              f"{kept} kept", file=sys.stderr)
 
     baseline = dict(load_baseline(args.baseline)) if args.baseline else {}
     active, baselined = [], []

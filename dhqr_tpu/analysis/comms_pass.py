@@ -1,0 +1,621 @@
+"""Pass 3 (dhqr-audit) — multi-device communication-contract analyzer.
+
+The jaxpr sanitizer (pass 2) traces the sharded engines under a 1-device
+mesh, which is exactly where a collective-shaped regression is invisible:
+an accidental ``all_gather`` of the trailing matrix, a resharding slipped
+in by pjit, a donation that silently stopped aliasing — none of them
+change a 1-device program's correctness, all of them burn a TPU session.
+This pass forces a P-device CPU topology (P ∈ {2, 4, 8} by default),
+abstractly traces every sharded engine, walks every sub-jaxpr with loop
+trip counts carried as multipliers, and classifies every collective with
+its byte volume computed from avals. Per-engine **comms contracts**
+(``comms_contracts.json``, committed) pin what the papers say actually
+decides distributed dense-linear-algebra performance — collective choice
+and volume (arXiv:2112.09017, arXiv:2112.01075):
+
+* **DHQR301** — a collective family the engine's contract does not allow
+  (e.g. any ``all_to_all`` in blocked QR, any collective at all in the
+  batched serving dispatch).
+* **DHQR302** — traced collective volume exceeds the analytic budget
+  (:mod:`dhqr_tpu.analysis.cost_model`) by more than the contract's
+  slack factor — or a collective hides inside a ``while`` loop whose
+  trip count the walk cannot bound.
+* **DHQR303** — an intermediate aval inside a ``shard_map`` body larger
+  than the contract's multiple of the per-shard input working set: a
+  replicated/gathered blow-up the mesh exists to avoid.
+* **DHQR304** — a ``donate_argnums`` entry point whose compiled CPU
+  executable reports no input-output aliasing (the donation contract of
+  ``ops/blocked._blocked_qr_impl_donate`` / ``_batched_qr_impl_donate``).
+* **DHQR305** — a sharded entry point whose jaxpr differs across two
+  traces of the same (shape, dtype, P, policy) key: cache-key
+  instability that means recompiles in serving.
+
+Tracing is abstract (``make_jaxpr`` — nothing executes); only the two
+DHQR304 donation probes compile, on the CPU AOT path at toy shapes. The
+preset sweep runs at the smallest P (presets change precision attributes,
+not comms structure — topology regressions are caught by the P sweep,
+preset regressions by the preset sweep; the matrix of both would only
+re-trace identical programs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+
+from dhqr_tpu.analysis.findings import Finding
+from dhqr_tpu.analysis.jaxpr_pass import _ensure_cpu_backend, sub_jaxprs
+from dhqr_tpu.analysis.cost_model import budget_bytes
+
+DEFAULT_DEVICE_COUNTS = (2, 4, 8)
+
+# Data-moving collective primitives, classified by family name. axis_index
+# is deliberately absent (it names the mesh but moves no words — pass 2's
+# DHQR103 covers its axis discipline).
+COMMS_COLLECTIVES = (
+    "psum", "pmin", "pmax", "ppermute", "pshuffle", "all_gather",
+    "all_to_all", "reduce_scatter", "psum_scatter", "pbroadcast",
+)
+
+CONTRACTS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "comms_contracts.json")
+
+
+# ---------------------------------------------------------------------------
+# Collective census over a traced program
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveUse:
+    """One collective eqn: ``launches`` is the static launch count with
+    enclosing scan trip counts multiplied in; ``payload_bytes`` the byte
+    size of its output avals for ONE launch. ``bounded=False`` marks a
+    use under a ``while`` loop — its true launch count is unknowable, so
+    it participates in family classification (DHQR301) but is excluded
+    from every count/volume aggregate (the DHQR302 opacity finding
+    covers it; folding a trips-ignored guess into the totals would make
+    the traced-vs-budget number the triage runbook reads silently
+    wrong)."""
+
+    prim: str
+    launches: int
+    payload_bytes: int
+    bounded: bool = True
+
+    @property
+    def volume_bytes(self) -> int:
+        return self.launches * self.payload_bytes
+
+
+@dataclasses.dataclass
+class BodyStats:
+    """One ``shard_map`` body: per-shard input bytes vs the largest
+    intermediate aval produced inside it (sub-jaxprs included)."""
+
+    input_bytes: int
+    max_aval_bytes: int
+    max_aval_desc: str
+
+
+@dataclasses.dataclass
+class CommsStats:
+    """Census of one traced entry point."""
+
+    uses: "list[CollectiveUse]" = dataclasses.field(default_factory=list)
+    bodies: "list[BodyStats]" = dataclasses.field(default_factory=list)
+    opaque_loop_collectives: "list[str]" = dataclasses.field(
+        default_factory=list)
+
+    def launches(self) -> "dict[str, int]":
+        out: "dict[str, int]" = {}
+        for u in self.uses:
+            if u.bounded:
+                out[u.prim] = out.get(u.prim, 0) + u.launches
+        return out
+
+    def volume(self) -> "dict[str, int]":
+        out: "dict[str, int]" = {}
+        for u in self.uses:
+            if u.bounded:
+                out[u.prim] = out.get(u.prim, 0) + u.volume_bytes
+        return out
+
+    def total_volume_bytes(self) -> int:
+        return sum(u.volume_bytes for u in self.uses if u.bounded)
+
+    def families(self) -> "set[str]":
+        return {u.prim for u in self.uses}
+
+
+def _aval_bytes(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    size = 1
+    for d in shape:
+        size *= int(d)
+    return size * dtype.itemsize
+
+
+def collect_comms(closed_jaxpr) -> CommsStats:
+    """Walk a closed jaxpr (and every sub-jaxpr) collecting the
+    collective census. ``scan`` bodies multiply launch counts by the
+    scan's trip count; a collective under a ``while`` has no static trip
+    count and is recorded as opaque (DHQR302 material). ``shard_map``
+    bodies additionally record per-shard input bytes and the largest
+    intermediate aval (DHQR303 material)."""
+    stats = CommsStats()
+
+    def walk(jaxpr, mult, body, in_while):
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            out_bytes = sum(_aval_bytes(v.aval) for v in eqn.outvars
+                            if hasattr(v, "aval"))
+            if body is not None and out_bytes > body.max_aval_bytes:
+                body.max_aval_bytes = out_bytes
+                avals = [str(getattr(v, "aval", "?")) for v in eqn.outvars]
+                body.max_aval_desc = f"{prim} -> {', '.join(avals)}"
+            if prim in COMMS_COLLECTIVES:
+                if in_while:
+                    stats.opaque_loop_collectives.append(prim)
+                stats.uses.append(CollectiveUse(prim, mult, out_bytes,
+                                                bounded=not in_while))
+            sub_mult = mult
+            if prim == "scan":
+                sub_mult = mult * int(eqn.params.get("length", 1))
+            sub_while = in_while or prim == "while"
+            if prim == "shard_map":
+                inner = eqn.params.get("jaxpr")
+                for j in sub_jaxprs(inner):
+                    new_body = BodyStats(
+                        input_bytes=sum(_aval_bytes(v.aval)
+                                        for v in j.invars),
+                        max_aval_bytes=0, max_aval_desc="")
+                    stats.bodies.append(new_body)
+                    walk(j, sub_mult, new_body, sub_while)
+                continue
+            for val in eqn.params.values():
+                for j in sub_jaxprs(val):
+                    walk(j, sub_mult, body, sub_while)
+
+    walk(closed_jaxpr.jaxpr, 1, None, False)
+    return stats
+
+
+# ---------------------------------------------------------------------------
+# Contracts
+
+
+def load_contracts(path: "str | None" = None) -> dict:
+    """Load the committed per-engine comms contracts
+    (``analysis/comms_contracts.json`` by default)."""
+    with open(path or CONTRACTS_PATH, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return data["engines"]
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineParams:
+    """The engine-shape key the analytic budget is evaluated at."""
+
+    m: int
+    n: int
+    nb: int
+    P: int
+    itemsize: int = 4
+    nrhs: int = 1
+
+
+def check_comms(closed_jaxpr, label: str, contract: dict,
+                params: EngineParams) -> "list[Finding]":
+    """DHQR301/302/303 for one traced engine against its contract."""
+    stats = collect_comms(closed_jaxpr)
+    findings = []
+    allowed = set(contract.get("collectives", ()))
+    for prim in sorted(stats.families() - allowed):
+        findings.append(Finding(
+            "DHQR301", label, 0,
+            f"collective family '{prim}' is not in the engine's comms "
+            f"contract (allowed: {sorted(allowed) or 'none'}): a new "
+            "collective in a pinned-communication engine is a scaling "
+            "regression until the contract is re-derived",
+            snippet=prim,
+        ))
+    budget = budget_bytes(contract["model"], params.m, params.n, params.nb,
+                          params.P, params.itemsize, nrhs=params.nrhs)
+    slack = float(contract.get("slack", 1.5))
+    traced = stats.total_volume_bytes()
+    if traced > budget * slack:
+        findings.append(Finding(
+            "DHQR302", label, 0,
+            f"traced collective volume {traced} B exceeds the analytic "
+            f"budget {budget} B (model '{contract['model']}' at m="
+            f"{params.m}, n={params.n}, nb={params.nb}, P={params.P}) "
+            f"x slack {slack}: the engine moves more words than its "
+            "communication pattern is contracted to",
+            snippet="volume",
+        ))
+    for prim in sorted(set(stats.opaque_loop_collectives)):
+        findings.append(Finding(
+            "DHQR302", label, 0,
+            f"collective '{prim}' inside a while-loop: its trip count is "
+            "not statically boundable, so the volume budget cannot be "
+            "checked — use scan/unrolled schedules for collectives",
+            snippet=f"while:{prim}",
+        ))
+    factor = float(contract.get("replicated_factor", 1.75))
+    for body in stats.bodies:
+        if body.input_bytes and body.max_aval_bytes > factor * body.input_bytes:
+            findings.append(Finding(
+                "DHQR303", label, 0,
+                f"intermediate aval of {body.max_aval_bytes} B inside a "
+                f"shard_map body ({body.max_aval_desc}) exceeds "
+                f"{factor}x the per-shard input working set "
+                f"({body.input_bytes} B): a replicated/gathered blow-up "
+                "— the memory the mesh exists to shard",
+                snippet=f"aval:{body.max_aval_desc.split(' -> ')[0]}",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# DHQR304 — donation aliasing on the CPU AOT path
+
+_HLO_ALIAS_PAIR_RE = re.compile(
+    r"\{[\d,\s]*\}:\s*\((\d+),\s*\{[\d,\s]*\},\s*(may-alias|must-alias)\)")
+
+
+def input_output_aliases(compiled) -> "list[tuple[int, str]]":
+    """(parameter number, alias kind) pairs the compiled executable
+    reports. Prefers a native ``compiled.input_output_aliases`` accessor
+    where the jax version ships one; otherwise parses the optimized
+    HLO's ``input_output_alias={...}`` entry (present on the CPU AOT
+    path for donated-and-used buffers, absent when XLA dropped the
+    donation)."""
+    native = getattr(compiled, "input_output_aliases", None)
+    if native is not None:
+        return list(native)
+    try:
+        txt = compiled.as_text()
+    except Exception:
+        return []
+    idx = txt.find("input_output_alias=")
+    if idx < 0:
+        return []
+    # The alias map lives on the (single) HLO module header line; entries
+    # nest braces ({0}: (0, {}, may-alias)), so bound the scan by the
+    # line, not by a regex over the braces.
+    end = txt.find("\n", idx)
+    seg = txt[idx:end if end > 0 else len(txt)]
+    return [(int(p), kind) for p, kind in _HLO_ALIAS_PAIR_RE.findall(seg)]
+
+
+def _donation_entries():
+    """The package's donate=True dispatch units, with toy AOT shapes.
+    Each entry: (label, jitted fn, args). Both outputs are input-shaped
+    by construction, so a healthy compile MUST alias parameter 0."""
+    import jax
+    import jax.numpy as jnp
+
+    from dhqr_tpu.ops.blocked import (
+        _batched_qr_impl_donate,
+        _blocked_qr_impl_donate,
+    )
+
+    f32 = jnp.float32
+    yield ("ops/blocked._blocked_qr_impl_donate", _blocked_qr_impl_donate,
+           (jax.ShapeDtypeStruct((16, 8), f32), 4))
+    yield ("ops/blocked._batched_qr_impl_donate", _batched_qr_impl_donate,
+           (jax.ShapeDtypeStruct((2, 16, 8), f32), 4))
+
+
+def check_donation(entries=None) -> "list[Finding]":
+    """DHQR304: AOT-compile each donated entry point on CPU and require
+    the executable to report input-output aliasing. ``entries``
+    overrides the package list (tests plant a donation-less twin)."""
+    findings = []
+    for label, fn, args in (entries if entries is not None
+                            else _donation_entries()):
+        try:
+            compiled = fn.lower(*args).compile()
+        except Exception as e:
+            findings.append(Finding(
+                "DHQR304", label, 0,
+                f"donated entry point failed to AOT-compile on CPU: "
+                f"{type(e).__name__}: {e}",
+                snippet=label,
+            ))
+            continue
+        if not input_output_aliases(compiled):
+            findings.append(Finding(
+                "DHQR304", label, 0,
+                "compiled executable reports no input-output aliasing: "
+                "the donate_argnums contract silently stopped holding, so "
+                "every dispatch pays a full extra matrix buffer of HBM",
+                snippet=label,
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# The engine matrix
+
+
+def _column_shape(P: int) -> "tuple[int, int, int]":
+    """(m, n, nb) for the column-sharded engines at mesh size P: 4 panels
+    at P <= 4, n scaled so the panel width still divides the local block
+    at P = 8 (constraint: nb | n/P)."""
+    n = 16 if P <= 4 else 4 * P
+    return 2 * n, n, 4
+
+
+_ROW_M, _ROW_N, _ROW_NB = 256, 8, 8
+_BATCH_B, _BATCH_M, _BATCH_N, _BATCH_NB = 8, 16, 8, 4
+
+
+def _engine_specs(P: int, preset: str, pol, sweep_presets: bool):
+    """(engine, label, thunk, params) per traced entry point at mesh
+    size P. ``sweep_presets=False`` restricts to the preset-insensitive
+    census (presets change precision attributes, not comms structure —
+    see the module docstring); the policy-parameterized engines are
+    yielded only when sweeping."""
+    import jax
+    import jax.numpy as jnp
+
+    from dhqr_tpu.parallel.mesh import column_mesh
+    from dhqr_tpu.parallel.sharded_cholqr import sharded_cholqr_lstsq
+    from dhqr_tpu.parallel.sharded_qr import (
+        sharded_blocked_qr,
+        sharded_householder_qr,
+    )
+    from dhqr_tpu.parallel.sharded_solve import sharded_solve
+    from dhqr_tpu.parallel.sharded_tsqr import row_mesh, sharded_tsqr_lstsq
+
+    m, n, nb = _column_shape(P)
+    cmesh = column_mesh(P)
+    rmesh = row_mesh(P)
+    A = jnp.zeros((m, n), jnp.float32)
+    H = jnp.zeros((m, n), jnp.float32)
+    alpha = jnp.zeros((n,), jnp.float32)
+    b = jnp.zeros((m,), jnp.float32)
+    At = jnp.zeros((_ROW_M, _ROW_N), jnp.float32)
+    bt = jnp.zeros((_ROW_M,), jnp.float32)
+    col = EngineParams(m, n, nb, P)
+    row = EngineParams(_ROW_M, _ROW_N, _ROW_NB, P)
+
+    def jx(fn, *args):
+        return lambda: jax.make_jaxpr(fn)(*args)
+
+    tag = f"[P={P},{preset}]" if sweep_presets else f"[P={P}]"
+
+    if sweep_presets:
+        blocked_variants = (
+            ("blocked_qr", {}),
+            ("blocked_qr_cyclic", {"layout": "cyclic"}),
+            ("blocked_qr_lookahead", {"lookahead": True}),
+            ("blocked_qr_agg", {"agg_panels": 2}),
+            ("blocked_qr_agg_lookahead", {"agg_panels": 2,
+                                          "lookahead": True}),
+        )
+        for engine, kw in blocked_variants:
+            yield (engine, f"comms::{engine}{tag}",
+                   jx(lambda A, kw=kw: sharded_blocked_qr(
+                       A, cmesh, block_size=nb, policy=preset, **kw), A),
+                   col)
+        # The serving dispatch, traced with its batch axis sharded over
+        # the mesh: the contract is ZERO collectives — any psum/gather in
+        # the bucket program means the vmapped engine stopped being
+        # embarrassingly parallel over requests.
+        from jax.sharding import NamedSharding, PartitionSpec
+        from dhqr_tpu.parallel.mesh import DEFAULT_AXIS
+        from dhqr_tpu.serve.engine import bucket_program
+
+        As = jnp.zeros((_BATCH_B, _BATCH_M, _BATCH_N), jnp.float32)
+        bs = jnp.zeros((_BATCH_B, _BATCH_M), jnp.float32)
+        sh = NamedSharding(cmesh, PartitionSpec(DEFAULT_AXIS))
+        fn = bucket_program("lstsq", block_size=_BATCH_NB, policy=preset)
+        yield ("batched_lstsq", f"comms::batched_lstsq{tag}",
+               jx(jax.jit(fn, in_shardings=(sh, sh)), As, bs),
+               EngineParams(_BATCH_M, _BATCH_N, _BATCH_NB, P))
+        return
+
+    yield ("unblocked_qr", f"comms::unblocked_qr{tag}",
+           jx(lambda A: sharded_householder_qr(A, cmesh,
+                                               precision=pol.panel), A),
+           col)
+    yield ("sharded_solve", f"comms::sharded_solve{tag}",
+           jx(lambda H, a, b: sharded_solve(
+               H, a, b, cmesh, block_size=nb,
+               precision=pol.resolved_apply()), H, alpha, b),
+           col)
+    yield ("tsqr_lstsq", f"comms::tsqr_lstsq{tag}",
+           jx(lambda A, b: sharded_tsqr_lstsq(A, b, rmesh,
+                                              block_size=_ROW_NB,
+                                              precision=pol.panel), At, bt),
+           row)
+    yield ("cholqr_lstsq", f"comms::cholqr_lstsq{tag}",
+           jx(lambda A, b: sharded_cholqr_lstsq(A, b, rmesh,
+                                                precision=pol.panel),
+              At, bt),
+           row)
+
+
+def trace_engine(engine: str, P: int, preset: str = "accurate"):
+    """Trace one engine of the matrix and return its
+    ``(CommsStats, EngineParams)`` — the golden-assertion surface
+    (tests/test_comms.py)."""
+    _ensure_cpu_backend()
+    from dhqr_tpu.precision import PRECISION_POLICIES
+
+    pol = PRECISION_POLICIES[preset]
+    for sweep in (True, False):
+        for name, _label, thunk, params in _engine_specs(
+                P, preset, pol, sweep_presets=sweep):
+            if name == engine:
+                return collect_comms(thunk()), params
+    raise KeyError(f"unknown comms engine {engine!r}")
+
+
+class InsufficientDevices(RuntimeError):
+    """The forced CPU topology did not materialize (backend already
+    initialized with fewer devices) — rerun in a subprocess."""
+
+
+def run_comms_pass(presets=None, device_counts=DEFAULT_DEVICE_COUNTS,
+                   contracts_path=None, stability: bool = True,
+                   donation: bool = True) -> "list[Finding]":
+    """Run the full comms audit: the engine matrix at every mesh size in
+    ``device_counts`` (preset sweep at the smallest), DHQR304 donation
+    probes, and DHQR305 double-trace stability at the smallest P.
+
+    Requires ``max(device_counts)`` CPU devices — raise
+    :class:`InsufficientDevices` otherwise (the CLI falls back to a
+    subprocess with ``--xla_force_host_platform_device_count`` forced;
+    see ``run_comms_pass_auto``).
+    """
+    _ensure_cpu_backend()
+    import jax
+
+    from dhqr_tpu.precision import PRECISION_POLICIES
+
+    device_counts = tuple(sorted(set(int(p) for p in device_counts)))
+    if not device_counts:
+        raise ValueError("device_counts must name at least one mesh size")
+    navail = len(jax.devices())
+    if navail < max(device_counts):
+        raise InsufficientDevices(
+            f"comms pass needs {max(device_counts)} CPU devices, have "
+            f"{navail}: the backend initialized before the topology could "
+            "be forced (XLA_FLAGS is read once, at first backend init)"
+        )
+    names = list(presets) if presets is not None \
+        else list(PRECISION_POLICIES)
+    contracts = load_contracts(contracts_path)
+    findings: "list[Finding]" = []
+    if donation:
+        findings.extend(check_donation())
+
+    def run_specs(P, preset, pol, sweep):
+        for engine, label, thunk, params in _engine_specs(
+                P, preset, pol, sweep_presets=sweep):
+            contract = contracts.get(engine)
+            if contract is None:
+                findings.append(Finding(
+                    "DHQR301", label, 0,
+                    f"engine '{engine}' has no committed comms contract "
+                    "(analysis/comms_contracts.json): every sharded "
+                    "engine must pin its communication pattern",
+                    snippet=engine,
+                ))
+                continue
+            try:
+                closed = thunk()
+            except Exception as e:  # a trace failure IS the regression
+                findings.append(Finding(
+                    "DHQR104", label, 0,
+                    f"sharded entry point failed to trace: "
+                    f"{type(e).__name__}: {e}",
+                ))
+                continue
+            findings.extend(check_comms(closed, label, contract, params))
+            yield engine, label, thunk, closed
+
+    def check_stability(label, thunk, closed):
+        # The re-trace must not be able to crash the gate: a second
+        # trace that RAISES is exactly the nondeterministic-builder bug
+        # DHQR305 hunts, so it becomes a finding like any other.
+        try:
+            second = thunk()
+        except Exception as e:
+            findings.append(Finding(
+                "DHQR104", label, 0,
+                f"sharded entry point failed to RE-trace for the "
+                f"stability check: {type(e).__name__}: {e}",
+            ))
+            return
+        if str(second.jaxpr) != str(closed.jaxpr):
+            findings.append(_instability(label))
+
+    p_sweep = device_counts[0]
+    for P in device_counts:
+        # Preset-parameterized engines: full preset sweep at the smallest
+        # mesh, canonical preset at the larger ones.
+        sweep_names = names if P == p_sweep else names[:1]
+        for preset in sweep_names:
+            pol = PRECISION_POLICIES[preset]
+            for engine, label, thunk, closed in run_specs(
+                    P, preset, pol, sweep=True):
+                if stability and P == p_sweep and preset == names[0]:
+                    check_stability(label, thunk, closed)
+        pol = PRECISION_POLICIES[names[0]]
+        for engine, label, thunk, closed in run_specs(
+                P, names[0], pol, sweep=False):
+            if stability and P == p_sweep:
+                check_stability(label, thunk, closed)
+    return findings
+
+
+def _instability(label: str) -> Finding:
+    return Finding(
+        "DHQR305", label, 0,
+        "two traces of the same (shape, dtype, P, policy) key produced "
+        "different jaxprs: cache-key instability — in serving this is a "
+        "recompile per request",
+        snippet="jaxpr-instability",
+    )
+
+
+def run_comms_pass_auto(presets=None, device_counts=DEFAULT_DEVICE_COUNTS,
+                        contracts_path=None) -> "list[Finding]":
+    """In-process when the CPU topology is wide enough, else re-run the
+    pass in a subprocess with the topology forced via XLA_FLAGS (the
+    ``jax.config`` route cannot widen an already-initialized backend on
+    this jax) and parse its JSON findings."""
+    try:
+        return run_comms_pass(presets=presets, device_counts=device_counts,
+                              contracts_path=contracts_path)
+    except InsufficientDevices:
+        return _run_comms_subprocess(presets, device_counts, contracts_path)
+
+
+def _run_comms_subprocess(presets, device_counts, contracts_path):
+    import subprocess
+    import sys
+
+    import dhqr_tpu
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("DHQR_LINT_KEEP_PLATFORM", None)
+    flags = env.get("XLA_FLAGS", "")
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count="
+        f"{max(device_counts)}"
+    ).strip()
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(
+        dhqr_tpu.__file__)))
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [sys.executable, "-m", "dhqr_tpu.analysis", "comms", "--json"]
+    for p in (presets or ()):
+        cmd += ["--preset", p]
+    for d in device_counts:
+        cmd += ["--devices", str(d)]
+    if contracts_path:
+        cmd += ["--contracts", contracts_path]
+    proc = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                          timeout=600)
+    if proc.returncode not in (0, 1):
+        tail = (proc.stderr or "").strip().splitlines()[-5:]
+        return [Finding(
+            "DHQR104", "comms::subprocess", 0,
+            f"comms-pass subprocess failed (exit {proc.returncode}): "
+            + " | ".join(tail),
+        )]
+    data = json.loads(proc.stdout)
+    keys = {f.name for f in dataclasses.fields(Finding)}
+    return [Finding(**{k: v for k, v in entry.items() if k in keys})
+            for entry in data["findings"]]

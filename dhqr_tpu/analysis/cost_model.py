@@ -1,0 +1,89 @@
+"""Analytic communication budgets for the sharded engines (dhqr-audit).
+
+Each model returns the engine's *intended* per-device collective payload
+in words, parameterized exactly like the engines themselves (m, n, nb,
+P, nrhs). The formulas are the unrolled-schedule volumes — the schedule
+the comms pass traces — so at the pass's own shapes the traced volume
+matches the budget to the word for the exact engines, and the contract's
+slack factor only has to absorb the deliberate schedule variations
+(super-block row frames, lookahead's one-panel-taller psum, the
+aggregated gather's packed group). Anything past slack is a regression:
+an accidental ``all_gather`` of the trailing matrix traced at P=2 is
+~P·m·n/2 words per panel, orders of magnitude over any of these.
+
+The arguments mirror the papers' cost accounting: arXiv:2112.09017
+(TPU distributed linear algebra: collective volume, not flops, sets the
+scaling) and arXiv:2112.01075 (collective *choice* decides redistribution
+cost) — which is why the budget is a static contract and not a benchmark.
+
+Volume convention: a collective's payload is the byte size of its OUTPUT
+aval on one device (what the jaxpr walk can see) — for ``psum`` that is
+the reduced operand, for ``all_gather`` the gathered (P·local) result.
+"""
+
+from __future__ import annotations
+
+
+def unblocked_qr_words(m: int, n: int, nb: int, P: int, nrhs: int = 1) -> int:
+    """One m-word column psum per column (the reference's per-column
+    reflector broadcast, src:141-143): n·m words."""
+    return n * m
+
+
+def blocked_qr_words(m: int, n: int, nb: int, P: int, nrhs: int = 1) -> int:
+    """One psum per nb-wide panel of the shrinking (m-k, nb) factored
+    panel plus its nb-word alpha block (sharded_qr._blocked_shard_body,
+    unrolled schedule)."""
+    return sum((m - k) * nb + nb for k in range(0, n, nb))
+
+
+def sharded_solve_words(m: int, n: int, nb: int, P: int, nrhs: int = 1) -> int:
+    """Q^H apply: one shrinking (m-k, nb) panel psum per panel; panel
+    back-substitution: one packed (n, nrhs) psum per panel
+    (sharded_solve._apply_qt_shard_body / _backsub_shard_body)."""
+    apply_qt = sum((m - k) * nb for k in range(0, n, nb))
+    backsub = (n // nb) * n * nrhs
+    return apply_qt + backsub
+
+
+def tsqr_lstsq_words(m: int, n: int, nb: int, P: int, nrhs: int = 1) -> int:
+    """Exactly one all_gather of the (n, n) R heads and the (n, nrhs)
+    reduced rhs: P·n·(n + nrhs) words gathered per device
+    (sharded_tsqr._tsqr_shard_body)."""
+    return P * n * (n + nrhs)
+
+
+def cholqr_lstsq_words(m: int, n: int, nb: int, P: int, nrhs: int = 1) -> int:
+    """One (n, n) Gram psum per CholeskyQR2 pass plus one (n, nrhs) psum
+    for Q^H b (sharded_cholqr._cholqr_shard_body, shift=False)."""
+    return 2 * n * n + n * nrhs
+
+
+def no_comms_words(m: int, n: int, nb: int, P: int, nrhs: int = 1) -> int:
+    """Engines contracted to run collective-free (the batched serving
+    dispatch): any traced collective volume at all is a regression."""
+    return 0
+
+
+MODELS = {
+    "unblocked_qr": unblocked_qr_words,
+    "blocked_qr": blocked_qr_words,
+    "sharded_solve": sharded_solve_words,
+    "tsqr_lstsq": tsqr_lstsq_words,
+    "cholqr_lstsq": cholqr_lstsq_words,
+    "none": no_comms_words,
+}
+
+
+def budget_bytes(model: str, m: int, n: int, nb: int, P: int,
+                 itemsize: int, nrhs: int = 1) -> int:
+    """Analytic per-device collective budget in bytes for ``model``
+    (a key of :data:`MODELS`) at the given engine parameters."""
+    try:
+        fn = MODELS[model]
+    except KeyError:
+        raise KeyError(
+            f"unknown comms cost model {model!r} (have {sorted(MODELS)}); "
+            "comms_contracts.json names a model this version does not ship"
+        ) from None
+    return fn(m, n, nb, P, nrhs=nrhs) * itemsize

@@ -107,6 +107,36 @@ def load_baseline(path) -> "dict[str, int]":
         entry["fingerprint"] for entry in data.get("findings", []))
 
 
+def prune_baseline(path, findings) -> "tuple[int, int]":
+    """Rewrite the baseline at ``path`` keeping only entries whose
+    fingerprint still matches a current finding — multiset-aware, like
+    :func:`load_baseline`: N accepted occurrences survive only while N
+    current findings still match. Returns ``(kept, removed)`` so the CLI
+    can report how many stale entries were dropped. The file's own
+    structure (comment, per-entry rule/path/snippet context) is
+    preserved for the surviving entries."""
+    import collections
+
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    current = collections.Counter(
+        f.fingerprint() for f in findings if not f.suppressed)
+    kept, removed = [], 0
+    for entry in data.get("findings", []):
+        fp = entry.get("fingerprint")
+        if current.get(fp, 0) > 0:
+            current[fp] -= 1
+            kept.append(entry)
+        else:
+            removed += 1
+    if removed:
+        data["findings"] = kept
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(data, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+    return len(kept), removed
+
+
 def write_baseline(path, findings) -> None:
     """Write the unsuppressed findings as the new accepted baseline."""
     payload = {

@@ -36,6 +36,13 @@ from dhqr_tpu.analysis.findings import Finding
 # exercise the blocked/panelled paths (two 4-wide panels per 8 columns).
 _M, _N, _NB = 16, 8, 4
 
+# One genuinely tall-skinny case: m/n = 8 is past the autotuner's
+# cholqr2 gate (tune/search.py: cholqr2 at m/n >= 8) and the serve
+# bucketing's tall regime, so the cholqr2/tsqr plan routes from round 9
+# are traced at an aspect ratio that actually selects them — the m/n = 2
+# default shape never would.
+_M_TALL, _N_TALL = 64, 8
+
 _F64_DTYPES = ("float64", "complex128")
 
 
@@ -56,22 +63,26 @@ def _ensure_cpu_backend() -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
-def iter_jaxprs(jaxpr):
-    """Yield ``jaxpr`` and every sub-jaxpr reachable through eqn params."""
+def sub_jaxprs(val):
+    """Yield every (open) jaxpr held by one eqn-param value — a
+    ClosedJaxpr/Jaxpr, or any list/tuple/dict nesting of them. Shared
+    with the comms pass (comms_pass.collect_comms)."""
     from jax import core
 
-    def subs(val):
-        if isinstance(val, core.ClosedJaxpr):
-            yield val.jaxpr
-        elif isinstance(val, core.Jaxpr):
-            yield val
-        elif isinstance(val, (list, tuple)):
-            for v in val:
-                yield from subs(v)
-        elif isinstance(val, dict):
-            for v in val.values():
-                yield from subs(v)
+    if isinstance(val, core.ClosedJaxpr):
+        yield val.jaxpr
+    elif isinstance(val, core.Jaxpr):
+        yield val
+    elif isinstance(val, (list, tuple)):
+        for v in val:
+            yield from sub_jaxprs(v)
+    elif isinstance(val, dict):
+        for v in val.values():
+            yield from sub_jaxprs(v)
 
+
+def iter_jaxprs(jaxpr):
+    """Yield ``jaxpr`` and every sub-jaxpr reachable through eqn params."""
     stack = [jaxpr]
     seen = set()
     while stack:
@@ -82,7 +93,7 @@ def iter_jaxprs(jaxpr):
         yield j
         for eqn in j.eqns:
             for val in eqn.params.values():
-                stack.extend(subs(val))
+                stack.extend(sub_jaxprs(val))
 
 
 def _collect_axis_names(params) -> "set[str]":
@@ -194,9 +205,12 @@ def _entry_points(preset: str, pol):
                policy=preset), A, b), ())
     if preset == "accurate":
         # Alt-engine plan routing is policy-free by pruning rule 5 —
-        # trace it once, on the tall problem the gates admit.
-        At = jnp.zeros((64 * _N, _N), jnp.float32)
-        bt = jnp.zeros((64 * _N,), jnp.float32)
+        # trace it once, on the tall-skinny problem whose aspect ratio
+        # the plan gates actually select (see _M_TALL above).
+        At = jnp.zeros((_M_TALL, _N_TALL), jnp.float32)
+        bt = jnp.zeros((_M_TALL,), jnp.float32)
+        yield ("lstsq_tall",
+               jx(lambda A, b: dhqr_tpu.lstsq(A, b), At, bt), ())
         yield ("lstsq_plan_tsqr",
                jx(lambda A, b: dhqr_tpu.lstsq(
                    A, b, plan=Plan(engine="tsqr")), At, bt), ())

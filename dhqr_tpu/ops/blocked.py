@@ -993,3 +993,10 @@ def blocked_apply_q(
     """b <- Q b using the compact-WY form, panels in reverse order."""
     del alpha
     return _apply_q_impl(H, b, int(block_size), precision=precision)
+
+
+# Donation contract (dhqr-audit DHQR304): _blocked_qr_impl_donate and
+# _batched_qr_impl_donate must AOT-compile with input-output aliasing
+# (the packed H is input-shaped by construction) — checked statically on
+# the CPU path by analysis/comms_pass.check_donation, and dynamically by
+# the buffer-pointer pin in tests/test_serve.py.

@@ -83,3 +83,8 @@ def sharded_cholqr_lstsq(
     A = jax.device_put(A, NamedSharding(mesh, P(axis_name, None)))
     b = jax.device_put(b, NamedSharding(mesh, P(axis_name)))
     return _build_cholqr(mesh, axis_name, precision, bool(shift))(A, b)
+
+
+# Comms contract (dhqr-audit): psum only, 2*n^2 + n*nrhs words per
+# solve (analysis/cost_model.py `cholqr_lstsq`) — the m-independence IS
+# the engine's value, so a volume regression here is a DHQR302 finding.

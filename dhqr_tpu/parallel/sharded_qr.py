@@ -934,3 +934,15 @@ def _check_divisibility(m, n, nproc, nb, layout="block"):
             f"panel width {nb} wider than local block {nloc}: lower block_size "
             f"to <= {nloc} so each panel has a single owner"
         )
+
+
+# Comms contract (pinned by dhqr-audit, analysis/comms_pass.py +
+# analysis/comms_contracts.json; appended here rather than in the module
+# docstring so existing line numbers — and with them the persistent
+# compile cache's HLO-metadata keys — stay stable): psum is the ONLY
+# collective family either engine may launch — one per column
+# (unblocked) or per panel/group (blocked), volume bounded by the
+# panel-broadcast budget in analysis/cost_model.py. A gather of the
+# trailing matrix, an all_to_all from a layout change, or a replicated
+# intermediate past the per-shard working set fails tools/lint.sh
+# (DHQR301/302/303) before it can burn a TPU session.

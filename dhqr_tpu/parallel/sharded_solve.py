@@ -370,3 +370,8 @@ def sharded_lstsq(
         layout=layout, _H_in_store_layout=True,
     )
     return x[:n]
+
+
+# Comms contract (dhqr-audit): psum only — one shrinking (m-k, nb)
+# panel psum per apply panel plus one packed (n, nrhs) psum per
+# back-substitution panel (analysis/cost_model.py `sharded_solve`).

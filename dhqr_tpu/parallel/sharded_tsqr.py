@@ -122,3 +122,9 @@ def sharded_tsqr_lstsq(
     with _pallas_cache_guard(interpret):
         return _build_tsqr(mesh, axis_name, n, nb, precision, pallas,
                            interpret, PALLAS_FLAT_WIDTH)(A, b)
+
+
+# Comms contract (dhqr-audit): exactly one all_gather pair per solve —
+# P*n*(n + nrhs) words, independent of m (analysis/cost_model.py
+# `tsqr_lstsq`); any psum/all_to_all here, or a second gather, is a
+# DHQR301/302 finding.

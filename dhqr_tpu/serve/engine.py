@@ -512,3 +512,10 @@ def prewarm(
                 keys.append(key)
                 cache.get_or_compile(key, partial(_lower_for_key, key))
     return keys
+
+
+# Comms contract (dhqr-audit): the bucket dispatch is contracted
+# COLLECTIVE-FREE — requests are embarrassingly parallel, so any psum
+# or gather appearing in bucket_program's trace under a sharded batch
+# axis is a DHQR301 finding, and the donated factor dispatch must keep
+# its input-output aliasing (DHQR304, analysis/comms_pass.check_donation).

@@ -1,0 +1,51 @@
+"""Planted comms regressions for tests/test_comms.py.
+
+``gathered_trailing_qr_jaxpr`` is the exact anti-pattern the comms pass
+(dhqr-audit, DHQR3xx) exists to catch: a blocked-QR-shaped engine that
+``all_gather``\\ s the FULL trailing matrix once per panel instead of
+psum-broadcasting the owner's nb-wide panel. Against the committed
+``blocked_qr`` contract it must trip
+
+* DHQR301 — ``all_gather`` is not in the engine's collective set,
+* DHQR302 — per-panel m x n words blow the panel-broadcast budget,
+* DHQR303 — the gathered (m, n) intermediate is P x the per-shard
+  working set.
+
+This module lives under tests/fixtures/ (excluded from the AST
+self-scan like every other fixture) and is imported by path, not by
+package name.
+"""
+
+from __future__ import annotations
+
+
+def gathered_trailing_qr_jaxpr(P: int, m: int = 32, n: int = 16,
+                               nb: int = 4):
+    """Trace the planted engine on a P-device column mesh and return its
+    closed jaxpr (abstract — nothing compiles or executes)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as Psp
+
+    from dhqr_tpu.parallel.mesh import DEFAULT_AXIS, column_mesh
+    from dhqr_tpu.utils.compat import shard_map
+
+    mesh = column_mesh(P)
+
+    def body(Al):
+        m_, nloc = Al.shape
+        for k in range(0, n, nb):
+            # THE regression: gather the whole trailing matrix to every
+            # device, every panel (the psum broadcast moves only the
+            # owner's (m - k, nb) panel).
+            Afull = lax.all_gather(Al, DEFAULT_AXIS, axis=1, tiled=True)
+            panel = lax.slice(Afull, (0, k), (m_, k + nb))
+            w = jnp.matmul(jnp.conj(panel.T), Al, precision="highest")
+            Al = Al - jnp.matmul(panel, w, precision="highest")
+        return Al
+
+    fn = shard_map(body, mesh=mesh, in_specs=Psp(None, DEFAULT_AXIS),
+                   out_specs=Psp(None, DEFAULT_AXIS), check_vma=False)
+    A = jnp.zeros((m, n), jnp.float32)
+    return jax.make_jaxpr(jax.jit(fn))(A)

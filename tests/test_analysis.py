@@ -316,3 +316,93 @@ def test_cache_guard_scope_is_thread_local():
             "another thread observed the guard window — the toggle went "
             "process-global")
     assert enable_compilation_cache.value == before_here  # restored
+
+
+# -- the rule catalogue and the docs it must not drift from -----------------
+
+def test_rule_catalogue_matches_design_doc():
+    """--list-rules prints the registry; docs/DESIGN.md must carry every
+    rule ID and name no rule the code does not ship — the two can only
+    move together."""
+    from dhqr_tpu.analysis.cli import rule_catalogue
+
+    rows = rule_catalogue()
+    ids = [r[0] for r in rows]
+    assert len(ids) == len(set(ids)), "duplicate rule IDs in catalogue"
+    assert all(summary for _, summary, _ in rows), (
+        "every rule needs a one-line summary")
+    with open(os.path.join(REPO, "docs", "DESIGN.md"),
+              encoding="utf-8") as fh:
+        design = fh.read()
+    import re
+
+    documented = set(re.findall(r"DHQR\d{3}", design))
+    missing = set(ids) - documented
+    assert not missing, f"rules undocumented in docs/DESIGN.md: {missing}"
+    phantom = documented - set(ids)
+    assert not phantom, (
+        f"docs/DESIGN.md names rules the code does not ship: {phantom}")
+
+
+def test_list_rules_cli(capsys):
+    from dhqr_tpu.analysis.cli import main, rule_catalogue
+
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule, _, pass_name in rule_catalogue():
+        assert rule in out and pass_name in out
+
+
+# -- baseline pruning (--prune-baseline) ------------------------------------
+
+def test_prune_baseline_drops_stale_entries(tmp_path, capsys):
+    """A baseline written against old findings loses exactly the entries
+    that no longer match, keeps the ones that still do, and the CLI
+    reports the count."""
+    from dhqr_tpu.analysis.cli import main
+
+    f = tmp_path / "mod.py"
+    f.write_text("import os\nos.environ['A'] = '1'\n"
+                 "os.environ['B'] = '1'\n")
+    baseline = tmp_path / "base.json"
+    assert main(["check", str(f), "--write-baseline", str(baseline)]) == 0
+    assert len(json.load(open(baseline))["findings"]) == 2
+    # The B mutation is fixed; its baseline entry is now stale.
+    f.write_text("import os\nos.environ['A'] = '1'\n")
+    capsys.readouterr()
+    rc = main(["check", str(f), "--baseline", str(baseline),
+               "--prune-baseline"])
+    assert rc == 0  # the surviving finding is still baselined
+    err = capsys.readouterr().err
+    assert "1 stale entry removed, 1 kept" in err
+    kept = json.load(open(baseline))["findings"]
+    assert len(kept) == 1 and "'A'" in kept[0]["snippet"]
+    # Idempotent: nothing further to prune.
+    assert main(["check", str(f), "--baseline", str(baseline),
+                 "--prune-baseline"]) == 0
+    assert "0 stale entries removed, 1 kept" in capsys.readouterr().err
+
+
+def test_prune_baseline_requires_baseline(capsys):
+    from dhqr_tpu.analysis.cli import main
+
+    bad = os.path.join(FIXTURES, "dhqr003_bad.py")
+    assert main(["check", bad, "--prune-baseline"]) == 2
+    assert "--baseline" in capsys.readouterr().err
+
+
+def test_prune_baseline_is_multiset_aware(tmp_path):
+    """Two identical violation lines share a fingerprint: with only one
+    still present, pruning keeps exactly ONE accepted occurrence."""
+    from dhqr_tpu.analysis.cli import main
+    from dhqr_tpu.analysis.findings import load_baseline
+
+    f = tmp_path / "mod.py"
+    f.write_text("import os\nos.environ['A'] = '1'\n"
+                 "os.environ['A'] = '1'\n")
+    baseline = tmp_path / "base.json"
+    assert main(["check", str(f), "--write-baseline", str(baseline)]) == 0
+    f.write_text("import os\nos.environ['A'] = '1'\n")
+    assert main(["check", str(f), "--baseline", str(baseline),
+                 "--prune-baseline"]) == 0
+    assert sum(load_baseline(baseline).values()) == 1

@@ -1,0 +1,229 @@
+"""dhqr-audit (the comms-contract pass, DHQR3xx): golden collective
+counts/volumes per sharded engine at P in {2, 4}, the committed-contract
+green gate, a planted trailing-matrix-gather regression that must trip
+DHQR301/302/303, and the donation-aliasing check (DHQR304) both ways.
+
+Runs under the conftest-forced 8-device virtual CPU platform, so every
+mesh size the pass audits is available in-process.
+"""
+
+import importlib.util
+import os
+
+import pytest
+
+from dhqr_tpu.analysis import cost_model
+from dhqr_tpu.analysis.comms_pass import (
+    EngineParams,
+    check_comms,
+    check_donation,
+    load_contracts,
+    run_comms_pass,
+    trace_engine,
+)
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "analysis")
+
+ITEM = 4  # float32
+
+
+def _fixture_module():
+    spec = importlib.util.spec_from_file_location(
+        "comms_regression", os.path.join(FIXTURES, "comms_regression.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -- golden counts and volumes (exact at the pass's unrolled shapes) --------
+
+@pytest.mark.parametrize("P", [2, 4])
+def test_blocked_qr_golden(P):
+    """One psum pair (panel + alpha) per nb-wide panel, volume exactly
+    the analytic panel-broadcast budget."""
+    stats, p = trace_engine("blocked_qr", P)
+    npanels = p.n // p.nb
+    assert stats.launches() == {"psum": 2 * npanels}
+    expected = sum((p.m - k) * p.nb + p.nb
+                   for k in range(0, p.n, p.nb)) * ITEM
+    assert stats.total_volume_bytes() == expected
+    assert stats.total_volume_bytes() == cost_model.budget_bytes(
+        "blocked_qr", p.m, p.n, p.nb, P, ITEM)
+
+
+@pytest.mark.parametrize("P", [2, 4])
+def test_unblocked_qr_golden(P):
+    """One m-word column psum per column — the reference's per-column
+    reflector broadcast, counted through the fori_loop's scan length."""
+    stats, p = trace_engine("unblocked_qr", P)
+    assert stats.launches() == {"psum": p.n}
+    assert stats.total_volume_bytes() == p.m * p.n * ITEM
+
+
+@pytest.mark.parametrize("P", [2, 4])
+def test_tsqr_golden(P):
+    """Exactly ONE all_gather pair (R heads + reduced rhs) regardless of
+    m — the communication-optimal regime the engine exists for."""
+    stats, p = trace_engine("tsqr_lstsq", P)
+    assert stats.launches() == {"all_gather": 2}
+    assert stats.total_volume_bytes() == P * p.n * (p.n + 1) * ITEM
+
+
+@pytest.mark.parametrize("P", [2, 4])
+def test_cholqr_golden(P):
+    """Three psums total: one n x n Gram per CholeskyQR2 pass plus one
+    for Q^H b."""
+    stats, p = trace_engine("cholqr_lstsq", P)
+    assert stats.launches() == {"psum": 3}
+    assert stats.total_volume_bytes() == (2 * p.n * p.n + p.n) * ITEM
+
+
+@pytest.mark.parametrize("P", [2, 4])
+def test_sharded_solve_golden(P):
+    """Q^H apply: one shrinking panel psum per panel; back-substitution:
+    one packed (n, 1) psum per panel."""
+    stats, p = trace_engine("sharded_solve", P)
+    npanels = p.n // p.nb
+    assert stats.launches() == {"psum": 2 * npanels}
+    expected = (sum((p.m - k) * p.nb for k in range(0, p.n, p.nb))
+                + npanels * p.n) * ITEM
+    assert stats.total_volume_bytes() == expected
+
+
+def test_batched_lstsq_collective_free():
+    """The serving dispatch traced with its batch axis sharded: zero
+    collectives — requests must stay embarrassingly parallel."""
+    stats, _ = trace_engine("batched_lstsq", 4, preset="fast")
+    assert stats.launches() == {}
+    assert stats.total_volume_bytes() == 0
+
+
+# -- the gate: every engine green against the committed contracts -----------
+
+def test_comms_pass_green_on_committed_contracts():
+    """THE acceptance invariant: the engine matrix produces zero
+    findings against the committed comms_contracts.json. One mesh size,
+    one preset, no donation probes (pinned by their own test below) and
+    no stability double-trace keep this inside the tier-1 wall-clock
+    budget; tools/lint.sh and the dryrun comms stage run the pass with
+    the DHQR305 double-trace on, and the full P in {2,4,8} x preset
+    sweep runs in tools/lint.sh."""
+    findings = run_comms_pass(device_counts=(2,), presets=["fast"],
+                              donation=False, stability=False)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_every_contract_names_a_known_model():
+    contracts = load_contracts()
+    for engine, contract in contracts.items():
+        assert contract["model"] in cost_model.MODELS, engine
+        assert contract.get("slack", 1.0) >= 1.0, engine
+
+
+# -- planted regression: the trailing-matrix gather -------------------------
+
+@pytest.mark.parametrize("P", [2, 4])
+def test_planted_gather_regression_trips_301_302_303(P):
+    """An engine variant that all_gathers the trailing matrix per panel
+    must trip the exact rule triple: foreign collective family (301),
+    volume past budget x slack (302), replicated blow-up (303)."""
+    mod = _fixture_module()
+    closed = mod.gathered_trailing_qr_jaxpr(P)
+    contract = load_contracts()["blocked_qr"]
+    findings = check_comms(closed, f"planted[P={P}]", contract,
+                           EngineParams(32, 16, 4, P))
+    rules = {f.rule for f in findings}
+    assert rules == {"DHQR301", "DHQR302", "DHQR303"}, [
+        f.render() for f in findings]
+    prims = {f.snippet for f in findings if f.rule == "DHQR301"}
+    assert prims == {"all_gather"}
+
+
+def test_planted_gather_volume_is_quantified():
+    """The DHQR302 finding carries the traced-vs-budget numbers (the
+    triage runbook reads them): per-panel full-matrix gathers are
+    (n/nb) * m * n words against a sum((m-k)*nb) budget."""
+    mod = _fixture_module()
+    closed = mod.gathered_trailing_qr_jaxpr(2)
+    from dhqr_tpu.analysis.comms_pass import collect_comms
+
+    stats = collect_comms(closed)
+    traced = stats.total_volume_bytes()
+    assert traced == (16 // 4) * 32 * 16 * ITEM  # 4 gathers of (m, n)
+    budget = cost_model.budget_bytes("blocked_qr", 32, 16, 4, 2, ITEM)
+    assert traced > 1.5 * budget
+
+
+# -- DHQR304: donation aliasing, both directions ----------------------------
+
+def test_donated_entry_points_alias():
+    """The package's donate=True dispatch units compile WITH
+    input-output aliasing on the CPU AOT path."""
+    assert check_donation() == []
+
+
+def test_dropped_donation_trips_304():
+    """The same factor program jitted WITHOUT donate_argnums must trip
+    DHQR304 — the check genuinely reads the executable, not the jit
+    wrapper's flags."""
+    import jax
+    import jax.numpy as jnp
+
+    from dhqr_tpu.ops.blocked import _blocked_qr_impl
+
+    findings = check_donation([
+        ("planted/no-donate", _blocked_qr_impl,
+         (jax.ShapeDtypeStruct((16, 8), jnp.float32), 4)),
+    ])
+    assert [f.rule for f in findings] == ["DHQR304"]
+    assert "aliasing" in findings[0].message
+
+
+# -- while-loop opacity: the budget check must refuse to be blind -----------
+
+def test_collective_in_while_loop_is_flagged():
+    """A collective under a while (no static trip count) cannot be
+    volume-audited — DHQR302 flags the opacity itself."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as Psp
+
+    from dhqr_tpu.parallel.mesh import DEFAULT_AXIS, column_mesh
+    from dhqr_tpu.utils.compat import shard_map
+
+    mesh = column_mesh(2)
+
+    def body(xl):
+        def cond(carry):
+            i, _ = carry
+            return i < 3
+
+        def step(carry):
+            i, x = carry
+            return i + 1, lax.psum(x, DEFAULT_AXIS)
+
+        return lax.while_loop(cond, step, (jnp.int32(0), xl))[1]
+
+    fn = shard_map(body, mesh=mesh, in_specs=Psp(DEFAULT_AXIS),
+                   out_specs=Psp(DEFAULT_AXIS), check_vma=False)
+    closed = jax.make_jaxpr(jax.jit(fn))(jnp.zeros((8,), jnp.float32))
+    contract = {"collectives": ["psum"], "model": "none", "slack": 1.0,
+                "replicated_factor": 4.0}
+    findings = check_comms(closed, "while-planted", contract,
+                           EngineParams(8, 8, 4, 2))
+    assert [(f.rule, f.snippet) for f in findings] == [
+        ("DHQR302", "while:psum")], [f.render() for f in findings]
+    # The opaque use is excluded from every aggregate (its trip count is
+    # unknowable — a trips-ignored guess would corrupt the traced-vs-
+    # budget number the triage runbook reads) but still classifies the
+    # family for DHQR301.
+    from dhqr_tpu.analysis.comms_pass import collect_comms
+
+    stats = collect_comms(closed)
+    assert stats.total_volume_bytes() == 0
+    assert stats.launches() == {}
+    assert stats.families() == {"psum"}

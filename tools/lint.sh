@@ -17,5 +17,14 @@ fi
 
 # JAX_PLATFORMS for subprocesses that respect it; the jaxpr pass also
 # pins the backend itself (sitecustomize-pinned hosts ignore the env).
-JAX_PLATFORMS=cpu python -m dhqr_tpu.analysis check dhqr_tpu tests \
+# XLA_FLAGS arms the multi-device CPU topology the comms-contract audit
+# (dhqr-audit, DHQR3xx) traces under — the CLI would force it too, but
+# setting it here keeps the audit in-process even if a future import
+# initializes the backend early. The committed contracts
+# (dhqr_tpu/analysis/comms_contracts.json) and the EMPTY baseline gate
+# together: any new collective, volume blow-up, lost donation alias or
+# trace instability fails this script.
+JAX_PLATFORMS=cpu \
+XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+python -m dhqr_tpu.analysis check dhqr_tpu tests \
     --baseline tools/lint_baseline.json
