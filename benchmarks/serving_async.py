@@ -1,0 +1,377 @@
+"""Async serving ladder: seeded open-loop load vs the sync tier.
+
+The round-11 tentpole's decision artifact. Two measured phases against
+the same SEEDED round-8 Zipf shape mix, because capacity and latency
+are different questions answered at different operating points:
+
+* ``async_capacity`` — every request queued up front (untimed, like
+  the sync ceiling's pre-collected list), then a timed ``drain()``:
+  the scheduler's own throughput ceiling with full coalescing
+  opportunity (the apples-to-apples comparison against the sync
+  ``batched_lstsq`` ceiling — what the flush machinery COSTS over bare
+  batch dispatch);
+* ``open_loop`` — Poisson arrivals (exponential inter-arrival gaps) at
+  ``rate_frac`` of the sync ceiling: client-observed latency
+  (submit -> future done, the shared bounded ``LatencyHistogram``)
+  under live load where arrivals do NOT wait for completions, so
+  queueing delay is measured rather than hidden by back-to-back calls
+  (arXiv 2112.09017 frames TPU linear algebra as exactly this kind of
+  serving workload). Reported requests/s is completions during the
+  arrival window over the window (trim-the-cooldown; the post-arrival
+  drain tail is a fixed cost a long-running service amortizes away) —
+  bounded above by the offered rate; the end-to-end quotient is
+  emitted alongside.
+
+Baselines: a warm per-request singles loop (the pre-serve answer) and
+the sync ``batched_lstsq`` ceiling — both measured INTERLEAVED with the
+capacity passes, round-robin in one time window, because this
+shared-CPU container's throughput drifts +-30% across minutes and every
+verdict ratio must compare code paths, not machine epochs.
+
+Acceptance (ISSUE 6): open-loop requests/s >= 2x the singles loop,
+burst capacity >= 0.9x the sync ceiling, open-loop p99 within the
+configured SLO, ZERO recompiles in steady state after prewarm (cache
+misses flat across both timed phases), zero admission rejects at the
+offered rate, and every request's normal-equations residual within the
+reference's 8x LAPACK criterion (runtests.jl:62).
+
+Usage:  python benchmarks/serving_async.py [n_requests] [rate_frac]
+        (rate_frac: offered rate as a fraction of the measured ASYNC
+         capacity; default 0.8 — high load, but sustainable: the SLO
+         phase measures latency at an operating point a service would
+         actually run, not at the edge of saturation)
+Writes: benchmarks/results/serving_async_<platform>.jsonl (append).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# The round-8 shape ladder verbatim (benchmarks/serving_throughput.py):
+# rank-weighted ~ 1/(rank+1)^1.1, all n <= 256, half on-lattice, half
+# awkward — the async numbers stay comparable to the sync artifact.
+SHAPE_LADDER = [
+    (64, 16), (100, 36), (128, 48), (192, 64),
+    (250, 100), (384, 128), (500, 180), (640, 256),
+]
+MICRO_BATCH = 32          # serve max_batch, matching the round-8 runs
+SLO_MS = 1000.0           # latency budget each request is submitted with
+                          # (must clear the heaviest bucket's ~400 ms CPU
+                          # dispatch plus a queueing allowance at 0.9+ load)
+# Coalescing window: at ~60 req/s per popular bucket a 100 ms window
+# gathers only ~6 requests per flush and per-dispatch overhead dominates
+# (measured 0.80x of the sync ceiling); 300 ms grows popular buckets to
+# 16-32 while staying far enough under the SLO that rare-bucket requests
+# (interval wait + a queued dispatch behind other flushes) keep p99
+# inside it — 600 ms measurably blew the p99 budget.
+FLUSH_INTERVAL_MS = 300.0
+WARM_PASSES = 3
+
+
+def _stage(name: str) -> None:
+    print(f"::stage {name} t={time.time():.1f}", file=sys.stderr, flush=True)
+
+
+def main(n_requests: int = 512, rate_frac: float = 0.92) -> None:
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(3))
+    from bench import ROUND, _Watchdog
+
+    _stage("import")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(_REPO, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+
+    import dhqr_tpu
+    from dhqr_tpu.serve import AsyncScheduler, batched_lstsq, prewarm
+    from dhqr_tpu.serve.cache import ExecutableCache
+    from dhqr_tpu.utils.config import SchedulerConfig, ServeConfig
+    from dhqr_tpu.utils.profiling import LatencyHistogram, sync
+    from dhqr_tpu.utils.testing import (TOLERANCE_FACTOR,
+                                        normal_equations_residual,
+                                        oracle_residual)
+
+    _stage("backend_init")
+    with _Watchdog("backend_init", 240):
+        dev = jax.devices()[0]
+        platform = dev.platform
+        kind = getattr(dev, "device_kind", "?")
+        sync(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    _stage(f"backend_ready_{platform}")
+    out_path = os.path.join(_REPO, "benchmarks", "results",
+                            f"serving_async_{platform}.jsonl")
+
+    def emit(rec):
+        rec.update(platform=platform, device_kind=kind, round=ROUND)
+        line = json.dumps(rec)
+        print(line, flush=True)
+        with open(out_path, "a") as f:
+            f.write(line + "\n")
+
+    # ---- the request stream (fixed seeds: artifact is reproducible) ----
+    rng = np.random.default_rng(0)
+    ranks = np.arange(len(SHAPE_LADDER))
+    weights = 1.0 / (ranks + 1.0) ** 1.1
+    weights /= weights.sum()
+    picks = rng.choice(len(SHAPE_LADDER), size=n_requests, p=weights)
+    shapes = [SHAPE_LADDER[i] for i in picks]
+    As = [jnp.asarray(rng.random(s), jnp.float32) for s in shapes]
+    bs = [jnp.asarray(rng.random(s[0]), jnp.float32) for s in shapes]
+    sync(As[-1])
+    scfg = ServeConfig(max_batch=MICRO_BATCH)
+
+    # ---- prewarm the async cache THROUGH THE SYNC TIER -----------------
+    # Deadline/interval flushes launch partial micro-batches, so steady
+    # state touches every power-of-two batch bucket up to the cap —
+    # prewarm mints them all per ladder shape (one spec per pow2 count;
+    # the same keys live dispatch hits, by the shared _plan_key).
+    _stage("prewarm")
+    with _Watchdog("prewarm", 2400):
+        acache = ExecutableCache(max_size=64)
+        pow2 = [1 << i for i in range((MICRO_BATCH - 1).bit_length() + 1)
+                if 1 << i <= MICRO_BATCH]
+        keys = prewarm([(c, m, n) for (m, n) in SHAPE_LADDER for c in pow2],
+                       serve_config=scfg, cache=acache)
+    emit({"metric": "serving_async", "phase": "prewarm",
+          "keys": len(keys), "cache": acache.stats()})
+
+    # ---- throughput triple: sync ceiling / async capacity / singles ----
+    # The three rates the verdict compares are measured INTERLEAVED,
+    # round-robin in the same time window: this shared-CPU container's
+    # throughput drifts +-30% across minutes (cgroup burst credits), so
+    # two phases measured minutes apart compare machine epochs, not code
+    # paths. One round = one timed sync batched_lstsq pass over the full
+    # list, one timed async drain pass of the same list, one timed
+    # singles pass over a fixed subset — every ratio is within-round.
+    #
+    # The async capacity pass queues everything first (UNTIMED, exactly
+    # like the sync ceiling's pre-collected list; admission cost under
+    # live load is measured by the open-loop phase, where it belongs),
+    # then times one drain(): group selection, tenant take, pow2
+    # chunking, stack/pad, dispatch, scatter, fence — everything the
+    # scheduler adds on top of the engine's shared dispatch path, in
+    # manual mode (start=False) so it is single-threaded like
+    # batched_lstsq.
+    sync_cache = ExecutableCache(max_size=64)
+    n_singles = min(256, n_requests)
+    _stage("throughput_warmup")
+    with _Watchdog("throughput_warmup", 1800):
+        for m, n in SHAPE_LADDER:  # pay the singles jit compiles up front
+            x = dhqr_tpu.lstsq(jnp.zeros((m, n), jnp.float32) +
+                               jnp.eye(m, n, dtype=jnp.float32),
+                               jnp.ones((m,), jnp.float32))
+            sync(x)
+        xs_ref = batched_lstsq(As, bs, serve_config=scfg, cache=sync_cache)
+        sync(xs_ref)
+    misses_before = acache.stats()["misses"]   # steady state starts here
+    cap_sched = AsyncScheduler(
+        serve_config=scfg,
+        sched_config=SchedulerConfig(slo_ms=30e3,
+                                     flush_interval_ms=FLUSH_INTERVAL_MS,
+                                     queue_depth=4 * n_requests),
+        cache=acache, start=False)
+    _stage("throughput_rounds")
+    sync_s, drain_s, singles_s = 0.0, 0.0, 0.0
+    rounds = []
+    with _Watchdog("throughput_rounds", 2400):
+        for _ in range(WARM_PASSES):
+            t0 = time.perf_counter()
+            xs = batched_lstsq(As, bs, serve_config=scfg, cache=sync_cache)
+            sync(xs)
+            dt_sync = time.perf_counter() - t0
+            cap_futs = [cap_sched.submit("lstsq", A, b, deadline=30.0)
+                        for A, b in zip(As, bs)]
+            t0 = time.perf_counter()
+            cap_sched.drain()
+            dt_drain = time.perf_counter() - t0
+            assert all(f.done() for f in cap_futs)
+            t0 = time.perf_counter()
+            for A, b in zip(As[:n_singles], bs[:n_singles]):
+                x = dhqr_tpu.lstsq(A, b)
+                sync(x)
+            dt_singles = time.perf_counter() - t0
+            sync_s += dt_sync
+            drain_s += dt_drain
+            singles_s += dt_singles
+            rounds.append({
+                "sync_rps": round(n_requests / dt_sync, 1),
+                "capacity_rps": round(n_requests / dt_drain, 1),
+                "singles_rps": round(n_singles / dt_singles, 1),
+            })
+    ceiling_rps = n_requests * WARM_PASSES / sync_s
+    capacity_rps = n_requests * WARM_PASSES / drain_s
+    singles_rps = n_singles * WARM_PASSES / singles_s
+    cap_stats = cap_sched.stats()
+    cap_sched.shutdown()
+    emit({"metric": "serving_async", "phase": "sync_ceiling",
+          "passes": WARM_PASSES, "requests": n_requests,
+          "micro_batch": MICRO_BATCH,
+          "requests_per_s": round(ceiling_rps, 1),
+          "cache": sync_cache.stats()})
+    emit({"metric": "serving_async", "phase": "async_capacity",
+          "passes": WARM_PASSES, "requests": n_requests,
+          "requests_per_s": round(capacity_rps, 1),
+          "fraction_of_ceiling": round(capacity_rps / ceiling_rps, 3),
+          "flushes": cap_stats["flushes"],
+          "dispatches": cap_stats["dispatches"]})
+    emit({"metric": "serving_async", "phase": "singles",
+          "passes": WARM_PASSES, "requests": n_singles,
+          "requests_per_s": round(singles_rps, 1),
+          "rounds": rounds})
+
+    # ---- async open-loop run ------------------------------------------
+    # Offered rate is a fraction of the async path's own measured
+    # capacity — the operating point a service would pick (utilization
+    # against what the serving path sustains, not against a ceiling it
+    # cannot reach) — so the queueing load, and with it p99, is actually
+    # controlled by rate_frac.
+    offered_rps = rate_frac * capacity_rps
+    inter = np.random.default_rng(1).exponential(
+        1.0 / offered_rps, size=n_requests)
+    arrivals = np.cumsum(inter)
+    client_lat = LatencyHistogram()       # the shared bounded histogram
+    done_at = [0.0] * n_requests
+    n_done = [0]
+    lock = threading.Lock()
+
+    sched = AsyncScheduler(
+        serve_config=scfg,
+        sched_config=SchedulerConfig(slo_ms=SLO_MS,
+                                     flush_interval_ms=FLUSH_INTERVAL_MS,
+                                     queue_depth=4096),
+        cache=acache)
+    futs = [None] * n_requests
+
+    def on_done(i, t_submit):
+        def cb(fut):
+            now = time.perf_counter()
+            client_lat.record(now - t_submit)
+            done_at[i] = now
+            with lock:
+                n_done[0] += 1
+        return cb
+
+    _stage("async_stream")
+    with _Watchdog("async_stream", 2400):
+        t_start = time.perf_counter()
+        rejected = 0
+        for i in range(n_requests):
+            target = t_start + arrivals[i]
+            delay = target - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            t_submit = time.perf_counter()
+            try:
+                fut = sched.submit("lstsq", As[i], bs[i],
+                                   deadline=SLO_MS / 1e3,
+                                   tenant=f"t{picks[i]}")
+            except Exception:
+                rejected += 1
+                continue
+            fut.add_done_callback(on_done(i, t_submit))
+            futs[i] = fut
+        if rejected:
+            # The run is compromised; finish what was accepted, say so.
+            print(f"::open_loop rejected={rejected}", file=sys.stderr,
+                  flush=True)
+        # Wait for every ACCEPTED request (a one-shot event keyed on
+        # n_requests would never fire after a reject and stall here).
+        target = n_requests - rejected
+        wait_until = time.perf_counter() + 600
+        while time.perf_counter() < wait_until:
+            with lock:
+                if n_done[0] >= target:
+                    break
+            time.sleep(0.01)
+        t_end = max(d for d in done_at if d) if any(done_at) else t_start
+    sched_stats = sched.stats()
+    sched.shutdown()
+    recompiles = acache.stats()["misses"] - misses_before
+    # End-to-end: first submit -> last completion, drain tail included.
+    end_to_end_rps = (n_requests - rejected) / (t_end - t_start)
+    # Stream rate: completions DURING the arrival window over the
+    # window — the standard trim-the-cooldown open-loop number (the
+    # tail after the last arrival is a fixed cost a long-running
+    # service amortizes to nothing; on a seconds-long stream it is a
+    # 10-20% haircut). Bounded above by the offered rate: completions
+    # in the window can never exceed its arrivals.
+    t_arr_end = t_start + arrivals[-1]
+    in_window = sum(1 for d in done_at if 0.0 < d <= t_arr_end)
+    async_rps = in_window / arrivals[-1]
+    emit({"metric": "serving_async", "phase": "open_loop",
+          "requests": n_requests, "rejected": rejected,
+          "offered_rps": round(offered_rps, 1),
+          "rate_frac_of_capacity": rate_frac,
+          "requests_per_s": round(async_rps, 1),
+          "end_to_end_rps": round(end_to_end_rps, 1),
+          "recompiles_steady_state": recompiles,
+          "slo_ms": SLO_MS,
+          "client_latency": client_lat.snapshot(),
+          "scheduler": sched_stats})
+
+    # ---- residuals: every async answer against the 8x criterion -------
+    _stage("residuals")
+    worst = 0.0
+    all_ok = True
+    for i, fut in enumerate(futs):
+        if fut is None:
+            continue
+        x = np.asarray(fut.result())
+        res = normal_equations_residual(As[i], x, bs[i])
+        ref = oracle_residual(np.asarray(As[i]), np.asarray(bs[i]))
+        ratio = res / (TOLERANCE_FACTOR * ref)
+        worst = max(worst, ratio)
+        all_ok = all_ok and ratio < 1.0
+    emit({"metric": "serving_async_residuals",
+          "requests": n_requests - rejected,
+          "criterion": "8x_lapack_normal_equations",
+          "all_within": all_ok, "worst_fraction_of_bar": round(worst, 4)})
+
+    # ---- verdict -------------------------------------------------------
+    # speedup_vs_singles and p99 come from the OPEN-LOOP phase (live
+    # load at the operating point); fraction_of_sync_ceiling from the
+    # BURST capacity phase (both sides see the whole list, measured
+    # interleaved in the same machine epoch).
+    p99_ms = client_lat.snapshot()["p99_ms"]
+    ok = (async_rps >= 2.0 * singles_rps
+          and capacity_rps >= 0.9 * ceiling_rps
+          and p99_ms <= SLO_MS
+          and recompiles == 0
+          and rejected == 0
+          and all_ok)
+    emit({"metric": "serving_async_verdict",
+          "speedup_vs_singles": round(async_rps / singles_rps, 2),
+          "fraction_of_sync_ceiling": round(capacity_rps / ceiling_rps, 3),
+          "open_loop_rps": round(async_rps, 1),
+          "end_to_end_rps": round(end_to_end_rps, 1),
+          "capacity_rps": round(capacity_rps, 1),
+          "ceiling_rps": round(ceiling_rps, 1),
+          "singles_rps": round(singles_rps, 1),
+          "p99_ms": p99_ms, "slo_ms": SLO_MS,
+          "p99_within_slo": bool(p99_ms <= SLO_MS),
+          "zero_recompiles_steady_state": recompiles == 0,
+          "zero_rejects": rejected == 0,
+          "all_residuals_within_8x": all_ok,
+          "deadline_misses": sched_stats["deadline_misses"],
+          "flushes": sched_stats["flushes"],
+          "ok": bool(ok)})
+    _stage("done")
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 2048,
+         float(sys.argv[2]) if len(sys.argv) > 2 else 0.80)

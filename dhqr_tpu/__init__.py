@@ -44,12 +44,22 @@ from dhqr_tpu.precision import (
     PrecisionPolicy,
     resolve_policy,
 )
-from dhqr_tpu.serve import batched_lstsq, batched_qr
+from dhqr_tpu.serve import (
+    AsyncScheduler,
+    BackpressureError,
+    batched_lstsq,
+    batched_qr,
+)
 # NOTE: the tune() search function itself stays at dhqr_tpu.tune.tune —
 # re-exporting it here would shadow the `dhqr_tpu.tune` submodule
 # attribute with a function (breaking `import dhqr_tpu.tune as t`).
 from dhqr_tpu.tune import Plan, PlanDB, resolve_plan
-from dhqr_tpu.utils.config import DHQRConfig, ServeConfig, TuneConfig
+from dhqr_tpu.utils.config import (
+    DHQRConfig,
+    SchedulerConfig,
+    ServeConfig,
+    TuneConfig,
+)
 
 __version__ = "0.4.0"
 
@@ -73,8 +83,11 @@ __all__ = [
     "alphafactor",
     "batched_qr",
     "batched_lstsq",
+    "AsyncScheduler",
+    "BackpressureError",
     "DHQRConfig",
     "ServeConfig",
+    "SchedulerConfig",
     "TuneConfig",
     "Plan",
     "PlanDB",

@@ -233,6 +233,32 @@ def _entry_points(preset: str, pol):
     yield (f"batched_lstsq[{preset}]",
            jx(bucket_program("lstsq", block_size=_NB, policy=preset),
               As, bs), ())
+    # The async scheduler's dispatch path (round 11): must be the SAME
+    # bucket_program the comms pass contracts — the scheduler owns no
+    # second lowering/key scheme. The thunk asserts function-identity
+    # parity BEFORE tracing, so a drift (someone giving the scheduler
+    # its own _plan_key or dispatch loop) surfaces as a DHQR104 finding
+    # on this entry rather than as silent steady-state recompiles.
+    from dhqr_tpu.serve import engine as _serve_engine
+    from dhqr_tpu.serve import scheduler as _serve_sched
+
+    def async_thunk():
+        # The drift this guards against is scheduler.py growing its OWN
+        # lowering helpers (a module-level _plan_key / _dispatch_groups /
+        # bucket_program shadowing the engine's), so check the
+        # scheduler's namespace — comparing engine attributes to
+        # themselves through the module alias would be a tautology.
+        shadowed = {"_plan_key", "_dispatch_groups", "bucket_program"} \
+            & set(vars(_serve_sched))
+        assert _serve_sched._engine is _serve_engine and not shadowed, (
+            "async scheduler dispatch path diverged from serve.engine "
+            f"(shadowed: {sorted(shadowed)}): cache-key parity (one "
+            "_plan_key, one _dispatch_groups) is the zero-recompile "
+            "contract")
+        return jax.make_jaxpr(_serve_sched.dispatch_program(
+            "lstsq", block_size=_NB, policy=preset))(As, bs)
+
+    yield (f"async_lstsq[{preset}]", async_thunk, ())
     yield (f"sharded_blocked_qr[{preset}]",
            jx(lambda A: sharded_blocked_qr(A, cmesh, block_size=_NB,
                                            policy=preset), A),

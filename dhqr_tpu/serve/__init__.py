@@ -13,8 +13,17 @@ padded-bucket lattice so both stay finite (``serve.buckets``).
     >>> prewarm([(32, 512, 256)])              # compile before traffic
     >>> cache_stats()                          # hits/misses/compile s
 
-See docs/DESIGN.md "Serving tier" for the bucket-lattice rationale and
-docs/OPERATIONS.md for the cache runbook.
+For a LIVE request stream (one request at a time, each with its own
+latency budget and tenant) the async front-end coalesces arrivals into
+the same buckets through the same dispatch path (``serve.scheduler``):
+
+    >>> from dhqr_tpu.serve import AsyncScheduler
+    >>> sched = AsyncScheduler()
+    >>> fut = sched.submit("lstsq", A, b, deadline=0.05, tenant="acme")
+    >>> x = fut.result()
+
+See docs/DESIGN.md "Serving tier" / "Async serving" for the rationale
+and docs/OPERATIONS.md for the cache and SLO-tuning runbooks.
 """
 
 from dhqr_tpu.serve.buckets import (
@@ -36,8 +45,11 @@ from dhqr_tpu.serve.engine import (
     bucket_program,
     prewarm,
 )
+from dhqr_tpu.serve.scheduler import AsyncScheduler, BackpressureError
 
 __all__ = [
+    "AsyncScheduler",
+    "BackpressureError",
     "Bucket",
     "CacheKey",
     "ExecutableCache",

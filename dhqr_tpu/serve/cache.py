@@ -115,18 +115,27 @@ class ExecutableCache:
 
     def stats(self) -> dict:
         """Counter snapshot + occupancy, JSON-ready (the benchmark
-        artifact and the dry run embed this verbatim)."""
+        artifact, the dry run and the async scheduler's stats endpoint
+        embed this verbatim).
+
+        The whole snapshot is taken under ONE acquisition of the cache
+        lock — counters and occupancy are a single consistent cut, so
+        invariants like ``misses >= size + evictions`` (every resident
+        entry and every eviction was once a miss) hold in every snapshot
+        a concurrent reader takes, never just in quiescence
+        (tests/test_serve.py pins this under a writer storm).
+        """
         with self._lock:
             snap = self.counters.snapshot()
-            size = len(self._entries)
-        return {
-            "size": size,
-            "max_size": self.max_size,
-            "hits": int(snap.get("hits", 0)),
-            "misses": int(snap.get("misses", 0)),
-            "evictions": int(snap.get("evictions", 0)),
-            "compile_seconds": round(float(snap.get("compile_seconds", 0)), 3),
-        }
+            return {
+                "size": len(self._entries),
+                "max_size": self.max_size,
+                "hits": int(snap.get("hits", 0)),
+                "misses": int(snap.get("misses", 0)),
+                "evictions": int(snap.get("evictions", 0)),
+                "compile_seconds": round(
+                    float(snap.get("compile_seconds", 0)), 3),
+            }
 
     def clear(self) -> None:
         """Drop every resident executable (counters keep accumulating —

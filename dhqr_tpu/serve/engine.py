@@ -282,6 +282,78 @@ def bucket_program(kind: str, config: Optional[DHQRConfig] = None,
     raise ValueError(f"kind must be 'lstsq' or 'qr', got {kind!r}")
 
 
+def _resolve_dispatch_cfg(kind: str, config: Optional[DHQRConfig],
+                          overrides):
+    """The ONE place serve config/policy resolution places the policy's
+    refine for a program family — shared by ``batched_lstsq`` /
+    ``batched_qr`` / :func:`prewarm` and the async scheduler
+    (``serve.scheduler``), so a request resolved for queued dispatch is
+    byte-identical to the same request resolved for a sync call.
+
+    Returns ``(cfg, pol, qr_solve_args)``:
+
+    * ``kind == "lstsq"``: the policy's refine is folded into
+      ``cfg.refine`` (in-program sweeps); ``qr_solve_args`` is None.
+    * ``kind == "qr"``: an explicit ``refine=`` is rejected (factor-only
+      programs have no solve to refine — arm it via ``policy=``), and
+      ``qr_solve_args = (apply_precision, solve_refine)`` carries what
+      the scatter stage records on each returned factorization.
+    """
+    cfg, pol = _resolve_serve_cfg(config, overrides)
+    if kind == "lstsq":
+        if pol is not None and pol.refine:
+            cfg = dataclasses.replace(cfg, refine=pol.refine)
+        return cfg, pol, None
+    if kind != "qr":
+        raise ValueError(f"kind must be 'lstsq' or 'qr', got {kind!r}")
+    if cfg.refine:
+        raise ValueError(
+            "refine applies to batched_lstsq only — batched_qr returns raw "
+            "factorizations; pass a policy= with refine > 0 to arm "
+            "refinement on the factorizations' solves"
+        )
+    solve_refine = pol.refine if pol is not None else 0
+    apply_prec = cfg.apply_precision or cfg.precision
+    return cfg, pol, (apply_prec, solve_refine)
+
+
+def _scatter_lstsq(As: Sequence, emit):
+    """Input-order scatter for lstsq dispatches: a ``consume`` callback
+    (see :func:`_dispatch_groups`) that slices each request's solution
+    out of the stacked output and hands it to ``emit(i, x_i)`` — the
+    sync API's ``emit`` fills a result list, the async scheduler's
+    resolves futures. One slicing rule, two front ends."""
+
+    def consume(chunk, key, xs):
+        for row, i in enumerate(chunk):
+            emit(i, xs[row, :As[i].shape[1]])
+
+    return consume
+
+
+def _scatter_qr(As: Sequence, emit, qr_solve_args):
+    """Input-order scatter for factor-only dispatches: truncates each
+    stacked factorization to its request's shape, wraps it in a
+    ``QRFactorization`` armed with the resolved solve-stage fields
+    (:func:`_resolve_dispatch_cfg`), and hands it to ``emit(i, fact)``."""
+    from dhqr_tpu.models.qr_model import QRFactorization
+
+    apply_prec, solve_refine = qr_solve_args
+
+    def consume(chunk, key, outs):
+        Hs, alphas = outs
+        for row, i in enumerate(chunk):
+            m, n = As[i].shape
+            emit(i, QRFactorization(
+                Hs[row, :m, :n], alphas[row, :n],
+                block_size=key.block_size, precision=apply_prec,
+                refine=solve_refine,
+                matrix=jnp.asarray(As[i]) if solve_refine else None,
+            ))
+
+    return consume
+
+
 def _validate_requests(As: Sequence, bs: "Sequence | None"):
     if bs is not None and len(As) != len(bs):
         raise ValueError(
@@ -383,16 +455,10 @@ def batched_lstsq(
     """
     scfg = serve_config or ServeConfig.from_env()
     cache = cache if cache is not None else default_cache()
-    cfg, pol = _resolve_serve_cfg(config, overrides)
-    if pol is not None and pol.refine:
-        cfg = dataclasses.replace(cfg, refine=pol.refine)
+    cfg, pol, _ = _resolve_dispatch_cfg("lstsq", config, overrides)
     _validate_requests(As, bs)
     out: "list[jax.Array | None]" = [None] * len(As)
-
-    def consume(chunk, key, xs):
-        for row, i in enumerate(chunk):
-            out[i] = xs[row, :As[i].shape[1]]
-
+    consume = _scatter_lstsq(As, lambda i, x: out.__setitem__(i, x))
     _dispatch_groups("lstsq", As, bs, cfg, scfg, cache, consume, pol=pol)
     return out
 
@@ -413,33 +479,13 @@ def batched_qr(
     returned factorization, exactly like ``qr(A, policy=...)`` (the
     original matrix rides along for the residual matvec).
     """
-    from dhqr_tpu.models.qr_model import QRFactorization
-
     scfg = serve_config or ServeConfig.from_env()
     cache = cache if cache is not None else default_cache()
-    cfg, pol = _resolve_serve_cfg(config, overrides)
-    if cfg.refine:
-        raise ValueError(
-            "refine applies to batched_lstsq only — batched_qr returns raw "
-            "factorizations; pass a policy= with refine > 0 to arm "
-            "refinement on the factorizations' solves"
-        )
-    solve_refine = pol.refine if pol is not None else 0
-    apply_prec = cfg.apply_precision or cfg.precision
+    cfg, pol, qr_solve_args = _resolve_dispatch_cfg("qr", config, overrides)
     _validate_requests(As, None)
     out: "list | None" = [None] * len(As)
-
-    def consume(chunk, key, outs):
-        Hs, alphas = outs
-        for row, i in enumerate(chunk):
-            m, n = As[i].shape
-            out[i] = QRFactorization(
-                Hs[row, :m, :n], alphas[row, :n],
-                block_size=key.block_size, precision=apply_prec,
-                refine=solve_refine,
-                matrix=jnp.asarray(As[i]) if solve_refine else None,
-            )
-
+    consume = _scatter_qr(As, lambda i, f: out.__setitem__(i, f),
+                          qr_solve_args)
     _dispatch_groups("qr", As, None, cfg, scfg, cache, consume, pol=pol)
     return out
 
@@ -473,11 +519,9 @@ def prewarm(
     """
     scfg = serve_config or ServeConfig.from_env()
     cache = cache if cache is not None else default_cache()
-    cfg, pol = _resolve_serve_cfg(config, overrides)
-    if kind == "lstsq" and pol is not None and pol.refine:
-        # Same fold batched_lstsq performs — prewarmed keys must be the
-        # keys live dispatch hits, policy presets included.
-        cfg = dataclasses.replace(cfg, refine=pol.refine)
+    # Shared resolver: prewarmed keys must be the keys live dispatch
+    # (sync or queued) hits, policy presets and refine placement included.
+    cfg, pol, _ = _resolve_dispatch_cfg(kind, config, overrides)
     per_arrival: "list[tuple[Bucket, int]]" = []
     merged: "dict[Bucket, int]" = {}
     for spec in shapes:

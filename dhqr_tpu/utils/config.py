@@ -274,6 +274,102 @@ class ServeConfig:
         return ServeConfig(**env)
 
 
+def _parse_tenant_weights(raw: str) -> "tuple[tuple[str, float], ...]":
+    """Parse ``DHQR_SERVE_TENANT_WEIGHTS``: ``"tenantA:3,tenantB:1"``."""
+    out = []
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, weight = part.partition(":")
+        if not sep or not name.strip():
+            raise ValueError(
+                f"tenant weight entry must be 'name:weight', got {part!r}"
+            )
+        out.append((name.strip(), float(weight)))
+    return tuple(out)
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedulerConfig:
+    """Knobs for the async serving scheduler (``dhqr_tpu.serve.scheduler``).
+
+    These shape ADMISSION and FLUSH policy — when a queued micro-batch is
+    launched and who gets in — not the bucket lattice (:class:`ServeConfig`)
+    or the numerics (:class:`DHQRConfig`). All are overridable from
+    ``DHQR_SERVE_*`` environment variables, following the serve-tier
+    pattern.
+
+    Attributes:
+      slo_ms: default latency budget (milliseconds) for requests
+        submitted without an explicit ``deadline`` — the service-level
+        objective the deadline-aware flush defends (``DHQR_SERVE_SLO_MS``).
+      queue_depth: admission high-water mark — total queued requests
+        across all buckets past which ``submit`` rejects with a
+        retry-after hint instead of queueing (``DHQR_SERVE_QUEUE_DEPTH``).
+        Backpressure by rejection keeps the tail bounded: an unbounded
+        queue converts overload into unbounded p99.
+      flush_interval_ms: maximum coalescing wait (milliseconds) — a
+        bucket whose oldest request has waited this long flushes even
+        with deadline headroom left, bounding the latency cost of waiting
+        for co-tenants under light traffic
+        (``DHQR_SERVE_FLUSH_INTERVAL_MS``).
+      tenant_weights: weighted round-robin shares as ``(tenant, weight)``
+        pairs; tenants not named weigh 1. Parsed from
+        ``DHQR_SERVE_TENANT_WEIGHTS`` as ``"tenantA:3,tenantB:1"``. A
+        dict is accepted programmatically and normalized to a sorted
+        tuple (the config stays hashable).
+    """
+
+    slo_ms: float = 100.0
+    queue_depth: int = 1024
+    flush_interval_ms: float = 20.0
+    tenant_weights: "tuple[tuple[str, float], ...]" = ()
+
+    def __post_init__(self):
+        if isinstance(self.tenant_weights, dict):
+            object.__setattr__(
+                self, "tenant_weights",
+                tuple(sorted(self.tenant_weights.items())))
+        if not self.slo_ms > 0:
+            raise ValueError(f"slo_ms must be > 0, got {self.slo_ms}")
+        if self.queue_depth < 1:
+            raise ValueError(
+                f"queue_depth must be >= 1, got {self.queue_depth}")
+        if not self.flush_interval_ms > 0:
+            raise ValueError(
+                f"flush_interval_ms must be > 0, got {self.flush_interval_ms}")
+        for name, weight in self.tenant_weights:
+            if not weight > 0:
+                raise ValueError(
+                    f"tenant weight must be > 0, got {name!r}: {weight}"
+                )
+
+    def weight_for(self, tenant: str) -> float:
+        for name, weight in self.tenant_weights:
+            if name == tenant:
+                return weight
+        return 1.0
+
+    @staticmethod
+    def from_env(**overrides) -> "SchedulerConfig":
+        """Build a scheduler config from ``DHQR_SERVE_*`` variables +
+        overrides."""
+        env = {}
+        if "DHQR_SERVE_SLO_MS" in os.environ:
+            env["slo_ms"] = float(os.environ["DHQR_SERVE_SLO_MS"])
+        if "DHQR_SERVE_QUEUE_DEPTH" in os.environ:
+            env["queue_depth"] = int(os.environ["DHQR_SERVE_QUEUE_DEPTH"])
+        if "DHQR_SERVE_FLUSH_INTERVAL_MS" in os.environ:
+            env["flush_interval_ms"] = float(
+                os.environ["DHQR_SERVE_FLUSH_INTERVAL_MS"])
+        if "DHQR_SERVE_TENANT_WEIGHTS" in os.environ:
+            env["tenant_weights"] = _parse_tenant_weights(
+                os.environ["DHQR_SERVE_TENANT_WEIGHTS"])
+        env.update(overrides)
+        return SchedulerConfig(**env)
+
+
 @dataclasses.dataclass(frozen=True)
 class TuneConfig:
     """Knobs for the dhqr-tune autotuner (``dhqr_tpu.tune``), all

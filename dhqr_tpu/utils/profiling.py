@@ -21,7 +21,9 @@ flamegraphs in the test harness (test/runtests.jl:40, 64-65). Per SURVEY.md
 
 from __future__ import annotations
 
+import bisect
 import contextlib
+import threading
 import time
 from typing import Dict, Iterator, List, Tuple
 
@@ -104,29 +106,154 @@ class PhaseTimer:
 
 
 class Counters:
-    """Monotonic named counters (int or float increments).
+    """Monotonic named counters (int or float increments), thread-safe.
 
     The serving tier's cache accounting rides here (hits / misses /
-    evictions / compile seconds — see ``dhqr_tpu.serve.cache``): one
-    shared spelling so benchmarks and the dry run read the same numbers
-    the engine maintains, instead of each keeping private tallies.
+    evictions / compile seconds — see ``dhqr_tpu.serve.cache``), as do
+    the async scheduler's flush-reason/admission counters
+    (``serve.scheduler``): one shared spelling so benchmarks and the dry
+    run read the same numbers the engine maintains, instead of each
+    keeping private tallies. The internal lock makes ``bump`` and
+    ``snapshot`` safe from concurrent request/dispatcher threads —
+    ``snapshot`` is a single consistent cut, never a torn read of
+    half-updated counts.
     """
 
     def __init__(self) -> None:
         self._counts: Dict[str, float] = {}
+        self._lock = threading.Lock()
 
     def bump(self, name: str, value: float = 1) -> None:
-        self._counts[name] = self._counts.get(name, 0) + value
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + value
 
     def get(self, name: str) -> float:
-        return self._counts.get(name, 0)
+        with self._lock:
+            return self._counts.get(name, 0)
 
     def snapshot(self) -> Dict[str, float]:
         """A point-in-time copy — subtract two snapshots for a delta."""
-        return dict(self._counts)
+        with self._lock:
+            return dict(self._counts)
 
     def reset(self) -> None:
-        self._counts.clear()
+        with self._lock:
+            self._counts.clear()
+
+
+class Ewma:
+    """Exponentially weighted moving average, thread-safe.
+
+    The async scheduler tracks one per serve bucket: "how long does a
+    dispatch of this bucket take lately" is what deadline-aware flushing
+    subtracts from the oldest request's deadline. EWMA (rather than a
+    plain mean) tracks drift — a bucket whose dispatch got slower after
+    an eviction/recompile raises its flush lead time within a few
+    observations instead of being dragged by history.
+
+    ``value`` is None until the first ``update`` — callers must decide
+    what "no measurement yet" means (the scheduler treats it as zero
+    lead time and lets the first dispatch seed it).
+    """
+
+    def __init__(self, alpha: float = 0.3) -> None:
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+        self.alpha = float(alpha)
+        self._value: "float | None" = None
+        self._lock = threading.Lock()
+
+    def update(self, x: float) -> float:
+        with self._lock:
+            if self._value is None:
+                self._value = float(x)
+            else:
+                self._value += self.alpha * (float(x) - self._value)
+            return self._value
+
+    @property
+    def value(self) -> "float | None":
+        with self._lock:
+            return self._value
+
+
+class LatencyHistogram:
+    """Bounded log-bucketed latency histogram: ``record(seconds)`` /
+    ``percentile(p)``, thread-safe, fixed memory.
+
+    Buckets are geometric from 1 µs up with ratio 2^(1/4) (~19% wide,
+    ~13 buckets per decade, 124 buckets to reach ~1000 s), so memory is
+    constant no matter how many observations arrive — a serving tier
+    must not grow a list per request — and any percentile is read in one
+    cumulative walk with ≤ ~9% relative error (half a bucket). Used by
+    both the async scheduler's stats (``serve.scheduler``) and the
+    open-loop load generator's report (``benchmarks/serving_async.py``),
+    so "p99 latency" means the same measurement in both places.
+    """
+
+    _RATIO = 2.0 ** 0.25
+    _FLOOR = 1e-6
+    _NBUCKETS = 124
+
+    # Upper edges, shared by every instance (read-only; module-level
+    # expression — a class-body comprehension cannot see class attrs).
+    _EDGES = [1e-6 * (2.0 ** 0.25) ** i for i in range(124)]
+
+    def __init__(self) -> None:
+        # +1 overflow bucket for observations past the last edge.
+        self._counts = [0] * (self._NBUCKETS + 1)
+        self._total = 0
+        self._sum = 0.0
+        self._lock = threading.Lock()
+
+    def record(self, seconds: float) -> None:
+        idx = bisect.bisect_left(self._EDGES, float(seconds))
+        with self._lock:
+            self._counts[idx] += 1
+            self._total += 1
+            self._sum += float(seconds)
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._total
+
+    def mean(self) -> float:
+        with self._lock:
+            return self._sum / self._total if self._total else 0.0
+
+    def _percentile_locked(self, p: float) -> float:
+        if not self._total:
+            return 0.0
+        target = max(1, int(-(-p * self._total // 1)))  # ceil(p*total)
+        seen = 0
+        for i, c in enumerate(self._counts):
+            seen += c
+            if seen >= target:
+                return self._EDGES[min(i, self._NBUCKETS - 1)]
+        return self._EDGES[-1]  # pragma: no cover - unreachable
+
+    def percentile(self, p: float) -> float:
+        """Upper edge of the bucket holding the p-quantile (0 <= p <= 1);
+        0.0 when empty. Biased high by at most one bucket (~19%) —
+        conservative in the direction an SLO check wants."""
+        if not 0.0 <= p <= 1.0:
+            raise ValueError(f"p must be in [0, 1], got {p}")
+        with self._lock:
+            return self._percentile_locked(p)
+
+    def snapshot(self) -> Dict[str, float]:
+        """JSON-ready summary (milliseconds, like the benchmark rows) —
+        one consistent cut under a single lock acquisition."""
+        with self._lock:
+            return {
+                "count": self._total,
+                "mean_ms": round(
+                    (self._sum / self._total if self._total else 0.0) * 1e3,
+                    3),
+                "p50_ms": round(self._percentile_locked(0.50) * 1e3, 3),
+                "p99_ms": round(self._percentile_locked(0.99) * 1e3, 3),
+            }
 
 
 @contextlib.contextmanager

@@ -50,3 +50,92 @@ def test_trace_writes_profile(tmp_path):
         for f in files
     ]
     assert found, "profiler trace directory is empty"
+
+
+def test_counters_thread_safe_bumps():
+    """Counters back the serve cache AND the async scheduler's stats;
+    concurrent bumps must never lose increments (the GIL does not make
+    read-modify-write atomic across the dict get/set pair)."""
+    import threading
+
+    from dhqr_tpu.utils.profiling import Counters
+
+    c = Counters()
+
+    def worker():
+        for _ in range(2000):
+            c.bump("n")
+            c.bump("x", 0.5)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.get("n") == 8000
+    assert c.snapshot()["x"] == 4000.0
+
+
+def test_ewma_tracks_drift():
+    from dhqr_tpu.utils.profiling import Ewma
+
+    e = Ewma(alpha=0.5)
+    assert e.value is None          # "no measurement yet" is observable
+    assert e.update(1.0) == 1.0     # first sample seeds
+    assert e.update(3.0) == 2.0     # then geometric tracking
+    assert e.update(2.0) == 2.0
+    import pytest
+
+    with pytest.raises(ValueError, match="alpha"):
+        Ewma(alpha=0.0)
+
+
+def test_latency_histogram_percentiles_and_bounds():
+    from dhqr_tpu.utils.profiling import LatencyHistogram
+
+    h = LatencyHistogram()
+    assert h.percentile(0.5) == 0.0 and h.count == 0
+    for _ in range(90):
+        h.record(0.010)
+    for _ in range(10):
+        h.record(1.0)
+    assert h.count == 100
+    # Log buckets are ~19% wide: percentiles land within one bucket
+    # (biased HIGH — conservative for an SLO check), never below truth.
+    assert 0.010 <= h.percentile(0.50) <= 0.012
+    assert 1.0 <= h.percentile(0.99) <= 1.2
+    assert 0.010 <= h.percentile(0.0) <= 0.012  # p0 -> first occupied
+    snap = h.snapshot()
+    assert snap["count"] == 100
+    assert 10.0 <= snap["p50_ms"] <= 12.0
+    assert abs(snap["mean_ms"] - 109.0) < 0.5
+    # Out-of-range observations clamp into the edge buckets instead of
+    # growing memory (bounded by construction).
+    h.record(0.0)
+    h.record(1e6)
+    assert h.count == 102
+    import pytest
+
+    with pytest.raises(ValueError, match="p must be"):
+        h.percentile(1.5)
+
+
+def test_latency_histogram_concurrent_records():
+    import threading
+
+    from dhqr_tpu.utils.profiling import LatencyHistogram
+
+    h = LatencyHistogram()
+
+    def worker(v):
+        for _ in range(1000):
+            h.record(v)
+
+    threads = [threading.Thread(target=worker, args=(0.001 * (i + 1),))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.count == 4000
+    assert 0.001 <= h.percentile(0.5) <= 0.0035

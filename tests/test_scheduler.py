@@ -1,0 +1,424 @@
+"""Async serving scheduler (dhqr_tpu/serve/scheduler): deadline-aware
+flush policy, tenant fairness, backpressure, drain/shutdown, and the
+one-dispatch-path (cache-key parity / zero-recompile) contract.
+
+Policy tests drive a FAKE clock in manual mode (``start=False`` +
+:meth:`poll`) and a stubbed ``engine._dispatch_groups``, so flush
+decisions are pinned without wall-clock races or compiles; one test at
+the end runs the real engine on tiny shapes with a private cache
+(tier-1 budget: the whole module stays under ~10 s).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dhqr_tpu.serve import AsyncScheduler, BackpressureError, prewarm
+from dhqr_tpu.serve import engine as serve_engine
+from dhqr_tpu.serve.cache import ExecutableCache
+from dhqr_tpu.utils.config import SchedulerConfig, ServeConfig
+
+SCFG = ServeConfig(min_dim=16, ratio=1.5, max_batch=4, cache_size=8)
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+@pytest.fixture()
+def stub(monkeypatch):
+    """Replace the engine dispatch with an instant fake; records each
+    flush's matrices so fairness/ordering is observable."""
+    calls = []
+
+    def fake_dispatch(kind, As, bs, cfg, scfg, cache, consume, pol=None):
+        calls.append(list(As))
+        maxn = max(A.shape[1] for A in As)
+        consume(list(range(len(As))), ("stub", len(As)),
+                np.zeros((len(As), maxn), np.float32))
+
+    monkeypatch.setattr(serve_engine, "_dispatch_groups", fake_dispatch)
+    return calls
+
+
+def _sched(clock, **kw):
+    kw.setdefault("serve_config", SCFG)
+    return AsyncScheduler(clock=clock, start=False, block_size=8, **kw)
+
+
+def _req(rng, m=24, n=10):
+    return (jnp.asarray(rng.random((m, n)), jnp.float32),
+            jnp.asarray(rng.random(m), jnp.float32))
+
+
+def test_deadline_flush_fires_at_budget_minus_ewma(stub):
+    """A sub-max_batch group must flush when the oldest request's
+    deadline minus the bucket's expected dispatch latency arrives — not
+    before, and without waiting for the bucket to fill."""
+    clock = FakeClock()
+    s = _sched(clock, sched_config=SchedulerConfig(slo_ms=1e4,
+                                                   flush_interval_ms=1e4))
+    rng = np.random.default_rng(0)
+    A, b = _req(rng)
+    fut = s.submit("lstsq", A, b, deadline=0.5)
+    assert s.poll() == 0 and not fut.done()       # plenty of headroom
+    clock.advance(0.4)
+    assert s.poll() == 0 and not fut.done()       # still inside budget
+    clock.advance(0.11)                           # past deadline - lead
+    assert s.poll() == 1 and fut.done()
+    st = s.stats()
+    assert st["flushes"]["deadline"] == 1 and st["completed"] == 1
+    # The EWMA raises the lead time: after a measured dispatch latency
+    # L, the next same-bucket request flushes 1.25 L (+1 ms floor)
+    # before its deadline instead of at it.
+    ewma = s._ewma[next(iter(s._ewma))]
+    ewma.update(0.2)                              # pretend dispatch got slow
+    lead = 1.25 * ewma.value + 1e-3
+    assert lead > 0.05                            # the seeded EWMA moved
+    submit_at = clock.now
+    fut2 = s.submit("lstsq", A, b, deadline=0.5)
+    clock.now = submit_at + 0.5 - lead - 0.01     # just inside the horizon
+    assert s.poll() == 0 and not fut2.done()
+    clock.now = submit_at + 0.5 - lead + 0.01     # just past it
+    assert s.poll() == 1 and fut2.done()
+
+
+def test_full_flush_at_max_batch_and_chunk_isolation(stub):
+    """Reaching the bucket's batch cap flushes immediately regardless of
+    deadlines; later arrivals stay queued for their own flush."""
+    clock = FakeClock()
+    s = _sched(clock)
+    rng = np.random.default_rng(1)
+    futs = [s.submit("lstsq", *_req(rng), deadline=1e3) for _ in range(5)]
+    assert s.poll() == 1                          # one "full" flush of 4
+    assert [f.done() for f in futs] == [True] * 4 + [False]
+    assert s.stats()["flushes"]["full"] == 1
+    assert len(stub[0]) == 4 and s.queue_depth() == 1
+
+
+def test_interval_flush_bounds_coalescing_wait(stub):
+    clock = FakeClock()
+    s = _sched(clock, sched_config=SchedulerConfig(
+        slo_ms=1e6, flush_interval_ms=100.0))
+    rng = np.random.default_rng(2)
+    fut = s.submit("lstsq", *_req(rng))           # deadline = slo: far away
+    clock.advance(0.09)
+    assert s.poll() == 0
+    clock.advance(0.02)
+    assert s.poll() == 1 and fut.done()
+    assert s.stats()["flushes"]["interval"] == 1
+
+
+def test_weighted_round_robin_fairness(stub):
+    """Tenant A (weight 3) floods a bucket; tenant B (weight 1) must
+    still land 1/4 of the oversubscribed flush instead of being starved
+    behind A's FIFO backlog."""
+    clock = FakeClock()
+    s = _sched(clock, sched_config=SchedulerConfig(
+        slo_ms=1e6, flush_interval_ms=1e6,
+        tenant_weights={"a": 3.0, "b": 1.0}))
+    rng = np.random.default_rng(3)
+    a_mats, b_mats = [], []
+    for _ in range(6):                            # A floods first...
+        A, b = _req(rng)
+        a_mats.append(A)
+        s.submit("lstsq", A, b, tenant="a", deadline=1e3)
+    for _ in range(2):                            # ...then B arrives
+        A, b = _req(rng)
+        b_mats.append(A)
+        s.submit("lstsq", A, b, tenant="b", deadline=1e3)
+    assert s.poll() == 2                          # two "full" flushes of 4
+    first = stub[0]
+    n_b = sum(1 for A in first if any(A is Bm for Bm in b_mats))
+    assert len(first) == 4 and n_b == 1, \
+        f"expected a 3:1 tenant mix in the first flush, got {4 - n_b}:{n_b}"
+    # FIFO within a tenant: A's requests dispatch in submission order.
+    a_order = [A for A in first if any(A is Am for Am in a_mats)]
+    assert [id(x) for x in a_order] == [id(x) for x in a_mats[:3]]
+
+
+def test_oldest_request_always_in_partial_flush(stub):
+    """The request whose deadline/interval fired the flush is always
+    taken, even when its tenant loses every WRR round (old bug: the
+    per-flush credit reset let a 5:1 flooder exclude the light tenant's
+    oldest request from every partial flush, missing its deadline on
+    every cycle)."""
+    clock = FakeClock()
+    s = _sched(clock, sched_config=SchedulerConfig(
+        slo_ms=1e6, flush_interval_ms=100.0,
+        tenant_weights={"a": 5.0, "b": 1.0}))
+    rng = np.random.default_rng(11)
+    Ab, bb = _req(rng)
+    s.submit("lstsq", Ab, bb, tenant="b", deadline=1e3)   # oldest
+    for _ in range(2):
+        s.submit("lstsq", *_req(rng), tenant="a", deadline=1e3)
+    clock.advance(0.11)                           # interval fires
+    assert s.poll() >= 1
+    assert any(A is Ab for A in stub[0]), \
+        "oldest (flush-triggering) request was starved out of its flush"
+
+
+def test_wrr_credit_persists_across_partial_flushes(stub):
+    """A light tenant that lost an oversubscribed flush banks its WRR
+    credit on the group (instead of restarting from zero), so it starts
+    the next flush ahead; credit for tenants with nothing queued is
+    dropped."""
+    clock = FakeClock()
+    s = _sched(clock, sched_config=SchedulerConfig(
+        slo_ms=1e6, flush_interval_ms=1e6,
+        tenant_weights={"a": 5.0, "b": 1.0}))
+    rng = np.random.default_rng(12)
+    for tenant in ("a", "a", "b"):
+        s.submit("lstsq", *_req(rng), tenant=tenant, deadline=1e3)
+    (group,) = s._groups.values()
+    with s._lock:
+        taken = s._take_locked(group, 2)
+    assert [p.tenant for p in taken] == ["a", "a"]    # 5:1 keeps the flush
+    assert group.credits == {"b": pytest.approx(2.0)}  # banked, a dropped
+    with s._lock:
+        taken2 = s._take_locked(group, 1)
+    assert [p.tenant for p in taken2] == ["b"]
+
+
+def test_cancelled_future_is_skipped_not_fatal(stub):
+    """``fut.cancel()`` on a queued request must drop it from the flush
+    — not raise ``InvalidStateError`` through the dispatcher (which
+    would kill the worker thread and hang every later submit)."""
+    clock = FakeClock()
+    s = _sched(clock, sched_config=SchedulerConfig(slo_ms=1e6,
+                                                   flush_interval_ms=1e6))
+    rng = np.random.default_rng(13)
+    A1, b1 = _req(rng)
+    A2, b2 = _req(rng)
+    f1 = s.submit("lstsq", A1, b1, deadline=1e3)
+    f2 = s.submit("lstsq", A2, b2, deadline=1e3)
+    assert f1.cancel()
+    s.drain()
+    assert f1.cancelled() and f2.done() and not f2.cancelled()
+    assert len(stub) == 1 and len(stub[0]) == 1 and stub[0][0] is A2
+    st = s.stats()
+    assert st["cancelled"] == 1 and st["completed"] == 1
+    # The dispatch loop survived: a follow-up request still completes.
+    f3 = s.submit("lstsq", A1, b1, deadline=1e3)
+    s.drain()
+    assert f3.done() and not f3.cancelled()
+    # All-cancelled flush: nothing dispatches, drain still terminates.
+    f4 = s.submit("lstsq", A1, b1, deadline=1e3)
+    f4.cancel()
+    s.drain()
+    assert f4.cancelled() and s.stats()["cancelled"] == 2
+    assert len(stub) == 2                         # no third dispatch
+
+
+def test_backpressure_rejects_with_retry_after(stub):
+    clock = FakeClock()
+    s = _sched(clock, sched_config=SchedulerConfig(
+        slo_ms=1e6, flush_interval_ms=50.0, queue_depth=4))
+    rng = np.random.default_rng(4)
+    reqs = [_req(rng) for _ in range(5)]
+    for A, b in reqs[:4]:
+        s.submit("lstsq", A, b, deadline=1e3)
+    with pytest.raises(BackpressureError) as exc:
+        s.submit("lstsq", *reqs[4], deadline=1e3)
+    assert exc.value.retry_after >= 0.05          # >= flush interval
+    assert s.stats()["rejected"] == 1
+    s.drain()                                     # capacity frees up...
+    fut = s.submit("lstsq", *reqs[4], deadline=1e3)  # ...admission resumes
+    s.drain()
+    assert fut.done()
+
+
+def test_policy_groups_do_not_cross_batch(stub):
+    """Same bucket, different policy => different compiled program =>
+    separate groups (one flush each), exactly like the sync tier's
+    cache-key separation."""
+    clock = FakeClock()
+    s = _sched(clock)
+    rng = np.random.default_rng(5)
+    A, b = _req(rng)
+    f1 = s.submit("lstsq", A, b, deadline=1e3)
+    f2 = s.submit("lstsq", A, b, deadline=1e3, policy="fast")
+    s.drain()
+    assert f1.done() and f2.done()
+    assert len(stub) == 2 and all(len(c) == 1 for c in stub)
+
+
+def test_submit_rejections(stub):
+    clock = FakeClock()
+    s = _sched(clock)
+    rng = np.random.default_rng(6)
+    A, b = _req(rng)
+    with pytest.raises(ValueError, match="right-hand side"):
+        s.submit("lstsq", A)
+    with pytest.raises(ValueError, match="no right-hand side"):
+        s.submit("qr", A, b)
+    with pytest.raises(ValueError, match="deadline"):
+        s.submit("lstsq", A, b, deadline=0.0)
+    with pytest.raises(ValueError, match="kind"):
+        s.submit("svd", A, b)
+    with pytest.raises(ValueError, match="tall"):
+        s.submit("lstsq", A.T, jnp.zeros((10,), jnp.float32))
+    # refine is a policy-armed knob on qr, same refusal as batched_qr
+    # (refine is a base-config override; submit resolves it per kind).
+    s_refine = _sched(clock, refine=1)
+    with pytest.raises(ValueError, match="batched_lstsq only"):
+        s_refine.submit("qr", A)
+
+
+def test_drain_shutdown_and_thread_lifecycle(stub):
+    """Real dispatcher thread: drain completes accepted work, shutdown
+    refuses new work, drain=False cancels the queue."""
+    rng = np.random.default_rng(7)
+    s = AsyncScheduler(serve_config=SCFG, block_size=8,
+                       sched_config=SchedulerConfig(slo_ms=1e6,
+                                                    flush_interval_ms=1e6))
+    futs = [s.submit("lstsq", *_req(rng), deadline=1e3) for _ in range(3)]
+    s.drain(timeout=10.0)
+    assert all(f.done() for f in futs)
+    assert s.stats()["flushes"]["drain"] >= 1
+    s.shutdown()
+    with pytest.raises(RuntimeError, match="shut down"):
+        s.submit("lstsq", *_req(rng))
+    s.shutdown()                                  # idempotent
+    # drain=False cancels what was still queued.
+    s2 = AsyncScheduler(serve_config=SCFG, block_size=8, start=False,
+                        sched_config=SchedulerConfig(slo_ms=1e6,
+                                                     flush_interval_ms=1e6))
+    fut = s2.submit("lstsq", *_req(rng), deadline=1e3)
+    s2.shutdown(drain=False)
+    assert fut.cancelled()
+
+
+def test_dispatch_failure_fails_futures(monkeypatch):
+    def boom(kind, As, bs, cfg, scfg, cache, consume, pol=None):
+        raise RuntimeError("boom")
+
+    monkeypatch.setattr(serve_engine, "_dispatch_groups", boom)
+    clock = FakeClock()
+    s = _sched(clock)
+    rng = np.random.default_rng(8)
+    fut = s.submit("lstsq", *_req(rng), deadline=1e3)
+    s.drain()
+    with pytest.raises(RuntimeError, match="boom"):
+        fut.result(timeout=1)
+    assert s.stats()["failed"] == 1
+
+
+def test_scheduler_config_from_env(monkeypatch):
+    monkeypatch.setenv("DHQR_SERVE_SLO_MS", "250")
+    monkeypatch.setenv("DHQR_SERVE_QUEUE_DEPTH", "32")
+    monkeypatch.setenv("DHQR_SERVE_FLUSH_INTERVAL_MS", "5")
+    monkeypatch.setenv("DHQR_SERVE_TENANT_WEIGHTS", "acme:3, free-tier:0.5")
+    cfg = SchedulerConfig.from_env(queue_depth=16)   # override wins
+    assert (cfg.slo_ms, cfg.queue_depth, cfg.flush_interval_ms) == \
+        (250.0, 16, 5.0)
+    assert cfg.weight_for("acme") == 3.0
+    assert cfg.weight_for("free-tier") == 0.5
+    assert cfg.weight_for("unnamed") == 1.0
+    with pytest.raises(ValueError, match="weight"):
+        SchedulerConfig(tenant_weights={"a": 0.0})
+    with pytest.raises(ValueError, match="name:weight"):
+        SchedulerConfig.from_env(
+            tenant_weights=__import__("dhqr_tpu.utils.config", fromlist=[
+                "_parse_tenant_weights"])._parse_tenant_weights("acme=3"))
+    with pytest.raises(ValueError, match="queue_depth"):
+        SchedulerConfig(queue_depth=0)
+
+
+def test_async_shares_sync_dispatch_path_key_parity():
+    """THE acceptance pin: a streamed mix dispatched by the scheduler
+    mints exactly the cache keys ``batched_lstsq`` mints for the same
+    requests (one ``_plan_key``, one ``_dispatch_groups``), so a cache
+    prewarmed through the sync tier serves the queue with ZERO
+    recompiles — and the answers match the sync tier's bit-for-bit.
+
+    Real engine, real compiles: tiny shapes, private caches.
+    """
+    rng = np.random.default_rng(9)
+    shapes = [(24, 10), (24, 10), (19, 19), (24, 10)]
+    As = [jnp.asarray(rng.random(s), jnp.float32) for s in shapes]
+    bs = [jnp.asarray(rng.random(s[0]), jnp.float32) for s in shapes]
+
+    # Sync pass on its own cache: the reference keys and answers.
+    sync_cache = ExecutableCache(max_size=8)
+    from dhqr_tpu.serve import batched_lstsq
+    xs_sync = batched_lstsq(As, bs, block_size=8, serve_config=SCFG,
+                            cache=sync_cache)
+
+    # Async pass against a cache prewarmed THROUGH THE SYNC TIER.
+    acache = ExecutableCache(max_size=8)
+    prewarm([(3, 24, 10), (1, 19, 19)], block_size=8, serve_config=SCFG,
+            cache=acache)
+    warm = acache.stats()["misses"]
+    s = AsyncScheduler(serve_config=SCFG, cache=acache, block_size=8,
+                       start=False,
+                       sched_config=SchedulerConfig(slo_ms=1e6,
+                                                    flush_interval_ms=1e6))
+    futs = [s.submit("lstsq", A, b, deadline=1e3, tenant=f"t{i % 2}")
+            for i, (A, b) in enumerate(zip(As, bs))]
+    s.drain()
+    assert acache.stats()["misses"] == warm, \
+        "async dispatch recompiled past the sync prewarm (key drift)"
+    for key in s.keys_seen:                       # every key the queue hit
+        assert key in sync_cache, key             # is a sync-tier key
+    for f, x_sync in zip(futs, xs_sync):
+        np.testing.assert_array_equal(np.asarray(f.result(timeout=1)),
+                                      np.asarray(x_sync))
+    # The qr kind rides the same path: factor one request through the
+    # queue and pin it against the sync batched_qr factorization.
+    from dhqr_tpu.serve import batched_qr
+    fact_sync = batched_qr(As[:1], block_size=8, serve_config=SCFG,
+                           cache=sync_cache)[0]
+    fq = s.submit("qr", As[0], deadline=1e3)
+    s.drain()
+    fact = fq.result(timeout=1)
+    np.testing.assert_array_equal(np.asarray(fact.H),
+                                  np.asarray(fact_sync.H))
+    np.testing.assert_array_equal(np.asarray(fact.alpha),
+                                  np.asarray(fact_sync.alpha))
+    # Latency accounting rode along: one histogram entry per request.
+    assert s.latency.count == len(As) + 1
+    assert s.stats()["latency"]["p99_ms"] > 0
+
+
+def test_submit_threads_race_single_dispatcher(stub):
+    """Admission is thread-safe: concurrent submitters against one
+    manual-mode scheduler never lose or double-complete a request."""
+    clock = FakeClock()
+    s = _sched(clock, sched_config=SchedulerConfig(
+        slo_ms=1e6, flush_interval_ms=1e6, queue_depth=4096))
+    rng = np.random.default_rng(10)
+    A, b = _req(rng)
+    futs, errs = [], []
+    lock = threading.Lock()
+
+    def submitter():
+        try:
+            mine = [s.submit("lstsq", A, b, deadline=1e3)
+                    for _ in range(25)]
+            with lock:
+                futs.extend(mine)
+        except Exception as e:  # pragma: no cover - the failure under test
+            errs.append(e)
+
+    threads = [threading.Thread(target=submitter) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    s.drain()
+    assert len(futs) == 100 and all(f.done() for f in futs)
+    st = s.stats()
+    assert st["submitted"] == 100 and st["completed"] == 100
+    assert st["queue_depth"] == 0

@@ -192,6 +192,7 @@ def test_batched_lstsq_mixed_dtypes_bucket_separately(cache):
     np.testing.assert_allclose(np.asarray(xs[1]), x_ref, atol=1e-10)
 
 
+@pytest.mark.slow  # ~7 s of policy-variant compiles; tier-1 budget
 def test_batched_lstsq_policy_and_refine(cache):
     As, bs = _mixed_requests(seed=23)
     xs = batched_lstsq(As, bs, block_size=8, policy="fast",
@@ -212,6 +213,7 @@ def test_batched_lstsq_policy_and_refine(cache):
                       serve_config=SCFG, cache=cache)
 
 
+@pytest.mark.slow  # ~5 s: per-request single-engine oracle compiles
 def test_batched_qr_matches_single_engine(cache):
     from dhqr_tpu.ops.blocked import blocked_householder_qr
 
@@ -225,6 +227,7 @@ def test_batched_qr_matches_single_engine(cache):
                                    atol=3e-5)
 
 
+@pytest.mark.slow  # ~7 s: refining solves compile per request shape
 def test_batched_qr_policy_arms_refining_solves(cache):
     As, bs = _mixed_requests(seed=47)
     facts = batched_qr(As, block_size=8, policy="balanced",
@@ -321,6 +324,57 @@ def test_cache_thread_safety_hit_evict_race():
     assert not errs, errs
     s = c.stats()
     assert s["size"] <= 2 and s["hits"] + s["misses"] == 160
+
+
+def test_cache_stats_atomic_under_concurrent_readers():
+    """The scheduler's stats endpoint reads cache.stats() from request
+    threads while dispatches mutate the cache. Every snapshot must be
+    one consistent cut (single lock acquisition): every resident entry
+    and every eviction was once a miss, so ``misses >= size + evictions``
+    and ``hits + misses`` never exceeds the operations issued so far —
+    in EVERY interleaving, not just at quiescence."""
+    import threading
+
+    c = ExecutableCache(max_size=3)
+
+    class _Lowered:  # instant "compile": the test is about locking
+        def compile(self):
+            return object()
+
+    stop = threading.Event()
+    bad = []
+
+    def reader():
+        while not stop.is_set():
+            s = c.stats()
+            if s["misses"] < s["size"] + s["evictions"]:
+                bad.append(("miss-accounting", s))
+            if s["hits"] + s["misses"] < s["size"]:
+                bad.append(("torn-snapshot", s))
+
+    readers = [threading.Thread(target=reader) for _ in range(2)]
+    for t in readers:
+        t.start()
+    writers_done = []
+
+    def writer(base):
+        for k in range(400):
+            c.get_or_compile(("s", (base + k) % 7), _Lowered)
+        writers_done.append(base)
+
+    writers = [threading.Thread(target=writer, args=(i,)) for i in range(3)]
+    for t in writers:
+        t.start()
+    for t in writers:
+        t.join()
+    stop.set()
+    for t in readers:
+        t.join()
+    assert not bad, bad[:3]
+    s = c.stats()
+    assert len(writers_done) == 3
+    assert s["hits"] + s["misses"] == 1200
+    assert s["misses"] == s["size"] + s["evictions"]
 
 
 def test_serve_rejections(cache):
