@@ -1,0 +1,362 @@
+"""Chaos ladder: the async serving tier under a seeded fault schedule.
+
+The round-12 tentpole's decision artifact. The SAME seeded Poisson×Zipf
+open-loop load generator as benchmarks/serving_async.py (round 11), run
+four times at one offered rate near saturation — fault-free baseline,
+light injected faults, heavy injected faults, and recovery (faults
+disarmed again) — plus a worker-crash segment and a quarantine segment:
+
+* ``open_loop_baseline`` — no faults armed: the PR-6 behavior (and the
+  number recovery is judged against);
+* ``open_loop_faults_light`` / ``..._heavy`` — ``dhqr_tpu.faults``
+  armed on the ``serve.dispatch`` (transient dispatch failures, retried
+  with backoff / bisected) and ``serve.latency`` (injected dispatch
+  latency) sites at two seeded intensities: throughput must DEGRADE
+  MONOTONICALLY with the injected fault rate, and every accepted
+  request's future must still resolve — success or typed ServeError,
+  no hang, no lost request (THE chaos invariant, also pinned by
+  tests/test_faults.py);
+* ``open_loop_recovery`` — harness disarmed: throughput must return to
+  >= 0.9x the fault-free baseline and the steady state must be
+  ZERO-recompile again (cache misses flat across the phase) — chaos
+  must leave no residue;
+* ``worker_crashes`` — ``serve.worker`` armed for exactly 2 crashes
+  against the live dispatcher pool: both crashes detected + respawned,
+  the stream still completes;
+* ``quarantine`` — a fresh cache with one injected compile failure: the
+  poison bucket fails typed (CompileFailed, then Quarantined inside the
+  cooldown — exactly ONE compile attempt), and after expiry the same
+  key compiles clean and serves warm.
+
+Acceptance (ISSUE 7): every submitted future resolves typed under every
+schedule; rps(heavy) <= rps(light) <= rps(baseline) within noise;
+recovery >= 0.9x baseline with 0 recompiles; quarantine caps the poison
+bucket at one compile per cooldown.
+
+Usage:  python benchmarks/serving_faults.py [n_requests] [rate_frac]
+Writes: benchmarks/results/serving_faults_<platform>.jsonl (append).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# The round-8/11 shape ladder verbatim: the chaos numbers stay
+# comparable to the serving_async artifact.
+SHAPE_LADDER = [
+    (64, 16), (100, 36), (128, 48), (192, 64),
+    (250, 100), (384, 128), (500, 180), (640, 256),
+]
+MICRO_BATCH = 32
+SLO_MS = 2000.0           # generous: faults should surface as retries
+                          # and degraded throughput, not deadline kills
+# Shorter than round-11's 300 ms on purpose: the chaos ladder wants MANY
+# dispatches per phase (every dispatch is a fault-site visit), and the
+# degradation metric is end-to-end throughput rather than SLO-shaped
+# in-window completions, so coalescing breadth matters less here.
+FLUSH_INTERVAL_MS = 100.0
+
+# The two seeded fault intensities. Aggressive on purpose: on this
+# shared CPU the run-to-run throughput noise is +-10-20%, so the
+# injected degradation must be far larger to make the monotonicity
+# check meaningful.
+LIGHT_FAULTS = dict(dispatch_p=0.15, latency_p=0.40, latency_ms=40.0)
+HEAVY_FAULTS = dict(dispatch_p=0.35, latency_p=0.70, latency_ms=80.0)
+
+
+def _stage(name: str) -> None:
+    print(f"::stage {name} t={time.time():.1f}", file=sys.stderr, flush=True)
+
+
+def main(n_requests: int = 384, rate_frac: float = 0.90) -> None:
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(3))
+    from bench import ROUND, _Watchdog
+
+    _stage("import")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(_REPO, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+
+    from dhqr_tpu import faults
+    from dhqr_tpu.serve import AsyncScheduler, ServeError, prewarm
+    from dhqr_tpu.serve.cache import ExecutableCache
+    from dhqr_tpu.serve.errors import CompileFailed, Quarantined
+    from dhqr_tpu.utils.config import (FaultConfig, SchedulerConfig,
+                                       ServeConfig)
+    from dhqr_tpu.utils.profiling import LatencyHistogram, sync
+
+    _stage("backend_init")
+    with _Watchdog("backend_init", 240):
+        dev = jax.devices()[0]
+        platform = dev.platform
+        kind = getattr(dev, "device_kind", "?")
+        sync(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    _stage(f"backend_ready_{platform}")
+    out_path = os.path.join(_REPO, "benchmarks", "results",
+                            f"serving_faults_{platform}.jsonl")
+
+    def emit(rec):
+        rec.update(platform=platform, device_kind=kind, round=ROUND)
+        line = json.dumps(rec)
+        print(line, flush=True)
+        with open(out_path, "a") as f:
+            f.write(line + "\n")
+
+    # ---- the request stream (fixed seeds: artifact is reproducible) ----
+    rng = np.random.default_rng(0)
+    ranks = np.arange(len(SHAPE_LADDER))
+    weights = 1.0 / (ranks + 1.0) ** 1.1
+    weights /= weights.sum()
+    picks = rng.choice(len(SHAPE_LADDER), size=n_requests, p=weights)
+    shapes = [SHAPE_LADDER[i] for i in picks]
+    As = [jnp.asarray(rng.random(s), jnp.float32) for s in shapes]
+    bs = [jnp.asarray(rng.random(s[0]), jnp.float32) for s in shapes]
+    sync(As[-1])
+    scfg = ServeConfig(max_batch=MICRO_BATCH)
+    arrivals = None  # filled after capacity is measured
+
+    _stage("prewarm")
+    with _Watchdog("prewarm", 2400):
+        acache = ExecutableCache(max_size=64)
+        pow2 = [1 << i for i in range((MICRO_BATCH - 1).bit_length() + 1)
+                if 1 << i <= MICRO_BATCH]
+        keys = prewarm([(c, m, n) for (m, n) in SHAPE_LADDER for c in pow2],
+                       serve_config=scfg, cache=acache)
+    emit({"metric": "serving_faults", "phase": "prewarm",
+          "keys": len(keys), "cache": acache.stats()})
+
+    # ---- capacity probe: sets the open-loop operating point ------------
+    _stage("capacity")
+    with _Watchdog("capacity", 1800):
+        cap_sched = AsyncScheduler(
+            serve_config=scfg,
+            sched_config=SchedulerConfig(slo_ms=60e3, queue_depth=16384,
+                                         flush_interval_ms=FLUSH_INTERVAL_MS),
+            cache=acache, start=False)
+        drain_s = 0.0
+        for _ in range(2):
+            futs = [cap_sched.submit("lstsq", A, b, deadline=60.0)
+                    for A, b in zip(As, bs)]
+            t0 = time.perf_counter()
+            cap_sched.drain()
+            drain_s += time.perf_counter() - t0
+            assert all(f.done() for f in futs)
+        capacity_rps = 2 * n_requests / drain_s
+        cap_sched.shutdown()
+    emit({"metric": "serving_faults", "phase": "capacity",
+          "requests_per_s": round(capacity_rps, 1)})
+    offered_rps = rate_frac * capacity_rps
+    inter = np.random.default_rng(1).exponential(
+        1.0 / offered_rps, size=n_requests)
+    arrivals = np.cumsum(inter)
+
+    # ---- one open-loop pass (shared by all four phases) ----------------
+    def open_loop(phase, fault_cfg=None):
+        """Poisson open loop at the fixed offered rate; returns the
+        phase record. The SAME arrival schedule every phase, so the
+        only variable across phases is the armed fault schedule. The
+        phase's throughput number is END-TO-END (first submit -> last
+        completion): on a seconds-long stream it is the measure that
+        actually moves with injected latency and retry work, where
+        in-window completions quantize to the offered rate."""
+        lat = LatencyHistogram()
+        sched = AsyncScheduler(
+            serve_config=scfg,
+            sched_config=SchedulerConfig(slo_ms=SLO_MS, queue_depth=4096,
+                                         flush_interval_ms=FLUSH_INTERVAL_MS),
+            cache=acache)
+        futs, done_at = [None] * n_requests, [0.0] * n_requests
+
+        def run_stream():
+            t_start = time.perf_counter()
+            rejected = 0
+            for i in range(n_requests):
+                delay = t_start + arrivals[i] - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                t_submit = time.perf_counter()
+                try:
+                    fut = sched.submit("lstsq", As[i], bs[i],
+                                       deadline=SLO_MS / 1e3,
+                                       tenant=f"t{picks[i]}")
+                except ServeError:
+                    rejected += 1
+                    continue
+
+                def cb(f, i=i, t=t_submit):
+                    done_at[i] = time.perf_counter()
+                    lat.record(done_at[i] - t)
+
+                fut.add_done_callback(cb)
+                futs[i] = fut
+            return t_start, rejected
+
+        misses0 = acache.stats()["misses"]
+        harness = faults.install(fault_cfg) if fault_cfg else None
+        try:
+            t_start, rejected = run_stream()
+            # THE chaos invariant: every ACCEPTED future resolves.
+            from concurrent.futures import wait as _wait
+            accepted = [f for f in futs if f is not None]
+            _wait(accepted, timeout=600)
+            assert all(f.done() for f in accepted), \
+                f"{phase}: futures hung under the fault schedule"
+        finally:
+            if fault_cfg:
+                faults.uninstall()
+        sched_stats = sched.stats()
+        sched.shutdown()
+        typed_failures = 0
+        for f in accepted:
+            exc = f.exception()
+            if exc is not None:
+                assert isinstance(exc, ServeError), exc
+                typed_failures += 1
+        t_arr_end = t_start + arrivals[-1]
+        in_window = sum(1 for d in done_at if 0.0 < d <= t_arr_end)
+        t_last = max((d for d in done_at if d), default=t_start)
+        ete_rps = len(accepted) / max(t_last - t_start, 1e-9)
+        rec = {
+            "metric": "serving_faults", "phase": phase,
+            "requests": n_requests, "rejected": rejected,
+            "offered_rps": round(offered_rps, 1),
+            "end_to_end_rps": round(ete_rps, 1),
+            "in_window_rps": round(in_window / arrivals[-1], 1),
+            "typed_failures": typed_failures,
+            "all_accepted_resolved": all(f.done() for f in accepted),
+            "recompiles": acache.stats()["misses"] - misses0,
+            "client_latency": lat.snapshot(),
+            "scheduler": {k: sched_stats[k] for k in (
+                "completed", "failed", "rejected", "rejected_unmeetable",
+                "retries", "bisections", "poisoned", "flush_failures",
+                "worker_crashes", "deadline_misses", "dispatches")},
+        }
+        if harness is not None:
+            rec["injected"] = harness.stats()
+        emit(rec)
+        return rec
+
+    def fault_config(p):
+        return FaultConfig(
+            sites=(("serve.dispatch", p["dispatch_p"], None),
+                   ("serve.latency", p["latency_p"], None)),
+            seed=7, latency_ms=p["latency_ms"])
+
+    # Untimed warm stream first: the first threaded pass pays one-time
+    # costs (thread-pool startup, executable first-touch) that would
+    # land entirely on the baseline and flatter every later phase.
+    _stage("open_loop_warmup")
+    with _Watchdog("open_loop_warmup", 2400):
+        open_loop("open_loop_warmup")
+    _stage("open_loop_baseline")
+    with _Watchdog("open_loop_baseline", 2400):
+        base = open_loop("open_loop_baseline")
+    _stage("open_loop_faults_light")
+    with _Watchdog("open_loop_faults_light", 2400):
+        light = open_loop("open_loop_faults_light",
+                          fault_config(LIGHT_FAULTS))
+    _stage("open_loop_faults_heavy")
+    with _Watchdog("open_loop_faults_heavy", 2400):
+        heavy = open_loop("open_loop_faults_heavy",
+                          fault_config(HEAVY_FAULTS))
+    _stage("open_loop_recovery")
+    with _Watchdog("open_loop_recovery", 2400):
+        recov = open_loop("open_loop_recovery")
+
+    # ---- worker-crash segment ------------------------------------------
+    _stage("worker_crashes")
+    with _Watchdog("worker_crashes", 1200):
+        wcfg = FaultConfig(sites=(("serve.worker", 1.0, 2),), seed=0)
+        wsched = AsyncScheduler(
+            serve_config=scfg, cache=acache, workers=2,
+            sched_config=SchedulerConfig(slo_ms=60e3,
+                                         flush_interval_ms=50.0))
+        with faults.injected(wcfg) as wharness:
+            wfuts = [wsched.submit("lstsq", As[i], bs[i], deadline=60.0)
+                     for i in range(min(64, n_requests))]
+            for f in wfuts:
+                f.result(timeout=120)
+        wstats = wsched.stats()
+        alive = sum(t.is_alive() for t in wsched._threads)
+        wsched.shutdown()
+    emit({"metric": "serving_faults", "phase": "worker_crashes",
+          "requests": len(wfuts), "injected": wharness.stats(),
+          "worker_crashes": wstats["worker_crashes"],
+          "workers_alive_after": alive,
+          "completed": wstats["completed"]})
+
+    # ---- quarantine segment --------------------------------------------
+    _stage("quarantine")
+    with _Watchdog("quarantine", 1200):
+        qcache = ExecutableCache(max_size=8, quarantine_s=2.0)
+        qcfg = FaultConfig(sites=(("serve.compile", 1.0, 1),), seed=0)
+        from dhqr_tpu.serve import batched_lstsq
+        qA, qb = As[0], bs[0]
+        outcomes = []
+        with faults.injected(qcfg):
+            for _ in range(3):      # poison bucket stays hot...
+                try:
+                    batched_lstsq([qA], [qb], serve_config=scfg,
+                                  cache=qcache)
+                    outcomes.append("ok")
+                except CompileFailed:
+                    outcomes.append("compile_failed")
+                except Quarantined:
+                    outcomes.append("quarantined")
+        time.sleep(2.1)             # ...cooldown expires...
+        x = batched_lstsq([qA], [qb], serve_config=scfg, cache=qcache)[0]
+        assert x.shape == (qA.shape[1],)
+        qstats = qcache.stats()
+    emit({"metric": "serving_faults", "phase": "quarantine",
+          "outcomes": outcomes, "cache": qstats})
+
+    # ---- verdict -------------------------------------------------------
+    rps = [base["end_to_end_rps"], light["end_to_end_rps"],
+           heavy["end_to_end_rps"], recov["end_to_end_rps"]]
+    noise = 1.05                     # shared-CPU run-to-run tolerance
+    monotone = rps[1] <= rps[0] * noise and rps[2] <= rps[1] * noise \
+        and rps[2] < rps[0]
+    recovered = rps[3] >= 0.9 * rps[0]
+    resolved = all(r["all_accepted_resolved"]
+                   for r in (base, light, heavy, recov))
+    quarantine_ok = (outcomes == ["compile_failed", "quarantined",
+                                  "quarantined"]
+                     and qstats["compile_failures"] == 1)
+    ok = (monotone and recovered and resolved
+          and recov["recompiles"] == 0 and base["typed_failures"] == 0
+          and recov["typed_failures"] == 0
+          and wstats["worker_crashes"] == 2 and alive >= 2
+          and quarantine_ok)
+    emit({"metric": "serving_faults_verdict",
+          "baseline_rps": rps[0], "faults_light_rps": rps[1],
+          "faults_heavy_rps": rps[2], "recovery_rps": rps[3],
+          "degradation_light": round(rps[1] / rps[0], 3),
+          "degradation_heavy": round(rps[2] / rps[0], 3),
+          "recovery_fraction_of_baseline": round(rps[3] / rps[0], 3),
+          "throughput_monotone_in_fault_rate": bool(monotone),
+          "recovered_to_0p9x": bool(recovered),
+          "every_accepted_future_resolved": bool(resolved),
+          "zero_recompiles_after_recovery": recov["recompiles"] == 0,
+          "worker_crashes_respawned": wstats["worker_crashes"],
+          "quarantine_single_compile": bool(quarantine_ok),
+          "ok": bool(ok)})
+    _stage("done")
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 384,
+         float(sys.argv[2]) if len(sys.argv) > 2 else 0.90)

@@ -47,6 +47,11 @@ from dhqr_tpu.precision import (
 from dhqr_tpu.serve import (
     AsyncScheduler,
     BackpressureError,
+    CompileFailed,
+    DeadlineExceeded,
+    DispatchFailed,
+    Quarantined,
+    ServeError,
     batched_lstsq,
     batched_qr,
 )
@@ -56,6 +61,7 @@ from dhqr_tpu.serve import (
 from dhqr_tpu.tune import Plan, PlanDB, resolve_plan
 from dhqr_tpu.utils.config import (
     DHQRConfig,
+    FaultConfig,
     SchedulerConfig,
     ServeConfig,
     TuneConfig,
@@ -85,7 +91,13 @@ __all__ = [
     "batched_lstsq",
     "AsyncScheduler",
     "BackpressureError",
+    "ServeError",
+    "CompileFailed",
+    "DispatchFailed",
+    "DeadlineExceeded",
+    "Quarantined",
     "DHQRConfig",
+    "FaultConfig",
     "ServeConfig",
     "SchedulerConfig",
     "TuneConfig",

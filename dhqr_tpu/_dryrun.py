@@ -189,6 +189,66 @@ def run(n_devices: int) -> None:
           f"{st['latency']['p99_ms']:.1f} ms <= SLO {slo_s * 1e3:.0f} ms)",
           flush=True)
 
+    # Fault model (round 12): a tiny stream with ONE injected compile
+    # failure and ONE injected dispatch failure through the resilient
+    # scheduler — every future must resolve (here: succeed, after
+    # retry/backoff and quarantine expiry), the harness must account
+    # exactly the two injected faults, and a warm repeat after recovery
+    # must be ZERO-recompile (the steady-state contract survives chaos).
+    import time as _time
+
+    from dhqr_tpu import faults as _faults_mod
+    from dhqr_tpu.utils.config import FaultConfig
+
+    fcache = ExecutableCache(max_size=16, quarantine_s=0.2)
+    fkcfg = SchedulerConfig(slo_ms=30e3, flush_interval_ms=20.0,
+                            retry_base_ms=5.0)
+    fault_cfg = FaultConfig(sites=(("serve.compile", 1.0, 1),
+                                   ("serve.dispatch", 1.0, 1)), seed=0)
+    fsched = AsyncScheduler(sched_config=fkcfg, cache=fcache,
+                            block_size=8, start=False)
+    with _faults_mod.injected(fault_cfg) as harness:
+        ffuts = [fsched.submit("lstsq", Ai, bi, deadline=30.0)
+                 for Ai, bi in zip(As, rhs)]
+        t0 = _time.monotonic()
+        while not all(f.done() for f in ffuts):
+            fsched.poll()
+            if _time.monotonic() - t0 > 120:
+                raise RuntimeError(
+                    "faults stage: futures did not resolve in 120 s "
+                    f"(stats: {fsched.stats()})")
+            _time.sleep(0.01)
+    for i, fut in enumerate(ffuts):
+        xi = fut.result(timeout=0)      # resolved: success, not typed err
+        res = normal_equations_residual(As[i], np.asarray(xi), rhs[i])
+        ref = oracle_residual(np.asarray(As[i]), np.asarray(rhs[i]))
+        assert res < TOLERANCE_FACTOR * ref, ("faults", i, res, ref)
+    hstats = harness.stats()
+    assert hstats["serve.compile"]["fired"] == 1, hstats
+    assert hstats["serve.dispatch"]["fired"] == 1, hstats
+    fstats = fsched.stats()
+    assert fstats["retries"] >= 2 and fstats["flush_failures"] >= 2, fstats
+    assert fstats["failed"] == 0 and fstats["completed"] == len(As), fstats
+    cstats = fcache.stats()
+    assert cstats["compile_failures"] == 1, cstats
+    # Recovery: one drain pass may mint drain-shaped batch keys; the
+    # repeat after it must be zero-recompile (back to PR-6 steady state).
+    for attempt in ("recovery", "warm"):
+        if attempt == "warm":
+            warm_misses = fcache.stats()["misses"]
+        ffuts = [fsched.submit("lstsq", Ai, bi, deadline=30.0)
+                 for Ai, bi in zip(As, rhs)]
+        fsched.drain()
+        assert all(f.exception() is None for f in ffuts), attempt
+    assert fcache.stats()["misses"] == warm_misses, (
+        "post-recovery repeat recompiled", fcache.stats())
+    fsched.shutdown()
+    print(f"dryrun: faults ok ({len(As)} requests through 1 injected "
+          f"compile + 1 injected dispatch failure, {fstats['retries']} "
+          "retries, all futures resolved within 8x, quarantine "
+          "released, warm repeat after recovery 0 recompiles)",
+          flush=True)
+
     # Plan autotuner (round 9): a tiny-grid on-device search must run end
     # to end on CPU — tune, persist, resolve through the PUBLIC lstsq
     # plan="auto" path — with the tuned answer held to the same 8x LAPACK

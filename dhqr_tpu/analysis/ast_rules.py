@@ -1,4 +1,4 @@
-"""Pass 1 — AST lint rules DHQR001-DHQR005.
+"""Pass 1 — AST lint rules DHQR001-DHQR006.
 
 Each rule is a small class with an id, a scope predicate over the
 (posix) file path, and a ``check(module)`` hook receiving a
@@ -507,12 +507,55 @@ class CollectiveAxisName(Rule):
         return out
 
 
+class SwallowedException(Rule):
+    """DHQR006 — an ``except ...: pass`` (or bare-``...`` body) in
+    package code silently discards a failure: the round-12 fault model
+    depends on every failure path SURFACING typed (retry, quarantine,
+    bisection, worker respawn all key on seeing the exception), and one
+    swallowed ``except`` upstream turns a designed failure into a
+    silent wrong answer or a hang. Where discarding really is the
+    intent (a best-effort cleanup, an optional probe), suppress with
+    the reason — the reason is the documentation the bare ``pass``
+    was hiding."""
+
+    id = "DHQR006"
+    title = "swallowed exception (except: pass) without a suppression"
+
+    def applies(self, path: str) -> bool:
+        return _in_package(path)
+
+    @staticmethod
+    def _is_noop(stmt: ast.stmt) -> bool:
+        return isinstance(stmt, ast.Pass) or (
+            isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is Ellipsis)
+
+    def check(self, ctx):
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not all(self._is_noop(s) for s in node.body):
+                continue
+            caught = "everything" if node.type is None else (
+                _call_name(node.type) or "multiple exception types")
+            out.append(self._finding(
+                ctx, node,
+                f"except block catching {caught} swallows the error "
+                "with a bare pass — handle it, reraise typed, or "
+                "suppress with the reason discarding is safe here",
+            ))
+        return out
+
+
 AST_RULES = (
     PrivateJaxImports(),
     UnannotatedContractions(),
     GlobalConfigMutation(),
     HostSyncInTracedBody(),
     CollectiveAxisName(),
+    SwallowedException(),
 )
 
 

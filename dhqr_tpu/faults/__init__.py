@@ -1,0 +1,44 @@
+"""Deterministic fault injection (``dhqr_tpu.faults``) — round 12.
+
+Arm a seeded fault schedule against the serving stack's named injection
+points and prove the failure behavior is designed, not discovered:
+
+    >>> from dhqr_tpu.faults import injected
+    >>> from dhqr_tpu.utils.config import FaultConfig
+    >>> cfg = FaultConfig(sites=(("serve.dispatch", 1.0, 1),), seed=0)
+    >>> with injected(cfg) as harness:
+    ...     xs = batched_lstsq(As, bs)      # first dispatch fails, typed
+    >>> harness.stats()["serve.dispatch"]["fired"]
+    1
+
+Environment arming: ``DHQR_FAULTS="serve.dispatch:0.05,serve.latency:0.2"``
+(+ ``DHQR_FAULTS_SEED`` / ``DHQR_FAULTS_LATENCY_MS``) then
+``faults.install()``. With nothing configured every injection point is a
+single module-global ``None`` check — see ``faults/harness.py`` for the
+site registry and guarantees, docs/DESIGN.md "Fault model" for the
+taxonomy the serving tier resolves injected failures into.
+"""
+
+from dhqr_tpu.faults.harness import (
+    SITES,
+    FaultHarness,
+    FaultInjected,
+    active,
+    fire,
+    injected,
+    install,
+    latency,
+    uninstall,
+)
+
+__all__ = [
+    "SITES",
+    "FaultHarness",
+    "FaultInjected",
+    "active",
+    "fire",
+    "injected",
+    "install",
+    "latency",
+    "uninstall",
+]

@@ -1,0 +1,227 @@
+"""Deterministic, seedable fault injection for the serving stack.
+
+Production failure behavior must be DESIGNED and TESTED, not discovered
+(the TPU linear-algebra paper's multi-chip jobs lose whole sessions to
+one wedged device init — the exact relay failure mode recorded in
+BENCH_r04/r05). This module is how the repo injects those failures on
+demand: a small registry of named injection points threaded through the
+serving tier, armed by a :class:`~dhqr_tpu.utils.config.FaultConfig`
+(``DHQR_FAULTS`` in the environment, or :func:`install` / the
+:func:`injected` context manager programmatically).
+
+Design constraints, in order:
+
+* **Zero overhead when disabled.** Every injection point compiles down
+  to one module-global read and a ``None`` check
+  (:func:`fire` / :func:`latency`); no config parse, no RNG draw, no
+  lock. ``DHQR_FAULTS`` unset means the serving tier runs the PR-6
+  code byte-for-byte.
+* **Deterministic.** Each site draws from its own ``random.Random``
+  stream seeded by (config seed, site name), so the schedule at one
+  site never depends on how often other sites were visited, and the
+  same seed replays the same schedule for the same visit sequence.
+  Cross-thread visit ORDER at a single site is the one residual
+  nondeterminism — configs that need exactness (tests, the dry run)
+  use ``prob=1.0`` with a ``max_triggers`` count, which is
+  interleaving-independent.
+* **Accounted.** Triggers land on a shared
+  :class:`~dhqr_tpu.utils.profiling.Counters` (``fired_<site>`` /
+  ``visits_<site>``), snapshot via :meth:`FaultHarness.stats` — the
+  chaos benchmark's "injected fault rate" is read from the harness
+  itself, not re-derived.
+
+Sites (the registry is closed on purpose — an unknown site name in a
+config is a spelled-wrong experiment, and it fails at install time):
+
+====================  ======  ==============================================
+site                  action  where it is threaded
+====================  ======  ==============================================
+``serve.compile``     raise   ``serve.cache.ExecutableCache.get_or_compile``,
+                              inside the compile block — surfaces as
+                              :class:`~dhqr_tpu.serve.errors.CompileFailed`
+                              and quarantines the key like a real one
+``serve.dispatch``    raise   ``serve.engine._dispatch_groups``, at the
+                              compiled-program call — surfaces as
+                              :class:`~dhqr_tpu.serve.errors.DispatchFailed`
+``serve.worker``      raise   ``serve.scheduler.AsyncScheduler._run``, top
+                              of the dispatcher-worker loop — kills the
+                              worker thread; crash detection respawns it
+``serve.latency``     sleep   ``serve.engine._dispatch_groups``, before the
+                              dispatch — models a slow device/host without
+                              failing anything
+====================  ======  ==============================================
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+import threading
+import time
+import zlib
+from typing import Iterator, Optional
+
+from dhqr_tpu.utils.config import FaultConfig
+from dhqr_tpu.utils.profiling import Counters
+
+# site name -> action kind. "raise" sites throw FaultInjected when they
+# trigger; "sleep" sites block for FaultConfig.latency_ms.
+SITES = {
+    "serve.compile": "raise",
+    "serve.dispatch": "raise",
+    "serve.worker": "raise",
+    "serve.latency": "sleep",
+}
+
+
+class FaultInjected(RuntimeError):
+    """The exception a triggered ``raise``-kind site throws. Carries the
+    site name so downstream classification (and tests) can tell an
+    injected failure from an organic one."""
+
+    def __init__(self, site: str) -> None:
+        super().__init__(f"injected fault at {site!r}")
+        self.site = site
+
+
+class _SiteState:
+    __slots__ = ("prob", "remaining", "rng")
+
+    def __init__(self, prob: float, max_triggers: "int | None",
+                 rng: random.Random) -> None:
+        self.prob = prob
+        self.remaining = max_triggers  # None = unbounded
+        self.rng = rng
+
+
+class FaultHarness:
+    """One armed fault schedule. Normally managed through the module
+    globals (:func:`install` / :func:`injected`); constructed directly
+    only by tests that probe determinism.
+
+    ``sleeper`` is injectable so latency-site tests don't wall-clock
+    sleep.
+    """
+
+    def __init__(self, config: FaultConfig,
+                 sleeper=time.sleep) -> None:
+        self.config = config
+        self.counters = Counters()
+        self._sleep = sleeper
+        self._lock = threading.Lock()
+        self._sites: "dict[str, _SiteState]" = {}
+        for site, prob, count in config.sites:
+            if site not in SITES:
+                raise ValueError(
+                    f"unknown fault site {site!r}; registered sites: "
+                    f"{', '.join(sorted(SITES))}")
+            # One independent stream per site, derived stably from
+            # (seed, site): crc32 rather than hash() so the schedule
+            # survives PYTHONHASHSEED randomization.
+            rng = random.Random(
+                (config.seed << 32) ^ zlib.crc32(site.encode("utf-8")))
+            self._sites[site] = _SiteState(prob, count, rng)
+
+    def should_fire(self, site: str) -> bool:
+        """Draw the site's next decision (and account the visit)."""
+        state = self._sites.get(site)
+        if state is None:
+            return False
+        with self._lock:
+            self.counters.bump(f"visits_{site}")
+            if state.remaining is not None and state.remaining <= 0:
+                return False
+            if state.prob < 1.0 and state.rng.random() >= state.prob:
+                return False
+            if state.remaining is not None:
+                state.remaining -= 1
+            self.counters.bump(f"fired_{site}")
+            return True
+
+    def fire(self, site: str) -> None:
+        """Raise :class:`FaultInjected` if the site triggers this visit."""
+        if SITES.get(site) != "raise":
+            raise ValueError(f"{site!r} is not a raise-kind fault site")
+        if self.should_fire(site):
+            raise FaultInjected(site)
+
+    def latency(self, site: str) -> None:
+        """Sleep ``latency_ms`` if the site triggers this visit."""
+        if SITES.get(site) != "sleep":
+            raise ValueError(f"{site!r} is not a sleep-kind fault site")
+        if self.should_fire(site) and self.config.latency_ms > 0:
+            self._sleep(self.config.latency_ms / 1e3)
+
+    def stats(self) -> dict:
+        """JSON-ready visit/trigger counts per configured site."""
+        snap = self.counters.snapshot()
+        return {
+            site: {
+                "visits": int(snap.get(f"visits_{site}", 0)),
+                "fired": int(snap.get(f"fired_{site}", 0)),
+            }
+            for site in self._sites
+        }
+
+
+# The one armed harness (or None — the fast path). Assignment is atomic
+# under the GIL; injection points read it exactly once per visit.
+_ACTIVE: "FaultHarness | None" = None
+_INSTALL_LOCK = threading.Lock()
+
+
+def install(config: "FaultConfig | None" = None,
+            sleeper=time.sleep) -> FaultHarness:
+    """Arm the process-wide harness from ``config`` (default: the
+    environment's ``DHQR_FAULTS*``). Replaces any previously armed
+    harness. Returns the harness so callers can read its stats."""
+    global _ACTIVE
+    cfg = config if config is not None else FaultConfig.from_env()
+    harness = FaultHarness(cfg, sleeper=sleeper)
+    with _INSTALL_LOCK:
+        _ACTIVE = harness if cfg.enabled else None
+    return harness
+
+
+def uninstall() -> None:
+    """Disarm: every injection point reverts to the zero-overhead path."""
+    global _ACTIVE
+    with _INSTALL_LOCK:
+        _ACTIVE = None
+
+
+def active() -> Optional[FaultHarness]:
+    """The currently armed harness, or None."""
+    return _ACTIVE
+
+
+@contextlib.contextmanager
+def injected(config: FaultConfig, sleeper=time.sleep) -> Iterator[FaultHarness]:
+    """Scope a fault schedule: arm on entry, disarm on exit (restoring
+    whatever was armed before — scopes nest)."""
+    global _ACTIVE
+    with _INSTALL_LOCK:
+        previous = _ACTIVE
+    harness = install(config, sleeper=sleeper)
+    try:
+        yield harness
+    finally:
+        with _INSTALL_LOCK:
+            _ACTIVE = previous
+
+
+def fire(site: str) -> None:
+    """Injection point for ``raise``-kind sites: no-op unless a harness
+    is armed AND the site triggers, in which case :class:`FaultInjected`
+    propagates. THE hot-path entry — one global read when disarmed."""
+    harness = _ACTIVE
+    if harness is not None:
+        harness.fire(site)
+
+
+def latency(site: str = "serve.latency") -> None:
+    """Injection point for ``sleep``-kind sites: no-op unless armed and
+    triggered, in which case the configured latency is slept."""
+    harness = _ACTIVE
+    if harness is not None:
+        harness.latency(site)

@@ -116,6 +116,7 @@ def _gate_params(device=None) -> tuple:
                     f"(or add the kind to _MEASURED_VMEM_KINDS).",
                     stacklevel=3,
                 )
+    # dhqr: ignore[DHQR006] best-effort unknown-chip WARNING only: the conservative budget below is already chosen, and a failure probing device_kind must not break planning
     except Exception:
         pass
     if env_budget:

@@ -22,8 +22,15 @@ the same buckets through the same dispatch path (``serve.scheduler``):
     >>> fut = sched.submit("lstsq", A, b, deadline=0.05, tenant="acme")
     >>> x = fut.result()
 
-See docs/DESIGN.md "Serving tier" / "Async serving" for the rationale
-and docs/OPERATIONS.md for the cache and SLO-tuning runbooks.
+Failure behavior is typed (round 12): every failed serve call or
+future carries a :class:`ServeError` subclass — ``CompileFailed`` /
+``DispatchFailed`` / ``DeadlineExceeded`` / ``Quarantined`` /
+``BackpressureError`` — and the scheduler retries, quarantines,
+bisects poison batches and respawns crashed workers so every submitted
+future resolves (``dhqr_tpu.faults`` injects the failures that prove
+it). See docs/DESIGN.md "Serving tier" / "Async serving" / "Fault
+model" for the rationale and docs/OPERATIONS.md for the cache, SLO
+and fault-triage runbooks.
 """
 
 from dhqr_tpu.serve.buckets import (
@@ -45,14 +52,27 @@ from dhqr_tpu.serve.engine import (
     bucket_program,
     prewarm,
 )
-from dhqr_tpu.serve.scheduler import AsyncScheduler, BackpressureError
+from dhqr_tpu.serve.errors import (
+    BackpressureError,
+    CompileFailed,
+    DeadlineExceeded,
+    DispatchFailed,
+    Quarantined,
+    ServeError,
+)
+from dhqr_tpu.serve.scheduler import AsyncScheduler
 
 __all__ = [
     "AsyncScheduler",
     "BackpressureError",
     "Bucket",
     "CacheKey",
+    "CompileFailed",
+    "DeadlineExceeded",
+    "DispatchFailed",
     "ExecutableCache",
+    "Quarantined",
+    "ServeError",
     "default_cache",
     "batched_lstsq",
     "batched_qr",

@@ -20,14 +20,30 @@ Eviction is LRU with a bound from ``ServeConfig.cache_size``
 executable handle; when a persistent jax compilation cache is enabled
 (utils/platform.enable_compile_cache) a re-miss recompiles cheaply from
 the serialized artifact instead of from scratch.
+
+Failed compiles QUARANTINE their key (round 12): a program whose
+compile raised is not retried for ``ServeConfig.quarantine_s`` —
+requests that land on it inside the cooldown get a typed
+:class:`~dhqr_tpu.serve.errors.Quarantined` with a positive
+``retry_after`` instead of re-paying a compile that is going to fail
+again on every flush of the poison bucket. The compile failure itself
+surfaces as :class:`~dhqr_tpu.serve.errors.CompileFailed` with the
+original exception chained.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from typing import Callable, NamedTuple
 
+# serve loads during ``import dhqr_tpu`` itself, so this import must
+# stay acyclic — it is safe because nothing under dhqr_tpu.faults
+# imports serve (the harness is deliberately dependency-free); keep it
+# that way when touching faults/__init__.py.
+from dhqr_tpu.faults import harness as _faults
+from dhqr_tpu.serve.errors import CompileFailed, Quarantined
 from dhqr_tpu.utils.config import ServeConfig
 from dhqr_tpu.utils.profiling import Counters, PhaseTimer
 
@@ -66,13 +82,25 @@ class ExecutableCache:
     works (bench.py's prewarm stages use plain tuples).
     """
 
-    def __init__(self, max_size: "int | None" = None) -> None:
-        if max_size is None:
-            max_size = ServeConfig.from_env().cache_size
+    def __init__(self, max_size: "int | None" = None,
+                 quarantine_s: "float | None" = None,
+                 clock=time.monotonic) -> None:
+        if max_size is None or quarantine_s is None:
+            scfg = ServeConfig.from_env()
+            max_size = scfg.cache_size if max_size is None else max_size
+            quarantine_s = scfg.quarantine_s if quarantine_s is None \
+                else quarantine_s
         if max_size < 1:
             raise ValueError(f"max_size must be >= 1, got {max_size}")
+        if not quarantine_s > 0:
+            raise ValueError(
+                f"quarantine_s must be > 0, got {quarantine_s}")
         self.max_size = int(max_size)
+        self.quarantine_s = float(quarantine_s)
+        self._clock = clock
         self._entries: "OrderedDict[object, object]" = OrderedDict()
+        # key -> cooldown expiry (clock seconds) after a failed compile.
+        self._quarantine: "dict[object, float]" = {}
         self.counters = Counters()
         self.timer = PhaseTimer()
         # One lock for lookup + insert + evict + counters: a serving tier
@@ -93,16 +121,41 @@ class ExecutableCache:
             return key in self._entries
 
     def get_or_compile(self, key, lower_fn: Callable[[], object]):
-        """Return the executable for ``key``, compiling on first miss."""
+        """Return the executable for ``key``, compiling on first miss.
+
+        A compile that raises (organic, or the ``serve.compile``
+        injection site) surfaces as :class:`CompileFailed` with the
+        cause chained, and quarantines ``key`` for ``quarantine_s``:
+        until the cooldown expires, further requests for the key raise
+        :class:`Quarantined` (with the remaining cooldown as a positive
+        ``retry_after``) WITHOUT compiling — one failed compile per
+        cooldown window, however hot the poison bucket is.
+        """
         with self._lock:
             if key in self._entries:
                 self.counters.bump("hits")
                 self._entries.move_to_end(key)
                 return self._entries[key]
+            until = self._quarantine.get(key)
+            if until is not None:
+                now = self._clock()
+                if now < until:
+                    self.counters.bump("quarantine_hits")
+                    # Quarantined clamps retry_after positive; the clamp
+                    # matters at the expiry boundary, where until - now
+                    # underflows toward zero.
+                    raise Quarantined(key, until - now)
+                del self._quarantine[key]  # cooldown over: one retry
             self.counters.bump("misses")
             before = self.timer.total("aot_compile")
-            with self.timer.measure("aot_compile"):
-                exe = lower_fn().compile()
+            try:
+                with self.timer.measure("aot_compile"):
+                    _faults.fire("serve.compile")
+                    exe = lower_fn().compile()
+            except Exception as e:
+                self.counters.bump("compile_failures")
+                self._quarantine[key] = self._clock() + self.quarantine_s
+                raise CompileFailed(key, e) from e
             # The timer is the ONE source of compile wall time; the
             # counter mirrors it so stats() stays a flat JSON dict.
             self.counters.bump("compile_seconds",
@@ -127,6 +180,9 @@ class ExecutableCache:
         """
         with self._lock:
             snap = self.counters.snapshot()
+            now = self._clock()
+            for k in [k for k, t in self._quarantine.items() if now >= t]:
+                del self._quarantine[k]  # expired: not "in quarantine"
             return {
                 "size": len(self._entries),
                 "max_size": self.max_size,
@@ -135,13 +191,18 @@ class ExecutableCache:
                 "evictions": int(snap.get("evictions", 0)),
                 "compile_seconds": round(
                     float(snap.get("compile_seconds", 0)), 3),
+                "compile_failures": int(snap.get("compile_failures", 0)),
+                "quarantined": len(self._quarantine),
+                "quarantine_hits": int(snap.get("quarantine_hits", 0)),
             }
 
     def clear(self) -> None:
-        """Drop every resident executable (counters keep accumulating —
-        they are lifetime telemetry, not occupancy)."""
+        """Drop every resident executable and every active quarantine
+        (counters keep accumulating — they are lifetime telemetry, not
+        occupancy)."""
         with self._lock:
             self._entries.clear()
+            self._quarantine.clear()
 
 
 # The process-default cache every public serve entry point dispatches

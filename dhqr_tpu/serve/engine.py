@@ -38,8 +38,10 @@ from typing import List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+from dhqr_tpu.faults import harness as _faults
 from dhqr_tpu.ops import blocked as _blocked
 from dhqr_tpu.ops import solve as _solve
+from dhqr_tpu.serve.errors import DispatchFailed, ServeError
 from dhqr_tpu.serve.buckets import (
     Bucket,
     bucket_batch,
@@ -410,7 +412,15 @@ def _dispatch_groups(kind, As, bs, cfg, scfg, cache, consume, pol=None):
     fix must not have to land twice). ``consume(chunk, key, outs)`` is
     called once per dispatched chunk with the request indices, the cache
     key, and the stacked program outputs. ``pol`` (the resolved policy,
-    if any) keys per-bucket plan resolution."""
+    if any) keys per-bucket plan resolution.
+
+    Failure routing (round 12): the cache raises typed
+    ``CompileFailed`` / ``Quarantined``; the device launch here is
+    wrapped into :class:`DispatchFailed` (the ``serve.dispatch`` /
+    ``serve.latency`` fault-injection sites live at the launch, so an
+    injected fault takes exactly the organic failure path). ``consume``
+    is OUTSIDE the wrap: a scatter/callback bug is the caller's error,
+    not a device failure to retry."""
     for bucket, idxs in _group_by_bucket(As, scfg).items():
         cfg_b = _resolve_bucket_plan(kind, cfg, bucket, pol)
         for lo in range(0, len(idxs), scfg.max_batch):
@@ -423,10 +433,17 @@ def _dispatch_groups(kind, As, bs, cfg, scfg, cache, consume, pol=None):
             A_buf, b_buf = pad_group(
                 [(As[i], None if bs is None else bs[i]) for i in chunk],
                 bucket, key.batch)
-            if kind == "lstsq":
-                outs = compiled(jnp.asarray(A_buf), jnp.asarray(b_buf))
-            else:
-                outs = compiled(jnp.asarray(A_buf))
+            _faults.latency("serve.latency")
+            try:
+                _faults.fire("serve.dispatch")
+                if kind == "lstsq":
+                    outs = compiled(jnp.asarray(A_buf), jnp.asarray(b_buf))
+                else:
+                    outs = compiled(jnp.asarray(A_buf))
+            except ServeError:
+                raise
+            except Exception as e:
+                raise DispatchFailed(key, e) from e
             consume(chunk, key, outs)
 
 

@@ -1,0 +1,86 @@
+"""Typed serving-tier failure taxonomy (the round-12 fault model).
+
+Every failure mode the serving stack can encounter — a compile that
+raised, a dispatch that raised, a latency budget that ran out, a
+quarantined program key, an admission queue that cannot take more —
+resolves to exactly ONE of the exception types below, and every one of
+them carries enough state for the caller's next decision (the failing
+cache key, or a positive ``retry_after`` hint). The contract they exist
+to enforce (docs/DESIGN.md "Fault model"): a future handed out by
+:meth:`AsyncScheduler.submit` always resolves — success or a typed
+``ServeError`` — never hangs, and never surfaces an anonymous exception
+the client cannot classify.
+
+All types subclass :class:`ServeError` (itself a ``RuntimeError``, so
+pre-round-12 callers catching ``RuntimeError`` keep working), and
+``retry_after`` hints are clamped positive at construction — a caller
+sleeping on the hint must never busy-spin on a zero or negative value
+(see ``serve/cache.py`` and the scheduler's admission pricing for the
+clamp rationale).
+"""
+
+from __future__ import annotations
+
+
+class ServeError(RuntimeError):
+    """Base of every typed serving-tier failure."""
+
+
+class CompileFailed(ServeError):
+    """An AOT compile for ``key`` raised. The original exception chains
+    as ``__cause__``; the key is quarantined by the executable cache, so
+    immediate retries of the same program surface :class:`Quarantined`
+    instead of recompiling on every flush."""
+
+    def __init__(self, key, cause: BaseException) -> None:
+        super().__init__(
+            f"AOT compile failed for {key!r}: "
+            f"{type(cause).__name__}: {cause}")
+        self.key = key
+
+
+class DispatchFailed(ServeError):
+    """A compiled program's device dispatch (or its completion fence)
+    raised. Usually transient (a wedged device stream, an injected
+    fault); the scheduler retries these with backoff and bisects the
+    batch when retries keep failing."""
+
+    def __init__(self, key, cause: BaseException) -> None:
+        super().__init__(
+            f"device dispatch failed for {key!r}: "
+            f"{type(cause).__name__}: {cause}")
+        self.key = key
+
+
+class DeadlineExceeded(ServeError):
+    """The request's latency budget ran out before a retry could land.
+    Only raised on FAILURE paths: a late-but-successful dispatch still
+    returns its result (and bumps ``deadline_misses``), exactly as in
+    the pre-fault-model tier."""
+
+
+class Quarantined(ServeError):
+    """The request's program key is in the failed-compile quarantine:
+    a recent compile of it raised, and the cooldown has not expired.
+    ``retry_after`` (seconds, always > 0) is the remaining cooldown."""
+
+    def __init__(self, key, retry_after: float) -> None:
+        retry_after = max(float(retry_after), 1e-3)
+        super().__init__(
+            f"program {key!r} is quarantined after a failed compile; "
+            f"retry in ~{retry_after:.3f}s")
+        self.key = key
+        self.retry_after = retry_after
+
+
+class BackpressureError(ServeError):
+    """Raised by :meth:`AsyncScheduler.submit` when admission refuses
+    the request — the queue is past its high-water mark, or the
+    admission-priced deadline check says the queue's expected drain time
+    already exceeds the request's budget. ``retry_after`` (seconds,
+    always > 0) estimates when capacity frees up — the
+    429-with-Retry-After of this tier."""
+
+    def __init__(self, message: str, retry_after: float) -> None:
+        super().__init__(message)
+        self.retry_after = max(float(retry_after), 1e-3)

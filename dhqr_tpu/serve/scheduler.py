@@ -22,12 +22,26 @@ of serving workload):
 * within an oversubscribed flush, requests are picked by smooth weighted
   round-robin across tenants (``SchedulerConfig.tenant_weights``), so a
   flooding tenant cannot starve the others out of a bucket;
-* past ``SchedulerConfig.queue_depth`` total queued requests, ``submit``
-  rejects with :class:`BackpressureError` carrying a ``retry_after``
-  hint — bounded queues keep the tail latency bounded;
+* past ``SchedulerConfig.queue_depth`` total queued requests — or when
+  the admission-priced deadline check says the queue's expected drain
+  time already exceeds the request's budget — ``submit`` rejects with
+  :class:`BackpressureError` carrying a ``retry_after`` hint — bounded
+  queues keep the tail latency bounded, and a request that cannot make
+  its deadline is refused at the door instead of timing out inside;
 * :meth:`AsyncScheduler.drain` / :meth:`AsyncScheduler.shutdown` flush
   and complete everything in flight, so rolling restarts never drop
   accepted work.
+
+Failure behavior is DESIGNED (round 12, docs/DESIGN.md "Fault model"):
+a failed flush retries with exponential backoff capped by the oldest
+in-group deadline; retries that keep failing bisect the batch until the
+poison request fails ALONE (typed) and the rest succeed; a quarantined
+program key backs off for its cooldown; a crashed dispatcher worker is
+detected, its in-flight requests requeued, and a replacement thread
+respawned. Every submitted request's future resolves — success or a
+typed :class:`~dhqr_tpu.serve.errors.ServeError` — never hangs. The
+``serve.worker`` fault-injection site (``dhqr_tpu.faults``) drives the
+crash path deterministically in tests and the chaos benchmark.
 
 ONE dispatch path, by construction: a flush calls the engine's own
 ``_dispatch_groups`` with consumers built by the engine's own
@@ -52,12 +66,21 @@ import collections
 import dataclasses
 import threading
 import time
+import traceback
 from concurrent.futures import Future
 from typing import Optional
 
+from dhqr_tpu.faults import harness as _faults
 from dhqr_tpu.serve import engine as _engine
 from dhqr_tpu.serve.buckets import Bucket, plan_bucket
 from dhqr_tpu.serve.cache import ExecutableCache, default_cache
+from dhqr_tpu.serve.errors import (
+    BackpressureError,
+    DeadlineExceeded,
+    DispatchFailed,
+    Quarantined,
+    ServeError,
+)
 from dhqr_tpu.utils.config import DHQRConfig, SchedulerConfig, ServeConfig
 from dhqr_tpu.utils.profiling import (
     Counters,
@@ -75,19 +98,20 @@ _LEAD_FACTOR = 1.25
 _LEAD_FLOOR_S = 1e-3
 
 
-class BackpressureError(RuntimeError):
-    """Raised by :meth:`AsyncScheduler.submit` past the queue-depth
-    high-water mark. ``retry_after`` (seconds) estimates when capacity
-    frees up — the 429-with-Retry-After of this tier."""
-
-    def __init__(self, message: str, retry_after: float) -> None:
-        super().__init__(message)
-        self.retry_after = float(retry_after)
+# BackpressureError moved to serve/errors.py in round 12 (it is one of
+# the typed ServeError family now); the name stays importable from here.
+__all__ = ["AsyncScheduler", "BackpressureError", "dispatch_program"]
 
 
 @dataclasses.dataclass
 class _Pending:
-    """One queued request (everything the flush stage needs)."""
+    """One queued request (everything the flush stage needs).
+
+    ``attempts`` counts FAILED flushes this request has ridden (the
+    retry/bisect escalation key); ``claimed`` marks a future already
+    moved to RUNNING by a prior flush — a requeued request must not
+    claim twice (``set_running_or_notify_cancel`` raises on a RUNNING
+    future)."""
 
     seq: int
     A: object
@@ -96,6 +120,8 @@ class _Pending:
     submitted_at: float
     deadline_at: float
     future: Future
+    attempts: int = 0
+    claimed: bool = False
 
 
 class _Group:
@@ -103,7 +129,7 @@ class _Group:
     unit the dispatcher flushes as one stacked micro-batch."""
 
     __slots__ = ("kind", "bucket", "cfg", "pol", "qr_solve_args", "queue",
-                 "credits")
+                 "credits", "not_before")
 
     def __init__(self, kind, bucket, cfg, pol, qr_solve_args):
         self.kind = kind
@@ -115,6 +141,9 @@ class _Group:
         # Smooth-WRR credit per tenant, persisted ACROSS flushes (a light
         # tenant that loses an oversubscribed flush is ahead next flush).
         self.credits: "dict[str, float]" = {}
+        # Retry backoff horizon: after a failed flush the group does not
+        # re-flush before this clock time (drain ignores it).
+        self.not_before: float = 0.0
 
 
 class AsyncScheduler:
@@ -172,6 +201,8 @@ class AsyncScheduler:
         self._seq = 0
         self._draining = False
         self._closed = False
+        self._crash_streak = 0     # consecutive worker crashes (backoff)
+        self._last_crash: "str | None" = None   # last crash traceback
 
         self.counters = Counters()
         self.latency = LatencyHistogram()
@@ -270,6 +301,23 @@ class AsyncScheduler:
                     f"admission queue full ({self._depth} >= "
                     f"{self._kcfg.queue_depth}); retry in ~{retry:.3f}s",
                     retry_after=retry)
+            # Admission-priced deadline (ROADMAP item 1 remainder): if
+            # the queue's expected drain time — batches ahead of this
+            # request x the bucket's measured EWMA dispatch latency —
+            # already exceeds the request's budget, reject NOW with a
+            # priced retry hint rather than accept work destined to blow
+            # its deadline inside the queue. A bucket with no EWMA yet
+            # (first request) is always admitted: rejection is priced on
+            # measurement, never on a guess.
+            est = self._admission_estimate_locked(bucket)
+            if est is not None and est > deadline:
+                self.counters.bump("rejected_unmeetable")
+                retry = max(self._kcfg.flush_interval_ms / 1e3,
+                            est - deadline)
+                raise BackpressureError(
+                    f"deadline {deadline:.3f}s cannot be met at the "
+                    f"current queue (expected wait ~{est:.3f}s); retry "
+                    f"in ~{retry:.3f}s", retry_after=retry)
             gkey = (kind, bucket, cfg, qr_solve_args)
             group = self._groups.get(gkey)
             if group is None:
@@ -285,11 +333,30 @@ class AsyncScheduler:
 
     def _retry_after_locked(self) -> float:
         """Backpressure hint: queue depth over the average dispatch
-        latency's implied drain rate, floored at the flush interval."""
+        latency's implied drain rate, floored at the flush interval —
+        the floor is the EMPTY-EWMA clamp: before any dispatch has been
+        measured (first-request buckets, a cold scheduler) the product
+        is 0.0, and a zero/negative retry hint would have clients
+        busy-spin on a queue that cannot possibly have drained."""
         lat = [e.value for e in self._ewma.values() if e.value is not None]
         avg = sum(lat) / len(lat) if lat else 0.0
         batches = -(-self._depth // max(1, self._scfg.max_batch))
         return max(self._kcfg.flush_interval_ms / 1e3, batches * avg)
+
+    def _admission_estimate_locked(self, bucket: Bucket) -> "float | None":
+        """Expected seconds until a request submitted NOW into ``bucket``
+        completes, priced from queue depth x the bucket's EWMA dispatch
+        latency. None when the bucket has no measurement yet (the
+        admission check must not reject on a guess). The global depth is
+        a deliberate over-approximation of the per-group backlog — under
+        mixed traffic it prices the dispatcher contention ahead of this
+        request, which is exactly what delays its flush."""
+        ewma = self._ewma.get(bucket)
+        val = ewma.value if ewma is not None else None
+        if val is None or val <= 0.0:
+            return None
+        batches = -(-(self._depth + 1) // max(1, self._scfg.max_batch))
+        return batches * val
 
     # ----------------------------------------------------------- flush policy
 
@@ -299,6 +366,8 @@ class AsyncScheduler:
         return _LEAD_FACTOR * (val or 0.0) + _LEAD_FLOOR_S
 
     def _flush_reason(self, group: _Group, now: float) -> "str | None":
+        if now < group.not_before:
+            return None         # retry backoff window (drain bypasses)
         if len(group.queue) >= self._scfg.max_batch:
             return "full"
         oldest = group.queue[0]
@@ -320,6 +389,10 @@ class AsyncScheduler:
                 oldest.deadline_at - self._lead_s(group.bucket),
                 oldest.submitted_at + self._kcfg.flush_interval_ms / 1e3,
             )
+            # A group in retry backoff is not ready before not_before,
+            # whatever its deadlines say — without this the dispatcher
+            # busy-spins on a past flush horizon for the backoff window.
+            t = max(t, group.not_before)
             soonest = t if soonest is None else min(soonest, t)
         if soonest is None:
             return None
@@ -406,29 +479,49 @@ class AsyncScheduler:
                reason: str) -> None:
         """Dispatch one popped micro-batch through the engine's shared
         path. Runs OUTSIDE the scheduler lock (a compile or a slow
-        dispatch must not block admission)."""
+        dispatch must not block admission). A dispatch failure is
+        HANDLED here — retry with backoff, bisect to isolate a poison
+        request, or resolve the futures with their typed error — so the
+        exception never reaches the worker loop and every taken request
+        either completes, requeues, or fails typed."""
         # Claim every future before dispatch: a client that already
         # called fut.cancel() drops out here, and a claimed (RUNNING)
         # future can no longer be cancelled, so the set_result /
         # set_exception below can never raise InvalidStateError (which
-        # would kill the dispatcher worker).
+        # would kill the dispatcher worker). A requeued request arrives
+        # already claimed and is kept as-is.
         live: "list[_Pending]" = []
         for p in taken:
-            if p.future.set_running_or_notify_cancel():
+            if p.claimed or p.future.set_running_or_notify_cancel():
+                p.claimed = True
                 live.append(p)
             else:
                 self.counters.bump("cancelled")
         if not live:
             return
-        taken = live
         self.counters.bump(f"flush_{reason}")
+        try:
+            self._dispatch_batch(group, live)
+        except Exception as e:
+            # Requests from chunks that completed before the failure
+            # were already resolved by _dispatch_batch: escalate only
+            # the unresolved remainder.
+            self._handle_failure(
+                group, [p for p in live if not p.future.done()], e)
+
+    def _dispatch_batch(self, group: _Group,
+                        batch: "list[_Pending]") -> None:
+        """One engine dispatch of ``batch``; resolves every future with
+        its result on success, raises (typed where the engine/cache
+        classified it) on failure WITHOUT touching the futures — the
+        caller decides between retry, bisect and typed failure."""
         self.counters.bump("dispatches")
-        As = [p.A for p in taken]
+        As = [p.A for p in batch]
         resolved: "list[tuple[int, object]]" = []
         raw_outs: "list[object]" = []
         emit = lambda i, val: resolved.append((i, val))  # noqa: E731
         if group.kind == "lstsq":
-            bs = [p.b for p in taken]
+            bs = [p.b for p in batch]
             consume_inner = _engine._scatter_lstsq(As, emit)
         else:
             bs = None
@@ -441,40 +534,233 @@ class AsyncScheduler:
             consume_inner(chunk, key, outs)
 
         t0 = self._clock()
+        compile0 = self._cache.timer.total("aot_compile")
         try:
             _engine._dispatch_groups(
                 group.kind, As, bs, group.cfg, self._scfg, self._cache,
                 consume, pol=group.pol)
-            out: "list[object | None]" = [None] * len(taken)
-            for i, val in resolved:
-                out[i] = val
-            # Fence on the STACKED program outputs (O(1) arrays per
-            # chunk), not the per-request slices (O(batch) readback
-            # kernels — measured ~10 ms/flush on CPU): once the stack is
-            # ready, the truncating slices the futures carry are views
-            # of completed work.
-            _sync(raw_outs)
-        except Exception as e:
-            self.counters.bump("failed", len(taken))
-            for p in taken:
-                p.future.set_exception(e)
-            return
-        finally:
-            seconds = self._clock() - t0
-            chunks = -(-len(taken) // self._scfg.max_batch)
-            # Under the lock: _retry_after_locked and stats() iterate
-            # _ewma, and a first-dispatch setdefault would resize the
-            # dict mid-iteration.
-            with self._lock:
-                self._ewma.setdefault(group.bucket, Ewma()).update(
-                    seconds / max(1, chunks))
+        except Exception:
+            # A multi-chunk batch (a drain can span many engine chunks)
+            # failed partway: chunks that already dispatched and
+            # consumed are FINISHED device work — resolve their futures
+            # now so the caller's retry/bisect only re-pays the failed
+            # remainder instead of the whole batch.
+            self._resolve_completed_chunks(batch, resolved, raw_outs)
+            raise
+        out: "list[object | None]" = [None] * len(batch)
+        for i, val in resolved:
+            out[i] = val
+        # Fence on the STACKED program outputs (O(1) arrays per
+        # chunk), not the per-request slices (O(batch) readback
+        # kernels — measured ~10 ms/flush on CPU): once the stack is
+        # ready, the truncating slices the futures carry are views
+        # of completed work.
+        _sync(raw_outs)
+        # The EWMA prices WARM dispatch, so subtract any AOT compile
+        # that happened inside this flush (first touch of a novel
+        # bucket, recompile after eviction). Priced WITH the compile,
+        # one multi-second spike would have the admission check reject
+        # every normal-deadline submit for the bucket — and since
+        # rejected requests never dispatch, the EWMA could never decay:
+        # a permanent starvation loop. Steady state is zero-recompile,
+        # so warm dispatch time is also what the estimate is FOR. (A
+        # concurrent worker's compile landing in the window can only
+        # over-subtract; the clamp keeps the sample sane.)
+        compile_s = self._cache.timer.total("aot_compile") - compile0
+        seconds = max(self._clock() - t0 - compile_s, 0.0)
+        chunks = -(-len(batch) // self._scfg.max_batch)
+        # EWMA updates on SUCCESS only: a failed dispatch returns in
+        # exception time, not dispatch time, and under injected faults
+        # those near-zero samples would drag the deadline-flush lead
+        # toward zero exactly when dispatches are least reliable.
+        # Under the lock: _retry_after_locked and stats() iterate
+        # _ewma, and a first-dispatch setdefault would resize the
+        # dict mid-iteration.
+        with self._lock:
+            self._ewma.setdefault(group.bucket, Ewma()).update(
+                seconds / max(1, chunks))
+            self._crash_streak = 0  # dispatching again: crash storm over
         done = self._clock()
-        for p, val in zip(taken, out):
-            self.latency.record(done - p.submitted_at)
-            if done > p.deadline_at:
-                self.counters.bump("deadline_misses")
-            self.counters.bump("completed")
-            p.future.set_result(val)
+        for p, val in zip(batch, out):
+            self._resolve_success(p, val, done)
+
+    def _resolve_success(self, p: _Pending, val, done: float) -> None:
+        self.latency.record(done - p.submitted_at)
+        if done > p.deadline_at:
+            self.counters.bump("deadline_misses")
+        self.counters.bump("completed")
+        p.future.set_result(val)
+
+    def _resolve_completed_chunks(self, batch: "list[_Pending]",
+                                  resolved: "list[tuple[int, object]]",
+                                  raw_outs: "list[object]") -> None:
+        """A chunked dispatch failed after some chunks already consumed:
+        fence those chunks' outputs and resolve their futures with the
+        finished results. Callers then see them as done and only
+        retry/bisect the remainder. If even the fence fails, resolve
+        nothing — everything retries. (No EWMA sample either way: the
+        timing window is polluted by the failure.)"""
+        if not resolved:
+            return
+        try:
+            _sync(raw_outs)
+        except Exception:
+            return
+        done = self._clock()
+        for i, val in resolved:
+            self._resolve_success(batch[i], val, done)
+
+    # ------------------------------------------------------ failure handling
+
+    def _typed_error(self, group: _Group, exc: BaseException) -> ServeError:
+        """Every failure a future carries is a ServeError: the engine
+        and cache already classify theirs (CompileFailed, DispatchFailed,
+        Quarantined); anything else — e.g. an XLA runtime error surfacing
+        at the completion fence — is a dispatch failure."""
+        if isinstance(exc, ServeError):
+            return exc
+        err = DispatchFailed((group.kind, group.bucket), exc)
+        err.__cause__ = exc
+        return err
+
+    def _fail(self, p: _Pending, err: ServeError) -> None:
+        self.counters.bump("failed")
+        p.future.set_exception(err)
+
+    def _requeue(self, group: _Group, batch: "list[_Pending]",
+                 not_before: float) -> None:
+        """Put a failed batch back at the FRONT of its group (original
+        order — they are the oldest work) and arm the backoff horizon."""
+        with self._lock:
+            group.queue.extendleft(reversed(batch))
+            self._depth += len(batch)
+            group.not_before = max(group.not_before, not_before)
+            self._work.notify_all()
+
+    def _handle_failure(self, group: _Group, batch: "list[_Pending]",
+                        exc: Exception) -> None:
+        """The retry / bisect / typed-failure escalation for one failed
+        flush (docs/DESIGN.md "Fault model" has the state machine):
+
+        1. requests whose deadline already passed fail typed NOW
+           (DeadlineExceeded chaining the underlying error) — no retry
+           can help them;
+        2. a Quarantined key backs the group off for the remaining
+           cooldown (deadline permitting) without spending retry
+           budget — the quarantine IS the schedule; during drain it
+           fails typed instead (drain means "complete everything now");
+        3. other failures retry the whole batch with exponential
+           backoff (``retry_base_ms * 2**k``) while attempts stay
+           within ``max_retries`` AND the backoff still lands before
+           the oldest in-batch deadline;
+        4. out of budget, a multi-request batch BISECTS: halves
+           dispatch independently, recursing on failure, until the
+           poison request fails alone (typed) and everyone else's
+           work completes.
+        """
+        err = self._typed_error(group, exc)
+        now = self._clock()
+        self.counters.bump("flush_failures")
+        alive: "list[_Pending]" = []
+        for p in batch:
+            if now >= p.deadline_at:
+                dead = DeadlineExceeded(
+                    f"deadline passed after a failed dispatch "
+                    f"({type(err).__name__}: {err})")
+                dead.__cause__ = err
+                self._fail(p, dead)
+            else:
+                alive.append(p)
+        if not alive:
+            return
+        draining = self._draining
+        if isinstance(err, Quarantined):
+            # The cooldown is the retry schedule; attempts are not
+            # spent on it (the compile was never re-run). Per-REQUEST
+            # deadline gating: one tight-deadline rider must not force
+            # typed failure on batchmates whose budgets absorb the
+            # cooldown. A request that cannot wait fails typed NOW —
+            # re-dispatching it is pointless, the quarantine guarantees
+            # the failure. Draining: nobody waits (drain means
+            # "complete everything now").
+            wait = err.retry_after
+            can_wait = [] if draining else \
+                [p for p in alive if now + wait < p.deadline_at]
+            waiting = set(map(id, can_wait))
+            for p in alive:
+                if id(p) not in waiting:
+                    self._fail(p, err)
+            if can_wait:
+                self.counters.bump("retries")
+                self._requeue(group, can_wait, now + wait)
+            return
+        # Retry budget and backoff are PER REQUEST, like the deadline
+        # gating above: a fresh request coalesced into a group whose
+        # older rider already burned its retries requeues on its own
+        # attempt-1 backoff; only requests that are out of budget, or
+        # whose own deadline cannot absorb their backoff, take the
+        # immediate isolation pass (a group bisects now, a lone request
+        # re-dispatches once and fails typed only if it fails alone
+        # again).
+        for p in alive:
+            p.attempts += 1
+        base = self._kcfg.retry_base_ms / 1e3
+        can_wait, escalate = [], []
+        for p in alive:
+            backoff = base * (2 ** (p.attempts - 1))
+            if p.attempts <= self._kcfg.max_retries and \
+                    now + backoff < p.deadline_at:
+                can_wait.append(p)
+            else:
+                escalate.append(p)
+        if can_wait:
+            self.counters.bump("retries")
+            # The group horizon takes the SOONEST requeued backoff: a
+            # fresh rider is not over-delayed by an older one's longer
+            # window (the older simply rides the earlier flush).
+            soonest = min(base * (2 ** (p.attempts - 1)) for p in can_wait)
+            self._requeue(group, can_wait, now + soonest)
+        if escalate:
+            self._isolate_now(group, escalate, err)
+
+    def _isolate_now(self, group: _Group, batch: "list[_Pending]",
+                     err: ServeError) -> None:
+        """Escalation for requests with no retry budget (or no deadline
+        room to wait one out): a group enters bisection — each half
+        re-dispatches now, so a transient that cleared still completes
+        the innocent requests — and a LONE request gets that same
+        immediate re-dispatch (failing typed only if it fails again,
+        alone): without it a singleton hit by a one-off transient would
+        be denied exactly the attempt a bisection half gets."""
+        if len(batch) > 1:
+            self._bisect(group, batch)
+        else:
+            self._dispatch_or_isolate(group, batch)
+
+    def _bisect(self, group: _Group, batch: "list[_Pending]") -> None:
+        self.counters.bump("bisections")
+        mid = len(batch) // 2
+        self._dispatch_or_isolate(group, batch[:mid])
+        self._dispatch_or_isolate(group, batch[mid:])
+
+    def _dispatch_or_isolate(self, group: _Group,
+                             batch: "list[_Pending]") -> None:
+        """Bisection leg: dispatch ``batch``; on failure split again
+        until the culprit fails alone. Terminates in O(log batch)
+        splits; every request resolves (result or typed error)."""
+        try:
+            self._dispatch_batch(group, batch)
+        except Exception as e:
+            err = self._typed_error(group, e)
+            # Chunks that completed before the failure already resolved.
+            batch = [p for p in batch if not p.future.done()]
+            if not batch:
+                return
+            if len(batch) == 1:
+                self.counters.bump("poisoned")
+                self._fail(batch[0], err)
+                return
+            self._bisect(group, batch)
 
     def _flush_count(self, reason: str, queued: int) -> int:
         """How many requests a flush takes. Full groups take the batch
@@ -513,6 +799,18 @@ class AsyncScheduler:
                 self._inflight += len(taken)
             try:
                 self._flush(group, taken, reason)
+            except BaseException:
+                # _flush handles dispatch failures itself, so anything
+                # arriving here is a crash past the failure handler (a
+                # scheduler bug, an injected worker fault landing
+                # mid-flush): requeue what this flush still owes before
+                # the exception takes the worker down, so crash recovery
+                # (respawn, or the next poll) re-dispatches it instead
+                # of hanging the futures forever.
+                self._requeue(group,
+                              [p for p in taken if not p.future.done()],
+                              not_before=0.0)
+                raise
             finally:
                 with self._lock:
                     self._inflight -= len(taken)
@@ -521,18 +819,116 @@ class AsyncScheduler:
 
     def _run(self) -> None:
         """Dispatcher thread: wait for work or the next flush horizon,
-        flush what is ready, repeat."""
-        while True:
-            with self._lock:
-                if self._closed and self._depth == 0:
-                    return
+        flush what is ready, repeat. A crash anywhere in the loop —
+        including the ``serve.worker`` fault-injection site at its top —
+        is detected, counted, and answered by RESPAWNING a replacement
+        worker (in-flight work was requeued by ``poll``), so the pool
+        never silently shrinks to zero dispatchers."""
+        try:
+            while True:
+                _faults.fire("serve.worker")
+                with self._lock:
+                    if self._closed and self._depth == 0:
+                        return
+                    now = self._clock()
+                    ready = self._select_locked(
+                        now, self._draining) is not None
+                    if not ready:
+                        timeout = self._next_wake_locked(now)
+                        self._work.wait(timeout)
+                        continue
+                self.poll()
+        except BaseException as e:
+            self._on_worker_crash(threading.current_thread(), cause=e)
+            # The crash is recorded (cause retained in stats) and
+            # replaced, not re-raised: a daemon thread's traceback on
+            # stderr is noise the respawn already answered.
+
+    def _on_worker_crash(self, thread: threading.Thread,
+                         cause: "BaseException | None" = None) -> None:
+        """Account a dispatcher-worker death and spawn its replacement.
+
+        The respawn gate matches ``_run``'s own exit condition
+        (``_closed and _depth == 0``) rather than ``_closed`` alone: a
+        worker that crashes DURING ``shutdown(drain=True)`` still has
+        queued work to complete, and skipping the respawn there would
+        hang the drain (and its futures) forever.
+
+        Consecutive crashes back the replacement off exponentially
+        (the NEW worker sleeps before entering its loop; reset by the
+        next successful dispatch): a persistent crash cause — an armed
+        unbounded ``serve.worker`` fault, a deterministic bug in the
+        loop — degrades to a ~2 s-period respawn heartbeat instead of
+        a tight thread-create/crash spin pegging a core.
+
+        A STORM of crashes (streak >= 2: the replacement died too, so
+        the dispatcher may never dispatch again) additionally fails the
+        queued requests whose deadline has already passed, typed
+        DeadlineExceeded — the respawn heartbeat becomes the resolution
+        cadence, so even under a permanent crash cause every
+        finite-deadline future resolves within ~2 s of its deadline
+        instead of hanging (and ``drain()``/``shutdown(drain=True)``
+        terminate once the last deadline expires). A single crash does
+        NOT sweep: its respawn normally drains the queue, and a
+        late-but-successful dispatch still returns its result."""
+        expired: "list[_Pending]" = []
+        with self._lock:
+            self.counters.bump("worker_crashes")
+            if cause is not None:
+                # Retain the cause for the operator: a deterministic
+                # bug respawn-loops at the heartbeat, and without this
+                # stats() would show worker_crashes climbing with no
+                # trace of WHY (the exact swallowed-failure pattern
+                # DHQR006 bans). Last crash wins — a storm has one
+                # cause.
+                self._last_crash = "".join(traceback.format_exception(
+                    type(cause), cause, cause.__traceback__))[-2000:]
+            if self._closed and self._depth == 0:
+                return
+            self._crash_streak += 1
+            if self._crash_streak >= 2:
                 now = self._clock()
-                ready = self._select_locked(now, self._draining) is not None
-                if not ready:
-                    timeout = self._next_wake_locked(now)
-                    self._work.wait(timeout)
-                    continue
-            self.poll()
+                for group in self._groups.values():
+                    if any(now >= p.deadline_at for p in group.queue):
+                        expired.extend(p for p in group.queue
+                                       if now >= p.deadline_at)
+                        group.queue = collections.deque(
+                            p for p in group.queue if now < p.deadline_at)
+                if expired:
+                    self._depth -= len(expired)
+                    self._idle.notify_all()
+            delay = min(0.01 * (2 ** min(self._crash_streak - 1, 8)), 2.0)
+            replacement = threading.Thread(
+                target=self._respawned_run, args=(delay,),
+                name=thread.name, daemon=True)
+            try:
+                self._threads[self._threads.index(thread)] = replacement
+            except ValueError:  # unmanaged caller thread: still recover
+                self._threads.append(replacement)
+        # Respawn FIRST: resolving the swept futures below can run
+        # client callbacks, and nothing they raise may cost the pool
+        # its replacement.
+        replacement.start()
+        for p in expired:
+            # Claim before resolving, exactly like _flush: a client that
+            # cancelled a queued future drops out here, and a claimed
+            # (or already-claimed requeued) future can no longer be
+            # cancelled, so set_exception cannot raise InvalidStateError
+            # inside the crash handler.
+            if p.claimed or p.future.set_running_or_notify_cancel():
+                dead = DeadlineExceeded(
+                    "deadline passed while the dispatcher was "
+                    "crash-looping (worker died repeatedly before the "
+                    "request could flush)")
+                dead.__cause__ = cause
+                self._fail(p, dead)
+            else:
+                self.counters.bump("cancelled")
+
+    def _respawned_run(self, delay: float) -> None:
+        if delay > 0:
+            time.sleep(delay)   # wall clock: crash-loop damping only
+        self._run()
 
     # ------------------------------------------------------- lifecycle/stats
 
@@ -587,7 +983,15 @@ class AsyncScheduler:
                     while group.queue:
                         p = group.queue.popleft()
                         self._depth -= 1
-                        p.future.cancel()
+                        if not p.future.cancel():
+                            # A requeued retry is already claimed
+                            # (RUNNING) and cannot be cancelled —
+                            # resolve it typed instead; the contract is
+                            # that no submitted future EVER hangs.
+                            self.counters.bump("failed")
+                            p.future.set_exception(ServeError(
+                                "scheduler shut down (drain=False) "
+                                "before the request's retry could run"))
             self._work.notify_all()
         for t in self._threads:
             if t.is_alive():
@@ -610,6 +1014,7 @@ class AsyncScheduler:
         snap = self.counters.snapshot()
         with self._lock:
             depth, inflight = self._depth, self._inflight
+            last_crash = self._last_crash
             ewma_ms = {
                 f"{b.m}x{b.n}:{b.dtype}": round((e.value or 0.0) * 1e3, 3)
                 for b, e in sorted(self._ewma.items())
@@ -621,9 +1026,16 @@ class AsyncScheduler:
             "completed": int(snap.get("completed", 0)),
             "failed": int(snap.get("failed", 0)),
             "rejected": int(snap.get("rejected", 0)),
+            "rejected_unmeetable": int(snap.get("rejected_unmeetable", 0)),
             "cancelled": int(snap.get("cancelled", 0)),
             "deadline_misses": int(snap.get("deadline_misses", 0)),
             "dispatches": int(snap.get("dispatches", 0)),
+            "flush_failures": int(snap.get("flush_failures", 0)),
+            "retries": int(snap.get("retries", 0)),
+            "bisections": int(snap.get("bisections", 0)),
+            "poisoned": int(snap.get("poisoned", 0)),
+            "worker_crashes": int(snap.get("worker_crashes", 0)),
+            "last_worker_crash": last_crash,
             "flushes": {
                 reason: int(snap.get(f"flush_{reason}", 0))
                 for reason in ("full", "deadline", "interval", "drain")

@@ -241,6 +241,7 @@ class PlanDB:
             except BaseException:
                 try:
                     os.unlink(tmp)
+                # dhqr: ignore[DHQR006] best-effort temp-file cleanup on the error path; the original exception reraises below
                 except OSError:
                     pass
                 raise

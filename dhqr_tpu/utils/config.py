@@ -241,12 +241,20 @@ class ServeConfig:
         (``serve.cache.ExecutableCache``). Eviction only drops the
         in-process handle; a persistent jax compilation cache, when
         enabled, still makes the recompile cheap.
+      quarantine_s: failed-compile quarantine cooldown in seconds
+        (``DHQR_SERVE_QUARANTINE_S``). A program key whose compile
+        raised is not recompiled for this long — requests hitting it
+        get a typed :class:`~dhqr_tpu.serve.errors.Quarantined` with a
+        positive ``retry_after`` instead of paying (and re-paying, on
+        every flush of the poison bucket) a compile that is going to
+        fail again.
     """
 
     ratio: float = math.sqrt(2.0)
     min_dim: int = 16
     max_batch: int = 256
     cache_size: int = 64
+    quarantine_s: float = 30.0
 
     def __post_init__(self):
         if not self.ratio > 1.0:
@@ -257,6 +265,9 @@ class ServeConfig:
             raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
         if self.cache_size < 1:
             raise ValueError(f"cache_size must be >= 1, got {self.cache_size}")
+        if not self.quarantine_s > 0:
+            raise ValueError(
+                f"quarantine_s must be > 0, got {self.quarantine_s}")
 
     @staticmethod
     def from_env(**overrides) -> "ServeConfig":
@@ -270,6 +281,9 @@ class ServeConfig:
             env["max_batch"] = int(os.environ["DHQR_SERVE_MAX_BATCH"])
         if "DHQR_SERVE_CACHE_SIZE" in os.environ:
             env["cache_size"] = int(os.environ["DHQR_SERVE_CACHE_SIZE"])
+        if "DHQR_SERVE_QUARANTINE_S" in os.environ:
+            env["quarantine_s"] = float(
+                os.environ["DHQR_SERVE_QUARANTINE_S"])
         env.update(overrides)
         return ServeConfig(**env)
 
@@ -319,12 +333,23 @@ class SchedulerConfig:
         ``DHQR_SERVE_TENANT_WEIGHTS`` as ``"tenantA:3,tenantB:1"``. A
         dict is accepted programmatically and normalized to a sorted
         tuple (the config stays hashable).
+      max_retries: how many times a FAILED flush of one group is
+        re-queued (exponential backoff) before the scheduler escalates —
+        bisecting the batch to isolate a poison request, or failing the
+        survivors with their typed error (``DHQR_SERVE_MAX_RETRIES``;
+        0 disables retry, failures escalate immediately).
+      retry_base_ms: first-retry backoff in milliseconds; attempt k
+        waits ``retry_base_ms * 2**(k-1)``, always capped by the oldest
+        in-group deadline — a retry that cannot land inside the budget
+        is not attempted (``DHQR_SERVE_RETRY_BASE_MS``).
     """
 
     slo_ms: float = 100.0
     queue_depth: int = 1024
     flush_interval_ms: float = 20.0
     tenant_weights: "tuple[tuple[str, float], ...]" = ()
+    max_retries: int = 2
+    retry_base_ms: float = 10.0
 
     def __post_init__(self):
         if isinstance(self.tenant_weights, dict):
@@ -344,6 +369,12 @@ class SchedulerConfig:
                 raise ValueError(
                     f"tenant weight must be > 0, got {name!r}: {weight}"
                 )
+        if self.max_retries < 0:
+            raise ValueError(
+                f"max_retries must be >= 0, got {self.max_retries}")
+        if not self.retry_base_ms > 0:
+            raise ValueError(
+                f"retry_base_ms must be > 0, got {self.retry_base_ms}")
 
     def weight_for(self, tenant: str) -> float:
         for name, weight in self.tenant_weights:
@@ -366,6 +397,11 @@ class SchedulerConfig:
         if "DHQR_SERVE_TENANT_WEIGHTS" in os.environ:
             env["tenant_weights"] = _parse_tenant_weights(
                 os.environ["DHQR_SERVE_TENANT_WEIGHTS"])
+        if "DHQR_SERVE_MAX_RETRIES" in os.environ:
+            env["max_retries"] = int(os.environ["DHQR_SERVE_MAX_RETRIES"])
+        if "DHQR_SERVE_RETRY_BASE_MS" in os.environ:
+            env["retry_base_ms"] = float(
+                os.environ["DHQR_SERVE_RETRY_BASE_MS"])
         env.update(overrides)
         return SchedulerConfig(**env)
 
@@ -438,3 +474,92 @@ class TuneConfig:
             env["on_miss"] = os.environ["DHQR_TUNE_ON_MISS"].strip().lower()
         env.update(overrides)
         return TuneConfig(**env)
+
+
+def _parse_fault_sites(raw: str) -> "tuple[tuple[str, float, int | None], ...]":
+    """Parse ``DHQR_FAULTS``: comma-separated ``site:prob[:count]``
+    entries, e.g. ``"serve.compile:0.5,serve.dispatch:0.1:3"`` — fire
+    at ``site`` with probability ``prob`` per visit, at most ``count``
+    times total (unbounded when omitted)."""
+    out = []
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        if len(fields) not in (2, 3) or not fields[0].strip():
+            raise ValueError(
+                f"fault entry must be 'site:prob[:count]', got {part!r}"
+            )
+        site = fields[0].strip()
+        prob = float(fields[1])
+        count = int(fields[2]) if len(fields) == 3 else None
+        out.append((site, prob, count))
+    return tuple(out)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultConfig:
+    """Knobs for the deterministic fault-injection harness
+    (``dhqr_tpu.faults``) — the round-12 chaos layer the resilient
+    serving tier is tested against. All overridable from ``DHQR_FAULTS*``
+    environment variables; with no sites configured the harness is inert
+    and every injection point is a single module-global ``None`` check.
+
+    Attributes:
+      sites: ``(site, probability, max_triggers)`` triples
+        (``DHQR_FAULTS`` as ``"site:prob[:count]"`` comma-separated).
+        ``site`` names an injection point registered in
+        ``faults.SITES`` (unknown names are rejected at install time,
+        not silently ignored); ``probability`` in [0, 1] is the per-visit
+        trigger chance; ``max_triggers`` (None = unbounded) caps total
+        firings — ``prob=1.0`` with a count gives an exactly-N
+        deterministic schedule, the shape tests and the dry run use.
+      seed: base seed (``DHQR_FAULTS_SEED``). Each site derives its own
+        independent deterministic stream from (seed, site name), so one
+        site's visit count never perturbs another's schedule.
+      latency_ms: sleep injected when a ``sleep``-kind site (e.g.
+        ``serve.latency``) triggers (``DHQR_FAULTS_LATENCY_MS``).
+    """
+
+    sites: "tuple[tuple[str, float, int | None], ...]" = ()
+    seed: int = 0
+    latency_ms: float = 10.0
+
+    def __post_init__(self):
+        if isinstance(self.sites, dict):
+            object.__setattr__(
+                self, "sites",
+                tuple((k, float(v[0]), v[1]) if isinstance(v, tuple)
+                      else (k, float(v), None)
+                      for k, v in sorted(self.sites.items())))
+        for site, prob, count in self.sites:
+            if not 0.0 <= prob <= 1.0:
+                raise ValueError(
+                    f"fault probability must be in [0, 1], got "
+                    f"{site!r}: {prob}")
+            if count is not None and count < 1:
+                raise ValueError(
+                    f"fault max_triggers must be >= 1 or None, got "
+                    f"{site!r}: {count}")
+        if not self.latency_ms >= 0:
+            raise ValueError(
+                f"latency_ms must be >= 0, got {self.latency_ms}")
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.sites)
+
+    @staticmethod
+    def from_env(**overrides) -> "FaultConfig":
+        """Build a fault config from ``DHQR_FAULTS*`` variables +
+        overrides."""
+        env = {}
+        if "DHQR_FAULTS" in os.environ:
+            env["sites"] = _parse_fault_sites(os.environ["DHQR_FAULTS"])
+        if "DHQR_FAULTS_SEED" in os.environ:
+            env["seed"] = int(os.environ["DHQR_FAULTS_SEED"])
+        if "DHQR_FAULTS_LATENCY_MS" in os.environ:
+            env["latency_ms"] = float(os.environ["DHQR_FAULTS_LATENCY_MS"])
+        env.update(overrides)
+        return FaultConfig(**env)

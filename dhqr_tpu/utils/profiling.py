@@ -109,10 +109,13 @@ class Counters:
     """Monotonic named counters (int or float increments), thread-safe.
 
     The serving tier's cache accounting rides here (hits / misses /
-    evictions / compile seconds — see ``dhqr_tpu.serve.cache``), as do
-    the async scheduler's flush-reason/admission counters
-    (``serve.scheduler``): one shared spelling so benchmarks and the dry
-    run read the same numbers the engine maintains, instead of each
+    evictions / compile seconds / quarantine counts — see
+    ``dhqr_tpu.serve.cache``), as do the async scheduler's
+    flush-reason/admission/resilience counters (``serve.scheduler``:
+    retries, bisections, worker crashes) and the fault-injection
+    harness's per-site visit/trigger tallies (``dhqr_tpu.faults``):
+    one shared spelling so benchmarks, the dry run and the chaos
+    ladder read the same numbers the engine maintains, instead of each
     keeping private tallies. The internal lock makes ``bump`` and
     ``snapshot`` safe from concurrent request/dispatcher threads —
     ``snapshot`` is a single consistent cut, never a torn read of

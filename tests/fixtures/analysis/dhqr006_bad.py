@@ -1,0 +1,24 @@
+"""DHQR006 fixture: swallowed exceptions (except: pass) in package code."""
+
+
+def lossy_probe(x):
+    try:
+        x.validate()
+    except ValueError:  # line 7: finding (single-pass body)
+        pass
+    try:
+        x.finalize()
+    except (OSError, RuntimeError):  # line 11: finding (tuple of types)
+        pass
+    try:
+        x.close()
+    except Exception:  # line 15: finding (ellipsis body is a pass too)
+        ...
+    return x
+
+
+def bare_catchall(x):
+    try:
+        return x.compute()
+    except:  # noqa: E722  line 23: finding (bare except)
+        pass

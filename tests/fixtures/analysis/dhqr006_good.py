@@ -1,0 +1,35 @@
+"""DHQR006 fixture: handled, reraised, logged, or suppressed-with-reason
+exception paths — none of these swallow silently."""
+
+import warnings
+
+
+def handled(x):
+    try:
+        return x.compute()
+    except ValueError as e:            # handled: substitute + record
+        warnings.warn(f"compute failed: {e}", stacklevel=2)
+        return None
+
+
+def reraised_typed(x):
+    try:
+        return x.compute()
+    except ValueError as e:            # reraised as the typed taxonomy
+        raise RuntimeError("compute failed") from e
+
+
+def best_effort_cleanup(tmp):
+    try:
+        tmp.unlink()
+    # dhqr: ignore[DHQR006] best-effort temp cleanup; nothing depends on it
+    except OSError:
+        pass
+
+
+def partial_body(x):
+    try:
+        return x.compute()
+    except ValueError:                 # body does work: not swallowed
+        x.reset()
+        return None

@@ -114,6 +114,26 @@ def test_dhqr005_collective_axis_names():
     assert _scan_fixture("dhqr005_good.py") == []
 
 
+def test_dhqr006_swallowed_exceptions():
+    findings = _scan_fixture("dhqr006_bad.py")
+    assert _hits(findings, "DHQR006") == [7, 11, 15, 23]
+    good = _scan_fixture("dhqr006_good.py")
+    assert _hits(good, "DHQR006") == []
+    # The one except:pass in the good fixture is visible but SUPPRESSED
+    # with a reason — the sanctioned spelling for a deliberate discard.
+    suppressed = [f for f in good if f.rule == "DHQR006" and f.suppressed]
+    assert len(suppressed) == 1 and "best-effort" in suppressed[0].reason
+
+
+def test_dhqr006_out_of_package_paths_exempt():
+    with open(os.path.join(FIXTURES, "dhqr006_bad.py")) as fh:
+        text = fh.read()
+    # tests/benchmarks commonly discard exceptions on purpose (probe
+    # loops, teardown); the rule scopes to package code only.
+    assert scan_source(text, "tests/test_fixture.py") == []
+    assert scan_source(text, "benchmarks/probe.py") == []
+
+
 def test_suppression_same_line_line_above_and_wrong_rule():
     findings = _scan_fixture("dhqr002_suppressed.py")
     by_line = {f.line: f for f in findings if f.rule == "DHQR002"}

@@ -160,3 +160,98 @@ def test_watchdog_scale_env(monkeypatch):
     assert bench._Watchdog("s", 240)._seconds == 240
     monkeypatch.setenv("DHQR_BENCH_WATCHDOG_SCALE", "3")
     assert bench._Watchdog("s", 240)._seconds == 720
+
+
+def test_init_budget_charges_only_failed_init_attempts(monkeypatch):
+    """Attempts that passed backend_ready charge nothing; attempts that
+    never did charge their full wall clock; forfeited records charge
+    nothing (they never spawned)."""
+    bench = _bench()
+    monkeypatch.delenv("DHQR_BENCH_INIT_BUDGET_S", raising=False)
+    budget = bench._InitBudget(200.0)
+    budget.charge({"ok": True, "passed_init": True, "attempt_s": 900.0})
+    assert budget.spent_s == 0.0 and not budget.exhausted()
+    budget.charge({"ok": False, "passed_init": False, "attempt_s": 120.0})
+    assert budget.spent_s == 120.0 and budget.failed_attempts == 1
+    assert not budget.exhausted()
+    budget.charge({"ok": False, "why": "relay_wedged", "forfeited": True,
+                   "passed_init": False, "attempt_s": 0.0})
+    assert budget.spent_s == 120.0          # forfeits are free
+    budget.charge({"ok": False, "passed_init": False, "attempt_s": 80.0})
+    assert budget.exhausted()
+    # A runaway un-deadlined child (e.g. a prewarm burning its whole
+    # multi-minute window without passing init) charges at most one
+    # worst-case probe — a single such attempt must never exhaust the
+    # default budget and forfeit the session's real measuring attempt.
+    runaway = bench._InitBudget(300.0)
+    runaway.charge({"ok": False, "passed_init": False, "attempt_s": 1140.0})
+    assert runaway.spent_s == bench._InitBudget.PROBE_S
+    assert not runaway.exhausted()
+    # Env override governs the default cap.
+    monkeypatch.setenv("DHQR_BENCH_INIT_BUDGET_S", "42")
+    assert bench._InitBudget().budget_s == 42.0
+
+
+def test_budgeted_attempt_forfeits_after_exhaustion(monkeypatch):
+    """Stubbed-child session: two wedged-init attempts exhaust the
+    budget; the next attempt is forfeited WITHOUT spawning a child and
+    classified relay_wedged (the BENCH_r04/r05 whole-window burn,
+    capped)."""
+    bench = _bench()
+    spawned = []
+
+    def stub_child(env, timeout, init_deadline=None):
+        spawned.append(timeout)
+        return {"ok": False, "why": "timeout", "sigkill_escalated": False,
+                "last_stage": "backend_init", "stderr_tail": "",
+                "passed_init": False, "attempt_s": 120.0}
+
+    monkeypatch.setattr(bench, "_run_child", stub_child)
+    budget = bench._InitBudget(200.0)
+    first = bench._budgeted_attempt(budget, {}, 600)
+    second = bench._budgeted_attempt(budget, {}, 600)
+    assert first["why"] == second["why"] == "timeout"
+    assert len(spawned) == 2 and budget.exhausted()
+    third = bench._budgeted_attempt(budget, {}, 600)
+    assert len(spawned) == 2, "exhausted budget must not spawn a child"
+    assert third["why"] == "relay_wedged" and third["forfeited"]
+    assert third["last_stage"] == "forfeited_backend_init_budget"
+    # A healthy session never forfeits: passed-init attempts are free.
+    healthy = bench._InitBudget(200.0)
+
+    def healthy_child(env, timeout, init_deadline=None):
+        return {"ok": True, "result": {"value": 1.0},
+                "passed_init": True, "attempt_s": 500.0}
+
+    monkeypatch.setattr(bench, "_run_child", healthy_child)
+    for _ in range(3):
+        rec = bench._budgeted_attempt(healthy, {}, 600)
+        assert rec["ok"]
+    assert not healthy.exhausted() and healthy.spent_s == 0.0
+
+
+def test_budgeted_attempt_derives_init_deadline_after_failure(monkeypatch):
+    """Budget enforced as init fast-fail time: after a session records
+    a failed init, an un-deadlined later attempt gets a deadline derived
+    from the budget remainder (floored at one probe) — the default
+    2-attempt session is bounded even though one capped prewarm charge
+    (120 s) can never reach the 300 s forfeit threshold, and even when
+    the wedge watcher wrote no marker."""
+    bench = _bench()
+    seen_deadlines = []
+
+    def capture_child(env, timeout, init_deadline=None):
+        seen_deadlines.append(init_deadline)
+        return {"ok": False, "why": "timeout", "sigkill_escalated": False,
+                "last_stage": "backend_init", "stderr_tail": "",
+                "passed_init": False, "attempt_s": 700.0}
+
+    monkeypatch.setattr(bench, "_run_child", capture_child)
+    derived = bench._InitBudget(300.0)
+    bench._budgeted_attempt(derived, {}, 600)          # prewarm, unarmed
+    assert seen_deadlines == [None] and derived.spent_s == 120.0
+    bench._budgeted_attempt(derived, {}, 600)          # real attempt
+    assert seen_deadlines[1] == 180                    # 300 - 120 spent
+    # A wedge-watcher-provided deadline is never overridden.
+    bench._budgeted_attempt(derived, {}, 600, init_deadline=120)
+    assert seen_deadlines[2] == 120

@@ -1,0 +1,873 @@
+"""Fault model (round 12): the injection harness, typed ServeError
+routing, retry/backoff, bisecting poison isolation, worker crash
+respawn + requeue, admission-priced rejection, and quarantine expiry.
+
+Policy tests drive a FAKE clock in manual mode (``start=False`` +
+``poll``) against a stubbed ``engine._dispatch_groups`` — failure
+decisions are pinned without wall-clock races or compiles, exactly the
+test_scheduler.py pattern. One end-to-end test runs the real engine on
+tiny shapes with a short real-clock quarantine (tier-1 budget: the
+whole module stays under ~10 s); the heavy chaos soak is ``-m slow``.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dhqr_tpu import faults
+from dhqr_tpu.faults import FaultInjected
+from dhqr_tpu.serve import (
+    AsyncScheduler,
+    BackpressureError,
+    CompileFailed,
+    DeadlineExceeded,
+    DispatchFailed,
+    Quarantined,
+    ServeError,
+)
+from dhqr_tpu.serve import engine as serve_engine
+from dhqr_tpu.serve.cache import ExecutableCache
+from dhqr_tpu.utils.config import FaultConfig, SchedulerConfig, ServeConfig
+
+SCFG = ServeConfig(min_dim=16, ratio=1.5, max_batch=4, cache_size=8)
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+def _sched(clock, **kw):
+    kw.setdefault("serve_config", SCFG)
+    return AsyncScheduler(clock=clock, start=False, block_size=8, **kw)
+
+
+def _req(rng, m=24, n=10):
+    return (jnp.asarray(rng.random((m, n)), jnp.float32),
+            jnp.asarray(rng.random(m), jnp.float32))
+
+
+def _ok_dispatch(kind, As, bs, cfg, scfg, cache, consume, pol=None):
+    maxn = max(A.shape[1] for A in As)
+    consume(list(range(len(As))), ("stub", len(As)),
+            np.zeros((len(As), maxn), np.float32))
+
+
+# ------------------------------------------------------------ the harness
+
+
+def test_fault_config_parsing_and_validation(monkeypatch):
+    monkeypatch.setenv("DHQR_FAULTS",
+                       "serve.compile:0.5, serve.dispatch:0.25:3")
+    monkeypatch.setenv("DHQR_FAULTS_SEED", "7")
+    monkeypatch.setenv("DHQR_FAULTS_LATENCY_MS", "2.5")
+    cfg = FaultConfig.from_env()
+    assert cfg.sites == (("serve.compile", 0.5, None),
+                         ("serve.dispatch", 0.25, 3))
+    assert cfg.seed == 7 and cfg.latency_ms == 2.5 and cfg.enabled
+    assert not FaultConfig().enabled
+    with pytest.raises(ValueError, match="site:prob"):
+        FaultConfig.from_env(sites=__import__(
+            "dhqr_tpu.utils.config", fromlist=["_parse_fault_sites"]
+        )._parse_fault_sites("serve.compile"))
+    with pytest.raises(ValueError, match="probability"):
+        FaultConfig(sites=(("serve.compile", 1.5, None),))
+    with pytest.raises(ValueError, match="max_triggers"):
+        FaultConfig(sites=(("serve.compile", 1.0, 0),))
+    # Unknown sites are a spelled-wrong experiment: rejected at arm time.
+    with pytest.raises(ValueError, match="unknown fault site"):
+        faults.FaultHarness(FaultConfig(sites=(("serve.nope", 1.0, None),)))
+
+
+def test_harness_deterministic_streams_and_trigger_counts():
+    cfg = FaultConfig(sites=(("serve.dispatch", 0.4, None),
+                             ("serve.compile", 1.0, 2)), seed=42)
+    sched_a = [faults.FaultHarness(cfg).should_fire("serve.dispatch")
+               for _ in range(1)]
+    h1, h2 = faults.FaultHarness(cfg), faults.FaultHarness(cfg)
+    seq1 = [h1.should_fire("serve.dispatch") for _ in range(50)]
+    # Interleave visits to ANOTHER site on h2: per-site streams are
+    # independent, so the dispatch schedule must not shift.
+    seq2 = []
+    for _ in range(50):
+        h2.should_fire("serve.compile")
+        seq2.append(h2.should_fire("serve.dispatch"))
+    assert seq1 == seq2 and any(seq1) and not all(seq1)
+    assert sched_a[0] == seq1[0]
+    # prob=1 + count: exactly-N deterministic schedule.
+    assert sum(h2.counters.snapshot().get("fired_serve.compile", 0)
+               for _ in (0,)) == 2
+    assert h2.should_fire("serve.compile") is False  # exhausted
+    st = h2.stats()["serve.compile"]
+    assert st["fired"] == 2 and st["visits"] == 51
+
+
+def test_disarmed_injection_points_are_noops():
+    faults.uninstall()
+    faults.fire("serve.dispatch")      # no harness: must not raise
+    faults.latency()
+    assert faults.active() is None
+    # injected() scopes arm/disarm and restores the previous harness.
+    outer = FaultConfig(sites=(("serve.worker", 1.0, 1),), seed=0)
+    inner = FaultConfig(sites=(("serve.dispatch", 1.0, 1),), seed=0)
+    with faults.injected(outer) as h_outer:
+        assert faults.active() is h_outer
+        with faults.injected(inner):
+            with pytest.raises(FaultInjected, match="serve.dispatch"):
+                faults.fire("serve.dispatch")
+        assert faults.active() is h_outer
+    assert faults.active() is None
+    # Raise/sleep kinds are not interchangeable.
+    h = faults.FaultHarness(FaultConfig(sites=(("serve.latency", 1.0, 1),)))
+    with pytest.raises(ValueError, match="raise-kind"):
+        h.fire("serve.latency")
+    with pytest.raises(ValueError, match="sleep-kind"):
+        h.latency("serve.worker")
+
+
+def test_latency_site_uses_injected_sleeper():
+    slept = []
+    cfg = FaultConfig(sites=(("serve.latency", 1.0, 2),), latency_ms=50.0)
+    h = faults.FaultHarness(cfg, sleeper=slept.append)
+    for _ in range(4):
+        h.latency("serve.latency")
+    assert slept == [0.05, 0.05]       # count-capped, ms -> s
+
+
+# ---------------------------------------------------- retry with backoff
+
+
+def test_retry_backoff_then_success(monkeypatch):
+    """A transiently failing dispatch requeues with exponential backoff
+    (no flush inside the backoff window) and succeeds on retry — the
+    future resolves with the RESULT, not an error."""
+    calls = {"n": 0}
+
+    def flaky(kind, As, bs, cfg, scfg, cache, consume, pol=None):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient wedge")
+        _ok_dispatch(kind, As, bs, cfg, scfg, cache, consume, pol)
+
+    monkeypatch.setattr(serve_engine, "_dispatch_groups", flaky)
+    clock = FakeClock()
+    s = _sched(clock, sched_config=SchedulerConfig(
+        slo_ms=1e6, flush_interval_ms=100.0, retry_base_ms=10.0))
+    rng = np.random.default_rng(0)
+    fut = s.submit("lstsq", *_req(rng), deadline=50.0)
+    clock.advance(0.11)                       # interval flush fires
+    assert s.poll() == 1 and not fut.done()   # failed -> requeued
+    assert s.poll() == 0                      # inside the backoff window
+    clock.advance(0.011)                      # past retry_base_ms
+    assert s.poll() == 1 and fut.done()
+    assert fut.result() is not None and calls["n"] == 2
+    st = s.stats()
+    assert st["retries"] == 1 and st["flush_failures"] == 1
+    assert st["completed"] == 1 and st["failed"] == 0
+    assert st["queue_depth"] == 0
+
+
+def test_retry_capped_by_deadline_fails_typed(monkeypatch):
+    """A retry that cannot land before the oldest in-group deadline is
+    not attempted: the future fails NOW with the typed error (wrapped
+    DispatchFailed for an anonymous exception), not after burning the
+    rest of the budget."""
+
+    def boom(kind, As, bs, cfg, scfg, cache, consume, pol=None):
+        raise RuntimeError("organic boom")
+
+    monkeypatch.setattr(serve_engine, "_dispatch_groups", boom)
+    clock = FakeClock()
+    s = _sched(clock, sched_config=SchedulerConfig(
+        slo_ms=1e6, flush_interval_ms=10.0, retry_base_ms=5000.0))
+    rng = np.random.default_rng(1)
+    fut = s.submit("lstsq", *_req(rng), deadline=1.0)  # < 5 s backoff
+    clock.advance(0.011)
+    assert s.poll() == 1 and fut.done()
+    with pytest.raises(DispatchFailed, match="organic boom"):
+        fut.result(timeout=0)
+    st = s.stats()
+    assert st["failed"] == 1 and st["retries"] == 0
+
+
+def test_failure_past_deadline_is_deadline_exceeded(monkeypatch):
+    """A request whose budget already ran out when its dispatch failed
+    resolves DeadlineExceeded (chaining the underlying error) — typed
+    for the client's timeout handling, not a generic dispatch error."""
+
+    def boom(kind, As, bs, cfg, scfg, cache, consume, pol=None):
+        clock.advance(2.0)                    # the dispatch ate the budget
+        raise RuntimeError("slow boom")
+
+    clock = FakeClock()
+    s = _sched(clock, sched_config=SchedulerConfig(
+        slo_ms=1e6, flush_interval_ms=10.0))
+    import dhqr_tpu.serve.engine as eng
+    orig = eng._dispatch_groups
+    eng._dispatch_groups = boom
+    try:
+        rng = np.random.default_rng(2)
+        fut = s.submit("lstsq", *_req(rng), deadline=1.0)
+        clock.advance(0.011)
+        s.poll()
+    finally:
+        eng._dispatch_groups = orig
+    with pytest.raises(DeadlineExceeded):
+        fut.result(timeout=0)
+    assert isinstance(fut.exception().__cause__, DispatchFailed)
+
+
+# ------------------------------------------------- bisect poison isolation
+
+
+def test_bisect_isolates_poison_request(monkeypatch):
+    """One poison request in a full batch: the batch splits until the
+    culprit fails ALONE (typed) and every other request succeeds — a
+    single bad input can no longer take down its co-batched neighbors."""
+    rng = np.random.default_rng(3)
+    reqs = [_req(rng) for _ in range(4)]
+    poison_A = reqs[2][0]
+    dispatched = []
+
+    def poisoned(kind, As, bs, cfg, scfg, cache, consume, pol=None):
+        dispatched.append(len(As))
+        if any(A is poison_A for A in As):
+            raise RuntimeError("poison NaN blowup")
+        _ok_dispatch(kind, As, bs, cfg, scfg, cache, consume, pol)
+
+    monkeypatch.setattr(serve_engine, "_dispatch_groups", poisoned)
+    clock = FakeClock()
+    s = _sched(clock, sched_config=SchedulerConfig(
+        slo_ms=1e6, flush_interval_ms=1e6, max_retries=0))
+    futs = [s.submit("lstsq", A, b, deadline=1e3) for A, b in reqs]
+    assert s.poll() == 1                      # one "full" flush of 4
+    assert all(f.done() for f in futs), "every future must resolve"
+    for i, f in enumerate(futs):
+        if i == 2:
+            with pytest.raises(DispatchFailed, match="poison"):
+                f.result(timeout=0)
+        else:
+            assert f.result(timeout=0) is not None
+    st = s.stats()
+    assert st["poisoned"] == 1 and st["bisections"] >= 2
+    assert st["completed"] == 3 and st["failed"] == 1
+    # Batch ladder: 4 (fail) -> 2+2 -> 1+1 on the failing half.
+    assert dispatched == [4, 2, 2, 1, 1]
+
+
+def test_retries_then_bisection_composes(monkeypatch):
+    """With retry budget, a poisoned batch retries (whole) first, then
+    escalates to bisection once attempts exceed max_retries."""
+    rng = np.random.default_rng(4)
+    reqs = [_req(rng) for _ in range(4)]
+    poison_A = reqs[0][0]
+
+    def poisoned(kind, As, bs, cfg, scfg, cache, consume, pol=None):
+        if any(A is poison_A for A in As):
+            raise RuntimeError("still poison")
+        _ok_dispatch(kind, As, bs, cfg, scfg, cache, consume, pol)
+
+    monkeypatch.setattr(serve_engine, "_dispatch_groups", poisoned)
+    clock = FakeClock()
+    s = _sched(clock, sched_config=SchedulerConfig(
+        slo_ms=1e6, flush_interval_ms=1e6, max_retries=1,
+        retry_base_ms=10.0))
+    futs = [s.submit("lstsq", A, b, deadline=1e3) for A, b in reqs]
+    assert s.poll() == 1                      # full flush: fail -> retry
+    assert not any(f.done() for f in futs)
+    clock.advance(0.011)
+    assert s.poll() == 1                      # retry fails -> bisection
+    assert all(f.done() for f in futs)
+    st = s.stats()
+    assert st["retries"] == 1 and st["poisoned"] == 1
+    assert st["completed"] == 3 and st["failed"] == 1
+
+
+def test_fresh_rider_keeps_own_retry_budget(monkeypatch):
+    """Retry budget is per REQUEST: a fresh request coalesced into a
+    group whose older rider already exhausted its retries still gets a
+    backoff-spaced retry of its own — only the exhausted rider
+    escalates to isolation."""
+    rng = np.random.default_rng(31)
+    A1, b1 = _req(rng)
+    A2, b2 = _req(rng)
+
+    def flaky(kind, As, bs, cfg, scfg, cache, consume, pol=None):
+        if any(A is A1 for A in As):
+            raise RuntimeError("A1 wedges its batch")
+        _ok_dispatch(kind, As, bs, cfg, scfg, cache, consume, pol)
+
+    monkeypatch.setattr(serve_engine, "_dispatch_groups", flaky)
+    clock = FakeClock()
+    s = _sched(clock, sched_config=SchedulerConfig(
+        slo_ms=1e6, flush_interval_ms=10.0, max_retries=1,
+        retry_base_ms=10.0))
+    f1 = s.submit("lstsq", A1, b1, deadline=1e3)
+    clock.advance(0.011)
+    assert s.poll() == 1                  # flush [A1] fails -> retry
+    assert not f1.done()
+    clock.advance(0.011)
+    f2 = s.submit("lstsq", A2, b2, deadline=1e3)  # fresh rider joins
+    assert s.poll() == 1                  # [A1, A2] fails together:
+    # A1 (attempts 2 > 1) escalates and fails alone typed; A2
+    # (attempts 1 <= 1) requeues on ITS budget instead of being
+    # dragged into immediate isolation.
+    assert f1.done() and not f2.done()
+    with pytest.raises(DispatchFailed):
+        f1.result(timeout=0)
+    clock.advance(0.011)
+    assert s.poll() == 1 and f2.result(timeout=0) is not None
+    st = s.stats()
+    assert st["retries"] == 2 and st["poisoned"] == 1
+    assert st["completed"] == 1 and st["failed"] == 1
+
+
+def test_multichunk_failure_keeps_completed_chunks(monkeypatch):
+    """A drain-sized flush spans several engine chunks; when a later
+    chunk fails, the chunks that already dispatched are FINISHED device
+    work — their futures resolve with results, and only the failed
+    remainder retries (no re-paying completed chunks at full device
+    cost)."""
+    calls = {"n": 0}
+
+    def chunky(kind, As, bs, cfg, scfg, cache, consume, pol=None):
+        calls["n"] += 1
+        maxn = max(A.shape[1] for A in As)
+        if calls["n"] == 1:
+            # First chunk of 4 lands and consumes; the next chunk's
+            # device launch blows up mid-batch.
+            consume(list(range(4)), ("stub", 4),
+                    np.zeros((4, maxn), np.float32))
+            raise RuntimeError("chunk 2 wedged")
+        _ok_dispatch(kind, As, bs, cfg, scfg, cache, consume, pol)
+
+    monkeypatch.setattr(serve_engine, "_dispatch_groups", chunky)
+    clock = FakeClock()
+    s = _sched(clock, sched_config=SchedulerConfig(
+        slo_ms=1e6, flush_interval_ms=1e6, max_retries=1,
+        retry_base_ms=10.0))
+    rng = np.random.default_rng(29)
+    reqs = [_req(rng) for _ in range(8)]
+    futs = [s.submit("lstsq", A, b, deadline=1e3) for A, b in reqs]
+    s.drain()                   # one 8-request flush -> 2 engine chunks
+    assert all(f.done() and f.result(timeout=0) is not None for f in futs)
+    st = s.stats()
+    assert st["completed"] == 8 and st["failed"] == 0
+    # Only the 4 unresolved requests rode the retry.
+    assert st["retries"] == 1 and calls["n"] == 2
+
+
+def test_mixed_deadline_batch_gates_retry_per_request(monkeypatch):
+    """One tight-deadline rider must not drag its batchmates down: on a
+    failed flush, requests whose own budget absorbs the wait requeue;
+    only the one that cannot wait fails typed."""
+    calls = {"n": 0}
+
+    def flaky(kind, As, bs, cfg, scfg, cache, consume, pol=None):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise Quarantined(("k",), 0.5)
+        _ok_dispatch(kind, As, bs, cfg, scfg, cache, consume, pol)
+
+    monkeypatch.setattr(serve_engine, "_dispatch_groups", flaky)
+    clock = FakeClock()
+    s = _sched(clock, sched_config=SchedulerConfig(
+        slo_ms=1e6, flush_interval_ms=10.0))
+    rng = np.random.default_rng(15)
+    A, b = _req(rng)
+    tight = s.submit("lstsq", A, b, deadline=0.2)   # < 0.5 s cooldown
+    loose = s.submit("lstsq", A, b, deadline=10.0)  # absorbs it easily
+    clock.advance(0.011)
+    assert s.poll() == 1
+    assert tight.done() and not loose.done()
+    with pytest.raises(Quarantined):
+        tight.result(timeout=0)
+    clock.advance(0.51)                             # cooldown over
+    assert s.poll() == 1 and loose.result() is not None
+    # Same per-request split on the generic backoff path: the request
+    # that cannot absorb the backoff is isolated NOW — re-dispatched
+    # once alone, the same immediate attempt a bisection half gets —
+    # and fails typed only because the failure PERSISTS; the other
+    # requeues and completes on retry.
+    calls["n"] = 0
+
+    def flaky2(kind, As, bs, cfg, scfg, cache, consume, pol=None):
+        calls["n"] += 1
+        if calls["n"] <= 2:              # the flush AND the lone retry
+            raise RuntimeError("persistent")
+        _ok_dispatch(kind, As, bs, cfg, scfg, cache, consume, pol)
+
+    monkeypatch.setattr(serve_engine, "_dispatch_groups", flaky2)
+    s2 = _sched(clock, sched_config=SchedulerConfig(
+        slo_ms=1e6, flush_interval_ms=10.0, retry_base_ms=5000.0))
+    tight2 = s2.submit("lstsq", A, b, deadline=1.0)   # < 5 s backoff
+    loose2 = s2.submit("lstsq", A, b, deadline=100.0)
+    clock.advance(0.011)
+    assert s2.poll() == 1
+    assert tight2.done() and not loose2.done()
+    with pytest.raises(DispatchFailed):
+        tight2.result(timeout=0)
+    clock.advance(5.01)
+    assert s2.poll() == 1 and loose2.result() is not None
+    # A transient that CLEARED by the isolation pass completes the
+    # singleton instead of failing it — a lone request is not denied
+    # the immediate re-dispatch a two-request batch would have gotten.
+    calls["n"] = 0
+
+    def flaky3(kind, As, bs, cfg, scfg, cache, consume, pol=None):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient")
+        _ok_dispatch(kind, As, bs, cfg, scfg, cache, consume, pol)
+
+    monkeypatch.setattr(serve_engine, "_dispatch_groups", flaky3)
+    s3 = _sched(clock, sched_config=SchedulerConfig(
+        slo_ms=1e6, flush_interval_ms=10.0, retry_base_ms=5000.0))
+    tight3 = s3.submit("lstsq", A, b, deadline=1.0)   # < 5 s backoff
+    loose3 = s3.submit("lstsq", A, b, deadline=100.0)
+    clock.advance(0.011)
+    assert s3.poll() == 1
+    assert tight3.done() and tight3.result(timeout=0) is not None
+    clock.advance(5.01)
+    assert s3.poll() == 1 and loose3.result() is not None
+    assert s3.stats()["poisoned"] == 0
+
+
+def test_worker_respawn_gate_covers_shutdown_drain(monkeypatch):
+    """A worker that dies while shutdown(drain=True) still has queued
+    work MUST be respawned (the drain would otherwise hang forever);
+    once closed AND empty, crashes stop respawning."""
+    monkeypatch.setattr(serve_engine, "_dispatch_groups", _ok_dispatch)
+    s = AsyncScheduler(serve_config=SCFG, block_size=8, start=False,
+                       sched_config=SchedulerConfig(slo_ms=1e6,
+                                                    flush_interval_ms=5.0))
+    rng = np.random.default_rng(16)
+    fut = s.submit("lstsq", *_req(rng), deadline=1e3)
+    with s._lock:
+        s._closed = True                  # mid-shutdown, work queued
+    ghost = threading.Thread(target=lambda: None)
+    s._on_worker_crash(ghost)
+    assert len(s._threads) == 1, "crash during drain must respawn"
+    assert fut.result(timeout=10.0) is not None  # the respawn drains it
+    for t in s._threads:                  # worker exits: closed + empty
+        t.join(timeout=10.0)
+    s._on_worker_crash(ghost)             # closed AND empty: no respawn
+    assert len(s._threads) == 1
+    assert s.stats()["worker_crashes"] == 2
+
+
+def test_crash_storm_fails_expired_deadlines_typed(monkeypatch):
+    """A REPEATING worker crash (the replacement died too, so the
+    dispatcher may never dispatch again) must not strand queued futures:
+    from the second consecutive crash on, queued requests whose deadline
+    already passed fail typed DeadlineExceeded at the respawn heartbeat,
+    while unexpired requests stay queued for recovery. A single crash
+    does NOT sweep — its respawn normally drains late work
+    successfully."""
+    monkeypatch.setattr(serve_engine, "_dispatch_groups", _ok_dispatch)
+    # Keep the respawned replacements out of the fake-clock queue: this
+    # test drives the crash handler directly.
+    monkeypatch.setattr(AsyncScheduler, "_respawned_run",
+                        lambda self, delay: None)
+    clock = FakeClock()
+    s = _sched(clock, sched_config=SchedulerConfig(
+        slo_ms=1e6, flush_interval_ms=1e6))
+    rng = np.random.default_rng(21)
+    A, b = _req(rng)
+    doomed = s.submit("lstsq", A, b, deadline=0.05)
+    cancelled = s.submit("lstsq", A, b, deadline=0.05)
+    survivor = s.submit("lstsq", A, b, deadline=1e3)
+    assert cancelled.cancel()              # client gave up while queued
+    clock.advance(0.06)                    # doomed's deadline passes
+    ghost = threading.Thread(target=lambda: None)
+    s._on_worker_crash(ghost)              # one crash: no sweep
+    assert not doomed.done()
+    s._on_worker_crash(ghost)              # a storm: sweep the expired
+    assert doomed.done()
+    with pytest.raises(DeadlineExceeded, match="crash-looping"):
+        doomed.result(timeout=0)
+    # The cancelled future must NOT blow up the sweep's set_exception
+    # (InvalidStateError would kill the crash handler): it drops out as
+    # cancelled, everyone else still resolves.
+    assert cancelled.cancelled()
+    assert not survivor.done() and s.queue_depth() == 1
+    st = s.stats()
+    assert st["worker_crashes"] == 2 and st["failed"] == 1
+    assert st["cancelled"] == 1
+    for t in s._threads:                   # no-op replacements exit
+        t.join(timeout=5.0)
+    s.drain()                              # recovery completes the rest
+    assert survivor.result(timeout=0) is not None
+
+
+def test_shutdown_without_drain_resolves_claimed_retries(monkeypatch):
+    """shutdown(drain=False) cancels what it can; a requeued retry is
+    already claimed (RUNNING, uncancellable) and must be resolved with
+    a typed error instead — no submitted future EVER hangs."""
+
+    def boom(kind, As, bs, cfg, scfg, cache, consume, pol=None):
+        raise RuntimeError("transient")
+
+    monkeypatch.setattr(serve_engine, "_dispatch_groups", boom)
+    clock = FakeClock()
+    s = _sched(clock, sched_config=SchedulerConfig(
+        slo_ms=1e6, flush_interval_ms=10.0, retry_base_ms=10.0))
+    rng = np.random.default_rng(14)
+    fut = s.submit("lstsq", *_req(rng), deadline=1e3)
+    clock.advance(0.011)
+    assert s.poll() == 1 and not fut.done()   # failed -> claimed requeue
+    s.shutdown(drain=False)
+    assert fut.done() and not fut.cancelled()
+    with pytest.raises(ServeError, match="drain=False"):
+        fut.result(timeout=0)
+
+
+# ------------------------------------- worker crash: respawn and requeue
+
+
+def test_worker_crash_respawns_and_work_completes(monkeypatch):
+    """An injected dispatcher-worker crash (the ``serve.worker`` site)
+    kills the thread; crash detection respawns a replacement and the
+    stream keeps completing — the pool never silently shrinks to zero."""
+    monkeypatch.setattr(serve_engine, "_dispatch_groups", _ok_dispatch)
+    cfg = FaultConfig(sites=(("serve.worker", 1.0, 1),), seed=0)
+    with faults.injected(cfg):
+        s = AsyncScheduler(serve_config=SCFG, block_size=8, workers=1,
+                           sched_config=SchedulerConfig(
+                               slo_ms=1e6, flush_interval_ms=5.0))
+        try:
+            # The single worker hits the armed site on its first loop
+            # iteration and dies; the respawned replacement (fault
+            # count exhausted) must pick the work up.
+            rng = np.random.default_rng(5)
+            fut = s.submit("lstsq", *_req(rng), deadline=30.0)
+            assert fut.result(timeout=10.0) is not None
+            st = s.stats()
+            assert st["worker_crashes"] == 1
+            # The crash CAUSE is retained for the operator (a counter
+            # climbing with no trace of why is the swallowed-failure
+            # pattern DHQR006 bans).
+            assert "FaultInjected" in st["last_worker_crash"]
+            assert any(t.is_alive() for t in s._threads)
+        finally:
+            s.shutdown()
+
+
+def test_crash_mid_flush_requeues_inflight(monkeypatch):
+    """A crash PAST the failure handler (scheduler bug / fault landing
+    mid-flush) must requeue the popped requests before the exception
+    takes the worker down — in-flight work is never lost."""
+    monkeypatch.setattr(serve_engine, "_dispatch_groups", _ok_dispatch)
+    clock = FakeClock()
+    s = _sched(clock, sched_config=SchedulerConfig(
+        slo_ms=1e6, flush_interval_ms=10.0))
+    rng = np.random.default_rng(6)
+    fut = s.submit("lstsq", *_req(rng), deadline=1e3)
+    orig_flush = s._flush
+    s._flush = lambda *a, **k: (_ for _ in ()).throw(
+        RuntimeError("mid-flush crash"))
+    clock.advance(0.011)
+    with pytest.raises(RuntimeError, match="mid-flush crash"):
+        s.poll()                              # manual mode: crash surfaces
+    assert not fut.done() and s.queue_depth() == 1, \
+        "crashed flush must requeue its in-flight requests"
+    s._flush = orig_flush
+    assert s.poll() == 1 and fut.done() and fut.result() is not None
+
+
+# --------------------------------------------- admission-priced deadlines
+
+
+def test_admission_priced_rejection(monkeypatch):
+    """With a measured EWMA, a request whose deadline cannot survive the
+    queue's expected drain time is rejected AT SUBMIT with a positive
+    priced retry hint; generous deadlines and unmeasured buckets are
+    always admitted (no rejection on a guess)."""
+    monkeypatch.setattr(serve_engine, "_dispatch_groups", _ok_dispatch)
+    clock = FakeClock()
+    s = _sched(clock, sched_config=SchedulerConfig(
+        slo_ms=1e6, flush_interval_ms=50.0, queue_depth=1024))
+    rng = np.random.default_rng(7)
+    A, b = _req(rng)
+    # Seed the bucket's EWMA through one completed dispatch, then pin it.
+    fut = s.submit("lstsq", A, b, deadline=1e3)
+    s.drain()
+    assert fut.done()
+    (bucket,) = s._ewma
+    s._ewma[bucket].update(0.0)               # converge toward...
+    for _ in range(60):
+        s._ewma[bucket].update(0.2)           # ...0.2 s per dispatch
+    # 5 queued + the candidate = 2 batches of 4 -> est 0.4 s.
+    for _ in range(5):
+        s.submit("lstsq", A, b, deadline=1e3)
+    with pytest.raises(BackpressureError, match="cannot be met") as exc:
+        s.submit("lstsq", A, b, deadline=0.3)
+    assert exc.value.retry_after >= 0.05      # >= flush interval (clamp)
+    assert s.stats()["rejected_unmeetable"] == 1
+    ok = s.submit("lstsq", A, b, deadline=1.0)    # 0.4 < 1.0: admitted
+    # A bucket with NO measurement admits even tight deadlines.
+    A2, b2 = _req(rng, m=48, n=24)
+    ok2 = s.submit("lstsq", A2, b2, deadline=0.01)
+    s.drain()
+    assert ok.done() and ok2.done()
+    assert s.stats()["rejected"] == 0         # depth mark never tripped
+
+
+def test_admission_ewma_excludes_compile_time(monkeypatch):
+    """The admission EWMA prices WARM dispatch only: the first flush of
+    a novel bucket pays its AOT compile inside the timed window, and
+    pricing that spike would reject every following normal-deadline
+    submit for the bucket forever — rejected requests never dispatch,
+    so the estimate could never decay (a permanent starvation loop)."""
+    clock = FakeClock()
+    cache = ExecutableCache(max_size=8)
+    state = {"first": True}
+
+    def dispatch(kind, As, bs, cfg, scfg, cache_, consume, pol=None):
+        if state["first"]:                # cold: a 2 s AOT compile...
+            state["first"] = False
+            cache.timer._records.append(("aot_compile", 2.0))
+            clock.advance(2.005)          # ...around 5 ms warm dispatch
+        else:
+            clock.advance(0.005)
+        _ok_dispatch(kind, As, bs, cfg, scfg, cache_, consume, pol)
+
+    monkeypatch.setattr(serve_engine, "_dispatch_groups", dispatch)
+    s = _sched(clock, cache=cache, sched_config=SchedulerConfig(
+        slo_ms=100.0, flush_interval_ms=10.0))
+    rng = np.random.default_rng(23)
+    A, b = _req(rng)
+    first = s.submit("lstsq", A, b, deadline=10.0)
+    clock.advance(0.011)
+    assert s.poll() == 1 and first.result(timeout=0) is not None
+    # The EWMA carries the 5 ms warm dispatch, not the 2 s compile...
+    ewma_ms = max(s.stats()["bucket_ewma_ms"].values())
+    assert ewma_ms < 50.0, ewma_ms
+    # ...so a normal 100 ms deadline is still ADMITTED (and met) right
+    # after the cold flush instead of being rejected unmeetable.
+    nxt = s.submit("lstsq", A, b, deadline=0.1)
+    clock.advance(0.011)
+    assert s.poll() == 1 and nxt.result(timeout=0) is not None
+    assert s.stats()["rejected_unmeetable"] == 0
+
+
+def test_retry_hints_never_zero_or_negative(monkeypatch):
+    """The empty-EWMA / first-request audit (round 12 satellite):
+    every retry hint a caller can receive — queue-full backpressure
+    before ANY dispatch was measured, admission pricing, quarantine at
+    its expiry boundary — clamps to at least one flush interval (or a
+    positive floor), so clients never busy-spin on a 0/negative hint."""
+    monkeypatch.setattr(serve_engine, "_dispatch_groups", _ok_dispatch)
+    clock = FakeClock()
+    s = _sched(clock, sched_config=SchedulerConfig(
+        slo_ms=1e6, flush_interval_ms=40.0, queue_depth=2))
+    rng = np.random.default_rng(13)
+    A, b = _req(rng)
+    for _ in range(2):
+        s.submit("lstsq", A, b, deadline=1e3)
+    # Queue full with an EMPTY EWMA map: depth x avg-latency is 0.0 —
+    # the hint must still be >= the flush interval.
+    with pytest.raises(BackpressureError) as exc:
+        s.submit("lstsq", A, b, deadline=1e3)
+    assert s._ewma == {} and exc.value.retry_after >= 0.04
+    # Constructor-level clamps (the last line of defense).
+    assert BackpressureError("x", 0.0).retry_after > 0
+    assert BackpressureError("x", -5.0).retry_after > 0
+    assert Quarantined(("k",), 0.0).retry_after > 0
+
+
+# ----------------------------------------------------- compile quarantine
+
+
+def test_quarantine_cooldown_and_expiry():
+    """Failed compile: typed CompileFailed, key quarantined (no second
+    compile inside the cooldown, positive retry_after), one retry after
+    expiry — and counters tell the story."""
+    clock = FakeClock()
+    c = ExecutableCache(max_size=4, quarantine_s=5.0, clock=clock)
+    calls = {"n": 0}
+
+    def boom():
+        calls["n"] += 1
+        raise RuntimeError("mosaic lowering exploded")
+
+    with pytest.raises(CompileFailed, match="mosaic") as exc:
+        c.get_or_compile(("bad",), boom)
+    assert isinstance(exc.value.__cause__, RuntimeError)
+    assert ("bad",) not in c
+    clock.advance(1.0)
+    with pytest.raises(Quarantined) as qexc:
+        c.get_or_compile(("bad",), boom)
+    assert calls["n"] == 1, "quarantine must prevent the recompile"
+    assert 0 < qexc.value.retry_after <= 4.0
+    st = c.stats()
+    assert st["compile_failures"] == 1 and st["quarantine_hits"] == 1
+    assert st["quarantined"] == 1 and st["misses"] == 1
+
+    class _Lowered:
+        def compile(self):
+            return "exe"
+
+    clock.advance(4.01)                       # cooldown over
+    assert c.get_or_compile(("bad",), _Lowered) == "exe"
+    assert calls["n"] == 1 and c.stats()["quarantined"] == 0
+    # retry_after clamps positive even at the expiry boundary.
+    assert Quarantined(("k",), -3.0).retry_after > 0
+
+
+def test_scheduler_backs_off_quarantined_group(monkeypatch):
+    """A quarantined program backs its group off for the remaining
+    cooldown WITHOUT spending retry budget, then completes after
+    expiry; a deadline that cannot survive the cooldown fails typed."""
+    calls = {"n": 0}
+
+    def quarantined_then_ok(kind, As, bs, cfg, scfg, cache, consume,
+                            pol=None):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise Quarantined(("key",), 0.5)
+        _ok_dispatch(kind, As, bs, cfg, scfg, cache, consume, pol)
+
+    monkeypatch.setattr(serve_engine, "_dispatch_groups",
+                        quarantined_then_ok)
+    clock = FakeClock()
+    s = _sched(clock, sched_config=SchedulerConfig(
+        slo_ms=1e6, flush_interval_ms=10.0, max_retries=0))
+    rng = np.random.default_rng(8)
+    fut = s.submit("lstsq", *_req(rng), deadline=10.0)
+    clock.advance(0.011)
+    assert s.poll() == 1 and not fut.done()   # backed off, not failed
+    clock.advance(0.3)
+    assert s.poll() == 0                      # still inside the cooldown
+    clock.advance(0.21)
+    assert s.poll() == 1 and fut.result() is not None
+    assert s.stats()["retries"] == 1
+    # Tight deadline: the cooldown cannot fit -> typed Quarantined.
+    calls["n"] = 0
+    fut2 = s.submit("lstsq", *_req(rng), deadline=0.2)
+    clock.advance(0.011)
+    s.poll()
+    with pytest.raises(Quarantined):
+        fut2.result(timeout=0)
+
+
+def test_typed_compile_failure_end_to_end_real_engine():
+    """Real engine, injected compile fault: the sync tier surfaces
+    CompileFailed, the quarantine absorbs the immediate repeat, and
+    after expiry the SAME call compiles clean and serves — recovery to
+    zero-recompile steady state."""
+    import time as _time
+
+    from dhqr_tpu.serve import batched_lstsq
+
+    rng = np.random.default_rng(9)
+    As = [jnp.asarray(rng.random((24, 10)), jnp.float32)]
+    bs = [jnp.asarray(rng.random(24), jnp.float32)]
+    cache = ExecutableCache(max_size=4, quarantine_s=0.2)
+    cfg = FaultConfig(sites=(("serve.compile", 1.0, 1),), seed=0)
+    with faults.injected(cfg) as harness:
+        with pytest.raises(CompileFailed) as exc:
+            batched_lstsq(As, bs, block_size=8, serve_config=SCFG,
+                          cache=cache)
+        assert isinstance(exc.value.__cause__, FaultInjected)
+        with pytest.raises(Quarantined):
+            batched_lstsq(As, bs, block_size=8, serve_config=SCFG,
+                          cache=cache)
+        assert harness.stats()["serve.compile"]["fired"] == 1
+        _time.sleep(0.25)                     # real clock: cooldown over
+        xs = batched_lstsq(As, bs, block_size=8, serve_config=SCFG,
+                           cache=cache)
+    assert xs[0].shape == (10,)
+    misses = cache.stats()["misses"]
+    batched_lstsq(As, bs, block_size=8, serve_config=SCFG, cache=cache)
+    assert cache.stats()["misses"] == misses, "recovery must be warm"
+    st = cache.stats()
+    assert st["compile_failures"] == 1 and st["quarantine_hits"] == 1
+
+
+# ------------------------------------------------------- chaos invariants
+
+
+def _chaos_run(n_requests, poison_rate, transient_rate, seed):
+    """Seeded mini-chaos against the stubbed dispatch: a seeded subset
+    of requests is POISON (any batch containing one fails), and whole
+    dispatches also fail transiently at ``transient_rate`` (batches of
+    > 2 only, so the ground truth stays decidable: clean requests must
+    eventually succeed, poison requests must fail typed). Returns
+    (poison_flags, futures, stats)."""
+    rng = np.random.default_rng(seed)
+    fail_rng = np.random.default_rng(seed + 1)
+    reqs, poison = [], []
+    for i in range(n_requests):
+        m = int(rng.integers(17, 33))
+        n = int(rng.integers(8, m // 2 + 4))
+        reqs.append(_req(rng, m=m, n=n))
+        poison.append(i == 3 or rng.random() < poison_rate)
+    poison_ids = {id(reqs[i][0]) for i in range(n_requests) if poison[i]}
+
+    def flaky(kind, As, bs, cfg, scfg, cache, consume, pol=None):
+        if any(id(A) in poison_ids for A in As):
+            raise RuntimeError("poison")
+        if len(As) > 2 and fail_rng.random() < transient_rate:
+            raise RuntimeError("chaos")
+        _ok_dispatch(kind, As, bs, cfg, scfg, cache, consume, pol)
+
+    import unittest.mock as mock
+    with mock.patch.object(serve_engine, "_dispatch_groups", flaky):
+        s = _sched(FakeClock(), sched_config=SchedulerConfig(
+            slo_ms=1e6, flush_interval_ms=10.0, queue_depth=4096,
+            max_retries=1, retry_base_ms=5.0))
+        futs = [s.submit("lstsq", A, b, deadline=1e3, tenant=f"t{i % 3}")
+                for i, (A, b) in enumerate(reqs)]
+        s.drain()
+        return poison, futs, s.stats()
+
+
+def test_chaos_every_future_resolves():
+    """THE acceptance pin: under a seeded fault schedule every submitted
+    request's future resolves — success or typed ServeError — with no
+    hang and no lost request; poison requests fail ALONE (typed) while
+    every clean request still gets its answer."""
+    poison, futs, st = _chaos_run(n_requests=60, poison_rate=0.08,
+                                  transient_rate=0.3, seed=12)
+    assert all(f.done() for f in futs), "a future never resolved"
+    for is_poison, f in zip(poison, futs):
+        if is_poison:
+            assert isinstance(f.exception(), ServeError), f.exception()
+        else:
+            assert f.exception() is None and f.result() is not None
+    assert st["completed"] + st["failed"] == 60
+    assert st["failed"] == sum(poison) and st["poisoned"] == sum(poison)
+    assert st["flush_failures"] > 0           # chaos actually happened
+    assert st["bisections"] > 0               # isolation actually ran
+    assert st["queue_depth"] == 0 and st["inflight"] == 0
+
+
+@pytest.mark.slow
+def test_chaos_soak_many_schedules():
+    """Longer soak across seeds and fault rates (slow tier): the
+    resolve-everything invariant holds for every schedule, including
+    high poison density and near-certain transient failure."""
+    for seed in range(5):
+        for poison_rate, transient_rate in ((0.0, 0.9), (0.2, 0.5),
+                                            (0.5, 0.2)):
+            poison, futs, st = _chaos_run(
+                n_requests=120, poison_rate=poison_rate,
+                transient_rate=transient_rate, seed=100 + seed)
+            key = (seed, poison_rate, transient_rate)
+            assert all(f.done() for f in futs), key
+            assert st["completed"] + st["failed"] == 120, key
+            for is_poison, f in zip(poison, futs):
+                if is_poison:
+                    assert isinstance(f.exception(), ServeError), key
+                else:
+                    assert f.exception() is None, (key, f.exception())
