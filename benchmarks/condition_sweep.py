@@ -1,0 +1,238 @@
+"""Condition sweep: the round-13 chaos benchmark for the numeric ladder.
+
+One cell per (cond, engine, policy): build a matrix with a geometric
+singular-value ladder at the target condition number, run the GUARDED
+least-squares path (``guards="full"`` — screening, breakdown detection,
+the fallback ladder, AND the one-shot 8x-LAPACK residual probe), and
+record what happened:
+
+* ``outcome="ok"`` — some rung answered within the 8x criterion; the
+  row carries the engine that answered, the escalation count, the
+  probe's residual ratio, and an INDEPENDENT recomputation of the
+  ratio (the "no silent garbage" cross-check — probe and recheck must
+  agree on pass/fail);
+* ``outcome=<typed error>`` — the ladder ran dry and refused typed
+  (``Breakdown`` / ``IllConditioned`` / ``ResidualGateFailed``), with
+  the condition estimate and the per-rung attempt record.
+
+The acceptance invariant (benchmarks/README.md round-13 rules, pinned
+by the verdict row): EVERY cell is ok-within-8x or typed — zero
+silent-garbage cells — and re-running a sample of cells after the
+sweep compiles NOTHING (the guards and every rung's engine impl are
+shape-cached).
+
+The policy axis per engine is the set the public ``lstsq`` accepts
+there (a trailing split is a blocked-householder knob; tsqr takes no
+refinement): householder runs accurate+fast, the cholqr family
+accurate+refine, tsqr accurate.
+
+CPU runs in float64 (the container pins x64 off-TPU), so the cond
+ladder is meaningful to 1e14: the f64 CholeskyQR2 window is ~7e7, the
+shifted form's ~5e14 — the ladder's engine transitions all happen
+inside the sweep. A TPU replay runs f32 (window ~3e3) with the same
+script; rows are platform-stamped.
+
+Usage:  python benchmarks/condition_sweep.py [m n]   (default 192 24)
+Writes: benchmarks/results/condition_sweep_<platform>.jsonl (append).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+CONDS = (1e2, 1e4, 1e6, 1e8, 1e10, 1e12, 1e14)
+
+#: (engine, policy-spec or None) cells — the combinations the public
+#: lstsq accepts per engine family (see module docstring).
+ENGINE_POLICIES = (
+    ("cholqr2", None),
+    ("cholqr2", "highest/r1"),
+    ("cholqr3", None),
+    ("cholqr3", "highest/r1"),
+    ("tsqr", None),
+    ("householder", None),
+    ("householder", "fast"),
+)
+
+
+def _stage(name: str) -> None:
+    print(f"::stage {name} t={time.time():.1f}", file=sys.stderr, flush=True)
+
+
+def _ill_conditioned(rng, m, n, cond, dtype):
+    import numpy as np
+
+    U, _ = np.linalg.qr(rng.standard_normal((m, n)))
+    V, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    s = np.geomspace(1.0, 1.0 / cond, n)
+    A = (U * s) @ V.T
+    b = rng.standard_normal(m)
+    return A.astype(dtype), b.astype(dtype)
+
+
+def main(m: int = 192, n: int = 24) -> None:
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(3))
+    _stage("import")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(_REPO, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception as e:
+        print(f"# compile cache unavailable: {e}", file=sys.stderr)
+
+    platform = jax.default_backend()
+    if platform == "cpu":
+        jax.config.update("jax_enable_x64", True)
+        dtype = np.float64
+    else:
+        dtype = np.float32
+
+    from dhqr_tpu.models.qr_model import _lstsq_impl
+    from dhqr_tpu.numeric import NumericalError, guarded_lstsq
+    from dhqr_tpu.numeric.guards import (
+        _nonfinite_impl,
+        _screen_impl,
+        _screen_rhs_impl,
+        residual_ratio,
+    )
+    from dhqr_tpu.ops.cholqr import _cholqr_lstsq_impl, cholqr_max_cond
+    from dhqr_tpu.ops.tsqr import _tsqr_lstsq_impl
+    from dhqr_tpu.utils.testing import TOLERANCE_FACTOR
+
+    def compiles():
+        return sum(f._cache_size() for f in
+                   (_lstsq_impl, _cholqr_lstsq_impl, _tsqr_lstsq_impl,
+                    _screen_impl, _screen_rhs_impl, _nonfinite_impl))
+
+    out_path = os.path.join(_REPO, "benchmarks", "results",
+                            f"condition_sweep_{platform}.jsonl")
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    fh = open(out_path, "a", buffering=1)
+
+    def emit(row):
+        row = {"round": 13, "platform": platform, "ts": round(time.time(), 1),
+               **row}
+        line = json.dumps(row)
+        print(line, flush=True)
+        fh.write(line + "\n")
+
+    emit({"kind": "meta", "m": m, "n": n, "dtype": np.dtype(dtype).name,
+          "conds": list(CONDS),
+          "cells": [f"{e}+{p or 'accurate'}" for e, p in ENGINE_POLICIES],
+          "windows": {"cholqr2": cholqr_max_cond(dtype),
+                      "cholqr3": cholqr_max_cond(dtype, shift=True)}})
+
+    rng = np.random.default_rng(13)
+    total = ok_cells = typed_cells = garbage_cells = 0
+    fallback_depth_max = 0
+    _stage("sweep")
+    for cond in CONDS:
+        A_np, b_np = _ill_conditioned(rng, m, n, cond, dtype)
+        A, b = jnp.asarray(A_np), jnp.asarray(b_np)
+        for engine, policy in ENGINE_POLICIES:
+            total += 1
+            cell = {"kind": "cell", "cond": cond, "engine": engine,
+                    "policy": policy or "accurate"}
+            t0 = time.perf_counter()
+            try:
+                res = guarded_lstsq(A, b, engine=engine, policy=policy,
+                                    guards="full")
+            except NumericalError as e:
+                typed_cells += 1
+                emit({**cell, "outcome": type(e).__name__,
+                      "cond_estimate": e.cond_estimate,
+                      "attempts": [
+                          {"engine": a.engine, "policy": a.policy,
+                           "outcome": a.outcome}
+                          for a in e.attempts],
+                      "seconds": round(time.perf_counter() - t0, 4)})
+                continue
+            seconds = time.perf_counter() - t0
+            # Independent recheck: the probe already gated at 8x; a
+            # disagreement here would BE the silent-garbage bug.
+            recheck = residual_ratio(A_np, b_np, np.asarray(res.x))
+            silent = recheck > TOLERANCE_FACTOR
+            garbage_cells += int(silent)
+            ok_cells += 1 - int(silent)
+            fallback_depth_max = max(fallback_depth_max, res.escalations)
+            emit({**cell, "outcome": "ok" if not silent else "GARBAGE",
+                  "engine_used": res.engine,
+                  "policy_used": res.attempts[-1].policy,
+                  "escalations": res.escalations,
+                  "path": [a.outcome for a in res.attempts],
+                  "residual_ratio": round(res.residual_ratio, 4),
+                  "recheck_ratio": round(recheck, 4),
+                  "seconds": round(seconds, 4)})
+
+    # Degenerate cells: a structurally singular input (zero column,
+    # cond = inf) and a NaN-poisoned input — the rows that MUST fail
+    # typed on every route (no ladder depth can answer them). These
+    # are the artifact's typed-refusal evidence.
+    _stage("degenerate")
+    A_np, b_np = _ill_conditioned(rng, m, n, 1e2, dtype)
+    degenerate = (
+        ("zero_column",
+         jnp.asarray(A_np).at[:, n // 2].set(0.0), jnp.asarray(b_np)),
+        ("nan_input",
+         jnp.asarray(A_np).at[0, 0].set(jnp.nan), jnp.asarray(b_np)),
+    )
+    for label, A, b in degenerate:
+        for engine, policy in ENGINE_POLICIES:
+            total += 1
+            cell = {"kind": "cell", "cond": label, "engine": engine,
+                    "policy": policy or "accurate"}
+            t0 = time.perf_counter()
+            try:
+                res = guarded_lstsq(A, b, engine=engine, policy=policy,
+                                    guards="full")
+            except NumericalError as e:
+                typed_cells += 1
+                emit({**cell, "outcome": type(e).__name__,
+                      "cond_estimate": e.cond_estimate,
+                      "seconds": round(time.perf_counter() - t0, 4)})
+                continue
+            garbage_cells += 1  # a degenerate input must never "succeed"
+            emit({**cell, "outcome": "GARBAGE",
+                  "engine_used": res.engine,
+                  "seconds": round(time.perf_counter() - t0, 4)})
+
+    # Warm-repeat pin: replay one representative cell per engine; the
+    # sweep already compiled every program, so this must add ZERO.
+    _stage("warm_repeat")
+    n_compiled = compiles()
+    A_np, b_np = _ill_conditioned(rng, m, n, 1e4, dtype)
+    A, b = jnp.asarray(A_np), jnp.asarray(b_np)
+    for engine, policy in ENGINE_POLICIES:
+        guarded_lstsq(A, b, engine=engine, policy=policy, guards="full")
+    warm_recompiles = compiles() - n_compiled
+
+    verdict = {
+        "kind": "verdict", "cells": total, "ok_within_8x": ok_cells,
+        "typed_failures": typed_cells, "silent_garbage": garbage_cells,
+        "max_fallback_depth": fallback_depth_max,
+        "warm_repeat_recompiles": warm_recompiles,
+        "no_silent_garbage": garbage_cells == 0,
+        "every_cell_ok_or_typed": ok_cells + typed_cells == total
+        and garbage_cells == 0,
+        "zero_recompiles_warm": warm_recompiles == 0,
+    }
+    emit(verdict)
+    fh.close()
+    if not (verdict["every_cell_ok_or_typed"]
+            and verdict["zero_recompiles_warm"]):
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main(*(int(a) for a in sys.argv[1:3]))

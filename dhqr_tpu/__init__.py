@@ -38,6 +38,15 @@ from dhqr_tpu.ops.solve import apply_q, apply_qt, back_substitute, solve_least_s
 from dhqr_tpu.ops.differentiable import lstsq_diff
 from dhqr_tpu.ops.tsqr import tsqr_lstsq, tsqr_r
 from dhqr_tpu.ops.cholqr import cholesky_qr2, cholesky_qr_lstsq
+from dhqr_tpu.numeric import (
+    Breakdown,
+    IllConditioned,
+    NonFiniteInput,
+    NumericalError,
+    ResidualGateFailed,
+    guarded_lstsq,
+    guarded_qr,
+)
 from dhqr_tpu.precision import (
     PRECISION_POLICIES,
     POLICY_LADDER,
@@ -96,6 +105,13 @@ __all__ = [
     "DispatchFailed",
     "DeadlineExceeded",
     "Quarantined",
+    "NumericalError",
+    "NonFiniteInput",
+    "Breakdown",
+    "IllConditioned",
+    "ResidualGateFailed",
+    "guarded_lstsq",
+    "guarded_qr",
     "DHQRConfig",
     "FaultConfig",
     "ServeConfig",

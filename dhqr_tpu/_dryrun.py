@@ -249,6 +249,57 @@ def run(n_devices: int) -> None:
           "released, warm repeat after recovery 0 recompiles)",
           flush=True)
 
+    # Numeric guardrails (round 13): one injected numeric.breakdown on a
+    # cholqr2 route must resolve via the fallback ladder within the 8x
+    # LAPACK criterion, the typed path taken must be recorded, and a
+    # warm repeat after recovery must be ZERO-recompile (the guard
+    # programs and every rung's engine impl are shape-cached — chaos
+    # leaves no compile residue, same contract as the serve faults
+    # stage above).
+    from dhqr_tpu.models.qr_model import _lstsq_impl as _li
+    from dhqr_tpu.numeric import guarded_lstsq
+    from dhqr_tpu.numeric.guards import (
+        _nonfinite_impl,
+        _screen_impl,
+        _screen_rhs_impl,
+    )
+    from dhqr_tpu.ops.cholqr import _cholqr_lstsq_impl as _ci
+    from dhqr_tpu.ops.tsqr import _tsqr_lstsq_impl as _ti
+
+    def _numeric_compiles():
+        return sum(f._cache_size() for f in
+                   (_li, _ci, _ti, _screen_impl, _screen_rhs_impl,
+                    _nonfinite_impl))
+
+    An_ = jnp.asarray(rng.random((96, 12)), jnp.float32)
+    bn_ = jnp.asarray(rng.random(96), jnp.float32)
+    ref_n = oracle_residual(np.asarray(An_), np.asarray(bn_))
+    # Warm pass: the healthy cholqr2 route, guarded.
+    gres = guarded_lstsq(An_, bn_, engine="cholqr2", guards="fallback")
+    assert gres.engine == "cholqr2" and gres.escalations == 0, gres
+    # Injected breakdown on rung 0: the ladder must recover on a later
+    # rung and still meet the reference criterion.
+    nfault = FaultConfig(sites=(("numeric.breakdown", 1.0, 1),), seed=0)
+    with _faults_mod.injected(nfault) as nharness:
+        gres2 = guarded_lstsq(An_, bn_, engine="cholqr2",
+                              guards="fallback")
+    assert nharness.stats()["numeric.breakdown"]["fired"] == 1
+    assert gres2.escalations == 1 and gres2.engine == "cholqr3", (
+        gres2.engine, [a.outcome for a in gres2.attempts])
+    res = normal_equations_residual(An_, np.asarray(gres2.x), bn_)
+    assert res < TOLERANCE_FACTOR * ref_n, ("numeric fallback", res)
+    # Recovery: disarmed, rung 0 healthy again; the repeat compiles
+    # NOTHING (all rungs and guard programs already cached).
+    n_compiled = _numeric_compiles()
+    gres3 = guarded_lstsq(An_, bn_, engine="cholqr2", guards="fallback")
+    assert gres3.escalations == 0, gres3
+    assert _numeric_compiles() == n_compiled, (
+        "warm guarded repeat recompiled")
+    assert bool(jnp.all(gres3.x == gres.x)), "guarded repeat diverged"
+    print("dryrun: numeric ok (injected breakdown -> cholqr3 fallback "
+          f"within 8x (residual {res:.2e}), warm repeat after recovery "
+          "0 recompiles)", flush=True)
+
     # Plan autotuner (round 9): a tiny-grid on-device search must run end
     # to end on CPU — tune, persist, resolve through the PUBLIC lstsq
     # plan="auto" path — with the tuned answer held to the same 8x LAPACK

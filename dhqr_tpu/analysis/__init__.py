@@ -4,10 +4,12 @@ Two passes over two program representations (docs/DESIGN.md "Static
 invariants"):
 
 * **Pass 1 (AST)** — :mod:`dhqr_tpu.analysis.ast_rules` walks the source
-  tree with rule classes DHQR001-DHQR006: private-jax import hygiene, MXU
+  tree with rule classes DHQR001-DHQR007: private-jax import hygiene, MXU
   precision annotations on every contraction, config/env mutation
   containment, host syncs inside traced bodies, collective axis-name
-  discipline inside ``shard_map`` bodies, and swallowed-exception bans.
+  discipline inside ``shard_map`` bodies, swallowed-exception bans, and
+  Cholesky-call containment (every Cholesky routes through the numeric
+  layer's guarded wrapper).
 * **Pass 2 (jaxpr)** — :mod:`dhqr_tpu.analysis.jaxpr_pass` abstractly
   traces the public entry points under every precision-policy preset (and
   the sharded engines under a 1-device mesh) and sanitizes the jaxpr:

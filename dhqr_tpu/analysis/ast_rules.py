@@ -1,4 +1,4 @@
-"""Pass 1 — AST lint rules DHQR001-DHQR006.
+"""Pass 1 — AST lint rules DHQR001-DHQR007.
 
 Each rule is a small class with an id, a scope predicate over the
 (posix) file path, and a ``check(module)`` hook receiving a
@@ -549,6 +549,76 @@ class SwallowedException(Rule):
         return out
 
 
+class UnguardedCholesky(Rule):
+    """DHQR007 — every Cholesky in package code routes through the one
+    guarded wrapper, ``dhqr_tpu.numeric.guards.checked_cholesky``.
+    ``lax.linalg.cholesky`` does not raise on a non-positive-definite
+    input — it returns NaN rows from the first failed pivot on, which
+    is exactly how CholeskyQR2 breaks down past its conditioning
+    window (ops/cholqr.py). The wrapper is where that breakdown
+    contract is written down (callers gate their outputs or document
+    why breakdown is impossible); a direct call silently opts out of
+    the round-13 numeric guardrails, so one engine tweak could
+    reintroduce the silent-NaN hazard the fallback ladder exists to
+    close."""
+
+    id = "DHQR007"
+    title = "direct cholesky call outside numeric.guards.checked_cholesky"
+
+    def applies(self, path: str) -> bool:
+        # The wrapper module itself is the one sanctioned call site.
+        return _in_package(path) and not path.endswith("numeric/guards.py")
+
+    def check(self, ctx):
+        # Every spelling reaches the same primitive, so every spelling
+        # is flagged: dotted *.linalg.cholesky, a bare name bound by
+        # `from <...linalg...> import cholesky [as x]`, and a module
+        # alias (`import jax.lax.linalg as lin; lin.cholesky(G)`).
+        flagged_names: "set[str]" = set()
+        module_aliases: "set[str]" = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    if "linalg" in (node.module or "") \
+                            and alias.name == "cholesky":
+                        flagged_names.add(alias.asname or "cholesky")
+                    elif alias.name == "linalg" and alias.asname:
+                        # `from jax.lax import linalg as la` — la is a
+                        # linalg module; without an asname the dotted
+                        # form already ends with linalg.cholesky.
+                        module_aliases.add(alias.asname)
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if "linalg" in alias.name and alias.asname:
+                        module_aliases.add(alias.asname)
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node.func)
+            dotted = _dotted(node.func)
+            if name == "cholesky" and isinstance(node.func, ast.Name):
+                if name not in flagged_names:
+                    continue  # a local wrapper named cholesky
+            elif name == "cholesky":
+                prefix = dotted[:-len(".cholesky")] if "." in dotted else ""
+                if not (dotted.endswith("linalg.cholesky")
+                        or prefix in module_aliases):
+                    continue  # checked_cholesky-style wrappers pass
+            elif name in flagged_names and isinstance(node.func, ast.Name):
+                pass  # `from ...linalg import cholesky as chol; chol(G)`
+            else:
+                continue
+            out.append(self._finding(
+                ctx, node,
+                f"direct {dotted}() call: route through "
+                "dhqr_tpu.numeric.guards.checked_cholesky (the guarded "
+                "wrapper carrying the NaN-breakdown contract), or "
+                "suppress with the reason breakdown is impossible here",
+            ))
+        return out
+
+
 AST_RULES = (
     PrivateJaxImports(),
     UnannotatedContractions(),
@@ -556,6 +626,7 @@ AST_RULES = (
     HostSyncInTracedBody(),
     CollectiveAxisName(),
     SwallowedException(),
+    UnguardedCholesky(),
 )
 
 

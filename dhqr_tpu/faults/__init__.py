@@ -17,6 +17,13 @@ Environment arming: ``DHQR_FAULTS="serve.dispatch:0.05,serve.latency:0.2"``
 single module-global ``None`` check — see ``faults/harness.py`` for the
 site registry and guarantees, docs/DESIGN.md "Fault model" for the
 taxonomy the serving tier resolves injected failures into.
+
+Round 13 adds the NUMERIC sites — ``numeric.nan`` (fires at the
+guarded entry points' input screen, as if the scan found a NaN) and
+``numeric.breakdown`` (fires per fallback-ladder rung, as if that
+rung's factors came back non-finite) — so every escalation path of
+``dhqr_tpu.numeric`` is deterministically replayable without crafting
+an ill-conditioned matrix for it.
 """
 
 from dhqr_tpu.faults.harness import (

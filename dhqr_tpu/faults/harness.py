@@ -49,6 +49,14 @@ site                  action  where it is threaded
 ``serve.latency``     sleep   ``serve.engine._dispatch_groups``, before the
                               dispatch — models a slow device/host without
                               failing anything
+``numeric.nan``       raise   ``numeric.ladder._screen``, at the input
+                              screen — treated exactly as a detected
+                              non-finite input, surfaces as
+                              :class:`~dhqr_tpu.numeric.NonFiniteInput`
+``numeric.breakdown`` raise   ``numeric.ladder`` guarded entry points, per
+                              ladder rung — treated exactly as that rung's
+                              factors coming back non-finite, so the
+                              fallback ladder escalates deterministically
 ====================  ======  ==============================================
 """
 
@@ -71,6 +79,8 @@ SITES = {
     "serve.dispatch": "raise",
     "serve.worker": "raise",
     "serve.latency": "sleep",
+    "numeric.nan": "raise",
+    "numeric.breakdown": "raise",
 }
 
 

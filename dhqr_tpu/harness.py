@@ -119,6 +119,16 @@ def main(argv=None) -> int:
         "sharded; see DHQRConfig.agg_panels)",
     )
     parser.add_argument(
+        "--guards", default=None,
+        choices=["screen", "fallback", "full"],
+        help="numeric guardrails for every solve in the sweep "
+        "(dhqr_tpu.numeric, round 13): 'screen' = input screening only, "
+        "'fallback' adds breakdown detection + the engine/policy "
+        "fallback ladder, 'full' adds the one-shot 8x-LAPACK residual "
+        "probe; a problem no rung answers fails TYPED instead of "
+        "printing a silent-garbage row",
+    )
+    parser.add_argument(
         "--profile-dir", default=None,
         help="write a jax.profiler trace here (the @profilehtml analogue)",
     )
@@ -181,6 +191,7 @@ def main(argv=None) -> int:
         "trailing_precision": args.trailing_precision,
         "lookahead": args.lookahead,
         "agg_panels": args.agg_panels,
+        "guards": args.guards,
     }.items() if v is not None}
     cfg = DHQRConfig.from_env(**overrides)
     if cfg.agg_panels == 0:  # explicit --agg-panels 0 = off (see above)
@@ -288,9 +299,18 @@ def main(argv=None) -> int:
             A, b = random_problem(m, n, dtype, seed=0)
             Aj, bj = jnp.asarray(A), jnp.asarray(b)
             timer = PhaseTimer()
-            with timer.measure("factor+solve"):
-                x = dhqr_tpu.lstsq(Aj, bj, config=cfg, mesh=size_mesh)
-                timer.observe(x)
+            try:
+                with timer.measure("factor+solve"):
+                    x = dhqr_tpu.lstsq(Aj, bj, config=cfg, mesh=size_mesh)
+                    timer.observe(x)
+            except dhqr_tpu.NumericalError as e:
+                # Guards armed (--guards): the ladder ran dry and
+                # refused typed — a FAIL row with the classification,
+                # never a silent-garbage residual line.
+                failures += 1
+                print(f"FAIL  {m}x{n} {dtype_name:<10} typed "
+                      f"{type(e).__name__}: {e}")
+                continue
             res = normal_equations_residual(A, np.asarray(x), b)
             ref = oracle_residual(A, b)
             # EXACTLY the reference's acceptance rule: normal-equations

@@ -97,7 +97,7 @@ def _resolve_policy_cfg(cfg: DHQRConfig):
 
 
 def _resolve_plan_cfg(cfg: DHQRConfig, kind: str, shape, dtype, mesh,
-                      pol) -> DHQRConfig:
+                      pol, applied: "list | None" = None) -> DHQRConfig:
     """Resolve ``cfg.plan`` into the classic engine-selection knobs
     (shared by ``qr`` and ``lstsq``; the serve tier has its own
     per-bucket twin in ``serve.engine``).
@@ -111,6 +111,13 @@ def _resolve_plan_cfg(cfg: DHQRConfig, kind: str, shape, dtype, mesh,
     as ``policy=``). Runs AFTER policy resolution: plans are keyed
     under the policy, and a policy-set ``trailing_precision`` always
     wins over the plan's (``tune.apply_plan_to_config``).
+
+    ``applied`` (optional list) receives the :class:`Plan` when one
+    ACTUALLY lands on the config — a "auto" DB miss with
+    ``on_miss="default"``, an m < n shape, or ``plan="default"`` all
+    leave it untouched. The numeric ladder keys plan demotion on this
+    (a rung-0 failure must never demote a key that served the static
+    default).
     """
     spec = cfg.plan
     if spec is None:
@@ -160,6 +167,8 @@ def _resolve_plan_cfg(cfg: DHQRConfig, kind: str, shape, dtype, mesh,
             f"plan must be 'auto', 'default', None or a dhqr_tpu.tune.Plan,"
             f" got {spec!r}"
         )
+    if applied is not None:
+        applied.append(plan)
     return apply_plan_to_config(cfg, plan)
 
 
@@ -374,6 +383,19 @@ def qr(
     from dhqr_tpu.utils.platform import ensure_complex_supported
 
     cfg = dataclasses.replace(config or DHQRConfig(), **overrides)
+    if cfg.guards is not None:
+        # Numeric guardrails (round 13): screening, breakdown
+        # detection, policy escalation, typed refusal — the provenance
+        # surface is dhqr_tpu.numeric.guarded_qr; this facade returns
+        # the factorization only.
+        if donate:
+            raise ValueError(
+                "donate=True cannot be combined with guards=: escalation "
+                "must be able to re-read A, which donation invalidates"
+            )
+        from dhqr_tpu.numeric.ladder import guarded_qr
+
+        return guarded_qr(A, config=cfg, mesh=mesh).factorization
     cfg, pol = _resolve_policy_cfg(cfg)
     cfg = _resolve_plan_cfg(cfg, "qr", A.shape, A.dtype, mesh, pol)
     if cfg.engine != "householder":
@@ -865,6 +887,14 @@ def lstsq(
     from dhqr_tpu.utils.platform import ensure_complex_supported
 
     cfg = dataclasses.replace(config or DHQRConfig(), **overrides)
+    if cfg.guards is not None:
+        # Numeric guardrails (round 13): screen -> run -> health check
+        # -> condition-aware fallback ladder -> typed refusal. The
+        # provenance surface (taken path, condition estimate) is
+        # dhqr_tpu.numeric.guarded_lstsq; this facade returns x only.
+        from dhqr_tpu.numeric.ladder import guarded_lstsq
+
+        return guarded_lstsq(A, b, config=cfg, mesh=mesh).x
     cfg, pol = _resolve_policy_cfg(cfg)
     if pol is not None and pol.refine:
         cfg = dataclasses.replace(cfg, refine=pol.refine)

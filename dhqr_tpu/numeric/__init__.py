@@ -1,0 +1,71 @@
+"""Numerical guardrails (``dhqr_tpu.numeric``) — round 13.
+
+Breakdown detection, a condition-aware fallback ladder, and typed
+degradation for the QR core — the numerics sibling of the round-12
+infrastructure fault model:
+
+    >>> from dhqr_tpu.numeric import guarded_lstsq
+    >>> res = guarded_lstsq(A, b, engine="cholqr2", guards="full")
+    >>> res.x                 # the solution (8x-LAPACK gated)
+    >>> res.engine            # the rung that answered ("tsqr", ...)
+    >>> res.attempts          # per-rung record of the taken path
+
+or through the public API, which returns plain values::
+
+    >>> x = dhqr_tpu.lstsq(A, b, engine="cholqr2", guards="fallback")
+
+Detected breakdown escalates ``cholqr2 -> cholqr3 -> tsqr ->
+householder`` and then ``fast -> accurate -> accurate+refine``; a
+problem no rung can answer raises one of the typed
+:class:`NumericalError` subclasses (``NonFiniteInput``, ``Breakdown``,
+``IllConditioned``, ``ResidualGateFailed``) carrying the condition
+estimate, the failing engine, and the per-rung attempt record. The
+``numeric.breakdown`` / ``numeric.nan`` fault sites
+(``dhqr_tpu.faults``) make every escalation path deterministically
+replayable. See docs/DESIGN.md "Numerical robustness" and
+docs/OPERATIONS.md "Triaging a red residual gate".
+"""
+
+from dhqr_tpu.numeric.errors import (
+    Breakdown,
+    IllConditioned,
+    NonFiniteInput,
+    NumericalError,
+    ResidualGateFailed,
+)
+from dhqr_tpu.numeric.guards import (
+    any_nonfinite,
+    checked_cholesky,
+    diag_condition_bound,
+    estimate_condition,
+    residual_ratio,
+    screen_input,
+)
+from dhqr_tpu.numeric.ladder import (
+    ENGINE_LADDER,
+    GUARD_MODES,
+    Attempt,
+    GuardedResult,
+    guarded_lstsq,
+    guarded_qr,
+)
+
+__all__ = [
+    "Attempt",
+    "Breakdown",
+    "ENGINE_LADDER",
+    "GUARD_MODES",
+    "GuardedResult",
+    "IllConditioned",
+    "NonFiniteInput",
+    "NumericalError",
+    "ResidualGateFailed",
+    "any_nonfinite",
+    "checked_cholesky",
+    "diag_condition_bound",
+    "estimate_condition",
+    "guarded_lstsq",
+    "guarded_qr",
+    "residual_ratio",
+    "screen_input",
+]

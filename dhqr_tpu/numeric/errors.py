@@ -1,0 +1,100 @@
+"""Typed numerical-failure taxonomy (the round-13 numeric guardrails).
+
+The serving stack already refuses to fail anonymously at the
+*infrastructure* layer: every compile/dispatch/queue failure resolves to
+one :class:`~dhqr_tpu.serve.errors.ServeError` subclass. This module is
+the NUMERICS sibling of that taxonomy — the failure modes that arrive
+INSIDE the matrices rather than around them: a NaN-bearing input, a
+CholeskyQR breakdown past its conditioning window
+(``cond(A) >~ 1/sqrt(eps)`` — ops/cholqr.py), a rank-deficient problem,
+a solution that came back finite but missed the 8x-LAPACK residual
+criterion.
+
+Every type carries enough state for the caller's next decision: which
+``engine`` observed the failure, the cheap ``cond_estimate`` lower
+bound when one was computed (None when not), and — for failures raised
+after the fallback ladder ran dry — the per-rung ``attempts`` record
+(``dhqr_tpu.numeric.ladder.Attempt`` tuples), so a production log line
+can say *what was tried* before the typed refusal.
+
+Deliberately a SIBLING of ``ServeError``, not a subclass: a numerical
+failure is a property of the *request's data* — retrying, re-routing to
+another worker, or backing off cannot fix it, which is exactly the
+opposite of the transient-infrastructure contract ``ServeError``
+retry/backoff machinery assumes. The async scheduler therefore passes a
+``NumericalError`` straight to bisect-isolation (no retry budget spent)
+so one bad matrix degrades itself, never its batch neighbors
+(``serve/scheduler.py``). Both roots subclass ``RuntimeError``.
+"""
+
+from __future__ import annotations
+
+
+class NumericalError(RuntimeError):
+    """Base of every typed numerical failure.
+
+    Attributes:
+      engine: the engine family that observed the failure ("cholqr2",
+        "tsqr", "householder", ...) or None when the failure precedes
+        engine selection (input screening).
+      cond_estimate: cheap LOWER bound on cond_2(A) when one was
+        computed (``max|r_ii| / min|r_ii|`` — see
+        :meth:`dhqr_tpu.QRFactorization.condition_estimate` for the
+        caveats); None when no estimate was available. ``float("inf")``
+        for structurally singular inputs (a zero column).
+      attempts: the fallback ladder's per-rung record (tuple of
+        ``dhqr_tpu.numeric.ladder.Attempt``) for failures raised after
+        escalation ran dry; ``()`` for pre-ladder failures.
+    """
+
+    def __init__(self, message: str, engine: "str | None" = None,
+                 cond_estimate: "float | None" = None,
+                 attempts: tuple = ()) -> None:
+        super().__init__(message)
+        self.engine = engine
+        self.cond_estimate = (None if cond_estimate is None
+                              else float(cond_estimate))
+        self.attempts = tuple(attempts)
+
+
+class NonFiniteInput(NumericalError):
+    """The input matrix (or right-hand side) carries NaN/Inf entries.
+    Raised by the device-side input screen BEFORE any factorization is
+    paid for — no engine, however stable, recovers a poisoned input,
+    so the ladder never runs."""
+
+
+class Breakdown(NumericalError):
+    """A factorization broke down: the engine returned non-finite
+    factors or a non-finite solution from a finite input — the LOUD
+    CholeskyQR failure mode (a non-positive-definite first Gram pass),
+    or an injected ``numeric.breakdown`` fault. The condition estimate,
+    when present, did NOT implicate conditioning (see
+    :class:`IllConditioned` for the case where it did)."""
+
+
+class IllConditioned(NumericalError):
+    """The problem's conditioning exceeds what the (remaining) engines
+    can handle: a structurally singular input (zero column —
+    ``cond_estimate`` is inf), or a breakdown whose cheap condition
+    lower bound already exceeds the failing engine's documented window
+    (``dhqr_tpu.ops.cholqr.cholqr_max_cond``). The caller's options are
+    data-side: regularize, re-scale, or drop the deficient columns."""
+
+
+class ResidualGateFailed(NumericalError):
+    """Every ladder rung returned a FINITE solution that still missed
+    the 8x-LAPACK normal-equations criterion (the one-shot residual
+    probe, ``guards="full"``). The worst observed ratio rides in
+    ``residual_ratio`` (residual / oracle residual; the gate is 8.0).
+    This is the "no silent garbage" guarantee: without the probe these
+    cells would have RETURNED."""
+
+    def __init__(self, message: str, engine: "str | None" = None,
+                 cond_estimate: "float | None" = None,
+                 attempts: tuple = (),
+                 residual_ratio: "float | None" = None) -> None:
+        super().__init__(message, engine=engine,
+                         cond_estimate=cond_estimate, attempts=attempts)
+        self.residual_ratio = (None if residual_ratio is None
+                               else float(residual_ratio))

@@ -1,0 +1,158 @@
+"""Device-side numerical guards: cheap screening + health checks.
+
+Three kinds of check, each costing one tiny compiled program (cached per
+shape/dtype by jit — a warm serving loop re-runs them with ZERO
+recompiles) and one scalar readback:
+
+* **input screen** (:func:`screen_input`): any-non-finite scan over A
+  (and b) plus zero-column detection, fused into one program — the
+  checks that must run BEFORE a factorization is paid for, because no
+  engine recovers a poisoned or structurally singular input;
+* **output health** (:func:`any_nonfinite`): the breakdown detector —
+  CholeskyQR fails LOUDLY (NaN) outside its conditioning window
+  (ops/cholqr.py), so finiteness of the result is the cheap, exact
+  post-factorization gate the fallback ladder keys on;
+* **residual probe** (:func:`residual_ratio`): the one-shot 8x-LAPACK
+  normal-equations gate — the SAME criterion the tune accuracy gate and
+  the test suite enforce (utils/testing.py) — for callers who want "no
+  silent garbage" at the cost of one host LAPACK solve per call
+  (``guards="full"``; the ladder documents when to pay it).
+
+This module also owns :func:`checked_cholesky` — THE package's one
+sanctioned route to ``lax.linalg.cholesky`` (lint rule DHQR007 flags
+any other call site): the wrapper is where the breakdown contract is
+written down, so every Cholesky in the package inherits it.
+
+Import discipline: jax/jnp only at module top (no ops/models imports —
+``ops/cholqr.py`` imports this module at ITS top, so anything heavier
+here would cycle).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def checked_cholesky(G: jax.Array) -> jax.Array:
+    """Upper-level Cholesky routing point: ``L`` with ``L L^H = G``.
+
+    ``lax.linalg.cholesky`` does not raise on a non-positive-definite
+    input — it returns NaN rows from the first failed pivot on. That
+    NaN-loudness IS the in-program breakdown signal the numeric layer
+    keys on (a compiled program cannot raise): callers must either
+    gate their outputs through :func:`any_nonfinite` (the fallback
+    ladder does), or document why breakdown is impossible on their
+    inputs. Package code calls Cholesky ONLY through here — lint rule
+    DHQR007 flags direct ``*.linalg.cholesky`` calls anywhere else in
+    ``dhqr_tpu/`` — so the contract cannot silently decay.
+    """
+    return lax.linalg.cholesky(G)
+
+
+@jax.jit
+def _screen_impl(A):
+    finite = jnp.all(jnp.isfinite(A))
+    # Exact equality, NOT a sum of squares: |a|^2 underflows to 0 for
+    # finite tiny-magnitude columns (~1e-25 in f32), and the screen
+    # must never typed-refuse a valid input the engines can solve.
+    zero_col = jnp.any(jnp.all(A == 0, axis=0))
+    return jnp.stack([~finite, zero_col])
+
+
+@jax.jit
+def _screen_rhs_impl(b):
+    return ~jnp.all(jnp.isfinite(b))
+
+
+def screen_input(A, b=None) -> "tuple[bool, bool, bool]":
+    """One fused device scan: ``(A_nonfinite, zero_column, b_nonfinite)``.
+
+    O(mn) elementwise work in one tiny program per (shape, dtype) —
+    negligible against any factorization — and a single scalar
+    readback. A zero column means cond(A) is exactly infinite: raising
+    typed BEFORE factoring beats letting back-substitution divide by
+    zero three engines down the ladder.
+    """
+    flags = _screen_impl(jnp.asarray(A))
+    bad_b = False
+    if b is not None:
+        bad_b = bool(_screen_rhs_impl(jnp.asarray(b)))
+    return bool(flags[0]), bool(flags[1]), bad_b
+
+
+@jax.jit
+def _nonfinite_impl(x):
+    return ~jnp.all(jnp.isfinite(x))
+
+
+def any_nonfinite(*arrays) -> bool:
+    """True when any entry of any given array is NaN/Inf — the
+    post-factorization breakdown detector (one tiny jitted reduction
+    per array shape, one readback per call)."""
+    return any(bool(_nonfinite_impl(jnp.asarray(a))) for a in arrays)
+
+
+@jax.jit
+def _diag_cond_impl(d):
+    mag = jnp.abs(d)
+    return jnp.max(mag) / jnp.min(mag)
+
+
+def diag_condition_bound(diag) -> float:
+    """Cheap LOWER bound on cond_2 from an R diagonal:
+    ``max|r_ii| / min|r_ii|`` (the
+    :meth:`~dhqr_tpu.models.qr_model.QRFactorization.condition_estimate`
+    rule, usable on any engine's R diagonal). Never overestimates; can
+    underestimate badly without pivoting (Kahan matrices) — which is
+    the right polarity for a guard: if even the lower bound exceeds an
+    engine's window, do not route there."""
+    return float(_diag_cond_impl(jnp.asarray(diag)))
+
+
+def estimate_condition(A) -> "float | None":
+    """Cheap condition LOWER bound for classification on failure paths:
+    one blocked Householder QR of A, then the R-diagonal ratio.
+
+    Costs a full (stable) factorization, so the ladder computes it only
+    AFTER something already failed — steady state never pays it. None
+    when the estimate itself comes back non-finite (a poisoned input
+    that slipped past screening, or an overflowing problem).
+    """
+    from dhqr_tpu.ops import blocked as _blocked
+
+    A = jnp.asarray(A)
+    nb = min(_blocked.DEFAULT_BLOCK_SIZE, A.shape[1])
+    _, alpha = _blocked._blocked_qr_impl(A, nb, precision="highest",
+                                         pallas=False)
+    est = diag_condition_bound(alpha)
+    import math
+
+    return est if math.isfinite(est) else None
+
+
+def residual_ratio(A, b, x) -> float:
+    """The one-shot residual probe: this solution's normal-equations
+    residual over the LAPACK oracle's own (utils/testing.py — the
+    reference's acceptance metric, runtests.jl:49-62). The gate passes
+    at ``<= TOLERANCE_FACTOR`` (8.0).
+
+    Cost: one host LAPACK QR solve of (A, b) — the same oracle the
+    tune accuracy gate pays per candidate. That is deliberate: the
+    probe exists for "no silent garbage" deployments and acceptance
+    benchmarks (``guards="full"``, benchmarks/condition_sweep.py), not
+    for every hot-path call.
+    """
+    import numpy as np
+
+    from dhqr_tpu.utils.testing import (
+        normal_equations_residual,
+        oracle_residual,
+    )
+
+    res = normal_equations_residual(A, np.asarray(x), b)
+    ref = oracle_residual(np.asarray(A), np.asarray(b))
+    if ref > 0:
+        return float(res / ref)
+    return 0.0 if res == 0 else float("inf")

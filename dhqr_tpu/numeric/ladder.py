@@ -1,0 +1,500 @@
+"""Condition-aware fallback ladder: breakdown detection + typed escalation.
+
+dhqr-tune routes tall-skinny solves to ``cholqr2`` for its measured
+4.6-11.8x wins — but CholeskyQR2 breaks down (NaN factors) once
+``cond(A)`` approaches ``1/sqrt(eps)`` (ops/cholqr.py), and a
+production stream sees ill-conditioned, rank-deficient and NaN-bearing
+matrices daily. This module is the runtime answer, the
+accuracy-vs-speed engine laddering of the TPU linear-algebra paper
+(arXiv 2112.09017) made automatic:
+
+* :func:`guarded_lstsq` / :func:`guarded_qr` screen the input
+  (``numeric.guards`` — non-finite scan, zero-column detection), run
+  the requested engine, health-check the output, and on detected
+  breakdown ESCALATE down a fixed engine ladder::
+
+      cholqr2 -> cholqr3 (shifted, +1 pass) -> tsqr -> householder
+
+  followed by POLICY escalation on the stable engine (``fast`` ->
+  ``accurate`` -> ``accurate`` + one more refinement sweep). Every
+  rung is recorded (:class:`Attempt`), the taken path rides on the
+  returned :class:`GuardedResult`, and a rung-0 failure under an
+  active plan is reported to ``dhqr_tpu.tune`` so a plan whose gate
+  keeps failing is demoted out of ``plan="auto"`` resolution.
+* Exhausting the ladder raises TYPED
+  (:mod:`dhqr_tpu.numeric.errors`): ``Breakdown`` when factors went
+  non-finite, ``IllConditioned`` when the cheap condition lower bound
+  implicates conditioning (or the input is structurally singular),
+  ``ResidualGateFailed`` when every rung returned finite-but-wrong
+  (``guards="full"`` only — the probe is one host LAPACK solve).
+
+Fallback rungs deliberately do NOT inherit a policy's trailing-GEMM
+split: Gram rounding is SQUARED through Cholesky (ops/cholqr.py), so a
+cheap syrk narrows the very conditioning window the ladder is escaping.
+A fallback rung runs the policy's PANEL precision with full-precision
+composition math; refinement sweeps carry over where the engine
+supports them (tsqr has no reusable factorization — its rung runs
+refine=0 and leans on the residual gate).
+
+Zero-recompile steady state: every guard program is a tiny jitted
+reduction cached per shape, and the engines the rungs dispatch are the
+SAME jitted impls the unguarded API uses — a warm repeat of a guarded
+call (including one that recovered via fallback) compiles nothing
+(pinned by tests/test_numeric.py and the ``_dryrun`` numeric stage).
+
+Deterministic testing: the ``numeric.nan`` fault site fires at the
+input screen (as if the scan had found a NaN) and ``numeric.breakdown``
+fires per rung (as if that rung's factors came back non-finite) —
+``dhqr_tpu.faults`` schedules make every escalation path replayable
+without crafting a matrix for it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from dhqr_tpu.faults import harness as _faults
+from dhqr_tpu.numeric import guards as _guards
+from dhqr_tpu.numeric.errors import (
+    Breakdown,
+    IllConditioned,
+    NonFiniteInput,
+    ResidualGateFailed,
+)
+
+#: Escalation order per starting engine: strictly toward stability
+#: (each step trades GEMM throughput for conditioning headroom).
+ENGINE_LADDER = {
+    "cholqr2": ("cholqr3", "tsqr", "householder"),
+    "cholqr3": ("tsqr", "householder"),
+    "tsqr": ("householder",),
+    "householder": (),
+}
+
+GUARD_MODES = ("screen", "fallback", "full")
+
+
+@dataclasses.dataclass(frozen=True)
+class Attempt:
+    """One ladder rung's outcome.
+
+    ``outcome`` is "ok", "breakdown" (non-finite output — organic, or
+    injected when ``detail`` says so), "inapplicable" (the engine
+    rejected the problem shape/knobs — e.g. tsqr needs genuinely tall
+    row blocks, the m < n path takes no refinement), "residual_gate"
+    (finite but over the 8x criterion; ratio in ``residual_ratio``),
+    or "zero_pivot" (``guarded_qr``: finite factors with an
+    exactly-zero R diagonal entry). Anything else a rung raises
+    propagates immediately — the ladder absorbs numerical failures,
+    not bugs."""
+
+    engine: str
+    policy: str
+    outcome: str
+    detail: "str | None" = None
+    residual_ratio: "float | None" = None
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardedResult:
+    """A guarded call's value plus its provenance.
+
+    ``value`` is what the unguarded API returns (``x`` for lstsq, a
+    ``QRFactorization`` for qr); ``engine``/``policy`` name the rung
+    that produced it; ``attempts`` is the full per-rung record (length
+    1 when nothing escalated); ``residual_ratio`` is the probe's
+    measurement when ``guards="full"`` ran it (None otherwise);
+    ``cond_estimate`` is the R-diagonal condition lower bound when the
+    mode computed one (``guarded_qr`` under ``"full"``)."""
+
+    value: object
+    engine: str
+    policy: str
+    attempts: "tuple[Attempt, ...]"
+    residual_ratio: "float | None" = None
+    cond_estimate: "float | None" = None
+
+    @property
+    def x(self):
+        """The solution array (lstsq spelling of ``value``)."""
+        return self.value
+
+    @property
+    def factorization(self):
+        """The factorization (qr spelling of ``value``)."""
+        return self.value
+
+    @property
+    def escalations(self) -> int:
+        """How many rungs failed before the one that answered."""
+        return len(self.attempts) - 1
+
+
+def _mode(cfg) -> str:
+    mode = cfg.guards or "fallback"
+    if mode not in GUARD_MODES:
+        raise ValueError(
+            f"guards must be one of {GUARD_MODES} or None, got {mode!r}"
+        )
+    return mode
+
+
+def _policy_desc(pol, cfg) -> str:
+    """Compact policy spelling for Attempt/GuardedResult records — the
+    tune DB's own ``policy_tag`` rendering in BOTH branches (classic
+    knobs are folded into a PrecisionPolicy first), so descriptions,
+    plan keys, and the escalation-rung dedupe can never diverge."""
+    from dhqr_tpu.tune.db import policy_tag
+
+    if pol is None:
+        from dhqr_tpu.precision import PrecisionPolicy
+
+        pol = PrecisionPolicy(
+            panel=cfg.precision, trailing=cfg.trailing_precision,
+            apply=cfg.apply_precision, refine=cfg.refine)
+    return policy_tag(pol)
+
+
+def _screen(A, b, engine_hint: "str | None") -> None:
+    """Input screening: typed raises, nothing else. The ``numeric.nan``
+    fault site fires here — an injected trigger is treated exactly as a
+    detected non-finite entry."""
+    try:
+        _faults.fire("numeric.nan")
+    except _faults.FaultInjected as e:
+        raise NonFiniteInput(
+            "non-finite input detected (injected numeric.nan fault)",
+            engine=engine_hint) from e
+    bad_A, zero_col, bad_b = _guards.screen_input(A, b)
+    if bad_A or bad_b:
+        which = "A" if bad_A else "b"
+        raise NonFiniteInput(
+            f"input {which} carries non-finite entries; no engine can "
+            "recover a poisoned input — clean or drop the request",
+            engine=engine_hint)
+    if zero_col:
+        raise IllConditioned(
+            "input has an exactly-zero column (structurally "
+            "rank-deficient, cond = inf); regularize or drop the column",
+            engine=engine_hint, cond_estimate=float("inf"))
+
+
+def _resolve_start(A, cfg, mesh):
+    """Mirror ``lstsq``'s own policy/plan resolution so rung 0 runs the
+    byte-identical program the unguarded call would have dispatched.
+    Returns ``(cfg0, pol, plan_active)`` — ``plan_active`` is True only
+    when a stored/explicit plan ACTUALLY landed on the config (a DB
+    miss falling back to the static default must never feed plan
+    demotion)."""
+    from dhqr_tpu.models import qr_model as _qm
+
+    cfg, pol = _qm._resolve_policy_cfg(cfg)
+    if pol is not None and pol.refine:
+        cfg = dataclasses.replace(cfg, refine=pol.refine)
+    applied: list = []
+    cfg = _qm._resolve_plan_cfg(cfg, "lstsq", A.shape, A.dtype, mesh, pol,
+                                applied=applied)
+    return cfg, pol, bool(applied)
+
+
+def _fallback_cfg(engine: str, pol, base, mesh):
+    """Config for a FALLBACK rung: the stable engine's defaults plus
+    the caller's accuracy-relevant knobs (panel precision, norm,
+    refinement where the engine supports it). Trailing/apply splits and
+    plan-selected knobs are deliberately dropped — see the module
+    docstring."""
+    from dhqr_tpu.utils.config import DHQRConfig
+
+    refine = pol.refine if pol is not None else base.refine
+    if engine == "tsqr" or (mesh is not None
+                            and engine in ("cholqr2", "cholqr3")):
+        refine = 0  # unsupported there (tsqr tree; mesh cholqr)
+    return DHQRConfig(
+        engine=engine,
+        precision=(pol.panel if pol is not None else base.precision),
+        norm=base.norm, mesh_axis=base.mesh_axis, refine=refine,
+    )
+
+
+def _escalation_policies(pol, base):
+    """The policy-escalation tail on the stable engine — the
+    ``fast -> accurate -> refine+1`` laddering, derived in
+    :func:`dhqr_tpu.precision.escalation_policies` (the precision
+    module owns what "cheaper than accurate" means)."""
+    from dhqr_tpu.precision import escalation_policies
+
+    if pol is not None:
+        return escalation_policies(pol)
+    cheap = bool(base.trailing_precision or base.apply_precision
+                 or base.precision != "highest")
+    return escalation_policies(base_refine=base.refine, cheap=cheap)
+
+
+def _note_plan_failure(A, mesh, pol) -> None:
+    """Rung 0 failed under an active plan: report to tune so
+    ``plan=\"auto\"`` demotes a repeat offender (tune/search.py)."""
+    from dhqr_tpu.tune.search import note_gate_failure
+
+    nproc = 1
+    if mesh is not None:
+        import numpy as np
+
+        nproc = int(np.prod(list(mesh.shape.values())))
+    note_gate_failure("lstsq", A.shape[0], A.shape[1], A.dtype,
+                      nproc=nproc, policy=pol)
+
+
+def _classify_exhausted(A, attempts, probe_ran: bool):
+    """Build the typed error once every rung has failed."""
+    broken = [a for a in attempts if a.outcome == "breakdown"]
+    gated = [a for a in attempts if a.outcome == "residual_gate"]
+    first_engine = attempts[0].engine if attempts else None
+    if broken:
+        est = _guards.estimate_condition(A)
+        window = None
+        eng = broken[0].engine
+        if eng in ("cholqr2", "cholqr3"):
+            from dhqr_tpu.ops.cholqr import cholqr_max_cond
+
+            window = cholqr_max_cond(A.dtype, shift=eng == "cholqr3")
+        if est is not None and window is not None and est > window:
+            return IllConditioned(
+                f"{eng} broke down and the condition lower bound "
+                f"{est:.3e} exceeds its window (~{window:.1e}); "
+                f"{len(attempts)} rung(s) tried without success",
+                engine=first_engine, cond_estimate=est, attempts=attempts)
+        return Breakdown(
+            f"factorization broke down on every applicable rung "
+            f"({len(attempts)} tried; condition lower bound "
+            f"{'unavailable' if est is None else format(est, '.3e')})",
+            engine=first_engine, cond_estimate=est, attempts=attempts)
+    if gated and probe_ran:
+        worst = max(a.residual_ratio for a in gated
+                    if a.residual_ratio is not None)
+        return ResidualGateFailed(
+            f"every rung returned a finite solution above the "
+            f"8x-LAPACK residual criterion (worst ratio {worst:.2f}x); "
+            "refusing to return silent garbage",
+            engine=first_engine,
+            cond_estimate=_guards.estimate_condition(A),
+            attempts=attempts, residual_ratio=worst)
+    # Nothing ran at all (every rung inapplicable) — a shape no engine
+    # takes would have raised from rung 0 already, so this is a ladder
+    # bug surfacing loudly rather than silently.
+    return Breakdown(
+        f"no ladder rung was applicable ({len(attempts)} recorded)",
+        engine=first_engine, attempts=attempts)
+
+
+def guarded_lstsq(
+    A,
+    b,
+    config=None,
+    mesh=None,
+    **overrides,
+) -> GuardedResult:
+    """Least squares with numeric guardrails: screen -> run -> health
+    check -> escalate -> typed refusal.
+
+    The guard mode comes from ``config.guards`` (or ``guards=`` in
+    overrides): ``"screen"`` = input screening only (one scan, then the
+    unguarded call); ``"fallback"`` (the default here) = screening +
+    breakdown detection + the engine/policy ladder; ``"full"`` =
+    fallback + the one-shot residual probe on every rung's output
+    (costs one host LAPACK solve per CALL — acceptance benchmarks and
+    "no silent garbage" deployments). The public
+    ``lstsq(A, b, guards=...)`` routes here and returns ``.x``; call
+    this directly for the provenance (:class:`GuardedResult`).
+    """
+    import jax.numpy as jnp
+
+    from dhqr_tpu.utils.config import DHQRConfig
+
+    cfg = dataclasses.replace(config or DHQRConfig(), **overrides)
+    mode = _mode(cfg)
+    cfg = dataclasses.replace(cfg, guards=None)
+    A = jnp.asarray(A)
+    b = jnp.asarray(b)
+
+    from dhqr_tpu.models.qr_model import lstsq as _lstsq
+
+    _screen(A, b, cfg.engine)
+    if mode == "screen":
+        x = _lstsq(A, b, config=cfg, mesh=mesh)
+        pol_desc = _policy_desc(None, cfg) if cfg.policy is None else \
+            str(cfg.policy)
+        att = Attempt(cfg.engine, pol_desc, "ok")
+        return GuardedResult(x, cfg.engine, pol_desc, (att,))
+
+    cfg0, pol, plan_active = _resolve_start(A, cfg, mesh)
+    probe = mode == "full"
+    m, n = A.shape
+
+    # Rung list: (engine, config, policy-description). Rung 0 is the
+    # caller's resolved route verbatim; the m < n minimum-norm path has
+    # exactly one engine, so its "ladder" is policy escalation only.
+    rungs: "list[tuple[str, object, str]]" = [
+        (cfg0.engine, cfg0, _policy_desc(pol, cfg0))]
+    if m >= n:
+        for eng in ENGINE_LADDER.get(cfg0.engine, ()):
+            fcfg = _fallback_cfg(eng, pol, cfg0, mesh)
+            rungs.append((eng, fcfg, _policy_desc(None, fcfg)))
+    from dhqr_tpu.tune.db import policy_tag
+
+    for esc in _escalation_policies(pol, cfg0):
+        ecfg = dataclasses.replace(
+            _fallback_cfg("householder", None, cfg0, mesh),
+            precision=DHQRConfig.precision, refine=0, policy=esc)
+        desc = policy_tag(esc)
+        # Dedupe against EVERY rung already queued (the engine ladder's
+        # own householder rung included), not just rung 0 — an
+        # identical config must never be factored twice.
+        if all((eng, d) != ("householder", desc)
+               for eng, _, d in rungs):
+            rungs.append(("householder", ecfg, desc))
+
+    attempts: "list[Attempt]" = []
+    for i, (eng, rcfg, desc) in enumerate(rungs):
+        try:
+            _faults.fire("numeric.breakdown")
+        except _faults.FaultInjected:
+            attempts.append(Attempt(eng, desc, "breakdown",
+                                    detail="injected numeric.breakdown"))
+            if i == 0 and plan_active:
+                _note_plan_failure(A, mesh, pol)
+            continue
+        try:
+            x = _lstsq(A, b, config=rcfg, mesh=mesh)
+        except ValueError as e:
+            if i == 0:
+                raise  # the caller's own config error — never masked
+            attempts.append(Attempt(eng, desc, "inapplicable",
+                                    detail=str(e)))
+            continue
+        if _guards.any_nonfinite(x):
+            attempts.append(Attempt(eng, desc, "breakdown"))
+            if i == 0 and plan_active:
+                _note_plan_failure(A, mesh, pol)
+            continue
+        ratio = None
+        if probe:
+            ratio = _guards.residual_ratio(A, b, x)
+            from dhqr_tpu.utils.testing import TOLERANCE_FACTOR
+
+            if ratio > TOLERANCE_FACTOR:
+                attempts.append(Attempt(eng, desc, "residual_gate",
+                                        residual_ratio=ratio))
+                if i == 0 and plan_active:
+                    _note_plan_failure(A, mesh, pol)
+                continue
+        attempts.append(Attempt(eng, desc, "ok", residual_ratio=ratio))
+        return GuardedResult(x, eng, desc, tuple(attempts),
+                             residual_ratio=ratio)
+    raise _classify_exhausted(A, tuple(attempts), probe)
+
+
+def guarded_qr(
+    A,
+    config=None,
+    mesh=None,
+    **overrides,
+) -> GuardedResult:
+    """Packed QR with numeric guardrails.
+
+    ``qr()`` supports exactly one engine family (householder — the
+    packed-reflector contract), so the ladder here is POLICY
+    escalation only: the caller's configuration, then ``accurate``.
+    Screening and typed refusal match :func:`guarded_lstsq`; a
+    structurally singular factorization (an exactly-zero R diagonal
+    entry — every later solve would divide by it) raises
+    :class:`IllConditioned` rather than returning. ``guards="full"``
+    additionally records the R-diagonal condition lower bound on the
+    result (no residual probe — a factorization has no residual).
+    ``donate=True`` is rejected: escalation must be able to re-read A.
+    """
+    import jax.numpy as jnp
+
+    from dhqr_tpu.utils.config import DHQRConfig
+
+    cfg = dataclasses.replace(config or DHQRConfig(), **overrides)
+    mode = _mode(cfg)
+    cfg = dataclasses.replace(cfg, guards=None)
+    A = jnp.asarray(A)
+
+    from dhqr_tpu.models.qr_model import qr as _qr
+    from dhqr_tpu.precision import PRECISION_POLICIES
+
+    _screen(A, None, cfg.engine)
+    if mode == "screen":
+        fact = _qr(A, config=cfg, mesh=mesh)
+        desc = _policy_desc(None, cfg) if cfg.policy is None else \
+            str(cfg.policy)
+        att = Attempt(cfg.engine, desc, "ok")
+        return GuardedResult(fact, cfg.engine, desc, (att,))
+
+    rungs: "list[tuple[object, str]]" = [(cfg, "caller")]
+    defaults = DHQRConfig()
+    # The "accurate" escalation rung exists only when the caller's
+    # FACTOR program is actually cheaper than it — a policy whose
+    # factor knobs already match accurate (e.g. policy="accurate", or
+    # one that only changes solve-stage fields) would re-factor the
+    # byte-identical program on the breakdown path.
+    pol0 = None
+    if cfg.policy is not None:
+        from dhqr_tpu.precision import resolve_policy
+
+        pol0 = resolve_policy(cfg.policy)
+    factor_cheap = (
+        (pol0 is not None and (pol0.panel != "highest"
+                               or pol0.split_trailing() is not None))
+        or (pol0 is None and (cfg.precision != defaults.precision
+                              or cfg.trailing_precision is not None))
+        or cfg.norm != defaults.norm)
+    if factor_cheap:
+        acc = dataclasses.replace(
+            defaults, policy=PRECISION_POLICIES["accurate"],
+            mesh_axis=cfg.mesh_axis, block_size=cfg.block_size)
+        rungs.append((acc, "accurate"))
+
+    attempts: "list[Attempt]" = []
+    for i, (rcfg, desc) in enumerate(rungs):
+        try:
+            _faults.fire("numeric.breakdown")
+        except _faults.FaultInjected:
+            attempts.append(Attempt("householder", desc, "breakdown",
+                                    detail="injected numeric.breakdown"))
+            continue
+        fact = _qr(A, config=rcfg, mesh=mesh)  # config errors propagate
+        if _guards.any_nonfinite(fact.H, fact.alpha):
+            attempts.append(Attempt("householder", desc, "breakdown"))
+            continue
+        if bool(jnp.any(jnp.abs(fact.alpha) == 0)):
+            # Record the rung that OBSERVED the zero pivot — the
+            # attempts contract is "what was tried before the refusal".
+            attempts.append(Attempt("householder", desc, "zero_pivot"))
+            raise IllConditioned(
+                "R has an exactly-zero diagonal entry (rank-deficient "
+                "to working precision); solves from this factorization "
+                "would divide by zero",
+                engine="householder", cond_estimate=float("inf"),
+                attempts=tuple(attempts))
+        attempts.append(Attempt("householder", desc, "ok"))
+        cond = (_guards.diag_condition_bound(fact.alpha)
+                if mode == "full" else None)
+        return GuardedResult(fact, "householder", desc, tuple(attempts),
+                             cond_estimate=cond)
+    raise Breakdown(
+        f"householder factorization broke down on every rung "
+        f"({len(attempts)} tried) — a finite input should never do "
+        "this; suspect hardware or an injected fault left armed",
+        engine="householder", attempts=tuple(attempts))
+
+
+__all__ = [
+    "Attempt",
+    "ENGINE_LADDER",
+    "GUARD_MODES",
+    "GuardedResult",
+    "guarded_lstsq",
+    "guarded_qr",
+]

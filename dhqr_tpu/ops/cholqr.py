@@ -26,14 +26,31 @@ checks and reports rather than silently degrading.
 
 from __future__ import annotations
 
+import math
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from dhqr_tpu.numeric.guards import checked_cholesky
 from dhqr_tpu.ops.householder import DEFAULT_PRECISION, _real_dtype
 from dhqr_tpu.ops.solve import as_matrix_rhs
+
+
+def cholqr_max_cond(dtype, shift: bool = False) -> float:
+    """Approximate upper edge of the CholeskyQR conditioning window.
+
+    Plain CholeskyQR2 needs the first Gram pass positive-definite,
+    which holds while roughly ``cond(A) < 1/sqrt(eps)`` (~3e3 in f32,
+    ~7e7 in f64); Fukaya et al.'s diagonal shift (``shift=True``, our
+    cholqr3) widens it toward ``cond(A) ~ 1/eps``. These are order-of-
+    magnitude guides, not guarantees — the numeric fallback ladder
+    uses them to CLASSIFY a breakdown (``IllConditioned`` vs
+    ``Breakdown``), never to promise success inside the window.
+    """
+    eps = float(jnp.finfo(_real_dtype(jnp.dtype(dtype))).eps)
+    return (0.1 / eps) if shift else 1.0 / math.sqrt(eps)
 
 
 def _chol_upper(G: jax.Array, shift: bool) -> jax.Array:
@@ -43,6 +60,11 @@ def _chol_upper(G: jax.Array, shift: bool) -> jax.Array:
     eps * trace(G) added to the diagonal, large enough to keep the
     factorization positive-definite for cond(A) up to ~1/sqrt(eps) while
     perturbing R by O(eps * ||A||^2) — repaired by the second pass.
+
+    The Cholesky itself routes through the package's one guarded
+    wrapper (``numeric.guards.checked_cholesky``, lint rule DHQR007):
+    breakdown past the window surfaces as NaN factors, which the
+    numeric layer's health checks catch and escalate.
     """
     n = G.shape[0]
     if shift:
@@ -50,7 +72,7 @@ def _chol_upper(G: jax.Array, shift: bool) -> jax.Array:
         eps = jnp.finfo(rdtype).eps
         s = 11.0 * (n + 16) * eps * jnp.real(jnp.trace(G)) / n
         G = G + s * jnp.eye(n, dtype=G.dtype)
-    L = lax.linalg.cholesky(G)  # lower
+    L = checked_cholesky(G)  # lower
     return jnp.conj(L.T)
 
 

@@ -122,7 +122,9 @@ def tsqr_lstsq(
     ``policy.refine`` must be 0: the TSQR tree never materializes a
     reusable factorization, so refinement would repeat the full
     factorization cost per sweep — route refined solves to the
-    householder or cholqr engines.
+    householder or cholqr engines. (The numeric fallback ladder's tsqr
+    rung runs refine=0 for exactly this reason and leans on the
+    residual gate instead — dhqr_tpu/numeric/ladder.py.)
     """
     from dhqr_tpu.precision import (apply_policy_to_factor_args,
                                     resolve_policy)

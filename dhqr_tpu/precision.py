@@ -157,6 +157,32 @@ def resolve_policy(policy) -> PrecisionPolicy:
     return PrecisionPolicy(panel=panel, trailing=trailing, refine=refine)
 
 
+def escalation_policies(policy=None, *, base_refine: int = 0,
+                        cheap: "bool | None" = None):
+    """The accuracy-escalation tail of the numeric fallback ladder
+    (``dhqr_tpu.numeric.ladder``): once the engine rungs run out, try
+    ``accurate`` (when the caller was running anything cheaper than it
+    without refinement), then ``accurate`` with one MORE refinement
+    sweep than anything tried so far — the ``fast -> accurate ->
+    refine+1`` laddering of docs/DESIGN.md "Numerical robustness".
+
+    ``cheap`` overrides the is-this-policy-cheaper-than-accurate
+    derivation for callers who spelled their precision via the classic
+    knobs rather than a policy (the ladder passes it explicitly then).
+    Returns a tuple of :class:`PrecisionPolicy`.
+    """
+    pol = resolve_policy(policy) if policy is not None else None
+    refine = pol.refine if pol is not None else int(base_refine)
+    if cheap is None:
+        cheap = pol is not None and bool(
+            pol.trailing or pol.apply or pol.panel != "highest")
+    out = []
+    if cheap and refine == 0:
+        out.append(PRECISION_POLICIES["accurate"])
+    out.append(PrecisionPolicy(refine=refine + 1))
+    return tuple(out)
+
+
 def apply_policy_to_factor_args(policy, precision, trailing_precision,
                                 default_precision: str = "highest"):
     """Shared factor-tier merge: map ``policy`` onto the classic

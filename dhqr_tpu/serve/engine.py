@@ -39,6 +39,8 @@ import jax
 import jax.numpy as jnp
 
 from dhqr_tpu.faults import harness as _faults
+from dhqr_tpu.numeric import guards as _nguards
+from dhqr_tpu.numeric.errors import Breakdown
 from dhqr_tpu.ops import blocked as _blocked
 from dhqr_tpu.ops import solve as _solve
 from dhqr_tpu.serve.errors import DispatchFailed, ServeError
@@ -420,7 +422,17 @@ def _dispatch_groups(kind, As, bs, cfg, scfg, cache, consume, pol=None):
     ``serve.latency`` fault-injection sites live at the launch, so an
     injected fault takes exactly the organic failure path). ``consume``
     is OUTSIDE the wrap: a scatter/callback bug is the caller's error,
-    not a device failure to retry."""
+    not a device failure to retry.
+
+    Numeric guard (round 13): with ``cfg.guards`` armed, the stacked
+    outputs are health-checked BEFORE scatter — a non-finite row
+    (a NaN-bearing or breakdown-grade request hiding in the batch)
+    raises a typed :class:`~dhqr_tpu.numeric.Breakdown` instead of
+    scattering garbage; the async scheduler passes that straight to
+    bisect-isolation, so the one bad matrix fails alone and its batch
+    neighbors complete. The check is OUTSIDE the compiled program
+    (same cache key, same executable, zero recompiles) and entirely
+    skipped when guards are off (the default)."""
     for bucket, idxs in _group_by_bucket(As, scfg).items():
         cfg_b = _resolve_bucket_plan(kind, cfg, bucket, pol)
         for lo in range(0, len(idxs), scfg.max_batch):
@@ -444,6 +456,16 @@ def _dispatch_groups(kind, As, bs, cfg, scfg, cache, consume, pol=None):
                 raise
             except Exception as e:
                 raise DispatchFailed(key, e) from e
+            if cfg.guards is not None:
+                bad = (_nguards.any_nonfinite(outs) if kind == "lstsq"
+                       else _nguards.any_nonfinite(*outs))
+                if bad:
+                    raise Breakdown(
+                        f"non-finite rows in the stacked {kind} dispatch "
+                        f"for {key!r}: a request in this batch is "
+                        "numerically poisoned (NaN input or breakdown); "
+                        "bisect to isolate it",
+                        engine=cfg_b.engine)
             consume(chunk, key, outs)
 
 

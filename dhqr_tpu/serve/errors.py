@@ -17,6 +17,14 @@ pre-round-12 callers catching ``RuntimeError`` keep working), and
 sleeping on the hint must never busy-spin on a zero or negative value
 (see ``serve/cache.py`` and the scheduler's admission pricing for the
 clamp rationale).
+
+Round 13 adds a SIBLING taxonomy for failures that live in the
+request's data rather than the infrastructure:
+:class:`dhqr_tpu.numeric.NumericalError` (``NonFiniteInput`` /
+``Breakdown`` / ``IllConditioned`` / ``ResidualGateFailed``). It is
+deliberately NOT a ``ServeError`` subclass — retry/backoff cannot fix
+data — and the scheduler routes it straight to bisect-isolation
+(``numeric/errors.py`` has the rationale).
 """
 
 from __future__ import annotations

@@ -41,7 +41,12 @@ detected, its in-flight requests requeued, and a replacement thread
 respawned. Every submitted request's future resolves — success or a
 typed :class:`~dhqr_tpu.serve.errors.ServeError` — never hangs. The
 ``serve.worker`` fault-injection site (``dhqr_tpu.faults``) drives the
-crash path deterministically in tests and the chaos benchmark.
+crash path deterministically in tests and the chaos benchmark. Round 13
+adds the numerics sibling: with ``DHQRConfig.guards`` armed, a
+non-finite output row raises a typed
+:class:`~dhqr_tpu.numeric.NumericalError`, which skips retry (the
+failure lives in the request's data) and goes straight to the bisection
+path — one bad matrix fails alone while its batch neighbors complete.
 
 ONE dispatch path, by construction: a flush calls the engine's own
 ``_dispatch_groups`` with consumers built by the engine's own
@@ -71,6 +76,7 @@ from concurrent.futures import Future
 from typing import Optional
 
 from dhqr_tpu.faults import harness as _faults
+from dhqr_tpu.numeric.errors import NumericalError
 from dhqr_tpu.serve import engine as _engine
 from dhqr_tpu.serve.buckets import Bucket, plan_bucket
 from dhqr_tpu.serve.cache import ExecutableCache, default_cache
@@ -612,18 +618,20 @@ class AsyncScheduler:
 
     # ------------------------------------------------------ failure handling
 
-    def _typed_error(self, group: _Group, exc: BaseException) -> ServeError:
-        """Every failure a future carries is a ServeError: the engine
-        and cache already classify theirs (CompileFailed, DispatchFailed,
-        Quarantined); anything else — e.g. an XLA runtime error surfacing
-        at the completion fence — is a dispatch failure."""
-        if isinstance(exc, ServeError):
+    def _typed_error(self, group: _Group, exc: BaseException):
+        """Every failure a future carries is typed: a ServeError (the
+        engine and cache classify theirs — CompileFailed,
+        DispatchFailed, Quarantined) or its round-13 numerics sibling
+        ``NumericalError`` (the serve guard's output health check).
+        Anything else — e.g. an XLA runtime error surfacing at the
+        completion fence — is a dispatch failure."""
+        if isinstance(exc, (ServeError, NumericalError)):
             return exc
         err = DispatchFailed((group.kind, group.bucket), exc)
         err.__cause__ = exc
         return err
 
-    def _fail(self, p: _Pending, err: ServeError) -> None:
+    def _fail(self, p: _Pending, err: RuntimeError) -> None:
         self.counters.bump("failed")
         p.future.set_exception(err)
 
@@ -649,6 +657,10 @@ class AsyncScheduler:
            cooldown (deadline permitting) without spending retry
            budget — the quarantine IS the schedule; during drain it
            fails typed instead (drain means "complete everything now");
+        2b. a NumericalError (round 13: the serve guard flagged
+           non-finite output rows) skips retry entirely — the failure
+           is in the request's data — and goes straight to bisection,
+           so one bad matrix degrades itself, never its neighbors;
         3. other failures retry the whole batch with exponential
            backoff (``retry_base_ms * 2**k``) while attempts stay
            within ``max_retries`` AND the backoff still lands before
@@ -693,6 +705,23 @@ class AsyncScheduler:
             if can_wait:
                 self.counters.bump("retries")
                 self._requeue(group, can_wait, now + wait)
+            return
+        if isinstance(err, NumericalError):
+            # Round 13: a numerical failure is a property of the
+            # request's DATA — no backoff or retry can fix it, so no
+            # retry budget is spent. A LONE request fails typed NOW
+            # (re-dispatching it would deterministically reproduce the
+            # same failure — the singleton second chance exists for
+            # transients, which this is not); a batch goes straight to
+            # bisection, which re-dispatches the halves (completing
+            # the innocent batchmates) until the poison request fails
+            # alone with the typed NumericalError.
+            self.counters.bump("numeric_failures")
+            if len(alive) == 1:
+                self.counters.bump("poisoned")
+                self._fail(alive[0], err)
+            else:
+                self._isolate_now(group, alive, err)
             return
         # Retry budget and backoff are PER REQUEST, like the deadline
         # gating above: a fresh request coalesced into a group whose
@@ -1033,6 +1062,7 @@ class AsyncScheduler:
             "flush_failures": int(snap.get("flush_failures", 0)),
             "retries": int(snap.get("retries", 0)),
             "bisections": int(snap.get("bisections", 0)),
+            "numeric_failures": int(snap.get("numeric_failures", 0)),
             "poisoned": int(snap.get("poisoned", 0)),
             "worker_crashes": int(snap.get("worker_crashes", 0)),
             "last_worker_crash": last_crash,

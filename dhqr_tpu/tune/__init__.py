@@ -30,9 +30,13 @@ from dhqr_tpu.tune.db import (
 from dhqr_tpu.tune.plan import DEFAULT_PLAN, PLAN_ENGINES, Plan
 from dhqr_tpu.tune.search import (
     Measurement,
+    PLAN_DEMOTE_AFTER,
     TuneResult,
     apply_plan_to_config,
     candidate_plans,
+    note_gate_failure,
+    plan_gate_stats,
+    reset_gate_failures,
     resolve_plan,
     tune,
 )
@@ -53,4 +57,8 @@ __all__ = [
     "resolve_plan",
     "Measurement",
     "TuneResult",
+    "PLAN_DEMOTE_AFTER",
+    "note_gate_failure",
+    "plan_gate_stats",
+    "reset_gate_failures",
 ]

@@ -59,6 +59,7 @@ kernel silently bypasses ``panel_impl`` — plans never encode it).
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from typing import Callable, List, Optional
 
@@ -66,6 +67,65 @@ from dhqr_tpu.tune.db import PlanDB, default_db, plan_key, policy_tag
 from dhqr_tpu.tune.plan import DEFAULT_PLAN, Plan
 
 TUNE_KINDS = ("qr", "lstsq", "serve_qr", "serve_lstsq")
+
+#: Gate failures on one plan key before ``resolve_plan`` demotes the
+#: stored plan (falls back to the static default instead of replaying
+#: it). Three strikes: one failure can be one adversarial matrix; a
+#: plan whose route keeps breaking down is mis-tuned for the traffic.
+PLAN_DEMOTE_AFTER = 3
+
+# key -> numeric-gate failure count, reported by the numeric fallback
+# ladder (dhqr_tpu.numeric.ladder._note_plan_failure) whenever rung 0
+# of a guarded call failed UNDER AN ACTIVE PLAN. In-memory only, by
+# design: a demotion is evidence about the live traffic mix, not a
+# measurement to persist (the DB keeps only measured winners).
+_GATE_FAILURES: "dict[str, int]" = {}
+_GATE_LOCK = threading.Lock()
+_DEMOTED_LOOKUPS = [0]
+
+
+def note_gate_failure(kind: str, m: int, n: int, dtype="float32", *,
+                      nproc: int = 1, policy=None) -> int:
+    """Record one numeric-gate failure against the plan key for this
+    (kind, shape, dtype, nproc, policy); returns the running count.
+    After :data:`PLAN_DEMOTE_AFTER` failures, ``resolve_plan`` stops
+    replaying the stored plan for the key (demotion)."""
+    from dhqr_tpu.precision import resolve_policy
+
+    pol = resolve_policy(policy) if policy is not None else None
+    key = plan_key(kind, m, n, dtype, nproc=nproc,
+                   policy_tag=policy_tag(pol))
+    with _GATE_LOCK:
+        _GATE_FAILURES[key] = _GATE_FAILURES.get(key, 0) + 1
+        return _GATE_FAILURES[key]
+
+
+def plan_gate_stats() -> dict:
+    """JSON-ready snapshot of the numeric-gate / demotion state:
+    per-key failure counts, the demotion threshold, and how many
+    ``resolve_plan`` lookups were answered with the static default
+    because their key was demoted."""
+    with _GATE_LOCK:
+        return {
+            "failures": dict(_GATE_FAILURES),
+            "demote_after": PLAN_DEMOTE_AFTER,
+            "demoted_lookups": _DEMOTED_LOOKUPS[0],
+        }
+
+
+def reset_gate_failures() -> None:
+    """Clear the demotion state (tests; or after re-tuning a key)."""
+    with _GATE_LOCK:
+        _GATE_FAILURES.clear()
+        _DEMOTED_LOOKUPS[0] = 0
+
+
+def _demoted(key: str) -> bool:
+    with _GATE_LOCK:
+        if _GATE_FAILURES.get(key, 0) >= PLAN_DEMOTE_AFTER:
+            _DEMOTED_LOOKUPS[0] += 1
+            return True
+        return False
 
 #: Batch the serve kinds are timed at. The round-8 vmapped nb ladder was
 #: flat in B (nb=32 won at B=16 and B=4 alike): the batch axis reshapes
@@ -454,7 +514,16 @@ def resolve_plan(kind: str, m: int, n: int, dtype="float32", *,
     """The ``plan="auto"`` resolution: DB hit -> stored plan; miss ->
     tune now (``on_miss="tune"``) or None (``on_miss="default"``, the
     caller keeps its static knobs). ``nproc`` is inferred from ``mesh``
-    when one is passed."""
+    when one is passed.
+
+    A key with :data:`PLAN_DEMOTE_AFTER` or more recorded numeric-gate
+    failures (:func:`note_gate_failure` — the numeric fallback ladder
+    reports rung-0 failures under an active plan) is DEMOTED: the
+    lookup returns None (static default) without consulting or
+    re-tuning the DB, because the stored winner was measured on
+    well-conditioned probes and the live traffic keeps refusing it.
+    ``reset_gate_failures()`` (or a process restart) re-admits it;
+    :func:`plan_gate_stats` is the observable."""
     import numpy as np
 
     from dhqr_tpu.precision import resolve_policy
@@ -466,6 +535,8 @@ def resolve_plan(kind: str, m: int, n: int, dtype="float32", *,
     if db is None:
         db = default_db()
     key = plan_key(kind, m, n, dtype, nproc=nproc, policy_tag=policy_tag(pol))
+    if _demoted(key):
+        return None
     hit = db.get(key)
     if hit is not None:
         return hit

@@ -143,6 +143,21 @@ class DHQRConfig:
         ``norm``, ``refine``, ``policy``) stay the caller's: plans are
         keyed UNDER the policy and never change the error bar on their
         own.
+      guards: numeric guardrails for ``qr()``/``lstsq()`` and the
+        serving tier (``dhqr_tpu.numeric``, round 13). None (default) =
+        off — the pre-round-13 programs byte-for-byte. "screen" =
+        device-side input screening only (non-finite scan, zero-column
+        detection; typed ``NonFiniteInput``/``IllConditioned`` raises
+        before a factorization is paid for). "fallback" = screening +
+        post-factorization breakdown detection + the condition-aware
+        engine/policy fallback ladder (cholqr2 -> cholqr3 -> tsqr ->
+        householder; then accurate, then +1 refinement sweep). "full" =
+        fallback + the one-shot 8x-LAPACK residual probe on every
+        rung's output — "no silent garbage", at one host LAPACK solve
+        per call. On the batched serving tier any non-None value arms
+        the per-dispatch output health check (a non-finite row raises
+        ``Breakdown``, which the async scheduler bisects down to the
+        poison request). ``DHQR_GUARDS`` in the environment.
     """
 
     block_size: "int | None" = None
@@ -161,6 +176,7 @@ class DHQRConfig:
     apply_precision: "str | None" = None
     policy: object = None
     plan: object = None
+    guards: "str | None" = None
 
     @staticmethod
     def from_env(**overrides) -> "DHQRConfig":
@@ -201,6 +217,12 @@ class DHQRConfig:
         if "DHQR_POLICY" in os.environ:
             raw = os.environ["DHQR_POLICY"].strip()
             env["policy"] = raw or None
+        if "DHQR_GUARDS" in os.environ:
+            raw = os.environ["DHQR_GUARDS"].strip().lower()
+            if raw in ("", "0", "off", "none", "false", "no"):
+                env["guards"] = None
+            else:
+                env["guards"] = raw  # validated by the numeric layer
         if "DHQR_TUNE_PLAN" in os.environ:
             raw = os.environ["DHQR_TUNE_PLAN"].strip().lower()
             if raw not in ("", "auto", "default"):

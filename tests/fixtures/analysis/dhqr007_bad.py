@@ -1,0 +1,38 @@
+"""DHQR007 fixture: direct cholesky calls outside the guarded wrapper."""
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+import jax.lax.linalg as lin
+from jax.lax import linalg as la
+from jax.lax.linalg import cholesky
+from jax.lax.linalg import cholesky as chol
+
+
+def gram_factor(G):
+    L = lax.linalg.cholesky(G)  # line 13: finding (dotted call)
+    return jnp.conj(L.T)
+
+
+def gram_factor_jnp(G):
+    return jnp.linalg.cholesky(G)  # line 18: finding (jnp direct call)
+
+
+def host_factor(G):
+    return np.linalg.cholesky(G)  # line 22: finding (numpy direct call)
+
+
+def bare_import_factor(G):
+    return cholesky(G)  # line 26: finding (bare imported name)
+
+
+def aliased_import_factor(G):
+    return chol(G)  # line 30: finding (aliased imported name)
+
+
+def module_alias_factor(G):
+    return lin.cholesky(G)  # line 34: finding (module-alias call)
+
+
+def from_import_alias_factor(G):
+    return la.cholesky(G)  # line 38: finding (from-import module alias)

@@ -1,0 +1,19 @@
+"""DHQR007 fixture: Cholesky through the guarded wrapper (or a
+reasoned suppression for a call site where breakdown is impossible)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from dhqr_tpu.numeric.guards import checked_cholesky
+
+
+def gram_factor(G):
+    # The sanctioned route: the wrapper carries the NaN-breakdown
+    # contract, callers gate their outputs through the numeric layer.
+    L = checked_cholesky(G)
+    return jnp.conj(L.T)
+
+
+def identity_factor(n):
+    # dhqr: ignore[DHQR007] the identity is positive-definite by construction; breakdown is impossible
+    return np.linalg.cholesky(np.eye(n))

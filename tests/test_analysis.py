@@ -125,6 +125,31 @@ def test_dhqr006_swallowed_exceptions():
     assert len(suppressed) == 1 and "best-effort" in suppressed[0].reason
 
 
+def test_dhqr007_direct_cholesky_calls():
+    # Every spelling: dotted, bare `from ...linalg import cholesky`,
+    # its asname, and linalg module aliases (both `import ... as` and
+    # `from ... import linalg as`) — all reach the same primitive,
+    # all flagged.
+    findings = _scan_fixture("dhqr007_bad.py")
+    assert _hits(findings, "DHQR007") == [13, 18, 22, 26, 30, 34, 38]
+    good = _scan_fixture("dhqr007_good.py")
+    assert _hits(good, "DHQR007") == []
+    # The one direct call in the good fixture is visible but SUPPRESSED
+    # with a reason (breakdown impossible by construction).
+    suppressed = [f for f in good if f.rule == "DHQR007" and f.suppressed]
+    assert len(suppressed) == 1 and "positive-definite" in \
+        suppressed[0].reason
+
+
+def test_dhqr007_wrapper_module_and_tests_exempt():
+    with open(os.path.join(FIXTURES, "dhqr007_bad.py")) as fh:
+        text = fh.read()
+    # The wrapper module is the one sanctioned call site; oracle/test
+    # code outside the package is out of scope.
+    assert scan_source(text, "dhqr_tpu/numeric/guards.py") == []
+    assert scan_source(text, "tests/test_something.py") == []
+
+
 def test_dhqr006_out_of_package_paths_exempt():
     with open(os.path.join(FIXTURES, "dhqr006_bad.py")) as fh:
         text = fh.read()

@@ -1,0 +1,400 @@
+"""Numeric guardrails (round 13): input screening, breakdown detection,
+the condition-aware fallback ladder, typed degradation, plan demotion,
+and scheduler batch-neighbor isolation.
+
+Deterministic escalation paths ride the ``numeric.breakdown`` /
+``numeric.nan`` fault sites (``dhqr_tpu.faults``); one organic
+ill-conditioned fixture (a geometric singular-value ladder past the
+f64 CholeskyQR2 window) proves the detector against real numerics.
+Tier-1 budget: tiny shapes throughout, the full cond x engine sweep
+lives in benchmarks/condition_sweep.py (committed CPU artifact).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import dhqr_tpu
+from dhqr_tpu import faults
+from dhqr_tpu.numeric import (
+    Breakdown,
+    ENGINE_LADDER,
+    IllConditioned,
+    NonFiniteInput,
+    NumericalError,
+    ResidualGateFailed,
+    guarded_lstsq,
+    guarded_qr,
+)
+from dhqr_tpu.numeric import guards as nguards
+from dhqr_tpu.utils.config import DHQRConfig, FaultConfig
+from dhqr_tpu.utils.testing import (
+    TOLERANCE_FACTOR,
+    normal_equations_residual,
+    oracle_residual,
+)
+
+
+def _problem(m=48, n=10, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    return (jnp.asarray(rng.random((m, n)), dtype),
+            jnp.asarray(rng.random(m), dtype))
+
+
+def _ill_conditioned(m, n, cond, seed=0, dtype=np.float64):
+    """Geometric singular-value ladder: sigma_i from 1 down to 1/cond."""
+    rng = np.random.default_rng(seed)
+    U, _ = np.linalg.qr(rng.standard_normal((m, n)))
+    V, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    s = np.geomspace(1.0, 1.0 / cond, n)
+    # dhqr: ignore[DHQR002] host-side f64 numpy fixture construction
+    A = (U * s) @ V.T
+    return jnp.asarray(A.astype(dtype)), \
+        jnp.asarray(rng.standard_normal(m).astype(dtype))
+
+
+# ------------------------------------------------------------- taxonomy
+
+
+def test_error_taxonomy_fields():
+    e = Breakdown("boom", engine="cholqr2", cond_estimate=1e9,
+                  attempts=("a", "b"))
+    assert isinstance(e, NumericalError) and isinstance(e, RuntimeError)
+    assert e.engine == "cholqr2" and e.cond_estimate == 1e9
+    assert e.attempts == ("a", "b")
+    g = ResidualGateFailed("gate", residual_ratio=12.5)
+    assert g.residual_ratio == 12.5 and g.cond_estimate is None
+    # Deliberately a SIBLING of ServeError, not a subclass: retry
+    # machinery must not treat data failures as transients.
+    assert not isinstance(e, dhqr_tpu.ServeError)
+
+
+def test_guard_mode_validation():
+    A, b = _problem()
+    with pytest.raises(ValueError, match="guards must be one of"):
+        guarded_lstsq(A, b, guards="bogus")
+
+
+# ------------------------------------------------------------ screening
+
+
+def test_nonfinite_input_raises_typed_before_factoring():
+    A, b = _problem()
+    with pytest.raises(NonFiniteInput):
+        guarded_lstsq(A.at[0, 0].set(jnp.nan), b, guards="screen")
+    with pytest.raises(NonFiniteInput, match="input b"):
+        guarded_lstsq(A, b.at[3].set(jnp.inf), guards="fallback")
+    # The public facade routes through the same screen.
+    with pytest.raises(NonFiniteInput):
+        dhqr_tpu.lstsq(A.at[1, 1].set(jnp.inf), b, guards="screen")
+
+
+def test_zero_column_raises_ill_conditioned_with_inf_estimate():
+    A, b = _problem()
+    with pytest.raises(IllConditioned) as ei:
+        guarded_lstsq(A.at[:, 2].set(0.0), b, guards="fallback")
+    assert ei.value.cond_estimate == float("inf")
+
+
+def test_injected_nan_site_takes_the_organic_path():
+    A, b = _problem()
+    cfg = FaultConfig(sites=(("numeric.nan", 1.0, 1),), seed=0)
+    with faults.injected(cfg) as h:
+        with pytest.raises(NonFiniteInput, match="injected"):
+            guarded_lstsq(A, b, guards="fallback")
+    assert h.stats()["numeric.nan"]["fired"] == 1
+
+
+# ------------------------------------------------------------ the ladder
+
+
+def test_injected_breakdown_escalates_and_records_path():
+    A, b = _problem()
+    cfg = FaultConfig(sites=(("numeric.breakdown", 1.0, 1),), seed=0)
+    with faults.injected(cfg) as h:
+        res = guarded_lstsq(A, b, engine="cholqr2", guards="fallback")
+    assert h.stats()["numeric.breakdown"]["fired"] == 1
+    # cholqr2's first fallback rung is the shifted form.
+    assert ENGINE_LADDER["cholqr2"][0] == "cholqr3"
+    assert res.engine == "cholqr3" and res.escalations == 1
+    assert [a.outcome for a in res.attempts] == ["breakdown", "ok"]
+    assert res.attempts[0].detail == "injected numeric.breakdown"
+    nres = normal_equations_residual(A, np.asarray(res.x), b)
+    assert nres < TOLERANCE_FACTOR * oracle_residual(
+        np.asarray(A), np.asarray(b))
+
+
+def test_exhausted_ladder_raises_typed_breakdown_with_attempts():
+    A, b = _problem()
+    cfg = FaultConfig(sites=(("numeric.breakdown", 1.0, None),), seed=0)
+    with faults.injected(cfg):
+        with pytest.raises(Breakdown) as ei:
+            guarded_lstsq(A, b, engine="cholqr2", guards="fallback")
+    err = ei.value
+    assert err.engine == "cholqr2"  # the original route
+    # Engine ladder (4 rungs) + refine escalation, all recorded.
+    assert len(err.attempts) >= 4
+    assert all(a.outcome == "breakdown" for a in err.attempts)
+    assert err.cond_estimate is not None  # classification measured it
+
+
+def test_organic_cholqr2_breakdown_recovers_within_8x():
+    """The real thing, no injection: cond ~ 1e12 in f64 is past the
+    CholeskyQR2 window (~7e7) but inside the shifted form's — the
+    ladder must detect the NaN factors and land on a stable rung that
+    meets the reference criterion."""
+    A, b = _ill_conditioned(96, 16, cond=1e12)
+    res = guarded_lstsq(A, b, engine="cholqr2", guards="full")
+    assert res.escalations >= 1
+    assert res.attempts[0].outcome == "breakdown"
+    assert res.residual_ratio is not None \
+        and res.residual_ratio <= TOLERANCE_FACTOR
+    # Unguarded, the same route returns silent NaN garbage — the
+    # exact hazard the ladder closes.
+    x_raw = dhqr_tpu.lstsq(A, b, engine="cholqr2")
+    assert not bool(jnp.all(jnp.isfinite(x_raw)))
+
+
+def test_residual_gate_failed_when_every_rung_is_garbage(monkeypatch):
+    A, b = _problem()
+    monkeypatch.setattr(nguards, "residual_ratio",
+                        lambda A_, b_, x_: 99.0)
+    with pytest.raises(ResidualGateFailed) as ei:
+        guarded_lstsq(A, b, engine="cholqr2", guards="full")
+    assert ei.value.residual_ratio == 99.0
+    assert all(a.outcome == "residual_gate" for a in ei.value.attempts)
+
+
+def test_rung0_config_error_propagates_not_masked():
+    A, b = _problem()
+    # layout=cyclic is a householder-only knob: the caller's own config
+    # error must surface as the usual ValueError, never be absorbed as
+    # an "inapplicable" ladder rung.
+    with pytest.raises(ValueError, match="layout"):
+        guarded_lstsq(A, b, engine="cholqr2", layout="cyclic",
+                      guards="fallback")
+
+
+def test_minimum_norm_path_is_guarded_too():
+    rng = np.random.default_rng(3)
+    A = jnp.asarray(rng.random((6, 12)), jnp.float32)
+    b = jnp.asarray(rng.random(6), jnp.float32)
+    res = guarded_lstsq(A, b, guards="fallback")
+    assert res.engine == "householder" and res.escalations == 0
+    with pytest.raises(NonFiniteInput):
+        guarded_lstsq(A.at[0, 0].set(jnp.nan), b, guards="fallback")
+
+
+def test_warm_guarded_repeat_compiles_nothing():
+    from dhqr_tpu.models.qr_model import _lstsq_impl
+    from dhqr_tpu.numeric.guards import (
+        _nonfinite_impl,
+        _screen_impl,
+        _screen_rhs_impl,
+    )
+    from dhqr_tpu.ops.cholqr import _cholqr_lstsq_impl
+    from dhqr_tpu.ops.tsqr import _tsqr_lstsq_impl
+
+    def compiles():
+        return sum(f._cache_size() for f in
+                   (_lstsq_impl, _cholqr_lstsq_impl, _tsqr_lstsq_impl,
+                    _screen_impl, _screen_rhs_impl, _nonfinite_impl))
+
+    A, b = _problem(m=40, n=8, seed=5)
+    first = guarded_lstsq(A, b, engine="cholqr2", guards="fallback")
+    n0 = compiles()
+    second = guarded_lstsq(A, b, engine="cholqr2", guards="fallback")
+    assert compiles() == n0, "warm guarded repeat recompiled"
+    assert bool(jnp.all(first.x == second.x))
+
+
+# ---------------------------------------------------------- guarded qr
+
+
+def test_guarded_qr_happy_path_and_escalation():
+    A, _ = _problem(m=32, n=8, seed=7)
+    res = guarded_qr(A, guards="full")
+    assert res.engine == "householder" and res.escalations == 0
+    assert res.cond_estimate is not None and res.cond_estimate >= 1.0
+    fact = dhqr_tpu.qr(A, guards="fallback")  # facade returns the fact
+    assert fact.H.shape == A.shape
+    # Injected breakdown on the caller rung escalates to "accurate"
+    # when the caller ran a cheaper policy.
+    cfg = FaultConfig(sites=(("numeric.breakdown", 1.0, 1),), seed=0)
+    with faults.injected(cfg):
+        res2 = guarded_qr(A, policy="fast", guards="fallback")
+    assert res2.escalations == 1 and res2.attempts[1].policy == "accurate"
+
+
+def test_guarded_qr_zero_pivot_raises_ill_conditioned():
+    # Exactly-dependent columns with exact arithmetic: r22 is exactly 0
+    # (the screen passes — no zero COLUMN — but solves would divide by
+    # the zero pivot).
+    A = jnp.asarray([[1.0, 1.0], [0.0, 0.0], [0.0, 0.0]], jnp.float64)
+    with pytest.raises(IllConditioned, match="zero diagonal"):
+        guarded_qr(A, guards="fallback")
+
+
+def test_guarded_qr_rejects_donate():
+    A, _ = _problem(m=32, n=8)
+    with pytest.raises(ValueError, match="donate"):
+        dhqr_tpu.qr(A, donate=True, guards="fallback")
+
+
+# ------------------------------------------------------- plan demotion
+
+
+def test_plan_demotion_after_repeated_gate_failures():
+    from dhqr_tpu import tune as t
+    from dhqr_tpu.tune.db import PlanDB, plan_key
+    from dhqr_tpu.tune.plan import Plan
+
+    t.reset_gate_failures()
+    try:
+        key = plan_key("lstsq", 80, 10, "float32")
+        db = PlanDB()
+        db.record(key, Plan(engine="cholqr2"))
+        assert t.resolve_plan("lstsq", 80, 10, "float32", db=db,
+                              on_miss="default") is not None
+        for i in range(t.PLAN_DEMOTE_AFTER):
+            count = t.note_gate_failure("lstsq", 80, 10, "float32")
+            assert count == i + 1
+        # Demoted: static default, even though the DB still has it.
+        assert t.resolve_plan("lstsq", 80, 10, "float32", db=db,
+                              on_miss="default") is None
+        stats = t.plan_gate_stats()
+        assert stats["failures"][key] == t.PLAN_DEMOTE_AFTER
+        assert stats["demoted_lookups"] >= 1
+    finally:
+        t.reset_gate_failures()
+    assert t.resolve_plan("lstsq", 80, 10, "float32", db=db,
+                          on_miss="default") is not None
+
+
+def test_ladder_reports_gate_failure_for_active_plan(monkeypatch,
+                                                     tmp_path):
+    from dhqr_tpu import tune as t
+    from dhqr_tpu.tune.plan import Plan
+
+    t.reset_gate_failures()
+    try:
+        A, b = _problem(m=64, n=8, seed=11)
+        cfg = FaultConfig(sites=(("numeric.breakdown", 1.0, 1),), seed=0)
+        with faults.injected(cfg):
+            res = guarded_lstsq(A, b, plan=Plan(engine="cholqr2"),
+                                guards="fallback")
+        assert res.escalations == 1
+        stats = t.plan_gate_stats()
+        assert sum(stats["failures"].values()) == 1
+        # plan="auto" on a DB MISS serves the static default — a rung-0
+        # failure there must NOT feed demotion (nothing to demote).
+        t.reset_gate_failures()
+        monkeypatch.setenv("DHQR_TUNE_DB",
+                           str(tmp_path / "empty_plans.json"))
+        monkeypatch.setenv("DHQR_TUNE_ON_MISS", "default")
+        with faults.injected(cfg):
+            guarded_lstsq(A, b, plan="auto", guards="fallback")
+        assert sum(t.plan_gate_stats()["failures"].values()) == 0
+    finally:
+        t.reset_gate_failures()
+
+
+# ------------------------------------- serve guard + scheduler isolation
+
+
+def test_batched_lstsq_guard_raises_typed_breakdown():
+    from dhqr_tpu.serve import batched_lstsq
+    from dhqr_tpu.utils.config import ServeConfig
+
+    scfg = ServeConfig(min_dim=16, ratio=1.5, max_batch=4, cache_size=8)
+    rng = np.random.default_rng(0)
+    As = [jnp.asarray(rng.random((24, 10)), jnp.float32)
+          for _ in range(3)]
+    bs = [jnp.asarray(rng.random(24), jnp.float32) for _ in range(3)]
+    # Guards off (default): the poisoned batch scatters NaN silently —
+    # the pre-round-13 behavior, byte-for-byte.
+    As[1] = As[1].at[0, 0].set(jnp.nan)
+    xs = batched_lstsq(As, bs, block_size=8, serve_config=scfg)
+    assert not bool(jnp.all(jnp.isfinite(xs[1])))
+    # Guards armed: typed Breakdown instead of silent garbage.
+    with pytest.raises(Breakdown):
+        batched_lstsq(As, bs, block_size=8, serve_config=scfg,
+                      guards="fallback")
+
+
+def test_scheduler_isolates_poison_request_from_batch_neighbors():
+    """One NaN-bearing request in a coalesced batch: with guards armed
+    the flush fails typed, the scheduler skips retry (data, not
+    infrastructure) and bisects until the poison request fails ALONE
+    with the NumericalError while every neighbor completes."""
+    from dhqr_tpu.serve import AsyncScheduler
+    from dhqr_tpu.serve.cache import ExecutableCache
+    from dhqr_tpu.utils.config import SchedulerConfig, ServeConfig
+
+    scfg = ServeConfig(min_dim=16, ratio=1.5, max_batch=4, cache_size=8)
+    rng = np.random.default_rng(0)
+    As = [jnp.asarray(rng.random((24, 10)), jnp.float32)
+          for _ in range(4)]
+    bs = [jnp.asarray(rng.random(24), jnp.float32) for _ in range(4)]
+    As[2] = As[2].at[0, 0].set(jnp.nan)
+    sched = AsyncScheduler(
+        serve_config=scfg, cache=ExecutableCache(max_size=8),
+        sched_config=SchedulerConfig(slo_ms=30e3, retry_base_ms=1.0),
+        block_size=8, guards="fallback", start=False)
+    futs = [sched.submit("lstsq", A, b, deadline=30.0)
+            for A, b in zip(As, bs)]
+    sched.drain()
+    for i, fut in enumerate(futs):
+        if i == 2:
+            assert isinstance(fut.exception(), NumericalError)
+        else:
+            assert fut.exception() is None
+            res = normal_equations_residual(
+                As[i], np.asarray(fut.result()), bs[i])
+            ref = oracle_residual(np.asarray(As[i]), np.asarray(bs[i]))
+            assert res < TOLERANCE_FACTOR * ref
+    st = sched.stats()
+    assert st["numeric_failures"] >= 1
+    assert st["poisoned"] == 1
+    assert st["retries"] == 0  # data failures never spend retry budget
+    sched.shutdown()
+
+
+# ----------------------------------------------------------- unit bits
+
+
+def test_guard_unit_helpers():
+    A, b = _problem(m=16, n=4)
+    assert nguards.screen_input(A, b) == (False, False, False)
+    assert nguards.screen_input(A.at[0, 0].set(jnp.nan), b)[0]
+    assert nguards.screen_input(A.at[:, 1].set(0.0), b)[1]
+    assert nguards.screen_input(A, b.at[0].set(jnp.nan))[2]
+    assert not nguards.any_nonfinite(A, b)
+    assert nguards.any_nonfinite(A, b.at[0].set(jnp.inf))
+    d = jnp.asarray([4.0, 2.0, 1.0])
+    assert nguards.diag_condition_bound(d) == pytest.approx(4.0)
+    est = nguards.estimate_condition(_ill_conditioned(64, 8, 1e6)[0])
+    assert est is not None and est > 1e4  # lower bound, right ballpark
+    ratio = nguards.residual_ratio(A, b, dhqr_tpu.lstsq(A, b))
+    assert ratio <= TOLERANCE_FACTOR
+
+
+def test_cholqr_window_and_escalation_policies():
+    from dhqr_tpu.ops.cholqr import cholqr_max_cond
+    from dhqr_tpu.precision import escalation_policies
+
+    assert 1e3 < cholqr_max_cond(np.float32) < 1e4
+    assert 1e7 < cholqr_max_cond(np.float64) < 1e8
+    assert cholqr_max_cond(np.float64, shift=True) > \
+        100 * cholqr_max_cond(np.float64)
+    # fast (cheap, already refining) escalates straight to accurate+r2.
+    pols = escalation_policies("fast")
+    assert [p.refine for p in pols] == [2]
+    assert pols[0].trailing is None
+    # A cheap non-refining policy first tries plain accurate.
+    pols = escalation_policies("highest/default")
+    assert [(p.trailing, p.refine) for p in pols] == [(None, 0),
+                                                     (None, 1)]
+    # The default (accurate) just adds a refinement sweep.
+    assert [p.refine for p in escalation_policies()] == [1]
