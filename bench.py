@@ -143,6 +143,20 @@ def _mfu_fields(gflops: float, device_kind: str) -> dict:
             "mfu_convention": "useful f32 FLOPs / dense bf16 MXU peak"}
 
 
+def _registry_metrics() -> dict:
+    """The round-14 unified metrics snapshot (dhqr_tpu.obs.registry) —
+    stamped into the bench summary JSON so every headline travels with
+    the process-wide serve-cache/scheduler/faults/numeric counters that
+    produced it (benchmarks/README names the decision rules that read
+    it). Never fails the bench: telemetry is evidence, not a gate."""
+    try:
+        from dhqr_tpu.obs import registry
+
+        return registry().snapshot()
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _emit(record: dict) -> None:
     """Print a result line; with DHQR_BENCH_TEE set, also append it there.
 
@@ -972,7 +986,8 @@ def _prewarm() -> None:
     _stage("prewarm_done")
     print(json.dumps({"prewarm": "done", "stages": done,
                       "seconds": round(time.time() - t0, 1),
-                      "cache": cache.stats()}))
+                      "cache": cache.stats(),
+                      "metrics": _registry_metrics()}))
 
 
 class _Watchdog:
@@ -1411,6 +1426,11 @@ def main() -> None:
                 if k.startswith("backward_error_") and not k.endswith("_pallas"):
                     key = k + ("_pallas" if r.get("pallas_panels") else "")
                     best.setdefault(key, v)
+        # Round 14: the summary travels with the unified registry
+        # snapshot (serve cache hit/miss/compile seconds, scheduler and
+        # numeric counters) — fresh per call, the LAST emitted summary
+        # carries the session's final numbers.
+        best["metrics"] = _registry_metrics()
         return best
 
     # The escalation is data (_TPU_STAGES, shared with the prewarm child):

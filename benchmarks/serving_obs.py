@@ -1,0 +1,398 @@
+"""dhqr-obs acceptance: traced chaos + armed-tracing overhead ladder.
+
+The round-14 tentpole's decision artifact, reusing the round-12 chaos
+machinery (benchmarks/serving_faults.py: same shape ladder, prewarmed
+AOT cache, seeded Poisson×Zipf open loop):
+
+* ``warm_disarmed`` / ``warm_armed`` — the warm closed-loop serving
+  throughput (repeated submit-all + drain over the prewarmed cache),
+  measured disarmed and with tracing ARMED, interleaved. Acceptance:
+  armed costs <= 5% requests/s (ratio of per-arm MEDIANS >= 0.95 —
+  per-sample noise on this shared CPU is ±30%, far above the
+  few-appends-per-request tracing cost, and a median absorbs the
+  one-off stalls a best-of amplified), and the armed passes compile
+  NOTHING (trace ids
+  provably absent from cache keys — the same pin tests/test_obs.py
+  holds as key parity);
+* ``chaos_traced`` — the seeded fault schedule (``serve.dispatch`` +
+  ``serve.latency``) at 0.9x capacity with tracing armed and the
+  flight recorder's auto-dump pointed at a scratch dir. Acceptance:
+  every accepted future resolves; every TYPED-ERROR future's trace
+  reconstructs its complete path — first span ``submit``, last span
+  ``resolve`` with the error's own type as outcome, a ``dispatch``
+  attempt in between, and (for post-retry failures) the
+  retry/isolate/bisect hop that explains WHY — and the auto-dump file
+  carries the same paths for ``python -m dhqr_tpu.obs dump``;
+* ``typed_path`` — the deterministic twin of the chaos check (light
+  chaos can recover EVERY request via retry, leaving nothing typed to
+  inspect): an unbounded ``serve.dispatch`` schedule against four
+  lone requests forces the full escalation — submit → flush →
+  dispatch → retry (cause) → isolate → resolve typed — so the
+  complete-path acceptance always has deterministic evidence;
+* the ``chaos_traced`` record embeds the unified registry snapshot
+  (``dhqr_tpu.obs.registry``) taken while the scheduler, the armed
+  fault harness and the trace recorder are all LIVE, so the artifact
+  itself demonstrates the full dotted-name surface
+  (``serve.sched.*``/``serve.cache.*``/``faults.*``/``numeric.*``/
+  ``obs.*``) the bench summary now stamps.
+
+Usage:  python benchmarks/serving_obs.py [n_requests] [rate_frac]
+Writes: benchmarks/results/serving_obs_<platform>.jsonl (append).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# The round-8/11/12 shape ladder verbatim — numbers stay comparable to
+# the serving_async / serving_faults artifacts.
+SHAPE_LADDER = [
+    (64, 16), (100, 36), (128, 48), (192, 64),
+    (250, 100), (384, 128), (500, 180), (640, 256),
+]
+MICRO_BATCH = 32
+SLO_MS = 2000.0
+FLUSH_INTERVAL_MS = 100.0
+WARM_REPEATS = 5          # median-of per arm: a single one-off stall
+                          # (GC pause, thread-pool start) cannot move a
+                          # median the way it moved a best-of-3 sample
+LIGHT_FAULTS = dict(dispatch_p=0.15, latency_p=0.40, latency_ms=40.0)
+
+
+def _stage(name: str) -> None:
+    print(f"::stage {name} t={time.time():.1f}", file=sys.stderr, flush=True)
+
+
+def main(n_requests: int = 384, rate_frac: float = 0.90) -> None:
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(3))
+    from bench import ROUND, _Watchdog
+
+    _stage("import")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(_REPO, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+
+    from dhqr_tpu import faults, obs
+    from dhqr_tpu.obs import ObsConfig
+    from dhqr_tpu.serve import AsyncScheduler, ServeError, prewarm
+    from dhqr_tpu.serve.cache import ExecutableCache
+    from dhqr_tpu.utils.config import (FaultConfig, SchedulerConfig,
+                                       ServeConfig)
+    from dhqr_tpu.utils.profiling import LatencyHistogram, sync
+
+    _stage("backend_init")
+    with _Watchdog("backend_init", 240):
+        dev = jax.devices()[0]
+        platform = dev.platform
+        kind = getattr(dev, "device_kind", "?")
+        sync(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    _stage(f"backend_ready_{platform}")
+    out_path = os.path.join(_REPO, "benchmarks", "results",
+                            f"serving_obs_{platform}.jsonl")
+
+    def emit(rec):
+        rec.update(platform=platform, device_kind=kind, round=ROUND)
+        line = json.dumps(rec)
+        print(line, flush=True)
+        with open(out_path, "a") as f:
+            f.write(line + "\n")
+
+    # ---- the request stream (fixed seeds: artifact is reproducible) ----
+    rng = np.random.default_rng(0)
+    ranks = np.arange(len(SHAPE_LADDER))
+    weights = 1.0 / (ranks + 1.0) ** 1.1
+    weights /= weights.sum()
+    picks = rng.choice(len(SHAPE_LADDER), size=n_requests, p=weights)
+    shapes = [SHAPE_LADDER[i] for i in picks]
+    As = [jnp.asarray(rng.random(s), jnp.float32) for s in shapes]
+    bs = [jnp.asarray(rng.random(s[0]), jnp.float32) for s in shapes]
+    sync(As[-1])
+    scfg = ServeConfig(max_batch=MICRO_BATCH)
+
+    _stage("prewarm")
+    with _Watchdog("prewarm", 2400):
+        acache = ExecutableCache(max_size=64)
+        pow2 = [1 << i for i in range((MICRO_BATCH - 1).bit_length() + 1)
+                if 1 << i <= MICRO_BATCH]
+        keys = prewarm([(c, m, n) for (m, n) in SHAPE_LADDER for c in pow2],
+                       serve_config=scfg, cache=acache)
+    emit({"metric": "serving_obs", "phase": "prewarm",
+          "keys": len(keys), "cache": acache.stats()})
+
+    # ---- warm closed-loop throughput, disarmed vs armed ----------------
+    def warm_drain_rps() -> float:
+        """One closed-loop measurement: submit the whole stream, drain,
+        twice; requests/s over the drains (the round-11 sync-ceiling
+        shape). MANUAL mode (``start=False`` — drain polls inline, no
+        dispatcher threads) on purpose: this phase measures the
+        INSTRUMENTATION delta, a few ring-buffer appends per request,
+        and threaded drains carry ±30% per-sample scheduling jitter
+        that would drown it (measured: manual-mode samples sit within
+        ±5%, threaded within ±30% on this CPU). Absolute threaded
+        capacity stays the round-11/12 artifacts' job — the chaos
+        phase below still runs the live dispatcher pool."""
+        sched = AsyncScheduler(
+            serve_config=scfg,
+            sched_config=SchedulerConfig(slo_ms=60e3, queue_depth=16384,
+                                         flush_interval_ms=FLUSH_INTERVAL_MS),
+            cache=acache, start=False)
+        drain_s = 0.0
+        for _ in range(2):
+            futs = [sched.submit("lstsq", A, b, deadline=60.0)
+                    for A, b in zip(As, bs)]
+            t0 = time.perf_counter()
+            sched.drain()
+            drain_s += time.perf_counter() - t0
+            assert all(f.exception() is None for f in futs)
+        sched.shutdown()
+        return 2 * n_requests / drain_s
+
+    _stage("warm_ladder")
+    with _Watchdog("warm_ladder", 2400):
+        warm_drain_rps()                      # untimed warm-up passes:
+        warm_drain_rps()                      # the minutes of prewarm
+        # compiles above leave the container in a transiently throttled
+        # state, and the first timed samples after it read low — two
+        # full settle passes keep that drift out of BOTH arms.
+        disarmed, armed = [], []
+        misses_before_armed = None
+
+        def one_armed_sample() -> float:
+            nonlocal misses_before_armed
+            with obs.observed(ObsConfig(enabled=True,
+                                        buffer_spans=65536)) as rec:
+                if misses_before_armed is None:
+                    misses_before_armed = acache.stats()["misses"]
+                rps = warm_drain_rps()
+                one_armed_sample.spans = rec.stats()
+            return rps
+
+        for rep in range(WARM_REPEATS):
+            # Interleaved A/B with ALTERNATING order: any slow
+            # monotone drift (throttle recovery, cache settling) lands
+            # on each arm's first-and-second slots equally, so the
+            # medians compare like with like.
+            if rep % 2 == 0:
+                disarmed.append(warm_drain_rps())
+                armed.append(one_armed_sample())
+            else:
+                armed.append(one_armed_sample())
+                disarmed.append(warm_drain_rps())
+        armed_spans = one_armed_sample.spans
+        armed_recompiles = acache.stats()["misses"] - misses_before_armed
+        import statistics
+
+        overhead_ratio = statistics.median(armed) / statistics.median(
+            disarmed)
+    emit({"metric": "serving_obs", "phase": "warm_disarmed",
+          "requests_per_s": [round(r, 1) for r in disarmed],
+          "median_rps": round(statistics.median(disarmed), 1)})
+    emit({"metric": "serving_obs", "phase": "warm_armed",
+          "requests_per_s": [round(r, 1) for r in armed],
+          "median_rps": round(statistics.median(armed), 1),
+          "armed_over_disarmed": round(overhead_ratio, 4),
+          "recompiles_armed": armed_recompiles,
+          "recorder": armed_spans})
+
+    # ---- traced chaos: open loop under the seeded fault schedule -------
+    from dhqr_tpu.serve.errors import DeadlineExceeded, Quarantined
+
+    def _path_complete(fut, exc, recorder) -> "tuple[bool, list]":
+        """THE tentpole acceptance predicate: a typed-error future's
+        trace must reconstruct its complete path — admission, a
+        dispatch attempt, and a typed resolution matching the error.
+        The retry/isolate/bisect hop is additionally required for
+        failures the scheduler escalates (DispatchFailed/CompileFailed/
+        numeric); a DeadlineExceeded (budget ran out right after a
+        failed dispatch) or a Quarantined (no headroom to absorb the
+        cooldown) legitimately resolves typed straight from
+        _handle_failure with no escalation hop — demanding one there
+        would fail the benchmark on exactly-as-specified behavior."""
+        tid = getattr(fut, "trace_id", None)
+        if tid is None or getattr(exc, "trace_id", None) is None:
+            return False, []
+        spans = recorder.dump(tid)["spans"]
+        names = [s["name"] for s in spans]
+        resolve = [s for s in spans if s["name"] == "resolve"]
+        needs_hop = not isinstance(exc, (DeadlineExceeded, Quarantined))
+        ok = (bool(names) and names[0] == "submit"
+              and names[-1] == "resolve" and "dispatch" in names
+              and (not needs_hop
+                   or any(h in names for h in
+                          ("retry", "isolate", "bisect",
+                           "numeric_isolate")))
+              and resolve[-1]["outcome"] == type(exc).__name__)
+        return ok, names
+
+    offered_rps = rate_frac * statistics.median(disarmed)
+    inter = np.random.default_rng(1).exponential(
+        1.0 / offered_rps, size=n_requests)
+    arrivals = np.cumsum(inter)
+    dump_dir = tempfile.mkdtemp(prefix="dhqr_obs_flight_")
+
+    _stage("chaos_traced")
+    with _Watchdog("chaos_traced", 2400):
+        fcfg = FaultConfig(
+            sites=(("serve.dispatch", LIGHT_FAULTS["dispatch_p"], None),
+                   ("serve.latency", LIGHT_FAULTS["latency_p"], None)),
+            seed=7, latency_ms=LIGHT_FAULTS["latency_ms"])
+        lat = LatencyHistogram()
+        with obs.observed(ObsConfig(enabled=True, buffer_spans=65536,
+                                    auto_dump=dump_dir)) as rec:
+            sched = AsyncScheduler(
+                serve_config=scfg,
+                sched_config=SchedulerConfig(
+                    slo_ms=SLO_MS, queue_depth=4096,
+                    flush_interval_ms=FLUSH_INTERVAL_MS),
+                cache=acache)
+            harness = faults.install(fcfg)
+            try:
+                t_start = time.perf_counter()
+                futs, rejected = [], 0
+                for i in range(n_requests):
+                    delay = t_start + arrivals[i] - time.perf_counter()
+                    if delay > 0:
+                        time.sleep(delay)
+                    t_submit = time.perf_counter()
+                    try:
+                        fut = sched.submit("lstsq", As[i], bs[i],
+                                           deadline=SLO_MS / 1e3,
+                                           tenant=f"t{picks[i]}")
+                    except ServeError:
+                        rejected += 1
+                        continue
+                    fut.add_done_callback(
+                        lambda f, t=t_submit:
+                        lat.record(time.perf_counter() - t))
+                    futs.append(fut)
+                from concurrent.futures import wait as _wait
+                _wait(futs, timeout=600)
+                assert all(f.done() for f in futs), "futures hung"
+                # The artifact's authoritative registry block: taken
+                # HERE, while the scheduler instance, the armed fault
+                # harness and the trace recorder are all still live —
+                # after uninstall/GC their serve.sched.*/faults.*/obs.*
+                # names would drop out of the snapshot (weak sources,
+                # disarmed providers) and decision rule 5 would have
+                # nothing to key on.
+                registry_snap = obs.registry().snapshot()
+            finally:
+                faults.uninstall()
+            sched_stats = sched.stats()
+            sched.shutdown()
+
+            # Every typed-error future's trace must reconstruct its
+            # complete path (typed failures here depend on the seeded
+            # schedule outrunning the retry budget; the typed_path
+            # segment below guarantees deterministic evidence).
+            typed, complete, incomplete = 0, 0, []
+            for f in futs:
+                exc = f.exception()
+                if exc is None:
+                    continue
+                assert isinstance(exc, ServeError), exc
+                typed += 1
+                ok, names = _path_complete(f, exc, rec)
+                if ok:
+                    complete += 1
+                else:
+                    incomplete.append({"trace_id": getattr(f, "trace_id",
+                                                           None),
+                                       "path": names,
+                                       "error": type(exc).__name__})
+            recorder_stats = rec.stats()
+        dump_file = os.path.join(dump_dir, f"flight_{os.getpid()}.jsonl")
+        dumped = sum(1 for _ in open(dump_file)) \
+            if os.path.exists(dump_file) else 0
+    emit({"metric": "serving_obs", "phase": "chaos_traced",
+          "requests": n_requests, "rejected": rejected,
+          "accepted": len(futs),
+          "offered_rps": round(offered_rps, 1),
+          "typed_failures": typed,
+          "typed_traces_complete": complete,
+          "typed_traces_incomplete": incomplete[:5],
+          "auto_dumped_records": dumped,
+          "client_latency": lat.snapshot(),
+          "recorder": recorder_stats,
+          "injected": harness.stats(),
+          "scheduler": {k: sched_stats[k] for k in (
+              "completed", "failed", "retries", "bisections", "poisoned",
+              "flush_failures", "deadline_misses", "dispatches")},
+          "registry": registry_snap})
+
+    # ---- deterministic typed-path segment ------------------------------
+    _stage("typed_path")
+    with _Watchdog("typed_path", 1200):
+        with obs.observed(ObsConfig(enabled=True, buffer_spans=4096,
+                                    auto_dump=dump_dir)) as rec2:
+            psched = AsyncScheduler(
+                serve_config=scfg, cache=acache, start=False,
+                sched_config=SchedulerConfig(slo_ms=30e3,
+                                             flush_interval_ms=5.0,
+                                             max_retries=1,
+                                             retry_base_ms=5.0))
+            with faults.injected(FaultConfig(
+                    sites=(("serve.dispatch", 1.0, None),), seed=3)):
+                pfuts = [psched.submit("lstsq", As[i], bs[i], deadline=10.0)
+                         for i in range(4)]
+                t0 = time.perf_counter()
+                while not all(f.done() for f in pfuts):
+                    psched.poll()
+                    if time.perf_counter() - t0 > 90:
+                        raise RuntimeError(
+                            f"typed_path hung: {psched.stats()}")
+                    time.sleep(0.002)
+            psched.shutdown()
+            typed2, complete2, paths2 = 0, 0, []
+            for f in pfuts:
+                exc = f.exception()
+                assert isinstance(exc, ServeError), exc
+                typed2 += 1
+                ok, names = _path_complete(f, exc, rec2)
+                complete2 += int(ok)
+                paths2.append(names)
+        dumped = sum(1 for _ in open(dump_file)) \
+            if os.path.exists(dump_file) else 0
+    emit({"metric": "serving_obs", "phase": "typed_path",
+          "typed_failures": typed2, "typed_traces_complete": complete2,
+          "example_path": paths2[0] if paths2 else [],
+          "auto_dumped_records_total": dumped})
+
+    # ---- verdict -------------------------------------------------------
+    typed_total = typed + typed2
+    complete_total = complete + complete2
+    ok = (overhead_ratio >= 0.95 and armed_recompiles == 0
+          and typed_total > 0 and complete_total == typed_total
+          and typed2 == 4 == complete2
+          and dumped >= typed_total
+          and all(f.done() for f in futs))
+    emit({"metric": "serving_obs_verdict",
+          "armed_over_disarmed": round(overhead_ratio, 4),
+          "armed_within_5pct": overhead_ratio >= 0.95,
+          "zero_recompiles_armed": armed_recompiles == 0,
+          "typed_failures": typed_total,
+          "every_typed_trace_complete": complete_total == typed_total,
+          "deterministic_typed_paths": typed2 == 4 == complete2,
+          "auto_dump_covers_typed": dumped >= typed_total,
+          "ok": bool(ok)})
+    _stage("done")
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 384,
+         float(sys.argv[2]) if len(sys.argv) > 2 else 0.90)

@@ -68,9 +68,14 @@ from dhqr_tpu.serve import (
 # re-exporting it here would shadow the `dhqr_tpu.tune` submodule
 # attribute with a function (breaking `import dhqr_tpu.tune as t`).
 from dhqr_tpu.tune import Plan, PlanDB, resolve_plan
+# Observability (round 14): the registry class rides the facade; the
+# arming/tracing API stays namespaced at dhqr_tpu.obs (arm, observed,
+# flight_dump, registry, ...) so the module attribute is not shadowed.
+from dhqr_tpu.obs import MetricsRegistry
 from dhqr_tpu.utils.config import (
     DHQRConfig,
     FaultConfig,
+    ObsConfig,
     SchedulerConfig,
     ServeConfig,
     TuneConfig,
@@ -114,6 +119,8 @@ __all__ = [
     "guarded_qr",
     "DHQRConfig",
     "FaultConfig",
+    "ObsConfig",
+    "MetricsRegistry",
     "ServeConfig",
     "SchedulerConfig",
     "TuneConfig",

@@ -210,9 +210,11 @@ def run(n_devices: int) -> None:
     with _faults_mod.injected(fault_cfg) as harness:
         ffuts = [fsched.submit("lstsq", Ai, bi, deadline=30.0)
                  for Ai, bi in zip(As, rhs)]
+        # dhqr: ignore[DHQR008] hang bound on a REAL poll loop — wall time is the point
         t0 = _time.monotonic()
         while not all(f.done() for f in ffuts):
             fsched.poll()
+            # dhqr: ignore[DHQR008] same hang bound, closing read
             if _time.monotonic() - t0 > 120:
                 raise RuntimeError(
                     "faults stage: futures did not resolve in 120 s "
@@ -299,6 +301,66 @@ def run(n_devices: int) -> None:
     print("dryrun: numeric ok (injected breakdown -> cholqr3 fallback "
           f"within 8x (residual {res:.2e}), warm repeat after recovery "
           "0 recompiles)", flush=True)
+
+    # Observability (round 14): a tiny TRACED async stream with one
+    # injected dispatch-fault escalation. The typed error must carry its
+    # trace id, the flight recorder must reconstruct the failed request's
+    # COMPLETE span path (submit -> flush -> dispatch -> isolate ->
+    # resolve typed), a warm traced repeat must be ZERO-recompile (trace
+    # ids provably absent from cache keys — armed tracing hits the same
+    # executables the async stage prewarmed), and the registry snapshot
+    # must carry the unified dotted names.
+    from dhqr_tpu import obs as _obs_mod
+    from dhqr_tpu.serve.errors import DispatchFailed
+    from dhqr_tpu.utils.config import ObsConfig
+
+    okcfg = SchedulerConfig(slo_ms=30e3, flush_interval_ms=5.0,
+                            max_retries=0)
+    with _obs_mod.observed(ObsConfig(enabled=True,
+                                     buffer_spans=2048)) as orec:
+        osched = AsyncScheduler(sched_config=okcfg, cache=acache,
+                                block_size=8, start=False)
+        with _faults_mod.injected(FaultConfig(
+                sites=(("serve.dispatch", 1.0, 2),), seed=0)):
+            bad = osched.submit("lstsq", As[0], rhs[0], deadline=30.0)
+            # dhqr: ignore[DHQR008] hang bound on a REAL poll loop — wall time is the point
+            t0 = _time.monotonic()
+            while not bad.done():
+                osched.poll()
+                # dhqr: ignore[DHQR008] same hang bound, closing read
+                if _time.monotonic() - t0 > 120:
+                    raise RuntimeError("obs stage: typed failure did not "
+                                       f"resolve ({osched.stats()})")
+                _time.sleep(0.005)
+        err = bad.exception(timeout=0)
+        assert isinstance(err, DispatchFailed), err
+        assert getattr(err, "trace_id", None) == bad.trace_id, (
+            "typed error lost its trace id", err)
+        opath = [s["name"] for s in
+                 _obs_mod.flight_dump(err.trace_id)["spans"]]
+        assert opath[0] == "submit" and opath[-1] == "resolve", opath
+        for hop in ("flush", "dispatch", "isolate"):
+            assert hop in opath, (hop, opath)
+        # Warm traced repeat of the full stream: 0 recompiles with
+        # tracing armed (the keys are the ones the async stage minted).
+        omisses = acache.stats()["misses"]
+        ofuts = [osched.submit("lstsq", Ai, bi, deadline=30.0)
+                 for Ai, bi in zip(As, rhs)]
+        osched.drain()
+        assert all(f.exception(timeout=0) is None for f in ofuts)
+        assert acache.stats()["misses"] == omisses, (
+            "traced warm stream recompiled", acache.stats())
+        osnap = _obs_mod.registry().snapshot()
+        for dotted in ("serve.cache.hits", "serve.sched.poisoned",
+                       "serve.sched.completed", "numeric.guarded_calls",
+                       "obs.minted"):
+            assert dotted in osnap, (dotted, sorted(osnap))
+        osched.shutdown()
+    print(f"dryrun: obs ok (typed {type(err).__name__} trace "
+          f"reconstructed {len(opath)} spans incl. "
+          f"{'/'.join(h for h in ('flush', 'dispatch', 'isolate') if h in opath)}, "
+          f"warm traced repeat of {len(As)} requests 0 recompiles, "
+          f"registry {len(osnap)} metrics)", flush=True)
 
     # Plan autotuner (round 9): a tiny-grid on-device search must run end
     # to end on CPU — tune, persist, resolve through the PUBLIC lstsq

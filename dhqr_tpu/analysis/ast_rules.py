@@ -1,4 +1,4 @@
-"""Pass 1 — AST lint rules DHQR001-DHQR007.
+"""Pass 1 — AST lint rules DHQR001-DHQR008.
 
 Each rule is a small class with an id, a scope predicate over the
 (posix) file path, and a ``check(module)`` hook receiving a
@@ -619,6 +619,72 @@ class UnguardedCholesky(Rule):
         return out
 
 
+class RawWallClock(Rule):
+    """DHQR008 — a raw wall-clock READ (``time.time()`` /
+    ``time.monotonic()`` / ``time.perf_counter()`` and their ``_ns``
+    twins) in package code bypasses the injectable-clock seams the
+    stack is built on: the scheduler, the executable cache's
+    quarantine, the fault harness and the round-14 trace recorder all
+    take ``clock=`` precisely so deadline/backoff/cooldown/span
+    decisions replay deterministically under a fake clock in tests and
+    the dry run. One stray ``time.monotonic()`` on such a path is a
+    wall-clock dependency a fake-clock test cannot see — it surfaces
+    as flakes. The sanctioned spellings are (a) passing the callable
+    as an injectable default (``clock=time.monotonic`` — a reference,
+    not a read; this rule flags CALLS only) and (b) a reasoned
+    suppression where a real wall clock IS the point (measuring
+    actual compile/device seconds, damping a crash-loop, bounding a
+    drain against real hangs)."""
+
+    id = "DHQR008"
+    title = "raw wall-clock read outside an injectable-clock seam"
+
+    _CLOCK_NAMES = {
+        "time", "monotonic", "perf_counter",
+        "time_ns", "monotonic_ns", "perf_counter_ns",
+    }
+
+    def applies(self, path: str) -> bool:
+        return _in_package(path)
+
+    def check(self, ctx):
+        # Every spelling that reaches the wall clock is flagged:
+        # `from time import monotonic [as now]` (a bare name), and
+        # `import time [as _time]` (a dotted read through any alias).
+        flagged_names: "set[str]" = set()
+        module_aliases: "set[str]" = {"time"}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "time":
+                for alias in node.names:
+                    if alias.name in self._CLOCK_NAMES:
+                        flagged_names.add(alias.asname or alias.name)
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "time" and alias.asname:
+                        module_aliases.add(alias.asname)
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            prefix, _, attr = dotted.rpartition(".")
+            via_module = prefix in module_aliases \
+                and attr in self._CLOCK_NAMES
+            bare = isinstance(node.func, ast.Name) \
+                and node.func.id in flagged_names
+            if not via_module and not bare:
+                continue
+            out.append(self._finding(
+                ctx, node,
+                f"raw wall-clock read {dotted or _call_name(node.func)}(): "
+                "route through the subsystem's injectable clock "
+                "(clock=/self._clock) so fake-clock tests stay "
+                "deterministic, or suppress with the reason a real "
+                "wall clock is the point here",
+            ))
+        return out
+
+
 AST_RULES = (
     PrivateJaxImports(),
     UnannotatedContractions(),
@@ -627,6 +693,7 @@ AST_RULES = (
     CollectiveAxisName(),
     SwallowedException(),
     UnguardedCholesky(),
+    RawWallClock(),
 )
 
 

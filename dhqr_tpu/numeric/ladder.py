@@ -59,8 +59,26 @@ from dhqr_tpu.numeric.errors import (
     Breakdown,
     IllConditioned,
     NonFiniteInput,
+    NumericalError,
     ResidualGateFailed,
 )
+from dhqr_tpu.obs import trace as _obs
+from dhqr_tpu.utils.profiling import Counters
+
+#: Process-wide guardrail accounting, exported by the metrics registry
+#: as ``numeric.*`` (``dhqr_tpu.obs.metrics``): ``guarded_calls``
+#: (entries into a guarded_* call), ``screen_rejects`` (typed refusals
+#: at the input screen), ``fallbacks`` (ladder rungs that FAILED —
+#: breakdown/inapplicable/residual-gate — whether or not a later rung
+#: recovered; a structural ``zero_pivot`` rung is NOT a fallback, the
+#: call refuses instead of escalating), ``recovered`` (guarded calls
+#: that escalated and still answered), ``exhausted`` (post-screen
+#: typed refusals: the ladder ran dry, or a structural rank
+#: deficiency — an exactly-zero R pivot — that no rung could ever
+#: answer, so escalation was never attempted). Always-on like every
+#: other subsystem's Counters — the registry view must not depend on
+#: tracing being armed.
+COUNTERS = Counters()
 
 #: Escalation order per starting engine: strictly toward stability
 #: (each step trades GEMM throughput for conditioning headroom).
@@ -113,6 +131,10 @@ class GuardedResult:
     attempts: "tuple[Attempt, ...]"
     residual_ratio: "float | None" = None
     cond_estimate: "float | None" = None
+    # Round 14: the obs trace id of this guarded call (None when tracing
+    # was disarmed) — ``dhqr_tpu.obs.flight_dump(result.trace_id)``
+    # replays the screen/rung path the attempts tuple summarizes.
+    trace_id: "int | None" = None
 
     @property
     def x(self):
@@ -128,6 +150,67 @@ class GuardedResult:
     def escalations(self) -> int:
         """How many rungs failed before the one that answered."""
         return len(self.attempts) - 1
+
+
+def _trace_guarded(kind: str, engine: str, mode: str, shape):
+    """Mint a call-scoped trace id for a guarded entry point and record
+    its admission span. Returns ``(recorder, trace_id)`` — None/None
+    when tracing is disarmed (the one global-read check the cold path
+    pays; every helper below no-ops on recorder None)."""
+    rec = _obs.active()
+    if rec is None:
+        return None, None
+    tid = rec.mint()
+    rec.event(tid, "submit", kind=kind, engine=engine, mode=mode,
+              m=int(shape[0]), n=int(shape[1]))
+    return rec, tid
+
+
+def _trace_rung(rec, tid, att: Attempt) -> None:
+    """One ladder rung as a span (recorded in real time, where the rung
+    ran — the GuardedResult's attempts tuple is the summary, this is
+    the timeline)."""
+    if rec is None:
+        return
+    attrs = {"engine": att.engine, "policy": att.policy,
+             "outcome": att.outcome}
+    if att.detail:
+        attrs["detail"] = att.detail[:200]
+    if att.residual_ratio is not None:
+        attrs["residual_ratio"] = round(att.residual_ratio, 4)
+    rec.event(tid, "rung", **attrs)
+
+
+def _trace_refusal(rec, tid, exc: BaseException) -> None:
+    """Close a guarded call's path with its typed refusal: the resolve
+    span, the trace id on the error, and the on_error auto-dump hook."""
+    if rec is None:
+        return
+    rec.event(tid, "resolve", outcome=type(exc).__name__,
+              error=str(exc)[:200])
+    rec.on_error(exc, tid)
+
+
+def _attempt_recorder(attempts: list, rec, tid):
+    """The one place a ladder rung is recorded — summary (attempts),
+    accounting (``numeric.fallbacks`` counts the rungs that did not
+    answer, recovered or not), and the real-time rung span. Shared by
+    ``guarded_lstsq`` and ``guarded_qr`` so the counters and the trace
+    can never desynchronize."""
+    def _att(att: Attempt) -> None:
+        attempts.append(att)
+        if att.outcome in ("breakdown", "inapplicable", "residual_gate"):
+            COUNTERS.bump("fallbacks")
+        _trace_rung(rec, tid, att)
+    return _att
+
+
+def _refuse(rec, tid, err: BaseException) -> "BaseException":
+    """The typed-refusal epilogue every dead-end shares: count it,
+    close the trace, hand the error back for ``raise``."""
+    COUNTERS.bump("exhausted")
+    _trace_refusal(rec, tid, err)
+    return err
 
 
 def _mode(cfg) -> str:
@@ -318,13 +401,26 @@ def guarded_lstsq(
 
     from dhqr_tpu.models.qr_model import lstsq as _lstsq
 
-    _screen(A, b, cfg.engine)
+    rec, tid = _trace_guarded("guarded_lstsq", cfg.engine, mode, A.shape)
+    COUNTERS.bump("guarded_calls")
+    try:
+        _screen(A, b, cfg.engine)
+    except NumericalError as e:
+        COUNTERS.bump("screen_rejects")
+        _trace_refusal(rec, tid, e)
+        raise
+    if rec is not None:
+        rec.event(tid, "screen", outcome="ok")
     if mode == "screen":
         x = _lstsq(A, b, config=cfg, mesh=mesh)
         pol_desc = _policy_desc(None, cfg) if cfg.policy is None else \
             str(cfg.policy)
         att = Attempt(cfg.engine, pol_desc, "ok")
-        return GuardedResult(x, cfg.engine, pol_desc, (att,))
+        _trace_rung(rec, tid, att)
+        if rec is not None:
+            rec.event(tid, "resolve", outcome="ok", engine=cfg.engine)
+        return GuardedResult(x, cfg.engine, pol_desc, (att,),
+                             trace_id=tid)
 
     cfg0, pol, plan_active = _resolve_start(A, cfg, mesh)
     probe = mode == "full"
@@ -354,12 +450,13 @@ def guarded_lstsq(
             rungs.append(("householder", ecfg, desc))
 
     attempts: "list[Attempt]" = []
+    _att = _attempt_recorder(attempts, rec, tid)
     for i, (eng, rcfg, desc) in enumerate(rungs):
         try:
             _faults.fire("numeric.breakdown")
         except _faults.FaultInjected:
-            attempts.append(Attempt(eng, desc, "breakdown",
-                                    detail="injected numeric.breakdown"))
+            _att(Attempt(eng, desc, "breakdown",
+                         detail="injected numeric.breakdown"))
             if i == 0 and plan_active:
                 _note_plan_failure(A, mesh, pol)
             continue
@@ -368,11 +465,10 @@ def guarded_lstsq(
         except ValueError as e:
             if i == 0:
                 raise  # the caller's own config error — never masked
-            attempts.append(Attempt(eng, desc, "inapplicable",
-                                    detail=str(e)))
+            _att(Attempt(eng, desc, "inapplicable", detail=str(e)))
             continue
         if _guards.any_nonfinite(x):
-            attempts.append(Attempt(eng, desc, "breakdown"))
+            _att(Attempt(eng, desc, "breakdown"))
             if i == 0 and plan_active:
                 _note_plan_failure(A, mesh, pol)
             continue
@@ -382,15 +478,20 @@ def guarded_lstsq(
             from dhqr_tpu.utils.testing import TOLERANCE_FACTOR
 
             if ratio > TOLERANCE_FACTOR:
-                attempts.append(Attempt(eng, desc, "residual_gate",
-                                        residual_ratio=ratio))
+                _att(Attempt(eng, desc, "residual_gate",
+                             residual_ratio=ratio))
                 if i == 0 and plan_active:
                     _note_plan_failure(A, mesh, pol)
                 continue
-        attempts.append(Attempt(eng, desc, "ok", residual_ratio=ratio))
+        _att(Attempt(eng, desc, "ok", residual_ratio=ratio))
+        if len(attempts) > 1:
+            COUNTERS.bump("recovered")
+        if rec is not None:
+            rec.event(tid, "resolve", outcome="ok", engine=eng,
+                      escalations=len(attempts) - 1)
         return GuardedResult(x, eng, desc, tuple(attempts),
-                             residual_ratio=ratio)
-    raise _classify_exhausted(A, tuple(attempts), probe)
+                             residual_ratio=ratio, trace_id=tid)
+    raise _refuse(rec, tid, _classify_exhausted(A, tuple(attempts), probe))
 
 
 def guarded_qr(
@@ -424,13 +525,26 @@ def guarded_qr(
     from dhqr_tpu.models.qr_model import qr as _qr
     from dhqr_tpu.precision import PRECISION_POLICIES
 
-    _screen(A, None, cfg.engine)
+    rec, tid = _trace_guarded("guarded_qr", cfg.engine, mode, A.shape)
+    COUNTERS.bump("guarded_calls")
+    try:
+        _screen(A, None, cfg.engine)
+    except NumericalError as e:
+        COUNTERS.bump("screen_rejects")
+        _trace_refusal(rec, tid, e)
+        raise
+    if rec is not None:
+        rec.event(tid, "screen", outcome="ok")
     if mode == "screen":
         fact = _qr(A, config=cfg, mesh=mesh)
         desc = _policy_desc(None, cfg) if cfg.policy is None else \
             str(cfg.policy)
         att = Attempt(cfg.engine, desc, "ok")
-        return GuardedResult(fact, cfg.engine, desc, (att,))
+        _trace_rung(rec, tid, att)
+        if rec is not None:
+            rec.event(tid, "resolve", outcome="ok", engine=cfg.engine)
+        return GuardedResult(fact, cfg.engine, desc, (att,),
+                             trace_id=tid)
 
     rungs: "list[tuple[object, str]]" = [(cfg, "caller")]
     defaults = DHQRConfig()
@@ -457,41 +571,48 @@ def guarded_qr(
         rungs.append((acc, "accurate"))
 
     attempts: "list[Attempt]" = []
+    _att = _attempt_recorder(attempts, rec, tid)
     for i, (rcfg, desc) in enumerate(rungs):
         try:
             _faults.fire("numeric.breakdown")
         except _faults.FaultInjected:
-            attempts.append(Attempt("householder", desc, "breakdown",
-                                    detail="injected numeric.breakdown"))
+            _att(Attempt("householder", desc, "breakdown",
+                         detail="injected numeric.breakdown"))
             continue
         fact = _qr(A, config=rcfg, mesh=mesh)  # config errors propagate
         if _guards.any_nonfinite(fact.H, fact.alpha):
-            attempts.append(Attempt("householder", desc, "breakdown"))
+            _att(Attempt("householder", desc, "breakdown"))
             continue
         if bool(jnp.any(jnp.abs(fact.alpha) == 0)):
             # Record the rung that OBSERVED the zero pivot — the
             # attempts contract is "what was tried before the refusal".
-            attempts.append(Attempt("householder", desc, "zero_pivot"))
-            raise IllConditioned(
+            _att(Attempt("householder", desc, "zero_pivot"))
+            raise _refuse(rec, tid, IllConditioned(
                 "R has an exactly-zero diagonal entry (rank-deficient "
                 "to working precision); solves from this factorization "
                 "would divide by zero",
                 engine="householder", cond_estimate=float("inf"),
-                attempts=tuple(attempts))
-        attempts.append(Attempt("householder", desc, "ok"))
+                attempts=tuple(attempts)))
+        _att(Attempt("householder", desc, "ok"))
+        if len(attempts) > 1:
+            COUNTERS.bump("recovered")
+        if rec is not None:
+            rec.event(tid, "resolve", outcome="ok", engine="householder",
+                      escalations=len(attempts) - 1)
         cond = (_guards.diag_condition_bound(fact.alpha)
                 if mode == "full" else None)
         return GuardedResult(fact, "householder", desc, tuple(attempts),
-                             cond_estimate=cond)
-    raise Breakdown(
+                             cond_estimate=cond, trace_id=tid)
+    raise _refuse(rec, tid, Breakdown(
         f"householder factorization broke down on every rung "
         f"({len(attempts)} tried) — a finite input should never do "
         "this; suspect hardware or an injected fault left armed",
-        engine="householder", attempts=tuple(attempts))
+        engine="householder", attempts=tuple(attempts)))
 
 
 __all__ = [
     "Attempt",
+    "COUNTERS",
     "ENGINE_LADDER",
     "GUARD_MODES",
     "GuardedResult",

@@ -1,0 +1,98 @@
+"""dhqr-obs — request-scoped tracing, unified metrics, flight recorder.
+
+Round 14's observability layer over the serving stack: the evidence
+layer that turns "a future resolved ``DeadlineExceeded``" from a
+counter increment into a reconstructable story.
+
+    >>> from dhqr_tpu import obs
+    >>> from dhqr_tpu.utils.config import ObsConfig
+    >>> obs.arm(ObsConfig(enabled=True))        # or DHQR_OBS=1 + obs.arm()
+    >>> fut = sched.submit("lstsq", A, b)       # fut.trace_id is minted
+    >>> try:
+    ...     fut.result()
+    ... except dhqr_tpu.ServeError as e:
+    ...     print(obs.recorder.format_dump(obs.flight_dump(e.trace_id)))
+    trace 17: ...
+      +0.000s submit      kind=lstsq bucket=64x16:float32 tenant=acme ...
+      +0.021s flush       reason=deadline wait_s=0.021 batch=4
+      +0.023s dispatch    key=lstsq:4x64x16 ...
+      +0.024s retry       attempt=1 backoff_s=0.01 cause=DispatchFailed
+      ...
+      +0.141s resolve     outcome=DispatchFailed
+
+    >>> obs.registry().snapshot()["serve.cache.hits"]   # unified metrics
+    >>> obs.registry().export_prometheus()              # scrape format
+
+Three pieces (each its own module):
+
+* ``obs.trace`` — trace ids minted at admission and threaded through
+  queue → coalesce → flush → retry/bisect → dispatch → resolve (and
+  the sync ``batched_*`` / ``guarded_*`` paths), spans recorded on an
+  injectable clock into a bounded ring buffer. Trace ids stay OUT of
+  cache keys and compiled programs: warm paths are zero-recompile with
+  tracing armed (key-parity pinned by tests/test_obs.py).
+* ``obs.metrics`` — :class:`MetricsRegistry`: the four historical
+  ``stats()`` surfaces (scheduler, cache, faults, tune plan gate) plus
+  the numeric ladder under stable dotted names, with JSONL and
+  Prometheus-text exporters. The old dict shapes remain as thin views
+  over the same counters.
+* ``obs.recorder`` — the flight recorder: typed errors carry their
+  trace id(s); :func:`flight_dump` / ``python -m dhqr_tpu.obs dump``
+  reconstruct the request's full span path, and the ``on_error`` hook
+  (``ObsConfig.auto_dump``) persists it the moment the error resolves.
+
+Armed behind :class:`~dhqr_tpu.utils.config.ObsConfig` / ``DHQR_OBS``
+with the faults-harness discipline: zero overhead disarmed (one
+module-global None check), deterministic under injected clocks. See
+docs/DESIGN.md "Observability" and docs/OPERATIONS.md "Reading a
+flight-recorder dump after a typed error".
+"""
+
+from __future__ import annotations
+
+from dhqr_tpu.obs import recorder
+from dhqr_tpu.obs.metrics import MetricsRegistry, registry, reset_registry
+from dhqr_tpu.obs.trace import (
+    Span,
+    TraceRecorder,
+    active,
+    arm,
+    disarm,
+    event,
+    mint,
+    observed,
+)
+from dhqr_tpu.utils.config import ObsConfig
+
+
+def flight_dump(trace_id: int) -> dict:
+    """The armed recorder's flight dump for one trace id (empty span
+    list when disarmed — the dump API never raises on a cold stack)."""
+    armed = active()
+    if armed is None:
+        return {"trace_id": trace_id, "spans": []}
+    return armed.dump(trace_id)
+
+
+def flight_dump_error(exc: BaseException) -> "list[dict]":
+    """Flight dumps for every trace id a typed error carries."""
+    return recorder.dump_error(exc)
+
+
+__all__ = [
+    "MetricsRegistry",
+    "ObsConfig",
+    "Span",
+    "TraceRecorder",
+    "active",
+    "arm",
+    "disarm",
+    "event",
+    "flight_dump",
+    "flight_dump_error",
+    "mint",
+    "observed",
+    "recorder",
+    "registry",
+    "reset_registry",
+]

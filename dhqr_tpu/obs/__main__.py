@@ -1,0 +1,78 @@
+"""``python -m dhqr_tpu.obs dump [FILE ...] [--trace-id N] [--json]``
+
+Render flight-recorder dump files (the JSONL the ``on_error`` hook
+writes when ``ObsConfig.auto_dump`` names a directory — see
+docs/OPERATIONS.md "Reading a flight-recorder dump after a typed
+error"). With no FILE, every ``flight_*.jsonl`` under ``DHQR_OBS_DUMP``
+(when it names a directory) is rendered, newest first.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+from dhqr_tpu.obs.recorder import format_dump, read_dump_file
+
+
+def _default_files() -> "list[str]":
+    dest = os.environ.get("DHQR_OBS_DUMP", "").strip()
+    if not dest or dest == "stderr" or not os.path.isdir(dest):
+        return []
+    files = glob.glob(os.path.join(dest, "flight_*.jsonl"))
+    return sorted(files, key=os.path.getmtime, reverse=True)
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m dhqr_tpu.obs",
+        description="Flight-recorder dump tools (dhqr-obs).")
+    sub = parser.add_subparsers(dest="command")
+    dump = sub.add_parser(
+        "dump", help="render flight dump files as span paths")
+    dump.add_argument("files", nargs="*", metavar="FILE",
+                      help="flight JSONL file(s); default: every "
+                      "flight_*.jsonl under $DHQR_OBS_DUMP")
+    dump.add_argument("--trace-id", type=int, default=None,
+                      help="only this trace id")
+    dump.add_argument("--json", action="store_true",
+                      help="raw JSON records instead of formatted paths")
+    args = parser.parse_args(argv)
+    if args.command != "dump":
+        parser.error("a command is required (dump)")
+
+    files = args.files or _default_files()
+    if not files:
+        print("no dump files given and none found under DHQR_OBS_DUMP",
+              file=sys.stderr)
+        return 2
+    shown = 0
+    for path in files:
+        try:
+            records = read_dump_file(path)
+        except OSError as e:
+            print(f"cannot read {path}: {e}", file=sys.stderr)
+            return 2
+        for rec in records:
+            if args.trace_id is not None \
+                    and rec.get("trace_id") != args.trace_id:
+                continue
+            shown += 1
+            if args.json:
+                print(json.dumps(rec))
+            else:
+                print(format_dump(rec))
+                print()
+    if not shown:
+        which = f"trace id {args.trace_id}" if args.trace_id is not None \
+            else "records"
+        print(f"no {which} found in {len(files)} file(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

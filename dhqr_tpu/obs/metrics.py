@@ -1,0 +1,288 @@
+"""MetricsRegistry — one process-wide registry of dotted-name metrics.
+
+Before round 14 the stack's operational numbers lived in four private
+``stats()`` dicts — the async scheduler's, the executable cache's, the
+fault harness's, and tune's ``plan_gate_stats()`` — each with its own
+spelling and no way to read "the process" in one snapshot. This module
+unifies them under stable dotted names (``serve.cache.hits``,
+``serve.sched.retries``, ``numeric.fallbacks``, ``faults.fired``,
+``tune.plan_gate.failures``, ``obs.spans``, ...) while the old dict
+shapes stay as thin compatibility views over the SAME counters (the
+subsystems still own their
+:class:`~dhqr_tpu.utils.profiling.Counters` /
+:class:`~dhqr_tpu.utils.profiling.Ewma` /
+:class:`~dhqr_tpu.utils.profiling.LatencyHistogram` instances — the
+registry references, never copies, so there is exactly one set of
+numbers).
+
+Sources come in two kinds:
+
+* **instances** (``register(prefix, obj)`` with an object exposing
+  ``metrics_snapshot() -> dict[str, number]``) are held by WEAK
+  reference: every :class:`~dhqr_tpu.serve.cache.ExecutableCache` and
+  :class:`~dhqr_tpu.serve.AsyncScheduler` self-registers at
+  construction, test instances evaporate with garbage collection, and
+  two live schedulers SUM under one name (process telemetry, not
+  per-object bookkeeping);
+* **providers** (``register(prefix, callable)``) are held strongly and
+  consulted at snapshot time — the default registry wires lazy
+  providers for the fault harness (whatever
+  :func:`dhqr_tpu.faults.harness.active` currently is), tune's plan
+  gate, the numeric ladder's counters, and the armed trace recorder,
+  so those modules never import obs (no cycle) and pay nothing until a
+  snapshot is taken.
+
+Exporters: :meth:`MetricsRegistry.export_jsonl` appends one
+timestamped JSON object per call (the benchmark/bench-summary
+stamping format) and :meth:`MetricsRegistry.export_prometheus` renders
+the Prometheus text exposition format (``dhqr_serve_cache_hits 42``).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+import weakref
+from typing import Callable, Union
+
+Number = Union[int, float]
+
+
+def _flatten(prefix: str, values: dict) -> "dict[str, float]":
+    """``{"hits": 3}`` under ``"serve.cache"`` -> ``{"serve.cache.hits":
+    3.0}``; nested dicts flatten recursively; non-numeric values are
+    dropped (a snapshot is numbers, not prose)."""
+    out: "dict[str, float]" = {}
+    for key, val in values.items():
+        name = f"{prefix}.{key}" if prefix else str(key)
+        if isinstance(val, dict):
+            out.update(_flatten(name, val))
+        elif isinstance(val, bool):
+            out[name] = float(val)
+        elif isinstance(val, (int, float)):
+            out[name] = float(val)
+    return out
+
+
+class MetricsRegistry:
+    """Dotted-name metric aggregation over weakly-held instances and
+    strongly-held provider callables (module docstring has the model).
+
+    Thread-safe; snapshots are merge-SUMMED per name across sources so
+    concurrent subsystems (two schedulers, N caches) read as one
+    process. A source whose snapshot raises is skipped for that
+    snapshot (telemetry must never take the serving path down with
+    it).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # prefix -> list of (weakref-to-instance | callable)
+        self._sources: "dict[str, list]" = {}
+
+    def register(self, prefix: str,
+                 source: "object | Callable[[], dict]") -> None:
+        """Attach a source under ``prefix``. Instances (anything with a
+        ``metrics_snapshot()`` method) are weakly referenced; bare
+        callables returning a flat dict are held strongly."""
+        if not prefix or not all(
+                part for part in prefix.split(".")):
+            raise ValueError(f"prefix must be dotted words, got {prefix!r}")
+        entry = source if callable(source) and not hasattr(
+            source, "metrics_snapshot") else weakref.ref(source)
+        with self._lock:
+            self._sources.setdefault(prefix, []).append(entry)
+
+    def unregister(self, prefix: str) -> None:
+        """Drop every source under ``prefix`` (tests)."""
+        with self._lock:
+            self._sources.pop(prefix, None)
+
+    def _live_sources(self) -> "list[tuple[str, Callable[[], dict]]]":
+        out = []
+        with self._lock:
+            for prefix, entries in list(self._sources.items()):
+                kept = []
+                for entry in entries:
+                    if isinstance(entry, weakref.ref):
+                        obj = entry()
+                        if obj is None:
+                            continue  # instance was garbage-collected
+                        kept.append(entry)
+                        out.append((prefix, obj.metrics_snapshot))
+                    else:
+                        kept.append(entry)
+                        out.append((prefix, entry))
+                if kept:
+                    self._sources[prefix] = kept
+                else:
+                    del self._sources[prefix]
+        return out
+
+    #: Metric-name suffixes that are NOT additive across instances:
+    #: config bounds and latency summaries. Two live schedulers' p99s
+    #: do not add — summing would stamp a latency no request saw into
+    #: the bench summary — so these merge by MAX (the conservative
+    #: worst-instance reading, which is what an SLO check wants).
+    #: Everything else (counters, occupancy, queue depth) sums.
+    _MAX_MERGED_SUFFIXES = ("max_size", "capacity", "demote_after",
+                            "p50_ms", "p99_ms", "mean_ms")
+
+    def snapshot(self) -> "dict[str, float]":
+        """One consistent-per-source cut of every registered metric,
+        dotted names, merged across same-prefix sources — counters sum,
+        the non-additive gauges named in :data:`_MAX_MERGED_SUFFIXES`
+        take the max. (Consistency is per SOURCE — each subsystem's
+        snapshot is taken under its own lock — not global: a
+        registry-wide stop-the-world would stall the serving path for
+        telemetry.)"""
+        merged: "dict[str, float]" = {}
+        for prefix, fn in self._live_sources():
+            try:
+                values = fn()
+            except Exception:
+                continue  # dhqr: ignore[DHQR006] telemetry-only path: a
+                # source mid-teardown (GC race, shut-down scheduler) must
+                # not fail an unrelated snapshot; its numbers just skip
+            for name, val in _flatten(prefix, values).items():
+                if name.rsplit(".", 1)[-1] in self._MAX_MERGED_SUFFIXES:
+                    merged[name] = max(merged.get(name, val), val)
+                else:
+                    merged[name] = merged.get(name, 0.0) + val
+        return dict(sorted(merged.items()))
+
+    # ------------------------------------------------------------ exporters
+
+    def export_jsonl(self, path: str, clock=time.time,
+                     **extra) -> dict:
+        """Append one ``{"ts": ..., "metrics": {...}}`` JSON line to
+        ``path`` and return the record. ``clock`` is injectable so
+        tests (and fake-clock benchmarks) stamp deterministically;
+        ``extra`` keys ride at the top level (phase names, run ids)."""
+        record = dict(extra)
+        record["ts"] = round(float(clock()), 3)
+        record["metrics"] = self.snapshot()
+        with open(path, "a", encoding="utf-8") as fh:
+            fh.write(json.dumps(record) + "\n")
+        return record
+
+    def export_prometheus(self, namespace: str = "dhqr") -> str:
+        """The Prometheus text exposition format: one ``# TYPE``-tagged
+        gauge per metric, dots/invalid chars folded to underscores.
+        (Gauge, not counter, uniformly: the registry also carries
+        occupancy/percentile values, and a scraper treats a
+        monotonically increasing gauge correctly.)"""
+        lines = []
+        for name, value in self.snapshot().items():
+            metric = re.sub(r"[^a-zA-Z0-9_]", "_", f"{namespace}_{name}")
+            lines.append(f"# TYPE {metric} gauge")
+            if value == int(value):
+                lines.append(f"{metric} {int(value)}")
+            else:
+                lines.append(f"{metric} {value}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+# --------------------------------------------------------------------------
+# The process-default registry + the lazy default providers.
+
+def _faults_provider() -> dict:
+    """``faults.fired`` / ``faults.visits`` totals + per-site counts of
+    WHATEVER harness is currently armed (nothing armed = no rows)."""
+    from dhqr_tpu.faults import harness as _faults
+
+    armed = _faults.active()
+    if armed is None:
+        return {}
+    per_site = armed.stats()
+    out = {
+        "fired": sum(s["fired"] for s in per_site.values()),
+        "visits": sum(s["visits"] for s in per_site.values()),
+    }
+    for site, counts in per_site.items():
+        out[f"fired.{site}"] = counts["fired"]
+        out[f"visits.{site}"] = counts["visits"]
+    return out
+
+
+def _tune_provider() -> dict:
+    """tune's ``plan_gate_stats()`` as registry numbers: total recorded
+    numeric-gate failures, distinct demoted keys, demoted lookups."""
+    from dhqr_tpu.tune.search import PLAN_DEMOTE_AFTER, plan_gate_stats
+
+    stats = plan_gate_stats()
+    failures = stats.get("failures", {})
+    return {
+        "failures": sum(failures.values()),
+        "failing_keys": len(failures),
+        "demoted_keys": sum(1 for v in failures.values()
+                            if v >= PLAN_DEMOTE_AFTER),
+        "demoted_lookups": stats.get("demoted_lookups", 0),
+        "demote_after": stats.get("demote_after", PLAN_DEMOTE_AFTER),
+    }
+
+
+def _numeric_provider() -> dict:
+    """The numeric ladder's module counters (``numeric.fallbacks`` et
+    al. — see ``dhqr_tpu.numeric.ladder.COUNTERS``). The known names
+    are emitted as zeros before the first bump so the series exist in
+    every snapshot (scrapers want stable series, not ones that appear
+    mid-run)."""
+    from dhqr_tpu.numeric.ladder import COUNTERS
+
+    out: dict = {name: 0 for name in (
+        "guarded_calls", "screen_rejects", "fallbacks", "recovered",
+        "exhausted")}
+    out.update(COUNTERS.snapshot())
+    return out
+
+
+def _obs_provider() -> dict:
+    """The armed trace recorder's own accounting (minted/spans/dropped),
+    empty when tracing is disarmed."""
+    from dhqr_tpu.obs import trace as _trace
+
+    recorder = _trace.active()
+    if recorder is None:
+        return {}
+    return recorder.stats()
+
+
+_REGISTRY: "MetricsRegistry | None" = None
+_REGISTRY_LOCK = threading.Lock()
+
+
+def _new_default_registry() -> MetricsRegistry:
+    reg = MetricsRegistry()
+    reg.register("faults", _faults_provider)
+    reg.register("tune.plan_gate", _tune_provider)
+    reg.register("numeric", _numeric_provider)
+    reg.register("obs", _obs_provider)
+    # serve.cache.* / serve.sched.* have no lazy provider: every
+    # ExecutableCache and AsyncScheduler instance self-registers at
+    # construction (weakly — test instances evaporate with GC).
+    return reg
+
+
+def registry() -> MetricsRegistry:
+    """The process-default registry (created on first use, with the
+    default providers wired)."""
+    global _REGISTRY
+    if _REGISTRY is None:
+        with _REGISTRY_LOCK:
+            if _REGISTRY is None:
+                _REGISTRY = _new_default_registry()
+    return _REGISTRY
+
+
+def reset_registry() -> MetricsRegistry:
+    """Replace the process-default registry with a fresh one (tests —
+    instance sources registered by long-gone schedulers/caches are
+    weakly held anyway, but a reset makes isolation exact). Returns
+    the new registry."""
+    global _REGISTRY
+    with _REGISTRY_LOCK:
+        _REGISTRY = _new_default_registry()
+    return _REGISTRY

@@ -118,6 +118,14 @@ class Bucket:
     n: int
     dtype: str
 
+    @property
+    def label(self) -> str:
+        """The one display spelling (``"192x64:float32"``) shared by the
+        scheduler's ``bucket_ewma_ms`` keys, the obs spans' ``bucket``
+        attribute, and the dump/runbook prose — span-to-ewma correlation
+        depends on every surface printing buckets identically."""
+        return f"{self.m}x{self.n}:{self.dtype}"
+
 
 def plan_bucket(m: int, n: int, dtype,
                 config: "ServeConfig | None" = None) -> Bucket:

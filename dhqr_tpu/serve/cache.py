@@ -43,6 +43,9 @@ from typing import Callable, NamedTuple
 # imports serve (the harness is deliberately dependency-free); keep it
 # that way when touching faults/__init__.py.
 from dhqr_tpu.faults import harness as _faults
+# obs.metrics only reads utils/* (providers import their subjects
+# lazily), so this import stays acyclic like the faults one above.
+from dhqr_tpu.obs import metrics as _obs_metrics
 from dhqr_tpu.serve.errors import CompileFailed, Quarantined
 from dhqr_tpu.utils.config import ServeConfig
 from dhqr_tpu.utils.profiling import Counters, PhaseTimer
@@ -111,6 +114,10 @@ class ExecutableCache:
         # and concurrent compiles of different keys would contend on
         # XLA's own compilation locks anyway.
         self._lock = threading.RLock()
+        # Unified metrics (round 14): every cache's numbers roll up
+        # under serve.cache.* dotted names. Weakly held — a test-scoped
+        # cache leaves the registry with garbage collection.
+        _obs_metrics.registry().register("serve.cache", self)
 
     def __len__(self) -> int:
         with self._lock:
@@ -169,7 +176,9 @@ class ExecutableCache:
     def stats(self) -> dict:
         """Counter snapshot + occupancy, JSON-ready (the benchmark
         artifact, the dry run and the async scheduler's stats endpoint
-        embed this verbatim).
+        embed this verbatim). Since round 14 this is a thin
+        compatibility view over :meth:`metrics_snapshot` — the same
+        names the metrics registry exports as ``serve.cache.*``.
 
         The whole snapshot is taken under ONE acquisition of the cache
         lock — counters and occupancy are a single consistent cut, so
@@ -178,6 +187,12 @@ class ExecutableCache:
         a concurrent reader takes, never just in quiescence
         (tests/test_serve.py pins this under a writer storm).
         """
+        return self.metrics_snapshot()
+
+    def metrics_snapshot(self) -> dict:
+        """The registry-facing snapshot (``serve.cache.<name>`` under
+        the process registry, ``dhqr_tpu.obs.metrics``); identical to
+        :meth:`stats` by construction — one set of numbers."""
         with self._lock:
             snap = self.counters.snapshot()
             now = self._clock()

@@ -32,6 +32,7 @@ panel/trailing go to the factor stage, ``apply`` to the Q^H-apply,
 from __future__ import annotations
 
 import dataclasses
+from contextlib import contextmanager
 from functools import partial
 from typing import List, Optional, Sequence
 
@@ -40,6 +41,7 @@ import jax.numpy as jnp
 
 from dhqr_tpu.faults import harness as _faults
 from dhqr_tpu.numeric import guards as _nguards
+from dhqr_tpu.obs import trace as _obs
 from dhqr_tpu.numeric.errors import Breakdown
 from dhqr_tpu.ops import blocked as _blocked
 from dhqr_tpu.ops import solve as _solve
@@ -408,7 +410,8 @@ def _group_by_bucket(As: Sequence, scfg: ServeConfig):
     return groups
 
 
-def _dispatch_groups(kind, As, bs, cfg, scfg, cache, consume, pol=None):
+def _dispatch_groups(kind, As, bs, cfg, scfg, cache, consume, pol=None,
+                     trace_id=None):
     """The one group -> chunk -> key -> compile -> pad -> dispatch loop
     shared by ``batched_lstsq`` and ``batched_qr`` (a chunking or key
     fix must not have to land twice). ``consume(chunk, key, outs)`` is
@@ -433,6 +436,12 @@ def _dispatch_groups(kind, As, bs, cfg, scfg, cache, consume, pol=None):
     neighbors complete. The check is OUTSIDE the compiled program
     (same cache key, same executable, zero recompiles) and entirely
     skipped when guards are off (the default)."""
+    # Tracing (round 14): ``trace_id`` is the SYNC caller's call-scoped
+    # id (batched_lstsq/batched_qr mint it); the async scheduler passes
+    # None here because it records per-request spans itself. The id is
+    # host-side only — _plan_key/CacheKey never see it, so armed
+    # tracing compiles exactly the disarmed programs.
+    rec = _obs.active() if trace_id is not None else None
     for bucket, idxs in _group_by_bucket(As, scfg).items():
         cfg_b = _resolve_bucket_plan(kind, cfg, bucket, pol)
         for lo in range(0, len(idxs), scfg.max_batch):
@@ -441,7 +450,25 @@ def _dispatch_groups(kind, As, bs, cfg, scfg, cache, consume, pol=None):
                                bucket.dtype, cfg_b, scfg)
             # plan_bucket is idempotent (bucket dims are lattice points),
             # so re-planning from the bucket's own shape returns it.
+            # Span compile attribution: a key already resident is
+            # DEFINITIVELY compile-free (0.0, whatever concurrent
+            # compiles land in the window); only a genuine miss reads
+            # the timer delta, which a concurrent worker's compile of a
+            # DIFFERENT key can still over-attribute — same
+            # shared-timer caveat (and clamp) the scheduler's EWMA
+            # documents. Good enough for the warm-vs-cold split the
+            # per-phase evidence needs; exact per-key attribution would
+            # need the cache to return its own compile time.
+            was_resident = rec is not None and key in cache
+            compile0 = cache.timer.total("aot_compile") \
+                if rec is not None and not was_resident else 0.0
             compiled = cache.get_or_compile(key, partial(_lower_for_key, key))
+            if rec is not None:
+                compile_s = 0.0 if was_resident else max(
+                    cache.timer.total("aot_compile") - compile0, 0.0)
+                rec.event(trace_id, "dispatch", bucket=bucket.label,
+                          batch=key.batch, requests=len(chunk),
+                          compile_s=round(compile_s, 6))
             A_buf, b_buf = pad_group(
                 [(As[i], None if bs is None else bs[i]) for i in chunk],
                 bucket, key.batch)
@@ -467,6 +494,39 @@ def _dispatch_groups(kind, As, bs, cfg, scfg, cache, consume, pol=None):
                         "bisect to isolate it",
                         engine=cfg_b.engine)
             consume(chunk, key, outs)
+
+
+def _trace_sync_call(kind: str, n_requests: int):
+    """Mint a call-scoped trace id for a SYNC batched entry point (the
+    whole list call is one "request" here — it has one caller, one
+    return). Returns ``(recorder, trace_id)``, both None/None when
+    tracing is disarmed — the hot path pays exactly one global read."""
+    rec = _obs.active()
+    if rec is None:
+        return None, None
+    tid = rec.mint()
+    rec.event(tid, "submit", kind=kind, sync=True, requests=n_requests)
+    return rec, tid
+
+
+@contextmanager
+def _trace_sync_resolve(rec, tid):
+    """Close a sync call's span path: "resolve ok" on normal exit, or a
+    typed-outcome resolve + error trace-id stamping + the on_error
+    auto-dump hook when the dispatch raised (the ServeError /
+    NumericalError contract — "the error carries its trace id" — holds
+    on the sync tier exactly as on futures)."""
+    if rec is None:
+        yield
+        return
+    try:
+        yield
+    except Exception as e:
+        rec.event(tid, "resolve", outcome=type(e).__name__,
+                  error=str(e)[:200])
+        rec.on_error(e, tid)
+        raise
+    rec.event(tid, "resolve", outcome="ok")
 
 
 def batched_lstsq(
@@ -496,9 +556,12 @@ def batched_lstsq(
     cache = cache if cache is not None else default_cache()
     cfg, pol, _ = _resolve_dispatch_cfg("lstsq", config, overrides)
     _validate_requests(As, bs)
+    rec, tid = _trace_sync_call("lstsq", len(As))
     out: "list[jax.Array | None]" = [None] * len(As)
     consume = _scatter_lstsq(As, lambda i, x: out.__setitem__(i, x))
-    _dispatch_groups("lstsq", As, bs, cfg, scfg, cache, consume, pol=pol)
+    with _trace_sync_resolve(rec, tid):
+        _dispatch_groups("lstsq", As, bs, cfg, scfg, cache, consume,
+                         pol=pol, trace_id=tid)
     return out
 
 
@@ -522,10 +585,13 @@ def batched_qr(
     cache = cache if cache is not None else default_cache()
     cfg, pol, qr_solve_args = _resolve_dispatch_cfg("qr", config, overrides)
     _validate_requests(As, None)
+    rec, tid = _trace_sync_call("qr", len(As))
     out: "list | None" = [None] * len(As)
     consume = _scatter_qr(As, lambda i, f: out.__setitem__(i, f),
                           qr_solve_args)
-    _dispatch_groups("qr", As, None, cfg, scfg, cache, consume, pol=pol)
+    with _trace_sync_resolve(rec, tid):
+        _dispatch_groups("qr", As, None, cfg, scfg, cache, consume,
+                         pol=pol, trace_id=tid)
     return out
 
 

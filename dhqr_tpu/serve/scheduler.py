@@ -77,6 +77,8 @@ from typing import Optional
 
 from dhqr_tpu.faults import harness as _faults
 from dhqr_tpu.numeric.errors import NumericalError
+from dhqr_tpu.obs import metrics as _obs_metrics
+from dhqr_tpu.obs import trace as _obs
 from dhqr_tpu.serve import engine as _engine
 from dhqr_tpu.serve.buckets import Bucket, plan_bucket
 from dhqr_tpu.serve.cache import ExecutableCache, default_cache
@@ -128,6 +130,10 @@ class _Pending:
     future: Future
     attempts: int = 0
     claimed: bool = False
+    # Round 14: the obs trace id minted at submit (None when tracing
+    # was disarmed at admission). Host-side request state ONLY — never
+    # part of _plan_key/CacheKey, never traced into a program.
+    trace_id: "int | None" = None
 
 
 class _Group:
@@ -214,6 +220,9 @@ class AsyncScheduler:
         self.latency = LatencyHistogram()
         self._ewma: "dict[Bucket, Ewma]" = {}
         self.keys_seen: set = set()
+        # Unified metrics (round 14): serve.sched.* dotted names on the
+        # process registry; weakly held, so test schedulers leave with GC.
+        _obs_metrics.registry().register("serve.sched", self)
 
         # Dispatcher pool: each worker runs the same select→take→flush
         # loop against the shared lock, so two ready groups flush
@@ -297,16 +306,31 @@ class AsyncScheduler:
 
         now = self._clock()
         fut: Future = Future()
+        # Trace admission (round 14): the id is minted HERE — the
+        # recorder read is the one None check the disarmed path pays —
+        # and rides the future (fut.trace_id), the queue entry, and any
+        # typed error this request ever resolves with.
+        rec = _obs.active()
+        tid = rec.mint() if rec is not None else None
+        if tid is not None:
+            fut.trace_id = tid
+        est = None
         with self._lock:
             if self._closed:
                 raise RuntimeError("scheduler is shut down")
             if self._depth >= self._kcfg.queue_depth:
                 self.counters.bump("rejected")
                 retry = self._retry_after_locked()
-                raise BackpressureError(
+                err = BackpressureError(
                     f"admission queue full ({self._depth} >= "
                     f"{self._kcfg.queue_depth}); retry in ~{retry:.3f}s",
                     retry_after=retry)
+                if rec is not None:
+                    rec.attach(err, tid)
+                    rec.event(tid, "reject", t=now, cause="queue_full",
+                              retry_after=round(retry, 6),
+                              depth=self._depth)
+                raise err
             # Admission-priced deadline (ROADMAP item 1 remainder): if
             # the queue's expected drain time — batches ahead of this
             # request x the bucket's measured EWMA dispatch latency —
@@ -320,18 +344,39 @@ class AsyncScheduler:
                 self.counters.bump("rejected_unmeetable")
                 retry = max(self._kcfg.flush_interval_ms / 1e3,
                             est - deadline)
-                raise BackpressureError(
+                err = BackpressureError(
                     f"deadline {deadline:.3f}s cannot be met at the "
                     f"current queue (expected wait ~{est:.3f}s); retry "
                     f"in ~{retry:.3f}s", retry_after=retry)
+                if rec is not None:
+                    rec.attach(err, tid)
+                    rec.event(tid, "reject", t=now, cause="unmeetable",
+                              est_s=round(est, 6),
+                              retry_after=round(retry, 6))
+                raise err
             gkey = (kind, bucket, cfg, qr_solve_args)
             group = self._groups.get(gkey)
             if group is None:
                 group = self._groups[gkey] = _Group(
                     kind, bucket, cfg, pol, qr_solve_args)
             self._seq += 1
+            # The submit span is recorded BEFORE the queue entry becomes
+            # visible (append + notify): with live dispatcher workers, a
+            # flush can otherwise race ahead of the admission span and
+            # the flight dump would open mid-path instead of at
+            # "submit" (the first-span contract the benchmark and the
+            # runbook rely on). The recorder lock is a leaf — same
+            # ordering the reject events above already use.
+            if rec is not None:
+                attrs = {"kind": kind, "bucket": bucket.label,
+                         "tenant": tenant, "deadline_s": round(deadline, 6),
+                         "depth": self._depth + 1}
+                if est is not None:   # the admission price, when measured
+                    attrs["est_s"] = round(est, 6)
+                rec.event(tid, "submit", t=now, **attrs)
             group.queue.append(_Pending(
-                self._seq, A, b, tenant, now, now + deadline, fut))
+                self._seq, A, b, tenant, now, now + deadline, fut,
+                trace_id=tid))
             self._depth += 1
             self.counters.bump("submitted")
             self._work.notify()
@@ -481,6 +526,23 @@ class AsyncScheduler:
 
     # ------------------------------------------------------------- dispatch
 
+    def _span_batch(self, requests, name: str, t: "float | None" = None,
+                    per=None, **attrs) -> None:
+        """Record one span per request — THE one spelling of the
+        fetch-recorder/None-check/loop block every batch-level hop uses
+        (a hop recorded through any other path risks drifting out of
+        the complete-path invariant the benchmark pins). ``per(p, now)``
+        supplies per-request attributes; ``attrs`` may include a
+        ``batch`` span attribute (hence the positional's name);
+        disarmed cost is the single recorder read."""
+        rec = _obs.active()
+        if rec is None:
+            return
+        now = self._clock() if t is None else t
+        for p in requests:
+            extra = per(p, now) if per is not None else {}
+            rec.event(p.trace_id, name, t=now, **attrs, **extra)
+
     def _flush(self, group: _Group, taken: "list[_Pending]",
                reason: str) -> None:
         """Dispatch one popped micro-batch through the engine's shared
@@ -506,6 +568,9 @@ class AsyncScheduler:
         if not live:
             return
         self.counters.bump(f"flush_{reason}")
+        self._span_batch(
+            live, "flush", reason=reason, batch=len(live),
+            per=lambda p, now: {"wait_s": round(now - p.submitted_at, 6)})
         try:
             self._dispatch_batch(group, live)
         except Exception as e:
@@ -522,6 +587,8 @@ class AsyncScheduler:
         classified it) on failure WITHOUT touching the futures — the
         caller decides between retry, bisect and typed failure."""
         self.counters.bump("dispatches")
+        self._span_batch(batch, "dispatch", bucket=group.bucket.label,
+                         batch=len(batch))
         As = [p.A for p in batch]
         resolved: "list[tuple[int, object]]" = []
         raw_outs: "list[object]" = []
@@ -587,6 +654,13 @@ class AsyncScheduler:
                 seconds / max(1, chunks))
             self._crash_streak = 0  # dispatching again: crash storm over
         done = self._clock()
+        # Warm dispatch seconds vs AOT compile seconds split per
+        # request — the per-phase evidence the ROADMAP's TPU
+        # re-laddering needs (EWMA-free: these are THIS flush's
+        # measurements, not a smoothed estimate).
+        self._span_batch(batch, "dispatch_ok", t=done,
+                         seconds=round(seconds, 6),
+                         compile_s=round(compile_s, 6), chunks=chunks)
         for p, val in zip(batch, out):
             self._resolve_success(p, val, done)
 
@@ -595,6 +669,8 @@ class AsyncScheduler:
         if done > p.deadline_at:
             self.counters.bump("deadline_misses")
         self.counters.bump("completed")
+        _obs.event(p.trace_id, "resolve", t=done, outcome="ok",
+                   e2e_s=round(done - p.submitted_at, 6))
         p.future.set_result(val)
 
     def _resolve_completed_chunks(self, batch: "list[_Pending]",
@@ -633,6 +709,14 @@ class AsyncScheduler:
 
     def _fail(self, p: _Pending, err: RuntimeError) -> None:
         self.counters.bump("failed")
+        rec = _obs.active()
+        if rec is not None:
+            # The typed error carries its request's trace id(s), the
+            # resolve span closes the path, and the on_error hook dumps
+            # it while the spans are still resident (ObsConfig.auto_dump).
+            rec.event(p.trace_id, "resolve", t=self._clock(),
+                      outcome=type(err).__name__, error=str(err)[:200])
+            rec.on_error(err, p.trace_id)
         p.future.set_exception(err)
 
     def _requeue(self, group: _Group, batch: "list[_Pending]",
@@ -704,6 +788,14 @@ class AsyncScheduler:
                     self._fail(p, err)
             if can_wait:
                 self.counters.bump("retries")
+                # Distinct vocabulary from the budgeted-retry span:
+                # ``cooldown_s``, no ``attempt`` — the quarantine wait
+                # spends no retry budget, and overloading the retry
+                # span's fields with different semantics would corrupt
+                # the runbook's reading of both.
+                self._span_batch(can_wait, "retry", t=now,
+                                 cause="Quarantined",
+                                 cooldown_s=round(wait, 6))
                 self._requeue(group, can_wait, now + wait)
             return
         if isinstance(err, NumericalError):
@@ -717,6 +809,8 @@ class AsyncScheduler:
             # the innocent batchmates) until the poison request fails
             # alone with the typed NumericalError.
             self.counters.bump("numeric_failures")
+            self._span_batch(alive, "numeric_isolate", t=now,
+                             cause=type(err).__name__, batch=len(alive))
             if len(alive) == 1:
                 self.counters.bump("poisoned")
                 self._fail(alive[0], err)
@@ -748,6 +842,15 @@ class AsyncScheduler:
             # fresh rider is not over-delayed by an older one's longer
             # window (the older simply rides the earlier flush).
             soonest = min(base * (2 ** (p.attempts - 1)) for p in can_wait)
+            # The span records the ACTUAL wait (the group horizon) —
+            # every rider re-flushes together at now+soonest, and a
+            # per-request nominal backoff here would overstate the
+            # delay for all but the freshest rider. ``attempt`` is the
+            # failed flushes this request has ridden, THIS one included.
+            self._span_batch(can_wait, "retry", t=now,
+                             cause=type(err).__name__,
+                             backoff_s=round(soonest, 6),
+                             per=lambda p, _: {"attempt": p.attempts})
             self._requeue(group, can_wait, now + soonest)
         if escalate:
             self._isolate_now(group, escalate, err)
@@ -761,6 +864,8 @@ class AsyncScheduler:
         immediate re-dispatch (failing typed only if it fails again,
         alone): without it a singleton hit by a one-off transient would
         be denied exactly the attempt a bisection half gets."""
+        self._span_batch(batch, "isolate", cause=type(err).__name__,
+                         batch=len(batch))
         if len(batch) > 1:
             self._bisect(group, batch)
         else:
@@ -768,6 +873,7 @@ class AsyncScheduler:
 
     def _bisect(self, group: _Group, batch: "list[_Pending]") -> None:
         self.counters.bump("bisections")
+        self._span_batch(batch, "bisect", size=len(batch))
         mid = len(batch) // 2
         self._dispatch_or_isolate(group, batch[:mid])
         self._dispatch_or_isolate(group, batch[mid:])
@@ -975,13 +1081,14 @@ class AsyncScheduler:
                 with self._lock:
                     self._draining = False
             return
+        # dhqr: ignore[DHQR008] drain's timeout bounds a REAL hang (wedged dispatch); it must keep ticking even under an injected scheduler clock
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._lock:
             self._draining = True
             self._work.notify()
             while self._depth or self._inflight:
-                left = None if deadline is None \
-                    else deadline - time.monotonic()
+                left = None if deadline is None else \
+                    deadline - time.monotonic()  # dhqr: ignore[DHQR008] same wall-clock hang bound as above
                 if left is not None and left <= 0:
                     self._draining = False
                     raise TimeoutError(
@@ -1036,44 +1143,63 @@ class AsyncScheduler:
         with self._lock:
             return self._depth
 
-    def stats(self) -> dict:
-        """JSON-ready operational snapshot: admission/flush counters,
-        queue depth, latency percentiles, per-bucket EWMA dispatch
-        seconds, and the executable cache's own stats."""
+    #: The scheduler counters the registry exports (``serve.sched.<name>``)
+    #: and stats() mirrors — ONE spelling for both surfaces.
+    _METRIC_COUNTERS = (
+        "submitted", "completed", "failed", "rejected",
+        "rejected_unmeetable", "cancelled", "deadline_misses",
+        "dispatches", "flush_failures", "retries", "bisections",
+        "numeric_failures", "poisoned", "worker_crashes",
+    )
+
+    def metrics_snapshot(self) -> dict:
+        """The registry-facing flat snapshot: every counter above plus
+        queue occupancy, flush reasons (``flush.<reason>``), and the
+        latency histogram's summary (``latency.p99_ms``...). Exported
+        process-wide as ``serve.sched.*`` by ``dhqr_tpu.obs.metrics``;
+        :meth:`stats` reshapes the same numbers for the round-11
+        callers."""
         snap = self.counters.snapshot()
         with self._lock:
             depth, inflight = self._depth, self._inflight
+        out: dict = {name: int(snap.get(name, 0))
+                     for name in self._METRIC_COUNTERS}
+        out["queue_depth"] = depth
+        out["inflight"] = inflight
+        for reason in ("full", "deadline", "interval", "drain"):
+            out[f"flush.{reason}"] = int(snap.get(f"flush_{reason}", 0))
+        for key, val in self.latency.snapshot().items():
+            out[f"latency.{key}"] = val
+        return out
+
+    def stats(self) -> dict:
+        """JSON-ready operational snapshot: admission/flush counters,
+        queue depth, latency percentiles, per-bucket EWMA dispatch
+        seconds, and the executable cache's own stats. Since round 14 a
+        thin compatibility view over :meth:`metrics_snapshot` — the
+        numbers ARE the ``serve.sched.*`` registry metrics, reshaped to
+        the round-11 dict layout existing tests and benchmarks read."""
+        m = self.metrics_snapshot()
+        with self._lock:
             last_crash = self._last_crash
             ewma_ms = {
-                f"{b.m}x{b.n}:{b.dtype}": round((e.value or 0.0) * 1e3, 3)
+                b.label: round((e.value or 0.0) * 1e3, 3)
                 for b, e in sorted(self._ewma.items())
             }
-        return {
-            "queue_depth": depth,
-            "inflight": inflight,
-            "submitted": int(snap.get("submitted", 0)),
-            "completed": int(snap.get("completed", 0)),
-            "failed": int(snap.get("failed", 0)),
-            "rejected": int(snap.get("rejected", 0)),
-            "rejected_unmeetable": int(snap.get("rejected_unmeetable", 0)),
-            "cancelled": int(snap.get("cancelled", 0)),
-            "deadline_misses": int(snap.get("deadline_misses", 0)),
-            "dispatches": int(snap.get("dispatches", 0)),
-            "flush_failures": int(snap.get("flush_failures", 0)),
-            "retries": int(snap.get("retries", 0)),
-            "bisections": int(snap.get("bisections", 0)),
-            "numeric_failures": int(snap.get("numeric_failures", 0)),
-            "poisoned": int(snap.get("poisoned", 0)),
-            "worker_crashes": int(snap.get("worker_crashes", 0)),
-            "last_worker_crash": last_crash,
-            "flushes": {
-                reason: int(snap.get(f"flush_{reason}", 0))
-                for reason in ("full", "deadline", "interval", "drain")
-            },
-            "latency": self.latency.snapshot(),
-            "bucket_ewma_ms": ewma_ms,
-            "cache": self._cache.stats(),
+        out = {name: m[name] for name in
+               ("queue_depth", "inflight") + self._METRIC_COUNTERS}
+        out["last_worker_crash"] = last_crash
+        out["flushes"] = {
+            reason: m[f"flush.{reason}"]
+            for reason in ("full", "deadline", "interval", "drain")
         }
+        out["latency"] = {
+            key: m[f"latency.{key}"]
+            for key in ("count", "mean_ms", "p50_ms", "p99_ms")
+        }
+        out["bucket_ewma_ms"] = ewma_ms
+        out["cache"] = self._cache.stats()
+        return out
 
 
 def dispatch_program(kind: str, config: Optional[DHQRConfig] = None,

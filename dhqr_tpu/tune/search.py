@@ -355,8 +355,10 @@ def _measure_wall(plan: Plan, runner: Callable, args, repeats: int) -> float:
     sync(runner(*args))  # compile + warm
     best = float("inf")
     for _ in range(max(1, repeats)):
+        # dhqr: ignore[DHQR008] the tuner MEASURES real wall seconds per candidate — tests inject `timing=` a level up instead
         t0 = time.perf_counter()
         sync(runner(*args))
+        # dhqr: ignore[DHQR008] same measurement, closing read
         best = min(best, time.perf_counter() - t0)
     return best
 

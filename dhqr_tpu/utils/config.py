@@ -498,6 +498,61 @@ class TuneConfig:
         return TuneConfig(**env)
 
 
+@dataclasses.dataclass(frozen=True)
+class ObsConfig:
+    """Knobs for the observability layer (``dhqr_tpu.obs``, round 14) —
+    request-scoped tracing, the unified metrics registry, and the
+    flight recorder. All overridable from ``DHQR_OBS*`` environment
+    variables; like the fault harness, the env vars CONFIGURE and only
+    :func:`dhqr_tpu.obs.arm` (or the :func:`~dhqr_tpu.obs.observed`
+    scope) ARMS — disarmed, every instrumentation point is a single
+    module-global ``None`` check and the serving stack runs the
+    pre-round-14 code byte-for-byte.
+
+    Attributes:
+      enabled: whether :func:`dhqr_tpu.obs.arm` with this config
+        actually installs a trace recorder (``DHQR_OBS`` — truthy
+        values arm, ``0``/``off``/unset leave the zero-overhead path).
+      buffer_spans: ring-buffer capacity in SPANS (``DHQR_OBS_BUFFER``).
+        The buffer is bounded by construction — a serving tier must not
+        grow a span list per request — so the oldest spans fall off
+        once the ring is full (the recorder counts the drops).
+      auto_dump: the ``on_error`` flight-recorder hook's destination
+        (``DHQR_OBS_DUMP``): None (default) = off; ``"stderr"`` =
+        print the formatted span path of every typed-error trace to
+        stderr; any other string = a DIRECTORY receiving JSONL dump
+        files (``flight_<pid>.jsonl``) that
+        ``python -m dhqr_tpu.obs dump`` renders.
+    """
+
+    enabled: bool = False
+    buffer_spans: int = 4096
+    auto_dump: "str | None" = None
+
+    def __post_init__(self):
+        if self.buffer_spans < 16:
+            raise ValueError(
+                f"buffer_spans must be >= 16, got {self.buffer_spans}")
+        if self.auto_dump is not None and not str(self.auto_dump).strip():
+            object.__setattr__(self, "auto_dump", None)
+
+    @staticmethod
+    def from_env(**overrides) -> "ObsConfig":
+        """Build an obs config from ``DHQR_OBS*`` variables + overrides."""
+        env = {}
+        if "DHQR_OBS" in os.environ:
+            env["enabled"] = os.environ["DHQR_OBS"].strip().lower() not in (
+                "0", "false", "no", "off", "n", "",
+            )
+        if "DHQR_OBS_BUFFER" in os.environ:
+            env["buffer_spans"] = int(os.environ["DHQR_OBS_BUFFER"])
+        if "DHQR_OBS_DUMP" in os.environ:
+            raw = os.environ["DHQR_OBS_DUMP"].strip()
+            env["auto_dump"] = raw or None
+        env.update(overrides)
+        return ObsConfig(**env)
+
+
 def _parse_fault_sites(raw: str) -> "tuple[tuple[str, float, int | None], ...]":
     """Parse ``DHQR_FAULTS``: comma-separated ``site:prob[:count]``
     entries, e.g. ``"serve.compile:0.5,serve.dispatch:0.1:3"`` — fire

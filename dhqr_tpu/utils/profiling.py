@@ -79,12 +79,14 @@ class PhaseTimer:
     @contextlib.contextmanager
     def measure(self, name: str) -> Iterator[None]:
         self._pending = []
+        # dhqr: ignore[DHQR008] PhaseTimer MEASURES real wall seconds (compile/device time) — a fake clock here would be the bug
         t0 = time.perf_counter()
         try:
             with phase(name):
                 yield
                 if self._pending:
                     sync(self._pending)
+            # dhqr: ignore[DHQR008] same measurement, closing read
             self._records.append((name, time.perf_counter() - t0))
         finally:
             # Exception safety: never leave stale array refs behind — a later

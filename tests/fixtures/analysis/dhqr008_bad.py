@@ -1,0 +1,17 @@
+"""DHQR008 fixture — raw wall-clock reads in package code (3 findings:
+a dotted read, a second spelling, and a from-import alias read)."""
+
+import time
+from time import monotonic as now
+
+
+def deadline_for(budget_s: float) -> float:
+    return time.monotonic() + budget_s  # finding: dotted read
+
+
+def stamp() -> float:
+    return time.time()  # finding: dotted read, second spelling
+
+
+def elapsed(t0: float) -> float:
+    return now() - t0  # finding: from-import alias read

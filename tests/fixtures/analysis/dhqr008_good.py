@@ -1,0 +1,22 @@
+"""DHQR008 fixture — the sanctioned spellings (0 findings): an
+injectable-clock seam (the callable as a DEFAULT is a reference, not a
+read), and a reasoned suppression where wall time is the measurement."""
+
+import time
+
+
+class Cooldown:
+    def __init__(self, window_s: float, clock=time.monotonic):
+        # The injectable-clock pattern: the default is a reference
+        # (never called here); tests pass a fake.
+        self._clock = clock
+        self._until = self._clock() + window_s
+
+    def expired(self) -> bool:
+        return self._clock() >= self._until
+
+
+def measure(fn) -> float:
+    t0 = time.perf_counter()  # dhqr: ignore[DHQR008] measuring real compile wall seconds is the point
+    fn()
+    return time.perf_counter() - t0  # dhqr: ignore[DHQR008] measuring real compile wall seconds is the point

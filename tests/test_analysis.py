@@ -150,6 +150,32 @@ def test_dhqr007_wrapper_module_and_tests_exempt():
     assert scan_source(text, "tests/test_something.py") == []
 
 
+def test_dhqr008_raw_wall_clock_reads():
+    # Every spelling that reaches the wall clock: dotted time.* reads
+    # and a `from time import monotonic as now` alias read.
+    findings = _scan_fixture("dhqr008_bad.py")
+    assert _hits(findings, "DHQR008") == [9, 13, 17]
+    good = _scan_fixture("dhqr008_good.py")
+    # The injectable-clock seam (`clock=time.monotonic` as a DEFAULT,
+    # then `self._clock()` reads) is the sanctioned spelling: the
+    # default is a reference, not a read — zero unsuppressed findings.
+    assert _hits(good, "DHQR008") == []
+    # The two perf_counter reads in the good fixture are visible but
+    # SUPPRESSED with the reason real wall time is the measurement.
+    suppressed = [f for f in good if f.rule == "DHQR008" and f.suppressed]
+    assert len(suppressed) == 2
+    assert all("wall seconds" in f.reason for f in suppressed)
+
+
+def test_dhqr008_out_of_package_paths_exempt():
+    with open(os.path.join(FIXTURES, "dhqr008_bad.py")) as fh:
+        text = fh.read()
+    # Tests and benchmarks own their clocks (arrival schedules, hang
+    # bounds); the rule scopes to package code only.
+    assert scan_source(text, "tests/test_fixture.py") == []
+    assert scan_source(text, "benchmarks/probe.py") == []
+
+
 def test_dhqr006_out_of_package_paths_exempt():
     with open(os.path.join(FIXTURES, "dhqr006_bad.py")) as fh:
         text = fh.read()

@@ -1,0 +1,396 @@
+"""dhqr-obs (round 14): request-scoped tracing, the metrics registry,
+and the flight recorder.
+
+The contracts pinned here, in order of importance:
+
+* trace ids stay OUT of cache keys: a traced warm stream hits exactly
+  the executables a disarmed stream compiled (key parity + zero
+  recompiles with tracing armed);
+* a typed error carries its trace id and the ring buffer reconstructs
+  the request's complete span path — admission, queue wait, each
+  retry/bisect hop with cause, typed resolution;
+* disarmed, every instrumentation point is inert (mint() is None and
+  nothing records);
+* the registry unifies the four historical stats() surfaces under
+  stable dotted names, and the old dict shapes still read the same
+  numbers (thin views);
+* span paths replay deterministically under injected clocks.
+"""
+
+import gc
+import json
+import math
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dhqr_tpu import faults, obs
+from dhqr_tpu.numeric import NonFiniteInput, guarded_lstsq
+from dhqr_tpu.numeric.ladder import COUNTERS as NUMERIC_COUNTERS
+from dhqr_tpu.obs import ObsConfig, MetricsRegistry
+from dhqr_tpu.obs.trace import TraceRecorder
+from dhqr_tpu.serve import AsyncScheduler, batched_lstsq
+from dhqr_tpu.serve.cache import ExecutableCache
+from dhqr_tpu.serve.errors import DispatchFailed
+from dhqr_tpu.utils.config import FaultConfig, SchedulerConfig
+
+RNG = np.random.default_rng(0)
+A8 = jnp.asarray(RNG.random((24, 8)), jnp.float32)
+B8 = jnp.asarray(RNG.random(24), jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def cache():
+    """One executable cache for the module: the bucket program for the
+    (24, 8) request compiles once, every test after that is warm."""
+    return ExecutableCache(max_size=8)
+
+
+def _manual_sched(cache, clock=None, **kcfg):
+    kwargs = dict(slo_ms=30e3, flush_interval_ms=1.0)
+    kwargs.update(kcfg)
+    return AsyncScheduler(
+        sched_config=SchedulerConfig(**kwargs), cache=cache,
+        block_size=8, start=False,
+        **({} if clock is None else {"clock": clock}))
+
+
+def _poll_until_done(sched, futures, budget_s=60.0):
+    t0 = time.monotonic()
+    while not all(f.done() for f in futures):
+        sched.poll()
+        if time.monotonic() - t0 > budget_s:
+            raise AssertionError(f"futures hung: {sched.stats()}")
+        time.sleep(0.002)
+
+
+# ---------------------------------------------------------------- config
+
+def test_obsconfig_env(monkeypatch):
+    monkeypatch.setenv("DHQR_OBS", "1")
+    monkeypatch.setenv("DHQR_OBS_BUFFER", "128")
+    monkeypatch.setenv("DHQR_OBS_DUMP", "stderr")
+    cfg = ObsConfig.from_env()
+    assert cfg.enabled and cfg.buffer_spans == 128
+    assert cfg.auto_dump == "stderr"
+    monkeypatch.setenv("DHQR_OBS", "off")
+    monkeypatch.setenv("DHQR_OBS_DUMP", "")
+    cfg = ObsConfig.from_env()
+    assert not cfg.enabled and cfg.auto_dump is None
+    with pytest.raises(ValueError, match="buffer_spans"):
+        ObsConfig(buffer_spans=4)
+
+
+def test_disarmed_is_inert(cache):
+    """The default state: mint() is None, events no-op, arming from an
+    empty environment stays disarmed (DHQR_OBS configures, arm() arms —
+    the faults-harness discipline)."""
+    assert obs.active() is None
+    assert obs.mint() is None
+    obs.event(None, "submit")          # must not raise
+    assert obs.flight_dump(1) == {"trace_id": 1, "spans": []}
+    assert obs.arm(ObsConfig(enabled=False)) is None
+    assert obs.active() is None
+    # A disarmed submit mints nothing onto the future.
+    sched = _manual_sched(cache)
+    fut = sched.submit("lstsq", A8, B8, deadline=30.0)
+    assert not hasattr(fut, "trace_id")
+    _poll_until_done(sched, [fut])
+    assert fut.exception() is None
+    sched.shutdown()
+
+
+# ---------------------------------------------------------------- recorder
+
+def test_ring_bounded_and_deterministic_under_injected_clock():
+    def run_once():
+        rec = TraceRecorder(ObsConfig(enabled=True, buffer_spans=16),
+                            clock=iter(float(i) for i in range(1000)).__next__)
+        tids = [rec.mint() for _ in range(3)]
+        for rep in range(10):
+            for tid in tids:
+                rec.event(tid, "hop", rep=rep)
+        return rec, tids
+
+    rec, tids = run_once()
+    stats = rec.stats()
+    assert stats["spans"] == 16                # bounded by construction
+    assert stats["recorded"] == 30
+    assert stats["dropped"] == 30 - 16         # evictions counted
+    # Determinism: a second identical run replays identical span paths
+    # (same seqs, same injected-clock timestamps, same attrs).
+    rec2, tids2 = run_once()
+    assert [s.to_json() for s in rec2.spans_for(tids2[0])] == \
+        [s.to_json() for s in rec.spans_for(tids[0])]
+    # Explicit t= beats the recorder clock (the scheduler stamps spans
+    # with ITS clock, so fake-clock tests replay exactly).
+    rec.event(tids[0], "stamped", t=123.5)
+    assert rec.spans_for(tids[0])[-1].t == 123.5
+
+
+def test_rearm_never_reuses_live_trace_ids():
+    """A re-arm mid-flight must not re-issue an id a still-in-flight
+    request could be recording under (spans land in whatever recorder
+    is active at span time — a reused id would merge two unrelated
+    requests into one flight dump). Armed recorders are floored past
+    their predecessor's high-water mark, across both the arm/disarm
+    and the observed-scope hand-offs (including restoration of an
+    outer scope after a deeper-minting inner one)."""
+    with obs.observed(ObsConfig(enabled=True)):
+        outer_tid = obs.mint()
+        with obs.observed(ObsConfig(enabled=True)):
+            inner_tid = obs.mint()
+            assert inner_tid > outer_tid
+        # The restored OUTER recorder must mint past the inner's ids.
+        assert obs.mint() > inner_tid
+    try:
+        obs.arm(ObsConfig(enabled=True))
+        first = obs.mint()
+        obs.arm(ObsConfig(enabled=True))      # re-arm (e.g. new dump dir)
+        assert obs.mint() > first
+    finally:
+        obs.disarm()
+    # Directly-constructed recorders (fake-clock determinism tests) keep
+    # their own id space from 1 — the floor is an armed-layer concern.
+    assert TraceRecorder(ObsConfig(enabled=True)).mint() == 1
+
+
+def test_observed_scope_nests_and_restores():
+    assert obs.active() is None
+    with obs.observed(ObsConfig(enabled=True)) as outer:
+        assert obs.active() is outer
+        with obs.observed(ObsConfig(enabled=True)) as inner:
+            assert obs.active() is inner
+        assert obs.active() is outer
+    assert obs.active() is None
+
+
+# ---------------------------------------------------------------- registry
+
+def test_registry_sums_sources_and_drops_dead_ones():
+    reg = MetricsRegistry()
+
+    class Src:
+        def __init__(self, n):
+            self.n = n
+
+        def metrics_snapshot(self):
+            return {"hits": self.n, "nested": {"deep": 1}}
+
+    a, b = Src(2), Src(3)
+    reg.register("serve.cache", a)
+    reg.register("serve.cache", b)
+    reg.register("custom", lambda: {"gauge": 1.5})
+    snap = reg.snapshot()
+    assert snap["serve.cache.hits"] == 5.0          # summed across instances
+    assert snap["serve.cache.nested.deep"] == 2.0   # nested dicts flatten
+    assert snap["custom.gauge"] == 1.5
+    del b
+    gc.collect()
+    assert reg.snapshot()["serve.cache.hits"] == 2.0  # weakly held
+    with pytest.raises(ValueError, match="prefix"):
+        reg.register("", lambda: {})
+
+
+def test_registry_exporters(tmp_path):
+    reg = MetricsRegistry()
+    reg.register("serve.sched", lambda: {"retries": 4, "p99_ms": 1.25})
+    path = os.path.join(tmp_path, "metrics.jsonl")
+    rec = reg.export_jsonl(path, clock=lambda: 1000.0, phase="warm")
+    assert rec["ts"] == 1000.0 and rec["phase"] == "warm"
+    lines = [json.loads(ln) for ln in open(path)]
+    assert lines[0]["metrics"]["serve.sched.retries"] == 4.0
+    text = reg.export_prometheus()
+    assert "# TYPE dhqr_serve_sched_retries gauge" in text
+    assert "dhqr_serve_sched_retries 4" in text.splitlines()
+    assert "dhqr_serve_sched_p99_ms 1.25" in text.splitlines()
+    # A raising source skips, never fails the snapshot.
+    reg.register("bad", lambda: 1 / 0)
+    assert reg.snapshot()["serve.sched.retries"] == 4.0
+
+
+def test_registry_unifies_the_four_stats_surfaces(cache):
+    """The tentpole's naming contract: scheduler, cache, faults and the
+    tune plan gate (plus the numeric ladder) all present under stable
+    dotted names in ONE snapshot — and the legacy dict shapes are views
+    of the same numbers."""
+    sched = _manual_sched(cache)
+    fut = sched.submit("lstsq", A8, B8, deadline=30.0)
+    _poll_until_done(sched, [fut])
+    with faults.injected(FaultConfig(sites=(("serve.latency", 1.0, 1),),
+                                     seed=0, latency_ms=0.0)) as harness:
+        harness.should_fire("serve.latency")
+        snap = obs.registry().snapshot()
+        assert snap.get("faults.visits.serve.latency") == 1.0
+    for name in ("serve.sched.completed", "serve.sched.queue_depth",
+                 "serve.sched.latency.p99_ms", "serve.sched.flush.drain",
+                 "serve.cache.hits", "serve.cache.misses",
+                 "numeric.guarded_calls", "tune.plan_gate.failures",
+                 "tune.plan_gate.demote_after"):
+        assert name in snap, (name, sorted(snap))
+    # Thin-view equivalence: the scheduler's stats() dict reads the
+    # registry numbers (this scheduler's own contribution).
+    m = sched.metrics_snapshot()
+    legacy = sched.stats()
+    assert legacy["completed"] == m["completed"] == 1
+    assert legacy["flushes"]["interval"] == m["flush.interval"]
+    assert legacy["latency"]["p99_ms"] == m["latency.p99_ms"]
+    assert cache.stats() == cache.metrics_snapshot()
+    sched.shutdown()
+
+
+# ------------------------------------------------------ traced serving paths
+
+def test_typed_error_trace_reconstructs_full_path(cache):
+    """One request, three injected dispatch faults, one retry budget:
+    the typed failure's trace must replay submit -> flush -> dispatch ->
+    retry (with cause) -> isolate -> resolve, on a FAKE clock, with the
+    error and the future both carrying the trace id."""
+    t = [0.0]
+    with obs.observed(ObsConfig(enabled=True), clock=lambda: t[0]) as rec:
+        sched = _manual_sched(cache, clock=lambda: t[0], max_retries=1,
+                              retry_base_ms=10.0, flush_interval_ms=5.0)
+        with faults.injected(FaultConfig(
+                sites=(("serve.dispatch", 1.0, 3),), seed=0)):
+            fut = sched.submit("lstsq", A8, B8, deadline=20.0)
+            t[0] = 0.006        # past the flush interval
+            sched.poll()        # dispatch #1 fails -> retry requeued
+            t[0] = 0.020        # past the 10 ms backoff horizon
+            sched.poll()        # dispatch #2 fails -> isolate -> #3 fails
+        err = fut.exception(timeout=0)
+        assert isinstance(err, DispatchFailed)
+        assert fut.trace_id == err.trace_id
+        assert err.trace_ids == (err.trace_id,)
+        spans = obs.flight_dump(err.trace_id)["spans"]
+        names = [s["name"] for s in spans]
+        assert names == ["submit", "flush", "dispatch", "retry", "flush",
+                         "dispatch", "isolate", "dispatch", "resolve"]
+        by_name = {s["name"]: s for s in spans}
+        assert by_name["submit"]["t"] == 0.0
+        assert by_name["retry"]["cause"] == "DispatchFailed"
+        assert by_name["retry"]["backoff_s"] == 0.01
+        assert by_name["flush"]["reason"] == "interval"
+        assert by_name["isolate"]["cause"] == "DispatchFailed"
+        assert by_name["resolve"]["outcome"] == "DispatchFailed"
+        assert spans[-1]["t"] == 0.020          # the scheduler's clock
+        sched.shutdown()
+
+
+def test_key_parity_and_zero_recompile_with_tracing_armed(cache):
+    """THE acceptance pin: trace ids are absent from cache keys. The
+    same stream through a disarmed and an armed scheduler produces
+    identical key sets and the armed pass compiles NOTHING new."""
+    streams = [(A8, B8)] * 4
+    base = _manual_sched(cache)
+    futs = [base.submit("lstsq", a, b, deadline=30.0) for a, b in streams]
+    base.drain()
+    assert all(f.exception() is None for f in futs)
+    base.shutdown()
+    misses0 = cache.stats()["misses"]
+    with obs.observed(ObsConfig(enabled=True)):
+        traced = _manual_sched(cache)
+        futs = [traced.submit("lstsq", a, b, deadline=30.0)
+                for a, b in streams]
+        traced.drain()
+        assert all(f.exception() is None for f in futs)
+        traced.shutdown()
+    assert traced.keys_seen == base.keys_seen
+    assert cache.stats()["misses"] == misses0, "armed tracing recompiled"
+    # And the sync tier, through the same cache: armed == disarmed keys.
+    xs0 = batched_lstsq([A8], [B8], block_size=8, cache=cache)
+    with obs.observed(ObsConfig(enabled=True)) as rec:
+        xs1 = batched_lstsq([A8], [B8], block_size=8, cache=cache)
+        tid = rec.trace_ids()[-1]
+        names = [s.name for s in rec.spans_for(tid)]
+        assert names == ["submit", "dispatch", "resolve"]
+        assert rec.spans_for(tid)[1].attrs["compile_s"] == 0.0
+    assert cache.stats()["misses"] == misses0
+    assert bool(jnp.all(xs0[0] == xs1[0]))
+
+
+def test_guarded_call_traced_and_typed_error_carries_id(tmp_path):
+    with obs.observed(ObsConfig(enabled=True,
+                                auto_dump=str(tmp_path))) as rec:
+        g = guarded_lstsq(A8, B8, guards="fallback")
+        assert g.trace_id is not None
+        names = [s.name for s in rec.spans_for(g.trace_id)]
+        assert names == ["submit", "screen", "rung", "resolve"]
+        rungs = [s for s in rec.spans_for(g.trace_id) if s.name == "rung"]
+        assert rungs[0].attrs["outcome"] == "ok"
+        # A poisoned input: the typed refusal carries the trace id and
+        # the on_error hook wrote the flight dump file.
+        bad = A8.at[0, 0].set(jnp.nan)
+        rejects0 = NUMERIC_COUNTERS.get("screen_rejects")
+        with pytest.raises(NonFiniteInput) as ei:
+            guarded_lstsq(bad, B8, guards="fallback")
+        assert ei.value.trace_id is not None
+        assert NUMERIC_COUNTERS.get("screen_rejects") == rejects0 + 1
+        dump_path = os.path.join(tmp_path, f"flight_{os.getpid()}.jsonl")
+        assert os.path.exists(dump_path)
+        records = [json.loads(ln) for ln in open(dump_path)]
+        assert records[-1]["error"] == "NonFiniteInput"
+        assert records[-1]["trace_id"] == ei.value.trace_id
+        assert [s["name"] for s in records[-1]["spans"]] == \
+            ["submit", "resolve"]
+        assert rec.stats()["error_dumps"] == 1
+
+
+def test_numeric_fallback_counters_and_rung_trace():
+    from dhqr_tpu.utils.config import FaultConfig as FC
+
+    fallbacks0 = NUMERIC_COUNTERS.get("fallbacks")
+    recovered0 = NUMERIC_COUNTERS.get("recovered")
+    with obs.observed(ObsConfig(enabled=True)) as rec:
+        with faults.injected(FC(sites=(("numeric.breakdown", 1.0, 1),),
+                                seed=0)):
+            g = guarded_lstsq(A8, B8, engine="cholqr2", guards="fallback")
+    assert g.escalations == 1
+    assert NUMERIC_COUNTERS.get("fallbacks") == fallbacks0 + 1
+    assert NUMERIC_COUNTERS.get("recovered") == recovered0 + 1
+    rungs = [s.attrs for s in rec.spans_for(g.trace_id)
+             if s.name == "rung"]
+    assert [r["outcome"] for r in rungs] == ["breakdown", "ok"]
+    assert rungs[0]["detail"] == "injected numeric.breakdown"
+    assert rungs[0]["engine"] == "cholqr2"
+    assert rungs[1]["engine"] == "cholqr3"
+
+
+# ---------------------------------------------------------------- dump CLI
+
+def test_dump_cli_renders_and_filters(tmp_path, capsys):
+    from dhqr_tpu.obs.__main__ import main as cli_main
+
+    path = os.path.join(tmp_path, "flight_1.jsonl")
+    with open(path, "w") as fh:
+        fh.write(json.dumps({
+            "trace_id": 7, "error": "DeadlineExceeded", "message": "late",
+            "spans": [
+                {"trace_id": 7, "seq": 1, "t": 1.0, "name": "submit",
+                 "bucket": "64x16:float32"},
+                {"trace_id": 7, "seq": 2, "t": 1.5, "name": "resolve",
+                 "outcome": "DeadlineExceeded"},
+            ]}) + "\n")
+        fh.write(json.dumps({"trace_id": 9, "spans": []}) + "\n")
+    assert cli_main(["dump", path]) == 0
+    out = capsys.readouterr().out
+    assert "trace 7: DeadlineExceeded: late" in out
+    assert "+0.500s resolve" in out and "outcome=DeadlineExceeded" in out
+    assert "trace 9" in out
+    assert cli_main(["dump", path, "--trace-id", "7", "--json"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["trace_id"] == 7
+    # Not found -> exit 1; unreadable -> exit 2.
+    assert cli_main(["dump", path, "--trace-id", "99"]) == 1
+    assert cli_main(["dump", os.path.join(tmp_path, "nope.jsonl")]) == 2
+
+
+def test_auto_dump_stderr(capsys):
+    with obs.observed(ObsConfig(enabled=True, auto_dump="stderr")):
+        bad = A8.at[2, 3].set(math.inf)
+        with pytest.raises(NonFiniteInput):
+            guarded_lstsq(bad, B8, guards="screen")
+    err = capsys.readouterr().err
+    assert "NonFiniteInput" in err and "submit" in err
