@@ -118,29 +118,45 @@ def _stage(name: str) -> None:
     print(f"::stage {name} t={time.time():.1f}", file=sys.stderr, flush=True)
 
 
-# Per-chip MXU peak (TFLOP/s, dense bf16 — the vendor-published number; no
-# official f32 peak exists for these parts) by PJRT device_kind. The bench
-# runs f32 at precision=highest (multi-pass MXU emulation), so ``mfu``
-# computed against the bf16 peak UNDERSTATES hardware utilization by the
-# pass count — it is the conservative, judgeable convention (VERDICT r4
-# #9: make "matching-or-beating" assessable without the A100 proxy).
-_MXU_PEAK_TFLOPS = {
-    "TPU v4": 275.0,
-    "TPU v5 lite": 197.0,   # v5e — the axon relay chip (round-3 memory)
-    "TPU v5": 459.0,        # v5p
-    "TPU v5p": 459.0,
-    "TPU v6 lite": 918.0,   # v6e
-}
+# Row schema version (round 15): stamped into every emitted row and
+# summary so the regress gate (dhqr_tpu/obs/regress.py) can evolve its
+# parser without guessing a row's vintage — rows without the field are
+# treated as v0 (the pre-round-15 shape). Bump on incompatible changes.
+SCHEMA_VERSION = 1
+
+
+_PLATFORM_MOD = None
+
+
+def _platform_mod():
+    """dhqr_tpu/utils/platform.py loaded BY FILE PATH, not as a package
+    import: the peak table moved there in round 15 (one MFU basis
+    shared with the xray reports — dense bf16 MXU peak, the
+    conservative judgeable convention of VERDICT r4 #9), but the
+    SUPERVISOR also reads it (_best_recorded_tpu annotates the CPU
+    fallback) and must not pull `import dhqr_tpu` — and therefore jax —
+    into a process whose whole design is staying off the fragile
+    backend. platform.py's module level imports only `os`, so this
+    load cannot fail for jax reasons."""
+    global _PLATFORM_MOD
+    if _PLATFORM_MOD is None:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "_dhqr_bench_platform",
+            os.path.join(_REPO, "dhqr_tpu", "utils", "platform.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _PLATFORM_MOD = mod
+    return _PLATFORM_MOD
 
 
 def _mfu_fields(gflops: float, device_kind: str) -> dict:
     """{"mfu": ..., "mfu_peak_tflops": ...} when the chip's peak is known,
-    {} otherwise (CPU fallback rows carry no MFU — not hardware evidence)."""
-    peak = _MXU_PEAK_TFLOPS.get(device_kind)
-    if not peak:
-        return {}
-    return {"mfu": round(gflops / 1e3 / peak, 4), "mfu_peak_tflops": peak,
-            "mfu_convention": "useful f32 FLOPs / dense bf16 MXU peak"}
+    {} otherwise (CPU fallback rows carry no MFU — not hardware
+    evidence). Thin wrapper over utils/platform.mfu_fields via the
+    file-path load above."""
+    return _platform_mod().mfu_fields(gflops, device_kind)
 
 
 def _registry_metrics() -> dict:
@@ -157,6 +173,68 @@ def _registry_metrics() -> dict:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _xray_block(stage: str, compiled, n_: int, device_kind: str,
+                compile_s: "float | None" = None) -> "dict | None":
+    """dhqr-xray introspection of one bench stage's compiled program
+    (round 15): cost/memory analysis + the analytic flop model +
+    roofline position, JSON-ready for the stage row and the summary
+    (the caller stamps achieved_gflops/mfu once the stage has a
+    measured time). None (with a stderr warn) if introspection itself
+    breaks — telemetry is evidence, not a gate, exactly like
+    _registry_metrics."""
+    try:
+        from dhqr_tpu.obs import flops as _flops
+        from dhqr_tpu.obs import xray as _xray
+
+        report = _xray.report_for(
+            stage, compiled, analytic_flops=_flops.qr_flops(n_, n_),
+            device_kind=device_kind, dtype="float32",
+            compile_seconds=compile_s)
+        return report.to_json()
+    except Exception as e:
+        print(f"::warn xray capture failed for {stage}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
+        return None
+
+
+def _stage_profile(stage: str):
+    """Optional jax.profiler timeline capture for one bench stage
+    (round 15): armed by ``ObsConfig.profile_dir`` / ``DHQR_OBS_PROFILE``
+    naming a directory — each stage's timed region writes a
+    TensorBoard/perfetto trace under ``<dir>/<stage>``. Disarmed (the
+    default) this returns a null context: zero overhead beyond one env
+    read per stage."""
+    import contextlib
+
+    try:
+        from dhqr_tpu.utils.config import ObsConfig
+
+        profile_dir = ObsConfig.from_env().profile_dir
+    except Exception as e:
+        print(f"::warn DHQR_OBS_PROFILE unreadable: {e}", file=sys.stderr,
+              flush=True)
+        profile_dir = None
+    if not profile_dir:
+        return contextlib.nullcontext()
+    from dhqr_tpu.utils.profiling import trace
+
+    return trace(os.path.join(profile_dir, stage))
+
+
+def _arm_obs_from_env() -> None:
+    """Arm observability in a bench child exactly as the environment
+    asks (DHQR_OBS / DHQR_OBS_XRAY — the ROADMAP item-1/2 TPU replays
+    set these): a no-op with nothing set, and never fatal — a broken
+    obs arm must not cost a hardware window."""
+    try:
+        from dhqr_tpu import obs as _obs
+
+        _obs.arm()
+    except Exception as e:
+        print(f"::warn obs arm failed: {type(e).__name__}: {e}",
+              file=sys.stderr, flush=True)
+
+
 def _emit(record: dict) -> None:
     """Print a result line; with DHQR_BENCH_TEE set, also append it there.
 
@@ -166,6 +244,7 @@ def _emit(record: dict) -> None:
     failure mode: measured numbers stranded in a dead child's pipe).
     """
     record.setdefault("round", ROUND)
+    record.setdefault("schema_version", SCHEMA_VERSION)
     line = json.dumps(record)
     print(line, flush=True)
     tee = os.environ.get("DHQR_BENCH_TEE")
@@ -858,6 +937,12 @@ def _prewarm() -> None:
     from dhqr_tpu.serve.cache import ExecutableCache
     from dhqr_tpu.utils.profiling import sync
 
+    # With DHQR_OBS_XRAY armed, every prewarm compile below captures its
+    # executable's cost/memory analysis through the cache's one compile
+    # entry — the prewarm summary then carries the xray table for the
+    # whole staged program set before any watchdog is armed.
+    _arm_obs_from_env()
+
     # Every prewarm compile goes through the serving tier's AOT cache
     # machinery (one code path with serve dispatch): the lower().compile()
     # it performs is exactly what populates the persistent jax
@@ -984,10 +1069,23 @@ def _prewarm() -> None:
             print(f"::prewarm_stage_failed prewarm_geqrf "
                   f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
     _stage("prewarm_done")
-    print(json.dumps({"prewarm": "done", "stages": done,
-                      "seconds": round(time.time() - t0, 1),
-                      "cache": cache.stats(),
-                      "metrics": _registry_metrics()}))
+    summary = {"prewarm": "done", "stages": done,
+               "seconds": round(time.time() - t0, 1),
+               "schema_version": SCHEMA_VERSION,
+               "cache": cache.stats(),
+               "metrics": _registry_metrics()}
+    try:
+        from dhqr_tpu.obs import xray as _xr
+
+        store = _xr.active()
+        if store is not None:
+            # The armed per-key xray table: what each staged program
+            # costs in flops/bytes, captured at its one compile.
+            summary["xray"] = [r.to_json() for r in store.reports()]
+    except Exception as e:
+        print(f"::warn prewarm xray summary failed: {e}", file=sys.stderr,
+              flush=True)
+    print(json.dumps(summary))
 
 
 class _Watchdog:
@@ -1056,6 +1154,11 @@ def main() -> None:
                                       _blocked_qr_impl)
     from dhqr_tpu.ops.solve import r_matrix
     from dhqr_tpu.utils.profiling import sync
+
+    # Observability as the environment asks (DHQR_OBS / DHQR_OBS_XRAY):
+    # the TPU replays of ROADMAP items 1-2 arm these for per-phase and
+    # per-executable evidence; unset, this is a no-op.
+    _arm_obs_from_env()
 
     _stage("backend_init")
     with _Watchdog("backend_init", 150):
@@ -1169,7 +1272,7 @@ def main() -> None:
         from jax import lax
 
         extra = _stage_extra(flat, lookahead, agg, tprec)
-        with _Watchdog(name, watchdog):
+        with _Watchdog(name, watchdog), _stage_profile(name):
             A = jnp.asarray(rng.random((n_, n_)), dtype=jnp.float32)
             sync(A)
             t0 = time.perf_counter()
@@ -1178,6 +1281,12 @@ def main() -> None:
                 panel_impl=panel, **extra,
             ).compile()
             compile_s = time.perf_counter() - t0
+            # dhqr-xray (round 15): introspect the stage's compiled
+            # program BEFORE running it — a stage that wedges mid-
+            # measurement still leaves its cost/memory story on stderr's
+            # side of the story via the emitted row of a later re-run.
+            xray = _xray_block(name, compiled, n_, device_kind,
+                               compile_s=compile_s)
             H, alpha = compiled(A)
             sync(alpha)
             times = []
@@ -1237,6 +1346,18 @@ def main() -> None:
                 "pallas_panels": pallas,
                 "panel_impl": panel,
             }
+            if xray is not None:
+                # MFU needs the measured per-factorization time; stamp it
+                # now that ``t`` exists, through the ONE mfu_fields
+                # implementation (utils/platform) the top-level row uses —
+                # the block's mfu and the row's mfu can never disagree.
+                xray["achieved_gflops"] = round(gflops, 2)
+                mfu_f = _mfu_fields(gflops, device_kind)
+                xray["mfu"] = mfu_f.get("mfu")
+                if not mfu_f:
+                    xray["mfu_reason"] = ("no published peak for "
+                                          f"device_kind {device_kind!r}")
+                result["xray"] = xray
             if plan_auto:
                 # Stamp the resolved plan so the JSONL row records WHY
                 # these knobs ran — a tuned row is only analyzable if it

@@ -121,8 +121,10 @@ def main(m: int = 192, n: int = 24) -> None:
     fh = open(out_path, "a", buffering=1)
 
     def emit(row):
+        from bench import SCHEMA_VERSION
+
         row = {"round": 13, "platform": platform, "ts": round(time.time(), 1),
-               **row}
+               "schema_version": SCHEMA_VERSION, **row}
         line = json.dumps(row)
         print(line, flush=True)
         fh.write(line + "\n")

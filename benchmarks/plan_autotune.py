@@ -72,7 +72,7 @@ def main() -> None:
     except Exception:
         pass
 
-    from bench import _Watchdog
+    from bench import SCHEMA_VERSION, _Watchdog
 
     from dhqr_tpu.models.qr_model import _lstsq_impl, lstsq
     from dhqr_tpu.ops.cholqr import _cholqr_lstsq_impl
@@ -94,7 +94,8 @@ def main() -> None:
     db = default_db()
 
     def emit(rec):
-        rec.update(platform=platform, device_kind=kind, round=rnd)
+        rec.update(platform=platform, device_kind=kind, round=rnd,
+                   schema_version=SCHEMA_VERSION)
         line = json.dumps(rec)
         print(line, flush=True)
         with open(out_path, "a") as f:

@@ -42,7 +42,7 @@ def _stage(name: str) -> None:
 
 def main(n: int = 1024) -> None:
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(3))
-    from bench import ROUND, _Watchdog, _chained_qr
+    from bench import SCHEMA_VERSION, ROUND, _Watchdog, _chained_qr
 
     _stage("import")
     import jax
@@ -83,7 +83,8 @@ def main(n: int = 1024) -> None:
     sync(A)
 
     def emit(rec):
-        rec.update(platform=platform, device_kind=kind, round=ROUND)
+        rec.update(platform=platform, device_kind=kind, round=ROUND,
+                   schema_version=SCHEMA_VERSION)
         line = json.dumps(rec)
         print(line, flush=True)
         with open(out_path, "a") as f:

@@ -82,7 +82,7 @@ def _stage(name: str) -> None:
 
 def main(n_requests: int = 512, rate_frac: float = 0.92) -> None:
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(3))
-    from bench import ROUND, _Watchdog
+    from bench import SCHEMA_VERSION, ROUND, _Watchdog
 
     _stage("import")
     import jax
@@ -116,7 +116,8 @@ def main(n_requests: int = 512, rate_frac: float = 0.92) -> None:
                             f"serving_async_{platform}.jsonl")
 
     def emit(rec):
-        rec.update(platform=platform, device_kind=kind, round=ROUND)
+        rec.update(platform=platform, device_kind=kind, round=ROUND,
+                   schema_version=SCHEMA_VERSION)
         line = json.dumps(rec)
         print(line, flush=True)
         with open(out_path, "a") as f:

@@ -73,7 +73,7 @@ def _stage(name: str) -> None:
 
 def main(n_requests: int = 384, rate_frac: float = 0.90) -> None:
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(3))
-    from bench import ROUND, _Watchdog
+    from bench import SCHEMA_VERSION, ROUND, _Watchdog
 
     _stage("import")
     import jax
@@ -106,7 +106,8 @@ def main(n_requests: int = 384, rate_frac: float = 0.90) -> None:
                             f"serving_obs_{platform}.jsonl")
 
     def emit(rec):
-        rec.update(platform=platform, device_kind=kind, round=ROUND)
+        rec.update(platform=platform, device_kind=kind, round=ROUND,
+                   schema_version=SCHEMA_VERSION)
         line = json.dumps(rec)
         print(line, flush=True)
         with open(out_path, "a") as f:

@@ -59,7 +59,7 @@ def _pctl(xs, q):
 
 def main(n_requests: int = 256) -> None:
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(3))
-    from bench import ROUND, _Watchdog
+    from bench import SCHEMA_VERSION, ROUND, _Watchdog
 
     _stage("import")
     import jax
@@ -92,7 +92,8 @@ def main(n_requests: int = 256) -> None:
                             f"serving_throughput_{platform}.jsonl")
 
     def emit(rec):
-        rec.update(platform=platform, device_kind=kind, round=ROUND)
+        rec.update(platform=platform, device_kind=kind, round=ROUND,
+                   schema_version=SCHEMA_VERSION)
         line = json.dumps(rec)
         print(line, flush=True)
         with open(out_path, "a") as f:

@@ -68,10 +68,11 @@ from dhqr_tpu.serve import (
 # re-exporting it here would shadow the `dhqr_tpu.tune` submodule
 # attribute with a function (breaking `import dhqr_tpu.tune as t`).
 from dhqr_tpu.tune import Plan, PlanDB, resolve_plan
-# Observability (round 14): the registry class rides the facade; the
-# arming/tracing API stays namespaced at dhqr_tpu.obs (arm, observed,
-# flight_dump, registry, ...) so the module attribute is not shadowed.
-from dhqr_tpu.obs import MetricsRegistry
+# Observability (rounds 14-15): the registry and xray-report classes
+# ride the facade; the arming/tracing/capture API stays namespaced at
+# dhqr_tpu.obs (arm, observed, flight_dump, registry, xray, ...) so
+# the module attribute is not shadowed.
+from dhqr_tpu.obs import MetricsRegistry, XrayReport
 from dhqr_tpu.utils.config import (
     DHQRConfig,
     FaultConfig,
@@ -121,6 +122,7 @@ __all__ = [
     "FaultConfig",
     "ObsConfig",
     "MetricsRegistry",
+    "XrayReport",
     "ServeConfig",
     "SchedulerConfig",
     "TuneConfig",

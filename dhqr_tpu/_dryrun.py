@@ -362,6 +362,44 @@ def run(n_devices: int) -> None:
           f"warm traced repeat of {len(As)} requests 0 recompiles, "
           f"registry {len(osnap)} metrics)", flush=True)
 
+    # Device observability / dhqr-xray (round 15): one mixed-shape
+    # batched call through a FRESH cache with capture armed must yield
+    # per-key XrayReports whose analytic/measured/roofline fields are
+    # populated (or null WITH a reason), register under the xray.*
+    # dotted names, and capture NOTHING on the zero-recompile warm
+    # repeat (armed capture lives on the compile path only — the
+    # <= 5% overhead bar holds by construction).
+    from dhqr_tpu.obs import xray as _xray_mod
+
+    xcache = ExecutableCache(max_size=16)
+    with _xray_mod.captured() as xstore:
+        xs_out = batched_lstsq(As[:4], rhs[:4], block_size=8, cache=xcache)
+        for i, xi in enumerate(xs_out):
+            assert bool(jnp.all(jnp.isfinite(xi))), ("xray stage", i)
+        xreports = xstore.reports()
+        assert xreports, "armed xray capture recorded no reports"
+        for rep in xreports:
+            assert rep.analytic_flops and rep.analytic_flops > 0, rep
+            assert rep.measured is not None or rep.measured_unavailable, rep
+            row = rep.to_json()
+            for field in ("analytic_flops", "measured_cost_analysis",
+                          "roofline_bound"):
+                assert field in row, (field, row)
+        xsnap = _obs_mod.registry().snapshot()
+        assert xsnap.get("xray.captures", 0) >= len(xreports), xsnap
+        captures_before = xstore.stats()["captures"]
+        batched_lstsq(As[:4], rhs[:4], block_size=8, cache=xcache)
+        assert xstore.stats()["captures"] == captures_before, (
+            "warm repeat re-captured — a recompile slipped through",
+            xstore.stats())
+    mflops = [r.measured.get("flops") if r.measured else None
+              for r in xreports]
+    print(f"dryrun: xray ok ({len(xreports)} compiled programs "
+          f"introspected, analytic "
+          f"{sum(r.analytic_flops for r in xreports) / 1e6:.1f} MF, "
+          f"measured flops {['%.1f MF' % (f / 1e6) if f else 'n/a' for f in mflops]}, "
+          "warm repeat 0 captures)", flush=True)
+
     # Plan autotuner (round 9): a tiny-grid on-device search must run end
     # to end on CPU — tune, persist, resolve through the PUBLIC lstsq
     # plan="auto" path — with the tuned answer held to the same 8x LAPACK

@@ -2,11 +2,12 @@
 
 Exit status 0 iff no unsuppressed, un-baselined findings. The AST pass
 runs on every named path; the jaxpr sanitizer, the API-consistency
-check, and the multi-device comms-contract audit (dhqr-audit,
-``analysis/comms_pass.py``) run whenever the dhqr_tpu package itself is
-among the scan targets (they validate the package, not arbitrary
-files), unless disabled with ``--no-jaxpr`` / ``--no-api`` /
-``--no-comms``. ``comms`` is the audit alone (the subprocess vehicle
+check, the multi-device comms-contract audit (dhqr-audit,
+``analysis/comms_pass.py``), and the xray introspection smoke
+(``analysis/xray_smoke.py``, DHQR401) run whenever the dhqr_tpu
+package itself is among the scan targets (they validate the package,
+not arbitrary files), unless disabled with ``--no-jaxpr`` /
+``--no-api`` / ``--no-comms`` / ``--no-xray``. ``comms`` is the audit alone (the subprocess vehicle
 ``check`` uses when the backend initialized before the multi-device CPU
 topology could be forced). ``--list-rules`` prints the full DHQR rule
 catalogue so the docs table cannot drift from the code
@@ -68,6 +69,8 @@ def rule_catalogue() -> "list[tuple[str, str, str]]":
          "aliasing", "comms"),
         ("DHQR305", "jaxpr differs across two traces of one cache key",
          "comms"),
+        ("DHQR401", "compiled-program xray introspection smoke failed",
+         "xray"),
     ]
     return rows
 
@@ -127,6 +130,8 @@ def main(argv=None) -> int:
                        help="skip the public-API consistency check")
     check.add_argument("--no-comms", action="store_true",
                        help="skip the multi-device comms-contract audit")
+    check.add_argument("--no-xray", action="store_true",
+                       help="skip the xray introspection smoke (DHQR401)")
     check.add_argument(
         "--preset", action="append", default=None,
         help="restrict the jaxpr/comms passes to these policy presets "
@@ -225,6 +230,10 @@ def main(argv=None) -> int:
         findings.extend(run_comms_pass_auto(presets=args.preset,
                                             device_counts=device_counts,
                                             contracts_path=args.contracts))
+    if _scans_package(paths) and not args.no_xray:
+        from dhqr_tpu.analysis.xray_smoke import run_xray_smoke
+
+        findings.extend(run_xray_smoke())
 
     if args.write_baseline:
         write_baseline(args.write_baseline, findings)

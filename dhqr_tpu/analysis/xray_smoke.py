@@ -1,0 +1,79 @@
+"""DHQR401: the xray introspection smoke (round 15).
+
+``check .`` (and the dry run) must prove — before any TPU window — that
+the device-observability seam actually produces evidence on this
+backend: one tiny bucket program compiled through the serving tier's
+ONE compile entry with capture armed yields an :class:`XrayReport`
+whose analytic/measured/roofline fields are populated (or null WITH a
+reason), and whose accounting registers under the ``xray.*`` dotted
+names. A refactor that silently disconnects the capture hook (moves
+the compile entry, breaks the compat shim, drops the registry
+provider) fails lint here instead of costing the next hardware
+window its per-executable accounting.
+"""
+
+from __future__ import annotations
+
+from dhqr_tpu.analysis.findings import Finding
+
+_PATH = "dhqr_tpu/obs/xray.py"
+
+
+def run_xray_smoke() -> "list[Finding]":
+    """Compile one tiny serve bucket with xray capture armed; every
+    broken invariant is one DHQR401 finding (an infrastructure crash is
+    one finding too — a smoke that cannot run must not pass)."""
+    findings = []
+
+    def bad(msg: str) -> None:
+        findings.append(Finding("DHQR401", _PATH, 0, msg))
+
+    try:
+        from functools import partial
+
+        from dhqr_tpu.obs import registry
+        from dhqr_tpu.obs import xray as _xray
+        from dhqr_tpu.serve.cache import ExecutableCache
+        from dhqr_tpu.serve.engine import _lower_for_key, _plan_key
+        from dhqr_tpu.utils.config import DHQRConfig, ServeConfig
+
+        with _xray.captured() as store:
+            cache = ExecutableCache(max_size=4)
+            key, _bucket = _plan_key(
+                "lstsq", 1, 24, 8, "float32",
+                DHQRConfig(block_size=8), ServeConfig())
+            cache.get_or_compile(key, partial(_lower_for_key, key))
+            reports = store.reports()
+            if not reports:
+                bad("armed capture recorded no report for a compile "
+                    "through ExecutableCache.get_or_compile — the "
+                    "cache-side hook is disconnected")
+                return findings
+            report = reports[0]
+            if not report.analytic_flops or report.analytic_flops <= 0:
+                bad("XrayReport.analytic_flops missing for a serve "
+                    "CacheKey — the obs.flops closed-form derivation "
+                    "is disconnected")
+            if report.measured is None and not report.measured_unavailable:
+                bad("cost_analysis is None WITHOUT a reason — the "
+                    "compat shim dropped its null-with-reason contract")
+            row = report.to_json()
+            for field in ("analytic_flops", "measured_cost_analysis",
+                          "roofline_bound"):
+                if field not in row:
+                    bad(f"XrayReport.to_json() lost the {field!r} field "
+                        "the artifact rows and the regress gate key on")
+            if report.roofline_bound is None and not report.roofline_reason:
+                bad("roofline_bound is None without a roofline_reason")
+            # MFU machinery: a known chip must yield a number; this
+            # backend (CPU in lint) must refuse with None, never crash.
+            mfu = report.mfu(1.0)
+            if report.peak_tflops is None and mfu is not None:
+                bad("mfu computed without a known device peak")
+            snap = registry().snapshot()
+            if not snap.get("xray.captures"):
+                bad("the metrics registry snapshot carries no armed "
+                    "xray.captures — the xray provider is unregistered")
+    except Exception as e:
+        bad(f"xray smoke crashed: {type(e).__name__}: {e}")
+    return findings

@@ -40,6 +40,18 @@ Three pieces (each its own module):
   trace id(s); :func:`flight_dump` / ``python -m dhqr_tpu.obs dump``
   reconstruct the request's full span path, and the ``on_error`` hook
   (``ObsConfig.auto_dump``) persists it the moment the error resolves.
+* ``obs.xray`` + ``obs.flops`` (round 15, dhqr-xray) — device-level
+  observability: compiled-program ``cost_analysis()`` /
+  ``memory_analysis()`` capture at the serve cache's compile entry
+  (armed via ``ObsConfig.xray`` / ``DHQR_OBS_XRAY``), paired with the
+  analytic per-engine flop model and the ``utils/platform`` peak
+  table into :class:`XrayReport`\\ s (MFU + roofline position);
+  ``python -m dhqr_tpu.obs xray`` renders the per-key table.
+* ``obs.regress`` (round 15) — the jax-free perf-regression gate over
+  the committed bench trajectory: ``python -m dhqr_tpu.obs regress``
+  (wired into tools/lint.sh) applies ``benchmarks/regress_rules.json``
+  and exits nonzero with a per-key verdict table on any unwaived
+  regression.
 
 Armed behind :class:`~dhqr_tpu.utils.config.ObsConfig` / ``DHQR_OBS``
 with the faults-harness discipline: zero overhead disarmed (one
@@ -50,8 +62,9 @@ flight-recorder dump after a typed error".
 
 from __future__ import annotations
 
-from dhqr_tpu.obs import recorder
+from dhqr_tpu.obs import recorder, xray
 from dhqr_tpu.obs.metrics import MetricsRegistry, registry, reset_registry
+from dhqr_tpu.obs.xray import XrayReport
 from dhqr_tpu.obs.trace import (
     Span,
     TraceRecorder,
@@ -84,6 +97,8 @@ __all__ = [
     "ObsConfig",
     "Span",
     "TraceRecorder",
+    "XrayReport",
+    "xray",
     "active",
     "arm",
     "disarm",

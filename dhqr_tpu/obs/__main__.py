@@ -1,10 +1,28 @@
-"""``python -m dhqr_tpu.obs dump [FILE ...] [--trace-id N] [--json]``
+"""``python -m dhqr_tpu.obs <dump|xray|regress> ...``
 
-Render flight-recorder dump files (the JSONL the ``on_error`` hook
-writes when ``ObsConfig.auto_dump`` names a directory — see
-docs/OPERATIONS.md "Reading a flight-recorder dump after a typed
-error"). With no FILE, every ``flight_*.jsonl`` under ``DHQR_OBS_DUMP``
-(when it names a directory) is rendered, newest first.
+The observability CLIs:
+
+* ``dump [FILE ...] [--trace-id N] [--json]`` — render flight-recorder
+  dump files (the JSONL the ``on_error`` hook writes when
+  ``ObsConfig.auto_dump`` names a directory — docs/OPERATIONS.md
+  "Reading a flight-recorder dump after a typed error"). With no FILE,
+  every ``flight_*.jsonl`` under ``DHQR_OBS_DUMP`` (when it names a
+  directory) is rendered, newest first.
+* ``xray [FILE ...] [--json]`` — the per-cache-key cost/memory table
+  (round 15): renders the ``xray`` blocks found in bench summary JSON,
+  artifact ``*.jsonl`` rows, or ``XrayStore.export_jsonl`` files
+  (docs/OPERATIONS.md "Reading an xray table").
+* ``regress [--rules FILE] [--waivers FILE] [--repo DIR] [--json]`` —
+  the perf-regression gate over the committed bench trajectory
+  (``dhqr_tpu.obs.regress``; wired into tools/lint.sh). Exit 0 green,
+  1 on regressions, 2 on malformed inputs.
+
+All three command MODULES are jax-free by construction (``obs.trace``
+docstring has the discipline) — but the ``-m dhqr_tpu.obs`` spelling
+imports the dhqr_tpu package (and therefore jax) on the way in. On a
+host where jax cannot even import, run the regress gate as a file:
+``python dhqr_tpu/obs/regress.py`` (what tools/lint.sh does;
+regress.py is stdlib-only and has its own ``__main__``).
 """
 
 from __future__ import annotations
@@ -26,24 +44,7 @@ def _default_files() -> "list[str]":
     return sorted(files, key=os.path.getmtime, reverse=True)
 
 
-def main(argv: "list[str] | None" = None) -> int:
-    parser = argparse.ArgumentParser(
-        prog="python -m dhqr_tpu.obs",
-        description="Flight-recorder dump tools (dhqr-obs).")
-    sub = parser.add_subparsers(dest="command")
-    dump = sub.add_parser(
-        "dump", help="render flight dump files as span paths")
-    dump.add_argument("files", nargs="*", metavar="FILE",
-                      help="flight JSONL file(s); default: every "
-                      "flight_*.jsonl under $DHQR_OBS_DUMP")
-    dump.add_argument("--trace-id", type=int, default=None,
-                      help="only this trace id")
-    dump.add_argument("--json", action="store_true",
-                      help="raw JSON records instead of formatted paths")
-    args = parser.parse_args(argv)
-    if args.command != "dump":
-        parser.error("a command is required (dump)")
-
+def _cmd_dump(args) -> int:
     files = args.files or _default_files()
     if not files:
         print("no dump files given and none found under DHQR_OBS_DUMP",
@@ -72,6 +73,109 @@ def main(argv: "list[str] | None" = None) -> int:
         print(f"no {which} found in {len(files)} file(s)", file=sys.stderr)
         return 1
     return 0
+
+
+def _parse_records(path: str) -> "list[dict]":
+    """Bench summary JSON (one object, possibly with stage rows inside)
+    or a JSONL artifact: every parseable JSON object found."""
+    records = []
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            text = fh.read()
+    except OSError as e:
+        print(f"cannot read {path}: {e}", file=sys.stderr)
+        return records
+    try:
+        whole = json.loads(text)
+        return whole if isinstance(whole, list) else [whole]
+    # dhqr: ignore[DHQR006] format sniffing, not error handling: a file that is not ONE json document is parsed as JSONL below
+    except ValueError:
+        pass
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            records.append(rec)
+    return records
+
+
+def _cmd_xray(args) -> int:
+    from dhqr_tpu.obs.xray import format_table, rows_from_json
+
+    if not args.files:
+        print("obs xray: name the file(s) to render — a bench summary "
+              "JSON, an artifact *.jsonl, or an XrayStore export",
+              file=sys.stderr)
+        return 2
+    rows = []
+    for path in args.files:
+        rows.extend(rows_from_json(_parse_records(path)))
+    if not rows:
+        print(f"no xray blocks found in {len(args.files)} file(s)",
+              file=sys.stderr)
+        return 1
+    if args.json:
+        for row in rows:
+            print(json.dumps(row))
+    else:
+        print(format_table(rows))
+    return 0
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m dhqr_tpu.obs",
+        description="Observability CLIs (dhqr-obs): flight dumps, the "
+        "xray cost/memory table, the perf-regression gate.")
+    sub = parser.add_subparsers(dest="command")
+
+    dump = sub.add_parser(
+        "dump", help="render flight dump files as span paths")
+    dump.add_argument("files", nargs="*", metavar="FILE",
+                      help="flight JSONL file(s); default: every "
+                      "flight_*.jsonl under $DHQR_OBS_DUMP")
+    dump.add_argument("--trace-id", type=int, default=None,
+                      help="only this trace id")
+    dump.add_argument("--json", action="store_true",
+                      help="raw JSON records instead of formatted paths")
+
+    xray = sub.add_parser(
+        "xray", help="render the per-cache-key cost/memory table from "
+        "bench summaries / artifact rows / XrayStore exports")
+    xray.add_argument("files", nargs="*", metavar="FILE")
+    xray.add_argument("--json", action="store_true",
+                      help="one JSON row per key instead of the table")
+
+    regress = sub.add_parser(
+        "regress", help="perf-regression gate over the committed bench "
+        "trajectory (exit 1 on regressions)")
+    regress.add_argument("--repo", default=None)
+    regress.add_argument("--rules", default=None)
+    regress.add_argument("--waivers", default=None)
+    regress.add_argument("--json", action="store_true")
+
+    args = parser.parse_args(argv)
+    if args.command == "dump":
+        return _cmd_dump(args)
+    if args.command == "xray":
+        return _cmd_xray(args)
+    if args.command == "regress":
+        from dhqr_tpu.obs import regress as _regress
+
+        argv2 = []
+        for flag in ("repo", "rules", "waivers"):
+            if getattr(args, flag):
+                argv2 += [f"--{flag}", getattr(args, flag)]
+        if args.json:
+            argv2.append("--json")
+        return _regress.main(argv2)
+    parser.error("a command is required (dump | xray | regress)")
+    return 2
 
 
 if __name__ == "__main__":

@@ -1,0 +1,105 @@
+"""Analytic per-engine flop model — the denominator of every MFU claim.
+
+The TPU linear-algebra paper (arXiv 2112.09017) reports QR/DMM results
+as *fraction of peak per chip*; the reference repo prints runtime ratios
+only. To report either, the useful-work numerator must be pinned down
+once, in closed form, per engine — not re-derived in each benchmark
+(bench.py's ``4/3 N^3`` was the square-matrix special case of
+:func:`qr_flops`, written inline).
+
+These are the standard LAPACK working-note operation counts for REAL
+dtypes (complex multiplies ~4x the real count; callers on complex
+inputs scale explicitly — nothing here inspects dtypes). They count
+*useful* algorithmic work, deliberately ignoring padding, precision
+emulation passes, and engine bookkeeping — so ``analytic / measured
+cost_analysis flops`` reads as a padding/overhead ratio, and
+``analytic / seconds / peak`` is the honest (conservative) MFU.
+
+Deliberately stdlib-only (no jax, no package deps): the regress gate
+and the xray table renderer import this in any python.
+
+Golden-tested in tests/test_xray.py at three shapes per engine against
+the literal closed forms.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "apply_qt_flops",
+    "back_substitute_flops",
+    "batched_lstsq_flops",
+    "batched_qr_flops",
+    "cholqr_flops",
+    "lstsq_flops",
+    "qr_flops",
+    "tsqr_flops",
+]
+
+
+def qr_flops(m: int, n: int) -> float:
+    """Householder QR factorization of (m, n), m >= n, factor only
+    (packed reflectors + R; Q never formed): ``2mn^2 - (2/3)n^3``
+    (LAPACK geqrf count; the blocked compact-WY engine performs the
+    same leading-order work — the T-factor/aggregation overhead is
+    engine bookkeeping, not counted). Square m = n gives the
+    ``(4/3)n^3`` bench.py always used."""
+    m, n = float(m), float(n)
+    return 2.0 * m * n * n - (2.0 / 3.0) * n ** 3
+
+
+def apply_qt_flops(m: int, n: int, k: int = 1) -> float:
+    """Apply Q^T (m x n packed reflectors) to an (m, k) block:
+    ``4mnk - 2n^2 k`` (LAPACK ormqr count; k = 1 for a vector RHS)."""
+    m, n, k = float(m), float(n), float(k)
+    return 4.0 * m * n * k - 2.0 * n * n * k
+
+
+def back_substitute_flops(n: int, k: int = 1) -> float:
+    """Triangular solve with the n x n R against k right-hand sides:
+    ``n^2 k``."""
+    n, k = float(n), float(k)
+    return n * n * k
+
+
+def lstsq_flops(m: int, n: int, refine: int = 0) -> float:
+    """QR least squares on (m, n) with one RHS vector: factor + Q^T b +
+    back substitution, plus ``refine`` iterative-refinement sweeps
+    (each: residual matvec ``2mn`` + one more apply/solve pair)."""
+    base = (qr_flops(m, n) + apply_qt_flops(m, n, 1)
+            + back_substitute_flops(n, 1))
+    sweep = (2.0 * float(m) * float(n) + apply_qt_flops(m, n, 1)
+             + back_substitute_flops(n, 1))
+    return base + max(0, int(refine)) * sweep
+
+
+def tsqr_flops(m: int, n: int, p: int) -> float:
+    """Communication-avoiding TSQR on (m, n) over ``p`` row blocks:
+    ``p`` local QRs of (m/p, n) plus ``p - 1`` pairwise combine QRs of
+    stacked (2n, n) blocks (the binary reduction tree performs exactly
+    p - 1 combines regardless of its shape)."""
+    p = max(1, int(p))
+    return p * qr_flops(m / p, n) + (p - 1) * qr_flops(2 * n, n)
+
+
+def cholqr_flops(m: int, n: int, passes: int = 2) -> float:
+    """CholeskyQR on (m, n), ``passes`` Gram/Cholesky/solve passes
+    (cholqr2 = 2, cholqr3 = 3). Per pass: Gram matrix ``m n^2`` (syrk,
+    symmetric half), Cholesky ``n^3 / 3``, triangular solve of the m x n
+    block ``m n^2``."""
+    m, n = float(m), float(n)
+    per_pass = 2.0 * m * n * n + (n ** 3) / 3.0
+    return max(1, int(passes)) * per_pass
+
+
+def batched_qr_flops(batch: int, m: int, n: int) -> float:
+    """Stacked (batch, m, n) factor-only dispatch of the vmapped
+    blocked engine: batch independent factorizations."""
+    return max(0, int(batch)) * qr_flops(m, n)
+
+
+def batched_lstsq_flops(batch: int, m: int, n: int,
+                        refine: int = 0) -> float:
+    """Stacked (batch, m, n) + (batch, m) least-squares dispatch:
+    batch independent single-RHS solves (in-program refinement sweeps
+    included, as on :func:`lstsq_flops`)."""
+    return max(0, int(batch)) * lstsq_flops(m, n, refine=refine)

@@ -170,19 +170,53 @@ class MetricsRegistry:
 
     def export_prometheus(self, namespace: str = "dhqr") -> str:
         """The Prometheus text exposition format: one ``# TYPE``-tagged
-        gauge per metric, dots/invalid chars folded to underscores.
-        (Gauge, not counter, uniformly: the registry also carries
+        gauge per metric, every name sanitized through
+        :func:`prometheus_name` (round-15 hygiene: dotted registry
+        names — and the dashes/colons inside bucket labels and fault
+        site names — must land as VALID prometheus identifiers, and two
+        registry names that sanitize identically must not emit
+        conflicting duplicate series, so collisions get a
+        deterministic ``_dupN`` suffix in sorted-name order). (Gauge,
+        not counter, uniformly: the registry also carries
         occupancy/percentile values, and a scraper treats a
         monotonically increasing gauge correctly.)"""
         lines = []
-        for name, value in self.snapshot().items():
-            metric = re.sub(r"[^a-zA-Z0-9_]", "_", f"{namespace}_{name}")
+        seen: "dict[str, int]" = {}
+        for name, value in self.snapshot().items():  # sorted by name
+            metric = prometheus_name(name, namespace=namespace)
+            bump = seen.get(metric, 0)
+            seen[metric] = bump + 1
+            if bump:
+                metric = f"{metric}_dup{bump}"
             lines.append(f"# TYPE {metric} gauge")
             if value == int(value):
                 lines.append(f"{metric} {int(value)}")
             else:
                 lines.append(f"{metric} {value}")
         return "\n".join(lines) + ("\n" if lines else "")
+
+
+#: What a Prometheus metric name must match (the exposition-format
+#: grammar, colons excluded — they are reserved for recording rules).
+_PROM_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def prometheus_name(name: str, namespace: str = "dhqr") -> str:
+    """One dotted registry name as a VALID prometheus identifier:
+    ``serve.cache.hits`` -> ``dhqr_serve_cache_hits``. Every character
+    outside ``[a-zA-Z0-9_]`` folds to ``_`` (dots, the dashes/colons in
+    bucket labels like ``64x16:float32``, braces from raw XLA property
+    names), runs collapse to one ``_``, and a leading digit — possible
+    only with an empty namespace — gets a ``_`` prefix. The round-trip
+    test in tests/test_obs.py holds this over the full live registry
+    snapshot."""
+    raw = f"{namespace}_{name}" if namespace else str(name)
+    metric = re.sub(r"_+", "_", re.sub(r"[^a-zA-Z0-9_]", "_", raw))
+    metric = metric.rstrip("_") or "_"
+    if not re.match(r"[a-zA-Z_]", metric):
+        metric = "_" + metric
+    assert _PROM_NAME_RE.match(metric), (name, metric)
+    return metric
 
 
 # --------------------------------------------------------------------------
@@ -250,6 +284,18 @@ def _obs_provider() -> dict:
     return recorder.stats()
 
 
+def _xray_provider() -> dict:
+    """The armed xray store's capture accounting (``xray.captures`` /
+    ``xray.reports`` / ``xray.unsupported`` ...), empty when capture is
+    disarmed — same armed-harness pattern as ``faults.*``/``obs.*``."""
+    from dhqr_tpu.obs import xray as _xray
+
+    store = _xray.active()
+    if store is None:
+        return {}
+    return store.stats()
+
+
 _REGISTRY: "MetricsRegistry | None" = None
 _REGISTRY_LOCK = threading.Lock()
 
@@ -260,6 +306,7 @@ def _new_default_registry() -> MetricsRegistry:
     reg.register("tune.plan_gate", _tune_provider)
     reg.register("numeric", _numeric_provider)
     reg.register("obs", _obs_provider)
+    reg.register("xray", _xray_provider)
     # serve.cache.* / serve.sched.* have no lazy provider: every
     # ExecutableCache and AsyncScheduler instance self-registers at
     # construction (weakly — test instances evaporate with GC).

@@ -1,0 +1,484 @@
+"""dhqr-regress: the perf-regression gate over the committed bench trajectory.
+
+``python -m dhqr_tpu.obs regress`` parses the repository's committed
+measurement trajectory — the driver's ``BENCH_r*.json`` round records
+and every ``benchmarks/results/*.jsonl`` artifact row — keys rows by
+(metric, stage, platform, device_kind), applies the declarative
+tolerance rules in ``benchmarks/regress_rules.json``, and exits
+nonzero with a per-key verdict table when a round's artifacts got
+WORSE than the trajectory allows (a throughput floor under the best
+prior round, a residual above the accuracy bar, armed-observability
+overhead past its budget). Wired into ``tools/lint.sh``, so every PR
+lands against a machine-checked baseline instead of a hand-read diff
+— the same promotion dhqr-lint made for static invariants.
+
+Deliberately **stdlib-only** (no jax, no package deps beyond this
+file): the gate must run in any python, including one where backend
+bring-up would hang — the obs-CLI discipline (``obs.trace`` module
+docstring). On a host where jax cannot even IMPORT, invoke this file
+directly (``python dhqr_tpu/obs/regress.py`` — the tools/lint.sh
+spelling; it has its own ``__main__``): the ``-m dhqr_tpu.obs``
+convenience spelling imports the dhqr_tpu package, which pulls jax.
+tests/test_regress.py pins the import-without-jax property by loading
+this file with jax import-blocked.
+
+Rule kinds (``benchmarks/regress_rules.json``; docs/DESIGN.md "Device
+observability" carries the schema):
+
+* ``min_ratio_vs_best_prior`` — group matching rows by ``key_by``
+  fields; within each group, the best value of the LATEST round must
+  be >= ``min_ratio`` x the best value of any PRIOR round. Groups with
+  data from fewer than two rounds SKIP (the gate bites as the
+  trajectory grows, it never fails vacuously).
+* ``min_value`` / ``max_value`` — every matching row's ``field`` (or
+  every field matching ``field_prefix``) must sit on the right side of
+  the bound.
+* ``require_true`` — every matching row's ``field`` must be truthy
+  (verdict booleans).
+
+Row selection: ``select.metric`` / ``metric_prefix`` /
+``metric_suffix``, plus ``where`` (field must be in the listed values;
+``null`` in the list accepts an absent field) and ``where_not`` (field
+must NOT be in the listed values; absent passes).
+
+Deliberate trade-offs are WAIVED, not deleted:
+``benchmarks/regress_waivers.json`` lists ``{rule, key, reason}``
+entries — the dhqr-lint-baseline mechanism transplanted — and the
+verdict table prints the reason next to every WAIVED key, so an
+accepted regression stays visible in every run instead of silently
+absorbed. Stale waivers (matching nothing) are reported.
+
+Row vintage: rows missing ``schema_version`` are treated as v0 (the
+pre-round-15 artifact shape); rows missing ``round`` inherit the
+``BENCH_r<N>`` filename's round or vintage 0 (the round-3 probe
+artifacts predate the tag). TPU rows missing ``device_kind`` default
+to "TPU v5 lite" — every committed TPU artifact was measured on the
+axon v5e (the bench._best_recorded_tpu convention).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+#: Rows missing an explicit schema_version are this vintage (the
+#: pre-round-15 artifact shape). Bump SCHEMA_VERSION in bench.py when
+#: the row shape changes incompatibly; the parser here keys on it.
+SCHEMA_V0 = 0
+
+#: The documented default chip for committed TPU rows that predate the
+#: device_kind field (bench._best_recorded_tpu applies the same rule).
+_TPU_DEFAULT_KIND = "TPU v5 lite"
+
+_BENCH_FILE_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+# ------------------------------------------------------------- trajectory
+
+def _rows_from_jsonl(path: str):
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            lines = fh.readlines()
+    except OSError:
+        return
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(row, dict):
+            yield row
+
+
+def _rows_from_bench_json(path: str):
+    """BENCH_r<N>.json: the driver's round record — its ``tail`` field
+    interleaves stderr markers with the bench's emitted JSON lines;
+    every parseable JSON object in it is a trajectory row, defaulting
+    its round to the filename's."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return
+    m = _BENCH_FILE_RE.search(os.path.basename(path))
+    file_round = int(m.group(1)) if m else None
+    for line in str(data.get("tail", "")).splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(row, dict):
+            if file_round is not None:
+                row.setdefault("round", file_round)
+            yield row
+
+
+def collect_trajectory(repo: str) -> "list[dict]":
+    """Every committed trajectory row, normalized: ``_round`` (int
+    vintage, 0 when untagged), ``_schema`` (schema_version, v0 when
+    absent), ``_source`` (display basename), device_kind defaulted for
+    TPU rows."""
+    rows = []
+    sources = []
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
+        sources.append((path, _rows_from_bench_json(path)))
+    results_dir = os.path.join(repo, "benchmarks", "results")
+    for path in sorted(glob.glob(os.path.join(results_dir, "*.jsonl"))):
+        sources.append((path, _rows_from_jsonl(path)))
+    for path, it in sources:
+        base = os.path.basename(path)
+        for row in it:
+            row = dict(row)
+            try:
+                row["_round"] = int(row.get("round", 0) or 0)
+            except (TypeError, ValueError):
+                row["_round"] = 0
+            try:
+                row["_schema"] = int(row.get("schema_version", SCHEMA_V0))
+            except (TypeError, ValueError):
+                row["_schema"] = SCHEMA_V0
+            row["_source"] = base
+            if not row.get("device_kind") and row.get("platform"):
+                # Vintage rows predate the device_kind field: TPU rows
+                # were all measured on the axon v5e (the documented
+                # bench._best_recorded_tpu default); other platforms
+                # key on the platform name itself.
+                row["device_kind"] = _TPU_DEFAULT_KIND \
+                    if row["platform"] == "tpu" else row["platform"]
+            rows.append(row)
+    return rows
+
+
+# ------------------------------------------------------------------ rules
+
+class RuleError(ValueError):
+    """A malformed rules/waivers file — exit 2, never a silent green."""
+
+
+def _in_values(row_value, values, present: bool) -> bool:
+    """Is ``row_value`` one of ``values``? ``null`` in the list accepts
+    an ABSENT field (and an explicit JSON null)."""
+    if not present or row_value is None:
+        return None in values
+    return row_value in values
+
+
+def _matches(rule: dict, row: dict) -> bool:
+    sel = rule.get("select", {})
+    metric = str(row.get("metric", ""))
+    if "metric" in sel and metric != sel["metric"]:
+        return False
+    if "metric_prefix" in sel and not metric.startswith(
+            sel["metric_prefix"]):
+        return False
+    if "metric_suffix" in sel and not metric.endswith(
+            sel["metric_suffix"]):
+        return False
+    if "metric" not in sel and "metric_prefix" not in sel \
+            and "metric_suffix" not in sel:
+        raise RuleError(
+            f"rule {rule.get('id')!r}: select needs metric, "
+            "metric_prefix or metric_suffix")
+    for field, values in (sel.get("where") or {}).items():
+        values = values if isinstance(values, list) else [values]
+        if not _in_values(row.get(field), values, field in row):
+            return False
+    for field, values in (sel.get("where_not") or {}).items():
+        values = values if isinstance(values, list) else [values]
+        if field in row and row.get(field) in values:
+            return False
+    return True
+
+
+def _key_of(rule: dict, row: dict) -> str:
+    fields = rule.get("key_by") or ["metric", "stage", "platform",
+                                    "device_kind"]
+    return "|".join(str(row.get(f, "-")) for f in fields)
+
+
+class Verdict:
+    """One per-key outcome: PASS / FAIL / SKIP (plus WAIVED applied in
+    :func:`apply_waivers`)."""
+
+    def __init__(self, rule_id: str, key: str, status: str, detail: str,
+                 reason: str = "") -> None:
+        self.rule_id = rule_id
+        self.key = key
+        self.status = status
+        self.detail = detail
+        self.reason = reason
+
+    def to_json(self) -> dict:
+        out = {"rule": self.rule_id, "key": self.key,
+               "status": self.status, "detail": self.detail}
+        if self.reason:
+            out["reason"] = self.reason
+        return out
+
+
+def _fields_of(rule: dict, row: dict) -> "list[tuple[str, object]]":
+    if "field" in rule:
+        if rule["field"] in row:
+            return [(rule["field"], row[rule["field"]])]
+        return []
+    prefix = rule.get("field_prefix")
+    if not prefix:
+        raise RuleError(
+            f"rule {rule.get('id')!r}: needs field or field_prefix")
+    return sorted((k, v) for k, v in row.items() if k.startswith(prefix))
+
+
+def _check_bound(rule: dict, rows: "list[dict]") -> "list[Verdict]":
+    """Bound/boolean rules, ONE verdict per key: the worst matching
+    row decides (a trajectory re-emits the same measurement many times
+    — banked rows, best-so-far summaries — and a verdict per row would
+    bury the table in duplicates)."""
+    kind = rule["kind"]
+    # key -> (worst_value, detail_row, row_count)
+    worst: "dict[str, tuple]" = {}
+    for row in rows:
+        for name, value in _fields_of(rule, row):
+            key = _key_of(rule, row) + f"|{name}"
+            if kind == "require_true":
+                rank = 0 if value else 1  # any falsy row wins (worst)
+            elif not isinstance(value, (int, float)) \
+                    or isinstance(value, bool):
+                continue  # non-numeric field under a numeric bound
+            else:
+                rank = value if kind == "max_value" else -value
+            prev = worst.get(key)
+            count = 1 if prev is None else prev[4] + 1
+            if prev is None or rank > prev[0]:
+                worst[key] = (rank, name, value, row, count)
+            else:
+                worst[key] = prev[:4] + (count,)
+    out = []
+    for key in sorted(worst):
+        _rank, name, value, row, count = worst[key]
+        of = f", worst of {count} rows" if count > 1 else ""
+        if kind == "require_true":
+            out.append(Verdict(
+                rule["id"], key, "PASS" if value else "FAIL",
+                f"{name}={value!r} ({row['_source']}{of})"))
+            continue
+        bound = float(rule["max"] if kind == "max_value"
+                      else rule["min"])
+        ok = value <= bound if kind == "max_value" else value >= bound
+        cmp = "<=" if kind == "max_value" else ">="
+        out.append(Verdict(
+            rule["id"], key, "PASS" if ok else "FAIL",
+            f"{name}={value:g} {cmp} {bound:g} "
+            f"(round {row['_round']}, {row['_source']}{of})"))
+    return out
+
+
+def _check_ratio(rule: dict, rows: "list[dict]") -> "list[Verdict]":
+    value_field = rule.get("value_field", "value")
+    min_ratio = float(rule["min_ratio"])
+    groups: "dict[str, list[dict]]" = {}
+    for row in rows:
+        if isinstance(row.get(value_field), (int, float)) \
+                and not isinstance(row.get(value_field), bool):
+            groups.setdefault(_key_of(rule, row), []).append(row)
+    out = []
+    for key in sorted(groups):
+        grows = groups[key]
+        rounds = sorted({r["_round"] for r in grows})
+        if len(rounds) < 2:
+            out.append(Verdict(
+                rule["id"], key, "SKIP",
+                f"only round {rounds[0]} has qualifying rows"))
+            continue
+        latest = rounds[-1]
+        best_latest = max(r[value_field] for r in grows
+                          if r["_round"] == latest)
+        best_prior = max(r[value_field] for r in grows
+                         if r["_round"] < latest)
+        if best_prior <= 0:
+            out.append(Verdict(rule["id"], key, "SKIP",
+                               f"best prior value {best_prior:g} <= 0"))
+            continue
+        ratio = best_latest / best_prior
+        out.append(Verdict(
+            rule["id"], key, "PASS" if ratio >= min_ratio else "FAIL",
+            f"round {latest}: {best_latest:g} = {ratio:.3f}x best prior "
+            f"{best_prior:g} (floor {min_ratio:g}x)"))
+    return out
+
+
+_RULE_KINDS = {
+    "min_ratio_vs_best_prior": _check_ratio,
+    "min_value": _check_bound,
+    "max_value": _check_bound,
+    "require_true": _check_bound,
+}
+
+
+def evaluate(rules: dict, rows: "list[dict]") -> "list[Verdict]":
+    if not isinstance(rules, dict) or not isinstance(
+            rules.get("rules"), list):
+        raise RuleError("rules file must be {'version': ..., 'rules': [...]}")
+    verdicts = []
+    for rule in rules["rules"]:
+        if not rule.get("id"):
+            raise RuleError(f"rule without id: {rule!r}")
+        kind = rule.get("kind")
+        checker = _RULE_KINDS.get(kind)
+        if checker is None:
+            raise RuleError(
+                f"rule {rule['id']!r}: unknown kind {kind!r} "
+                f"(have {sorted(_RULE_KINDS)})")
+        matching = [r for r in rows if _matches(rule, r)]
+        if not matching:
+            verdicts.append(Verdict(rule["id"], "-", "SKIP",
+                                    "no trajectory rows match"))
+            continue
+        verdicts.extend(checker(rule, matching))
+    return verdicts
+
+
+def apply_waivers(verdicts: "list[Verdict]",
+                  waivers: dict) -> "list[str]":
+    """Convert FAILs with a matching ``{rule, key}`` waiver to WAIVED
+    (reason attached); returns the STALE waiver descriptions — entries
+    that matched no failing verdict — so a fixed regression's waiver is
+    flagged for removal rather than lying in wait."""
+    entries = list((waivers or {}).get("waivers", []))
+    for entry in entries:
+        if not entry.get("rule") or not entry.get("key") \
+                or not entry.get("reason"):
+            raise RuleError(
+                f"waiver needs rule, key and reason: {entry!r}")
+    used = [False] * len(entries)
+    for verdict in verdicts:
+        if verdict.status != "FAIL":
+            continue
+        for i, entry in enumerate(entries):
+            if entry["rule"] == verdict.rule_id \
+                    and entry["key"] == verdict.key:
+                verdict.status = "WAIVED"
+                verdict.reason = entry["reason"]
+                used[i] = True
+                break
+    return [f"{e['rule']} {e['key']}" for e, u in zip(entries, used)
+            if not u]
+
+
+def format_verdicts(verdicts: "list[Verdict]") -> str:
+    """The readable per-key verdict table (FAILs first, then WAIVED,
+    then PASS, SKIPs last)."""
+    order = {"FAIL": 0, "WAIVED": 1, "PASS": 2, "SKIP": 3}
+    rows = [("status", "rule", "key", "detail")]
+    for v in sorted(verdicts,
+                    key=lambda v: (order.get(v.status, 9), v.rule_id,
+                                   v.key)):
+        detail = v.detail + (f"  [waived: {v.reason}]" if v.reason else "")
+        rows.append((v.status, v.rule_id, v.key, detail))
+    widths = [max(len(r[i]) for r in rows) for i in range(3)]
+    lines = []
+    for i, r in enumerate(rows):
+        lines.append("  ".join(
+            [r[0].ljust(widths[0]), r[1].ljust(widths[1]),
+             r[2].ljust(widths[2]), r[3]]).rstrip())
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths)
+                         + "  " + "-" * 6)
+    return "\n".join(lines)
+
+
+def run_gate(repo: str, rules_path: str,
+             waivers_path: "str | None" = None,
+             as_json: bool = False,
+             out=None) -> int:
+    """The CLI body: 0 green, 1 regression(s), 2 malformed inputs."""
+    out = out or sys.stdout
+    try:
+        with open(rules_path, "r", encoding="utf-8") as fh:
+            rules = json.load(fh)
+    except (OSError, ValueError) as e:
+        print(f"regress: cannot load rules {rules_path}: {e}",
+              file=sys.stderr)
+        return 2
+    waivers = {}
+    if waivers_path and os.path.exists(waivers_path):
+        try:
+            with open(waivers_path, "r", encoding="utf-8") as fh:
+                waivers = json.load(fh)
+        except (OSError, ValueError) as e:
+            print(f"regress: cannot load waivers {waivers_path}: {e}",
+                  file=sys.stderr)
+            return 2
+    rows = collect_trajectory(repo)
+    if not rows:
+        print(f"regress: no trajectory rows under {repo} "
+              "(BENCH_r*.json / benchmarks/results/*.jsonl)",
+              file=sys.stderr)
+        return 2
+    try:
+        verdicts = evaluate(rules, rows)
+        stale = apply_waivers(verdicts, waivers)
+    except RuleError as e:
+        print(f"regress: {e}", file=sys.stderr)
+        return 2
+    failed = sum(1 for v in verdicts if v.status == "FAIL")
+    if as_json:
+        print(json.dumps({
+            "rows": len(rows), "failed": failed,
+            "waived": sum(1 for v in verdicts if v.status == "WAIVED"),
+            "stale_waivers": stale,
+            "verdicts": [v.to_json() for v in verdicts],
+        }, indent=2), file=out)
+    else:
+        print(format_verdicts(verdicts), file=out)
+        counts = {}
+        for v in verdicts:
+            counts[v.status] = counts.get(v.status, 0) + 1
+        summary = ", ".join(f"{n} {s}" for s, n in sorted(counts.items()))
+        print(f"\nregress: {len(rows)} trajectory rows -> {summary}",
+              file=out)
+        for s in stale:
+            print(f"regress: STALE waiver (matched no failure): {s}",
+                  file=out)
+    return 1 if failed else 0
+
+
+def main(argv=None) -> int:
+    default_repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    parser = argparse.ArgumentParser(
+        prog="python -m dhqr_tpu.obs regress",
+        description="dhqr-regress: perf-regression gate over the "
+        "committed bench trajectory (jax-free).")
+    parser.add_argument("--repo", default=default_repo,
+                        help="repository root holding BENCH_r*.json and "
+                        "benchmarks/results/ (default: this checkout)")
+    parser.add_argument("--rules", default=None,
+                        help="rules JSON (default: "
+                        "<repo>/benchmarks/regress_rules.json)")
+    parser.add_argument("--waivers", default=None,
+                        help="waivers JSON (default: "
+                        "<repo>/benchmarks/regress_waivers.json, if "
+                        "present)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable verdicts")
+    args = parser.parse_args(argv)
+    rules = args.rules or os.path.join(args.repo, "benchmarks",
+                                       "regress_rules.json")
+    waivers = args.waivers or os.path.join(args.repo, "benchmarks",
+                                           "regress_waivers.json")
+    return run_gate(args.repo, rules, waivers_path=waivers,
+                    as_json=args.json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -264,23 +264,34 @@ def _swap_active_locked(recorder: "TraceRecorder | None") -> None:
 
 def arm(config: "ObsConfig | None" = None,
         clock=time.monotonic) -> "TraceRecorder | None":
-    """Arm process-wide tracing from ``config`` (default: the
-    environment's ``DHQR_OBS*``). A config with ``enabled=False``
-    DISARMS (so ``obs.arm()`` with no env set is a no-op, exactly like
-    ``faults.install()`` with no sites). Returns the armed recorder, or
-    None when left disarmed."""
+    """Arm process-wide observability from ``config`` (default: the
+    environment's ``DHQR_OBS*``), DECLARATIVELY: tracing iff
+    ``config.enabled``, xray capture (``dhqr_tpu.obs.xray``, round 15)
+    iff ``config.xray`` — each field disarms its subsystem when false,
+    so ``obs.arm()`` with no env set is a no-op, exactly like
+    ``faults.install()`` with no sites. Returns the armed trace
+    recorder, or None when tracing is left disarmed."""
+    from dhqr_tpu.obs import xray as _xray
+
     cfg = config if config is not None else ObsConfig.from_env()
     recorder = TraceRecorder(cfg, clock=clock) if cfg.enabled else None
     with _ARM_LOCK:
         _swap_active_locked(recorder)
+    if cfg.xray:
+        _xray.arm(max_reports=cfg.xray_reports)
+    else:
+        _xray.disarm()
     return recorder
 
 
 def disarm() -> None:
     """Back to the zero-overhead path (the ring and its spans are
-    dropped with the recorder)."""
+    dropped with the recorder; the xray store with its reports)."""
+    from dhqr_tpu.obs import xray as _xray
+
     with _ARM_LOCK:
         _swap_active_locked(None)
+    _xray.disarm()
 
 
 def active() -> Optional[TraceRecorder]:

@@ -1,0 +1,448 @@
+"""dhqr-xray: compiled-program cost/memory introspection + MFU/roofline.
+
+Round 15's device-level half of observability. PR 9 (trace/metrics/
+flight recorder) answers *what happened to a request*; this module
+answers *where the flops and bytes go inside each compiled executable*
+— the evidence ROADMAP items 1–2 need before the next TPU window, and
+the per-chip fraction-of-peak accounting the TPU linear-algebra paper
+(arXiv 2112.09017) reports its results in.
+
+One :class:`XrayReport` per compiled program pairs three sources:
+
+* the executable's own ``cost_analysis()`` / ``memory_analysis()``
+  (compat-shimmed in ``utils/compat.py`` — jax-0.4 list shapes
+  normalized, unsupported backends degrade to ``None`` + reason,
+  NEVER a raised exception on the compile path);
+* the analytic per-engine flop model (``obs.flops`` closed forms) —
+  the *useful-work* numerator, so ``measured / analytic`` reads as
+  padding+overhead and ``analytic / seconds / peak`` is the honest MFU;
+* the ``device_kind -> peak TF/s / HBM GB/s`` table
+  (``utils/platform``) — the denominators, giving the roofline
+  position: arithmetic intensity vs the ridge point decides
+  compute- vs memory-bound, and ``min(peak, intensity * bw)`` is the
+  ceiling a perfect kernel could reach.
+
+Capture discipline (the faults/obs pattern): the serving stack's
+single compile entry (``serve.cache.ExecutableCache.get_or_compile``)
+consults :func:`active` ON ITS MISS PATH ONLY — disarmed, warm serving
+never reads even the module global; armed, each *compile* (already
+seconds-scale) pays one sub-millisecond introspection and warm
+dispatches pay nothing, so armed capture holds the <= 5% overhead bar
+by construction (pinned by benchmarks/serving_xray.py). Arm via
+``ObsConfig.xray`` / ``DHQR_OBS_XRAY`` + :func:`dhqr_tpu.obs.arm`, or
+scope with :func:`captured`. bench.py captures its stage programs
+directly through :func:`report_for` (no arming — its compiles are
+counted in single digits).
+
+This module imports no jax at module level (the table renderer and
+report maths must work in any python); only :func:`report_for` touches
+the compat shims, and only when handed a live executable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+from typing import Optional
+
+from dhqr_tpu.obs import flops as _flops
+
+__all__ = [
+    "XrayReport",
+    "XrayStore",
+    "active",
+    "arm",
+    "captured",
+    "disarm",
+    "format_table",
+    "report_for",
+    "rows_from_json",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class XrayReport:
+    """Cost/memory introspection of ONE compiled program.
+
+    ``measured``/``memory`` are the compat-normalized XLA analyses (or
+    None, with the refusal spelled out in ``measured_unavailable`` /
+    ``memory_unavailable`` respectively — "null with reason", never
+    silently absent; the two analyses can fail independently). ``analytic_flops`` is
+    the closed-form useful-work count for the program's engine/shape
+    (None for programs the model does not cover). Roofline fields are
+    populated when both the device table knows the chip AND the
+    measured byte count exists; otherwise ``roofline_bound`` is None
+    and ``roofline_reason`` says why. MFU needs a wall-time, which a
+    compile-time capture does not have — :meth:`mfu` derives it when a
+    caller pairs the report with measured seconds."""
+
+    key: str
+    analytic_flops: "float | None" = None
+    measured: "dict | None" = None
+    memory: "dict | None" = None
+    measured_unavailable: "str | None" = None
+    memory_unavailable: "str | None" = None
+    device_kind: "str | None" = None
+    dtype: "str | None" = None
+    peak_tflops: "float | None" = None
+    hbm_gbps: "float | None" = None
+    intensity_flops_per_byte: "float | None" = None
+    ridge_flops_per_byte: "float | None" = None
+    roofline_bound: "str | None" = None
+    roofline_reason: "str | None" = None
+    ceiling_gflops: "float | None" = None
+    compile_seconds: "float | None" = None
+
+    def mfu(self, seconds: float) -> "float | None":
+        """Analytic-flops MFU for one execution taking ``seconds``
+        (None without a known peak or analytic count)."""
+        if not seconds or not self.analytic_flops or not self.peak_tflops:
+            return None
+        return (self.analytic_flops / seconds) / (self.peak_tflops * 1e12)
+
+    def achieved_gflops(self, seconds: float) -> "float | None":
+        if not seconds or not self.analytic_flops:
+            return None
+        return self.analytic_flops / seconds / 1e9
+
+    def to_json(self) -> dict:
+        """JSON-ready record — the shape bench summaries, artifact rows
+        and the ``obs xray`` table all speak."""
+        out = {"key": self.key, "analytic_flops": self.analytic_flops}
+        if self.measured is not None:
+            out["measured_cost_analysis"] = {
+                "flops": self.measured.get("flops"),
+                "bytes_accessed": self.measured.get("bytes accessed"),
+            }
+        else:
+            out["measured_cost_analysis"] = None
+            out["measured_unavailable"] = (
+                self.measured_unavailable or "no analysis captured")
+        if self.memory is not None:
+            out["memory"] = dict(self.memory)
+        else:
+            out["memory"] = None
+            out["memory_unavailable"] = (
+                self.memory_unavailable or "no analysis captured")
+        for field in ("device_kind", "dtype", "peak_tflops", "hbm_gbps",
+                      "intensity_flops_per_byte", "ridge_flops_per_byte",
+                      "roofline_bound", "roofline_reason",
+                      "ceiling_gflops", "compile_seconds"):
+            val = getattr(self, field)
+            if val is not None:
+                out[field] = val
+        if self.roofline_bound is None and "roofline_reason" not in out:
+            out["roofline_reason"] = "no roofline basis captured"
+        out.setdefault("roofline_bound", None)
+        return out
+
+
+def _roofline(analytic, measured, peak_tflops, hbm_gbps):
+    """(intensity, ridge, bound, reason, ceiling) from whatever subset
+    of the basis exists. Intensity uses the ANALYTIC flop count over
+    the MEASURED bytes: useful work per byte actually moved — the
+    padding-honest reading (padded flops would flatter intensity)."""
+    flops = analytic if analytic else (
+        measured.get("flops") if measured else None)
+    bytes_accessed = measured.get("bytes accessed") if measured else None
+    if not flops or not bytes_accessed:
+        return (None, None, None,
+                "cost_analysis byte count unavailable", None)
+    intensity = flops / bytes_accessed
+    if not peak_tflops or not hbm_gbps:
+        return (round(intensity, 3), None, None,
+                "no published peak/bandwidth for this device_kind",
+                None)
+    ridge = (peak_tflops * 1e12) / (hbm_gbps * 1e9)
+    bound = "compute" if intensity >= ridge else "memory"
+    ceiling = min(peak_tflops * 1e3, intensity * hbm_gbps)
+    return (round(intensity, 3), round(ridge, 3), bound, None,
+            round(ceiling, 1))
+
+
+def _analytic_for_key(key) -> "float | None":
+    """Closed-form flop count for a serve :class:`CacheKey` (duck-typed
+    on its fields so this module never imports serve); None for keys
+    the model does not describe (bench's plain tuples pass analytic
+    explicitly via :func:`report_for`)."""
+    kind = getattr(key, "kind", None)
+    batch = getattr(key, "batch", None)
+    m, n = getattr(key, "m", None), getattr(key, "n", None)
+    if None in (kind, batch, m, n):
+        return None
+    if kind == "qr":
+        return _flops.batched_qr_flops(batch, m, n)
+    if kind == "lstsq":
+        return _flops.batched_lstsq_flops(
+            batch, m, n, refine=getattr(key, "refine", 0) or 0)
+    return None
+
+
+_DEVICE_KIND_CACHE: "list[tuple[str | None, str | None]]" = []
+
+
+def _default_device_kind() -> "tuple[str | None, str | None]":
+    """(device_kind, dtype-agnostic platform) of the default backend,
+    probed lazily ONCE per process and only from capture paths where a
+    backend necessarily exists (a compile just succeeded). Never
+    raises; an unreachable backend reads as (None, None)."""
+    if _DEVICE_KIND_CACHE:
+        return _DEVICE_KIND_CACHE[0]
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        entry = (str(getattr(dev, "device_kind", None)),
+                 str(getattr(dev, "platform", None)))
+    # dhqr: ignore[DHQR006] introspection must never fail the compile that triggered it; an unprobeable backend reads as unknown-chip
+    except Exception:
+        entry = (None, None)
+    _DEVICE_KIND_CACHE.append(entry)
+    return entry
+
+
+def report_for(key, compiled, *, analytic_flops: "float | None" = None,
+               device_kind: "str | None" = None,
+               dtype: "str | None" = None,
+               compile_seconds: "float | None" = None) -> XrayReport:
+    """Build the :class:`XrayReport` for one compiled executable.
+
+    ``key`` is any display-able cache key (serve ``CacheKey``\\ s get
+    their analytic flop count derived automatically; pass
+    ``analytic_flops`` for anything else). Degrades field-by-field and
+    never raises — this runs on compile paths."""
+    from dhqr_tpu.utils.compat import (executable_cost_analysis,
+                                       executable_memory_analysis)
+    from dhqr_tpu.utils.platform import (device_hbm_gbps,
+                                         device_peak_tflops)
+
+    measured, reason = executable_cost_analysis(compiled)
+    memory, mem_reason = executable_memory_analysis(compiled)
+    if analytic_flops is None:
+        analytic_flops = _analytic_for_key(key)
+    if device_kind is None:
+        device_kind, _platform = _default_device_kind()
+    if dtype is None:
+        dtype = str(getattr(key, "dtype", None) or "") or None
+    peak = device_peak_tflops(device_kind, dtype or "float32") \
+        if device_kind else None
+    bw = device_hbm_gbps(device_kind) if device_kind else None
+    intensity, ridge, bound, roof_reason, ceiling = _roofline(
+        analytic_flops, measured, peak, bw)
+    return XrayReport(
+        key=str(key), analytic_flops=analytic_flops, measured=measured,
+        memory=memory, measured_unavailable=reason,
+        memory_unavailable=mem_reason,
+        device_kind=device_kind, dtype=dtype, peak_tflops=peak,
+        hbm_gbps=bw, intensity_flops_per_byte=intensity,
+        ridge_flops_per_byte=ridge, roofline_bound=bound,
+        roofline_reason=roof_reason, ceiling_gflops=ceiling,
+        compile_seconds=(round(compile_seconds, 4)
+                         if compile_seconds is not None else None),
+    )
+
+
+class XrayStore:
+    """Bounded per-cache-key report store for one armed capture session.
+
+    ``capture`` is called by the serve cache's compile path (under the
+    cache lock, so a report's insertion order is its compile order);
+    insertion past ``max_reports`` evicts the oldest (a serving tier
+    must not grow introspection state per key forever — counted)."""
+
+    def __init__(self, max_reports: int = 512) -> None:
+        if max_reports < 1:
+            raise ValueError(
+                f"max_reports must be >= 1, got {max_reports}")
+        self.max_reports = int(max_reports)
+        self._lock = threading.Lock()
+        self._reports: "dict[str, XrayReport]" = {}
+        self._captures = 0
+        self._unsupported = 0
+        self._evicted = 0
+        self._failed = 0
+
+    def capture(self, key, compiled,
+                compile_seconds: "float | None" = None) -> None:
+        """Introspect one freshly compiled executable. Never raises."""
+        try:
+            report = report_for(key, compiled,
+                                compile_seconds=compile_seconds)
+        # dhqr: ignore[DHQR006] capture rides the serve compile path: introspection breakage must cost the report, never the executable
+        except Exception:
+            with self._lock:
+                self._captures += 1
+                self._failed += 1
+            return
+        with self._lock:
+            self._captures += 1
+            if report.measured is None:
+                self._unsupported += 1
+            self._reports[report.key] = report
+            while len(self._reports) > self.max_reports:
+                self._reports.pop(next(iter(self._reports)))
+                self._evicted += 1
+
+    def reports(self) -> "list[XrayReport]":
+        """Resident reports in capture order (oldest first)."""
+        with self._lock:
+            return list(self._reports.values())
+
+    def report(self, key) -> Optional[XrayReport]:
+        with self._lock:
+            return self._reports.get(str(key))
+
+    def stats(self) -> dict:
+        """The ``xray.*`` numbers the metrics registry exports."""
+        with self._lock:
+            return {
+                "captures": self._captures,
+                "reports": len(self._reports),
+                "unsupported": self._unsupported,
+                "evicted": self._evicted,
+                "failed": self._failed,
+                "capacity": self.max_reports,
+            }
+
+    def export_jsonl(self, path: str) -> int:
+        """Append every resident report as one JSON line each (the
+        file format ``python -m dhqr_tpu.obs xray`` renders); returns
+        the number written."""
+        reports = self.reports()
+        with open(path, "a", encoding="utf-8") as fh:
+            for rep in reports:
+                fh.write(json.dumps({"xray": rep.to_json()}) + "\n")
+        return len(reports)
+
+
+# The one armed store (or None — the fast path); same module-global
+# discipline as faults.harness / obs.trace.
+_ACTIVE: "XrayStore | None" = None
+_ARM_LOCK = threading.Lock()
+
+
+def arm(max_reports: int = 512) -> XrayStore:
+    """Arm process-wide capture (normally reached via
+    ``dhqr_tpu.obs.arm`` with ``ObsConfig.xray`` / ``DHQR_OBS_XRAY``)."""
+    global _ACTIVE
+    with _ARM_LOCK:
+        _ACTIVE = XrayStore(max_reports=max_reports)
+        return _ACTIVE
+
+
+def disarm() -> None:
+    global _ACTIVE
+    with _ARM_LOCK:
+        _ACTIVE = None
+
+
+def active() -> Optional[XrayStore]:
+    """The armed store, or None — THE hot-path read (the serve cache
+    consults it on compile misses only)."""
+    return _ACTIVE
+
+
+class captured:
+    """Scope an xray capture session (arm on entry, restore the
+    previous store on exit; scopes nest):
+
+    >>> with xray.captured() as store:
+    ...     serve.prewarm(...)
+    ...     store.reports()
+    """
+
+    def __init__(self, max_reports: int = 512) -> None:
+        self._store = XrayStore(max_reports=max_reports)
+        self._previous: "XrayStore | None" = None
+
+    def __enter__(self) -> XrayStore:
+        global _ACTIVE
+        with _ARM_LOCK:
+            self._previous = _ACTIVE
+            _ACTIVE = self._store
+        return self._store
+
+    def __exit__(self, *exc) -> None:
+        global _ACTIVE
+        with _ARM_LOCK:
+            _ACTIVE = self._previous
+
+
+# ------------------------------------------------------------------ table
+
+def rows_from_json(records) -> "list[dict]":
+    """Extract xray blocks from parsed JSON records (bench summaries,
+    artifact rows, ``export_jsonl`` lines): any dict carrying an
+    ``"xray"`` sub-dict or sub-LIST (the bench prewarm summary stamps
+    the whole per-stage report list), or that IS a report (has
+    ``analytic_flops``)."""
+    rows = []
+    for rec in records:
+        if not isinstance(rec, dict):
+            continue
+        blk = rec.get("xray")
+        blocks = blk if isinstance(blk, list) else [blk]
+        matched = False
+        for one in blocks:
+            if isinstance(one, dict):
+                matched = True
+                row = dict(one)
+                row.setdefault("key", rec.get("stage") or rec.get("metric")
+                               or rec.get("key") or "?")
+                rows.append(row)
+        if not matched and "analytic_flops" in rec:
+            rows.append(dict(rec))
+    return rows
+
+
+def _fmt_flops(value) -> str:
+    if not isinstance(value, (int, float)):
+        return "-"
+    if value >= 1e12:
+        return f"{value / 1e12:.2f}T"
+    if value >= 1e9:
+        return f"{value / 1e9:.2f}G"
+    if value >= 1e6:
+        return f"{value / 1e6:.2f}M"
+    return f"{value:.0f}"
+
+
+def format_table(rows: "list[dict]") -> str:
+    """Aligned per-key table of xray rows (the ``obs xray`` CLI output).
+
+    Columns: key, analytic flops, measured flops, bytes accessed,
+    intensity (flop/byte), roofline bound, ceiling GF/s, MFU (when the
+    row carries one), compile seconds."""
+    header = ("key", "analytic", "measured", "bytes", "f/B", "bound",
+              "ceilGF", "mfu", "compile_s")
+    table = [header]
+    for row in rows:
+        meas = row.get("measured_cost_analysis") or {}
+        mfu = row.get("mfu")
+        table.append((
+            str(row.get("key", "?"))[:48],
+            _fmt_flops(row.get("analytic_flops")),
+            _fmt_flops(meas.get("flops")),
+            _fmt_flops(meas.get("bytes_accessed")),
+            (f"{row['intensity_flops_per_byte']:.1f}"
+             if isinstance(row.get("intensity_flops_per_byte"),
+                           (int, float)) else "-"),
+            str(row.get("roofline_bound") or "-"),
+            (f"{row['ceiling_gflops']:.0f}"
+             if isinstance(row.get("ceiling_gflops"), (int, float))
+             else "-"),
+            (f"{mfu:.4f}" if isinstance(mfu, (int, float)) else "-"),
+            (f"{row['compile_seconds']:.2f}"
+             if isinstance(row.get("compile_seconds"), (int, float))
+             else "-"),
+        ))
+    widths = [max(len(r[i]) for r in table) for i in range(len(header))]
+    lines = []
+    for i, r in enumerate(table):
+        lines.append("  ".join(
+            c.ljust(w) if j == 0 else c.rjust(w)
+            for j, (c, w) in enumerate(zip(r, widths))))
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)

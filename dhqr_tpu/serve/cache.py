@@ -46,6 +46,9 @@ from dhqr_tpu.faults import harness as _faults
 # obs.metrics only reads utils/* (providers import their subjects
 # lazily), so this import stays acyclic like the faults one above.
 from dhqr_tpu.obs import metrics as _obs_metrics
+# obs.xray imports only obs.flops at module level (compat/platform are
+# reached lazily from capture paths) — acyclic for the same reason.
+from dhqr_tpu.obs import xray as _obs_xray
 from dhqr_tpu.serve.errors import CompileFailed, Quarantined
 from dhqr_tpu.utils.config import ServeConfig
 from dhqr_tpu.utils.profiling import Counters, PhaseTimer
@@ -165,8 +168,18 @@ class ExecutableCache:
                 raise CompileFailed(key, e) from e
             # The timer is the ONE source of compile wall time; the
             # counter mirrors it so stats() stays a flat JSON dict.
-            self.counters.bump("compile_seconds",
-                               self.timer.total("aot_compile") - before)
+            compile_s = self.timer.total("aot_compile") - before
+            self.counters.bump("compile_seconds", compile_s)
+            # dhqr-xray (round 15): armed capture introspects the fresh
+            # executable's cost/memory analysis HERE — the one compile
+            # entry of the serving stack — so every compiled program
+            # gets a report without a second code path. On the MISS
+            # branch only: disarmed (and on every warm hit) this line
+            # never runs; armed, the sub-ms capture rides a
+            # seconds-scale compile. capture() never raises.
+            xray_store = _obs_xray.active()
+            if xray_store is not None:
+                xray_store.capture(key, exe, compile_seconds=compile_s)
             self._entries[key] = exe
             while len(self._entries) > self.max_size:
                 self._entries.popitem(last=False)

@@ -345,6 +345,23 @@ def _problem(kind: str, m: int, n: int, dtype, seed: int):
     return draw((TUNE_SERVE_BATCH, m, n)), draw((TUNE_SERVE_BATCH, m))
 
 
+def _analytic_flops(kind: str, m: int, n: int) -> "float | None":
+    """Closed-form useful-work flops for one timed call of ``kind``
+    (dhqr_tpu.obs.flops — the serve kinds time a TUNE_SERVE_BATCH
+    stacked dispatch)."""
+    from dhqr_tpu.obs import flops as _oflops
+
+    if kind == "qr":
+        return _oflops.qr_flops(m, n)
+    if kind == "lstsq":
+        return _oflops.lstsq_flops(m, n)
+    if kind == "serve_qr":
+        return _oflops.batched_qr_flops(TUNE_SERVE_BATCH, m, n)
+    if kind == "serve_lstsq":
+        return _oflops.batched_lstsq_flops(TUNE_SERVE_BATCH, m, n)
+    return None
+
+
 def _measure_wall(plan: Plan, runner: Callable, args, repeats: int) -> float:
     """Min wall seconds over ``repeats`` timed calls (after the
     warmup/compile call), fenced with the shared value-dependent sync.
@@ -493,6 +510,18 @@ def tune(kind: str, m: int, n: int, dtype="float32", *,
     winner = min(timed, key=lambda r: (r.seconds, candidates.index(r.plan)))
     if db is None:
         db = default_db()
+    extra = {}
+    if not stubbed:
+        # dhqr-xray (round 15): measured entries carry their analytic
+        # throughput — useful-work flops (obs.flops closed forms) over
+        # the winner's measured seconds — so a shipped plan DB reads as
+        # GF/s per key, comparable across rounds/platforms, not just as
+        # relative speedups. Stubbed searches skip it (fake seconds
+        # would mint fake GF/s).
+        analytic = _analytic_flops(kind, m, n)
+        if analytic and winner.seconds > 0:
+            extra["analytic_flops"] = analytic
+            extra["gflops"] = round(analytic / winner.seconds / 1e9, 2)
     db.record(
         key, winner.plan,
         seconds=round(winner.seconds, 6),
@@ -500,6 +529,7 @@ def tune(kind: str, m: int, n: int, dtype="float32", *,
         speedup=round(baseline_seconds / winner.seconds, 4),
         candidates=len(candidates),
         source="stub" if stubbed else "measured",
+        **extra,
     )
     if save and db.path:
         db.save()

@@ -31,6 +31,64 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
                       **kwargs)
 
 
+def executable_cost_analysis(compiled) -> "tuple[dict | None, str | None]":
+    """``(cost, None)`` or ``(None, reason)`` for a compiled executable's
+    XLA cost analysis, normalized across jax versions.
+
+    jax 0.4 (this container) returns a LIST of per-device dicts from
+    ``Compiled.cost_analysis()``; newer jax returns the dict directly;
+    some backends (notably PJRT plugins like the axon TPU relay) raise
+    UNIMPLEMENTED. The caller gets a flat ``{"flops": ..., "bytes
+    accessed": ...}`` dict of the first device's analysis, or a reason
+    string — NEVER an exception: introspection must not be able to fail
+    a compile (the serve cache calls this on its hot compile path)."""
+    try:
+        analysis = compiled.cost_analysis()
+    except Exception as e:
+        return None, f"cost_analysis unsupported: {type(e).__name__}: {e}"
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else None
+    if not isinstance(analysis, dict) or not analysis:
+        return None, "cost_analysis returned no per-device properties"
+    out = {}
+    for key, val in analysis.items():
+        if isinstance(val, (int, float)):
+            out[str(key)] = float(val)
+    if not out:
+        return None, "cost_analysis carried no numeric properties"
+    return out, None
+
+
+def executable_memory_analysis(compiled) -> "tuple[dict | None, str | None]":
+    """``(memory, None)`` or ``(None, reason)`` for a compiled
+    executable's memory analysis, normalized to a flat dict of the
+    allocation sizes the ROADMAP's TPU re-measurement needs (argument /
+    output / temp / generated-code bytes; ``peak_bytes`` only where the
+    jaxlib exposes it — this container's 0.4 CompiledMemoryStats does
+    not, and the field degrades to absent rather than fabricated)."""
+    try:
+        stats = compiled.memory_analysis()
+    except Exception as e:
+        return None, f"memory_analysis unsupported: {type(e).__name__}: {e}"
+    if stats is None:
+        return None, "memory_analysis returned None"
+    out = {}
+    for attr, name in (
+            ("argument_size_in_bytes", "argument_bytes"),
+            ("output_size_in_bytes", "output_bytes"),
+            ("temp_size_in_bytes", "temp_bytes"),
+            ("alias_size_in_bytes", "alias_bytes"),
+            ("generated_code_size_in_bytes", "generated_code_bytes"),
+            ("peak_memory_in_bytes", "peak_bytes"),
+    ):
+        val = getattr(stats, attr, None)
+        if isinstance(val, (int, float)):
+            out[name] = int(val)
+    if not out:
+        return None, "memory_analysis carried no known size fields"
+    return out, None
+
+
 def multiprocess_cpu_supported() -> bool:
     """Can THIS jaxlib run multi-process collectives on the CPU backend?
 

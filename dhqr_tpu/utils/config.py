@@ -523,18 +523,41 @@ class ObsConfig:
         stderr; any other string = a DIRECTORY receiving JSONL dump
         files (``flight_<pid>.jsonl``) that
         ``python -m dhqr_tpu.obs dump`` renders.
+      xray: arm compiled-program cost/memory introspection
+        (``dhqr_tpu.obs.xray``, round 15; ``DHQR_OBS_XRAY``). Armed,
+        every compile through the serve executable cache captures the
+        executable's ``cost_analysis()``/``memory_analysis()`` paired
+        with the analytic flop model into an :class:`XrayReport`;
+        disarmed (the default), the compile path never reads past one
+        module-global None check and warm dispatch reads nothing.
+      xray_reports: bound on resident xray reports per armed store
+        (``DHQR_OBS_XRAY_REPORTS``); oldest evicted past it.
+      profile_dir: directory for optional ``jax.profiler`` timeline
+        captures of bench stages (``DHQR_OBS_PROFILE``). None (the
+        default) = off, zero overhead — bench.py only wraps a stage's
+        timed region in ``jax.profiler.trace`` when this names a
+        directory (one subdirectory per stage name).
     """
 
     enabled: bool = False
     buffer_spans: int = 4096
     auto_dump: "str | None" = None
+    xray: bool = False
+    xray_reports: int = 512
+    profile_dir: "str | None" = None
 
     def __post_init__(self):
         if self.buffer_spans < 16:
             raise ValueError(
                 f"buffer_spans must be >= 16, got {self.buffer_spans}")
+        if self.xray_reports < 1:
+            raise ValueError(
+                f"xray_reports must be >= 1, got {self.xray_reports}")
         if self.auto_dump is not None and not str(self.auto_dump).strip():
             object.__setattr__(self, "auto_dump", None)
+        if self.profile_dir is not None \
+                and not str(self.profile_dir).strip():
+            object.__setattr__(self, "profile_dir", None)
 
     @staticmethod
     def from_env(**overrides) -> "ObsConfig":
@@ -549,6 +572,14 @@ class ObsConfig:
         if "DHQR_OBS_DUMP" in os.environ:
             raw = os.environ["DHQR_OBS_DUMP"].strip()
             env["auto_dump"] = raw or None
+        if "DHQR_OBS_XRAY" in os.environ:
+            env["xray"] = os.environ["DHQR_OBS_XRAY"].strip().lower() \
+                not in ("0", "false", "no", "off", "n", "")
+        if "DHQR_OBS_XRAY_REPORTS" in os.environ:
+            env["xray_reports"] = int(os.environ["DHQR_OBS_XRAY_REPORTS"])
+        if "DHQR_OBS_PROFILE" in os.environ:
+            raw = os.environ["DHQR_OBS_PROFILE"].strip()
+            env["profile_dir"] = raw or None
         env.update(overrides)
         return ObsConfig(**env)
 

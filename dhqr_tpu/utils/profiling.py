@@ -71,6 +71,15 @@ class PhaseTimer:
     def __init__(self) -> None:
         self._records: List[Tuple[str, float]] = []
         self._pending: list = []
+        # Reader/writer safety (round 15): the serve cache's compile
+        # path and the xray capture read total("aot_compile") from
+        # request threads while another thread's measure() is
+        # appending — the record list is guarded so a reader always
+        # sees whole (name, dt) tuples and a consistent sum. measure()
+        # itself (and _pending) stays externally serialized — the cache
+        # holds its own lock across compiles, and two concurrent
+        # measures on ONE timer would interleave their device fences.
+        self._lock = threading.Lock()
 
     def observe(self, tree) -> None:
         """Register outputs for the end-of-phase device fence (accumulates)."""
@@ -87,7 +96,9 @@ class PhaseTimer:
                 if self._pending:
                     sync(self._pending)
             # dhqr: ignore[DHQR008] same measurement, closing read
-            self._records.append((name, time.perf_counter() - t0))
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self._records.append((name, dt))
         finally:
             # Exception safety: never leave stale array refs behind — a later
             # measure() must not fence on arrays from a failed phase. The
@@ -96,15 +107,19 @@ class PhaseTimer:
 
     def report(self) -> Dict[str, List[float]]:
         out: Dict[str, List[float]] = {}
-        for name, dt in self._records:
+        with self._lock:
+            records = list(self._records)
+        for name, dt in records:
             out.setdefault(name, []).append(dt)
         return out
 
     def total(self, name: str) -> float:
-        return sum(dt for n, dt in self._records if n == name)
+        with self._lock:
+            return sum(dt for n, dt in self._records if n == name)
 
     def reset(self) -> None:
-        self._records.clear()
+        with self._lock:
+            self._records.clear()
 
 
 class Counters:

@@ -488,6 +488,9 @@ def test_agg_panels_engages_when_ppo_smaller(monkeypatch):
     assert calls[0][0] >= calls[0][1], calls
 
 
+@pytest.mark.slow  # 22 s: the tier-1 wall-clock budget (round-15 triage,
+# --durations=25) — agg-panels forward parity stays in tier-1 via
+# test_agg_panels_matches_default; the gradient cross-check runs -m slow
 def test_agg_panels_gradients_match_default():
     """The custom-JVP plumbing carries agg_panels (nondiff index 12):
     gradients through lstsq with aggregation must match the default

@@ -394,3 +394,90 @@ def test_auto_dump_stderr(capsys):
             guarded_lstsq(bad, B8, guards="screen")
     err = capsys.readouterr().err
     assert "NonFiniteInput" in err and "submit" in err
+
+
+# ----------------------------------------------------- prometheus hygiene
+
+
+def test_prometheus_name_sanitization():
+    from dhqr_tpu.obs.metrics import prometheus_name
+
+    assert prometheus_name("serve.cache.hits") == "dhqr_serve_cache_hits"
+    # Bucket labels and fault-site names carry colons/dashes/x's; all
+    # must fold to one valid identifier (no raw dots or dashes out).
+    assert prometheus_name("serve.sched.ewma.64x16:float32.ms") == \
+        "dhqr_serve_sched_ewma_64x16_float32_ms"
+    assert prometheus_name("a-b.c{d}") == "dhqr_a_b_c_d"
+    # Empty namespace + leading digit: still a valid identifier.
+    assert prometheus_name("9lives", namespace="") == "_9lives"
+
+
+def test_prometheus_collisions_get_deterministic_suffixes():
+    from dhqr_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    # Two dotted names that sanitize identically must NOT emit two
+    # conflicting series under one name.
+    reg.register("x", lambda: {"b-c": 1, "b.c": 2, "b_c": 3})
+    text = reg.export_prometheus()
+    names = [ln.split()[0] for ln in text.splitlines()
+             if not ln.startswith("#")]
+    assert len(names) == len(set(names)) == 3
+    assert sorted(n[len("dhqr_x_b_c"):] for n in names) == \
+        ["", "_dup1", "_dup2"]
+
+
+def test_prometheus_roundtrip_full_live_registry():
+    """The round-15 hygiene pin: with EVERY source live (cache,
+    scheduler, armed faults harness, armed trace recorder, armed xray
+    store, tune/numeric providers), the exported text is valid —
+    every sample name matches the prometheus grammar, and every
+    snapshot entry round-trips to exactly one sample with its value."""
+    import re as _re
+
+    from dhqr_tpu.obs import xray as _xray
+    from dhqr_tpu.obs.metrics import prometheus_name
+
+    class _Exe:
+        def cost_analysis(self):
+            return [{"flops": 2.0, "bytes accessed": 4.0}]
+
+        def memory_analysis(self):
+            return None
+
+    cache = ExecutableCache(max_size=4)
+    sched = AsyncScheduler(cache=cache, start=False,
+                           sched_config=SchedulerConfig())
+    name_re = _re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+    try:
+        with faults.injected(FaultConfig(
+                sites=(("serve.dispatch", 0.0, None),))):
+            with obs.observed(ObsConfig(enabled=True)) as rec:
+                rec.mint()
+                with _xray.captured() as store:
+                    store.capture("roundtrip-key", _Exe())
+                    snap = obs.registry().snapshot()
+                    text = obs.registry().export_prometheus()
+    finally:
+        sched.shutdown()
+    for prefix in ("serve.cache.", "serve.sched.", "faults.", "obs.",
+                   "xray.", "numeric.", "tune.plan_gate."):
+        assert any(k.startswith(prefix) for k in snap), (prefix,
+                                                         sorted(snap))
+    samples = {}
+    types = set()
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            _h, _t, name, kind = line.split()
+            assert kind == "gauge" and name_re.match(name), line
+            types.add(name)
+        else:
+            name, value = line.split()
+            assert name_re.match(name), line
+            assert name not in samples, f"duplicate sample {name}"
+            samples[name] = float(value)
+    assert types == set(samples)
+    assert len(samples) == len(snap)
+    for dotted, value in snap.items():
+        prom = prometheus_name(dotted)
+        assert samples[prom] == pytest.approx(value), dotted

@@ -235,3 +235,125 @@ def test_latency_histogram_concurrent_records():
         t.join()
     assert h.count == 4000
     assert 0.001 <= h.percentile(0.5) <= 0.0035
+
+
+# ------------------------------------------- concurrency (round 15, xray)
+# The serve cache's compile path and the xray capture read the shared
+# Counters/PhaseTimer from request threads while compiles write them;
+# these pin the bump/snapshot contract under a real thread storm.
+
+
+def test_counters_concurrent_bump_snapshot_exact_and_monotone():
+    import threading
+
+    from dhqr_tpu.utils.profiling import Counters
+
+    counters = Counters()
+    n_threads, per_thread = 8, 2000
+    seen = []
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            seen.append(counters.snapshot().get("hits", 0))
+
+    def writer():
+        for _ in range(per_thread):
+            counters.bump("hits")
+            counters.bump("bytes", 0.5)
+
+    read_t = threading.Thread(target=reader)
+    read_t.start()
+    writers = [threading.Thread(target=writer) for _ in range(n_threads)]
+    for t in writers:
+        t.start()
+    for t in writers:
+        t.join()
+    stop.set()
+    read_t.join()
+    # Exact final totals (no lost increments)...
+    assert counters.get("hits") == n_threads * per_thread
+    assert counters.get("bytes") == pytest.approx(
+        n_threads * per_thread * 0.5)
+    # ...and every concurrent snapshot was a consistent, monotone cut.
+    assert all(b >= a for a, b in zip(seen, seen[1:]))
+
+
+def test_phase_timer_concurrent_totals_while_measuring():
+    import threading
+
+    timer = PhaseTimer()
+    totals, errors = [], []
+    stop = threading.Event()
+
+    def reader():
+        try:
+            while not stop.is_set():
+                totals.append(timer.total("aot_compile"))
+                timer.report()
+        except Exception as e:  # pragma: no cover - the failure mode
+            errors.append(e)
+
+    readers = [threading.Thread(target=reader) for _ in range(4)]
+    for t in readers:
+        t.start()
+    # Serialized writer (the cache-lock discipline) against storming
+    # readers — the round-15 xray path's exact access pattern.
+    for _ in range(200):
+        with timer.measure("aot_compile"):
+            pass
+    stop.set()
+    for t in readers:
+        t.join()
+    assert not errors, errors
+    assert len(timer.report()["aot_compile"]) == 200
+    assert all(b >= a for a, b in zip(totals, totals[1:]))
+    assert timer.total("aot_compile") == pytest.approx(
+        sum(timer.report()["aot_compile"]))
+
+
+def test_cache_compile_race_xray_captures_once_per_key():
+    """Concurrent get_or_compile storms on overlapping keys with xray
+    armed: exactly one compile AND one capture per distinct key, and
+    the cache counter invariant (misses == size + evictions) holds in
+    every concurrent snapshot."""
+    import threading
+    from functools import partial
+
+    from dhqr_tpu.obs import xray
+    from dhqr_tpu.serve.cache import ExecutableCache
+    from dhqr_tpu.serve.engine import _lower_for_key, _plan_key
+    from dhqr_tpu.utils.config import DHQRConfig, ServeConfig
+
+    cache = ExecutableCache(max_size=8)
+    keys = [
+        _plan_key("lstsq", 1, 24, 8, "float32",
+                  DHQRConfig(block_size=8), ServeConfig())[0],
+        _plan_key("lstsq", 2, 24, 8, "float32",
+                  DHQRConfig(block_size=8), ServeConfig())[0],
+    ]
+    snapshots, errors = [], []
+    with xray.captured() as store:
+        def worker(i):
+            try:
+                key = keys[i % len(keys)]
+                cache.get_or_compile(key, partial(_lower_for_key, key))
+                snapshots.append(cache.stats())
+            except Exception as e:  # pragma: no cover - the failure mode
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        assert store.stats()["captures"] == len(keys)
+        assert {r.key for r in store.reports()} == \
+            {str(k) for k in keys}
+    final = cache.stats()
+    assert final["misses"] == len(keys)
+    assert final["hits"] == 8 - len(keys)
+    for snap in snapshots:
+        assert snap["misses"] >= snap["size"] + snap["evictions"]

@@ -724,6 +724,10 @@ def test_agg_lookahead_wide_gemm_independent_of_group_psum():
             "broken")
 
 
+@pytest.mark.slow  # 18 s: the tier-1 wall-clock budget (round-15 triage,
+# --durations=25) — the single-device ladder
+# (test_blocked.py::test_policy_error_ladder_1024_blocked) keeps the
+# per-policy error bars in tier-1; the 8-device twin runs -m slow
 def test_policy_error_ladder_1024_sharded():
     """Sharded twin of the 1024^2 policy error ladder
     (tests/test_blocked.py::test_policy_error_ladder_1024_blocked): every

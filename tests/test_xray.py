@@ -1,0 +1,310 @@
+"""dhqr-xray: the analytic flop model (golden), capture plumbing,
+roofline/MFU derivation, and the platform peak table (round 15)."""
+
+from __future__ import annotations
+
+from functools import partial
+
+import pytest
+
+import jax.numpy as jnp
+
+from dhqr_tpu.obs import flops as oflops
+from dhqr_tpu.obs import xray
+from dhqr_tpu.serve.cache import ExecutableCache
+from dhqr_tpu.serve.engine import _lower_for_key, _plan_key
+from dhqr_tpu.utils.config import DHQRConfig, ObsConfig, ServeConfig
+
+
+# --------------------------------------------------------- flop model golden
+# Three shapes per engine, pinned against the LITERAL closed forms —
+# independently re-derived here, not imported, so a drive-by "cleanup"
+# of obs/flops.py cannot silently move every MFU claim in the repo.
+
+@pytest.mark.parametrize("m,n", [(8, 8), (4096, 4096), (1024, 128)])
+def test_qr_flops_golden(m, n):
+    assert oflops.qr_flops(m, n) == pytest.approx(
+        2 * m * n**2 - (2 / 3) * n**3)
+
+
+def test_qr_flops_square_is_bench_model():
+    # bench.py's headline model, 4/3 N^3, is the square special case.
+    for n in (512, 4096, 12288):
+        assert oflops.qr_flops(n, n) == pytest.approx((4 / 3) * n**3)
+
+
+@pytest.mark.parametrize("m,n", [(64, 8), (4096, 128), (100, 100)])
+def test_lstsq_flops_golden(m, n):
+    factor = 2 * m * n**2 - (2 / 3) * n**3
+    apply_qt = 4 * m * n - 2 * n**2
+    base = factor + apply_qt + n**2
+    assert oflops.lstsq_flops(m, n) == pytest.approx(base)
+    # Each refinement sweep: residual matvec + one more apply/solve.
+    sweep = 2 * m * n + apply_qt + n**2
+    assert oflops.lstsq_flops(m, n, refine=2) == pytest.approx(
+        base + 2 * sweep)
+
+
+@pytest.mark.parametrize("m,n,p", [(1024, 16, 4), (8192, 64, 8),
+                                   (512, 8, 1)])
+def test_tsqr_flops_golden(m, n, p):
+    local = p * (2 * (m / p) * n**2 - (2 / 3) * n**3)
+    combine = (p - 1) * (2 * (2 * n) * n**2 - (2 / 3) * n**3)
+    assert oflops.tsqr_flops(m, n, p) == pytest.approx(local + combine)
+
+
+@pytest.mark.parametrize("m,n,passes", [(256, 16, 2), (4096, 64, 3),
+                                        (64, 64, 2)])
+def test_cholqr_flops_golden(m, n, passes):
+    per_pass = 2 * m * n**2 + n**3 / 3
+    assert oflops.cholqr_flops(m, n, passes=passes) == pytest.approx(
+        passes * per_pass)
+
+
+@pytest.mark.parametrize("b,m,n", [(1, 64, 16), (16, 384, 128),
+                                   (3, 24, 8)])
+def test_batched_flops_golden(b, m, n):
+    assert oflops.batched_qr_flops(b, m, n) == pytest.approx(
+        b * oflops.qr_flops(m, n))
+    assert oflops.batched_lstsq_flops(b, m, n, refine=1) == pytest.approx(
+        b * oflops.lstsq_flops(m, n, refine=1))
+
+
+# ------------------------------------------------------------ platform table
+
+def test_device_peak_table():
+    from dhqr_tpu.utils import platform as plat
+
+    assert plat.device_peak_tflops("TPU v5 lite") == 197.0
+    assert plat.device_peak_tflops("TPU v4") == 275.0
+    assert plat.device_peak_tflops("cpu") is None
+    assert plat.device_hbm_gbps("TPU v5 lite") == 819.0
+    assert plat.device_hbm_gbps("nonsense") is None
+    # The bench round-3 headline's MFU must reproduce exactly (13.0
+    # TF/s at 12288^2 on v5e was recorded as 6.6%).
+    fields = plat.mfu_fields(13037.23, "TPU v5 lite")
+    assert fields["mfu"] == pytest.approx(0.0662, abs=1e-4)
+    assert fields["mfu_peak_tflops"] == 197.0
+    assert plat.mfu_fields(100.0, "cpu") == {}
+
+
+# -------------------------------------------------------------- capture path
+
+@pytest.fixture(scope="module")
+def tiny_key_and_cache():
+    """One tiny bucket program compiled through the serve cache with
+    capture armed — shared by the capture tests (one compile, not N)."""
+    cache = ExecutableCache(max_size=4)
+    key, _ = _plan_key("lstsq", 1, 24, 8, "float32",
+                       DHQRConfig(block_size=8), ServeConfig())
+    with xray.captured() as store:
+        cache.get_or_compile(key, partial(_lower_for_key, key))
+        reports = store.reports()
+        stats = store.stats()
+    return cache, key, reports, stats
+
+
+def test_cache_compile_captures_report(tiny_key_and_cache):
+    _cache, key, reports, stats = tiny_key_and_cache
+    assert stats["captures"] == 1
+    assert len(reports) == 1
+    rep = reports[0]
+    assert rep.key == str(key)
+    # Analytic flops derived from the CacheKey's own fields.
+    bucket_m, bucket_n = key.m, key.n
+    assert rep.analytic_flops == pytest.approx(
+        oflops.batched_lstsq_flops(key.batch, bucket_m, bucket_n))
+    # This container's CPU backend supports both analyses.
+    assert rep.measured is not None and rep.measured["flops"] > 0
+    assert rep.measured["bytes accessed"] > 0
+    assert rep.memory is not None and rep.memory["argument_bytes"] > 0
+    assert rep.compile_seconds is not None and rep.compile_seconds > 0
+
+
+def test_warm_hit_captures_nothing(tiny_key_and_cache):
+    cache, key, _reports, _stats = tiny_key_and_cache
+    with xray.captured() as store:
+        cache.get_or_compile(key, partial(_lower_for_key, key))  # hit
+        assert store.stats()["captures"] == 0
+
+
+def test_report_json_null_with_reason_fields(tiny_key_and_cache):
+    _cache, _key, reports, _stats = tiny_key_and_cache
+    row = reports[0].to_json()
+    assert row["analytic_flops"] > 0
+    assert row["measured_cost_analysis"]["flops"] > 0
+    # CPU: no published peak -> roofline refuses WITH a reason, and
+    # intensity (pure measurement) is still populated.
+    assert row["roofline_bound"] is None
+    assert "peak/bandwidth" in row["roofline_reason"]
+    assert row["intensity_flops_per_byte"] > 0
+    assert reports[0].mfu(1.0) is None  # no peak -> no fake MFU
+
+
+def test_mfu_and_roofline_with_known_chip():
+    # Same measured analysis, re-based onto a known chip: MFU and the
+    # roofline classification must materialize from the table.
+    class FakeExe:
+        def cost_analysis(self):
+            # Intensity 1e4 flop/byte >> v5e ridge (~240): compute-bound.
+            return [{"flops": 1e9, "bytes accessed": 1e5}]
+
+        def memory_analysis(self):
+            return None
+
+    rep = xray.report_for("fake", FakeExe(), analytic_flops=1e9,
+                          device_kind="TPU v5 lite", dtype="float32")
+    assert rep.peak_tflops == 197.0
+    assert rep.roofline_bound == "compute"
+    assert rep.ceiling_gflops == pytest.approx(197e3)
+    # 1e9 flops in 1 ms = 1 TF/s on a 197 TF/s part.
+    assert rep.mfu(1e-3) == pytest.approx(1.0 / 197.0, rel=1e-6)
+    # Memory-bound twin: intensity 1 flop/byte, ceiling = bw * 1.
+    class MemExe(FakeExe):
+        def cost_analysis(self):
+            return [{"flops": 1e6, "bytes accessed": 1e6}]
+
+    rep2 = xray.report_for("fake2", MemExe(), analytic_flops=1e6,
+                           device_kind="TPU v5 lite")
+    assert rep2.roofline_bound == "memory"
+    assert rep2.ceiling_gflops == pytest.approx(819.0)
+
+
+def test_unsupported_backend_null_with_reason():
+    class BrokenExe:
+        def cost_analysis(self):
+            raise RuntimeError("UNIMPLEMENTED on this relay")
+
+        def memory_analysis(self):
+            raise RuntimeError("UNIMPLEMENTED on this relay")
+
+    rep = xray.report_for("broken", BrokenExe(), analytic_flops=42.0)
+    assert rep.measured is None
+    assert "UNIMPLEMENTED" in rep.measured_unavailable
+    row = rep.to_json()
+    assert row["measured_cost_analysis"] is None
+    assert "UNIMPLEMENTED" in row["measured_unavailable"]
+
+
+def test_store_bound_and_eviction():
+    class E:
+        def cost_analysis(self):
+            return [{"flops": 1.0, "bytes accessed": 1.0}]
+
+        def memory_analysis(self):
+            return None
+
+    store = xray.XrayStore(max_reports=2)
+    for i in range(4):
+        store.capture(f"k{i}", E())
+    stats = store.stats()
+    assert stats["captures"] == 4 and stats["reports"] == 2
+    assert stats["evicted"] == 2
+    assert [r.key for r in store.reports()] == ["k2", "k3"]
+
+
+def test_registry_names_and_arm_wiring():
+    import dhqr_tpu.obs as obs
+
+    # obs.arm is declarative over the whole ObsConfig: xray=True arms
+    # the store (without tracing), a plain disarm clears it.
+    obs.arm(ObsConfig(enabled=False, xray=True, xray_reports=32))
+    try:
+        store = xray.active()
+        assert store is not None and store.max_reports == 32
+
+        class E:
+            def cost_analysis(self):
+                return [{"flops": 1.0, "bytes accessed": 1.0}]
+
+            def memory_analysis(self):
+                return None
+
+        store.capture("k", E())
+        snap = obs.registry().snapshot()
+        assert snap.get("xray.captures") == 1.0
+        assert snap.get("xray.reports") == 1.0
+    finally:
+        obs.disarm()
+    assert xray.active() is None
+    snap = obs.registry().snapshot()
+    assert "xray.captures" not in snap
+
+
+def test_obsconfig_xray_env(monkeypatch):
+    monkeypatch.setenv("DHQR_OBS_XRAY", "1")
+    monkeypatch.setenv("DHQR_OBS_XRAY_REPORTS", "64")
+    monkeypatch.setenv("DHQR_OBS_PROFILE", "/tmp/p")
+    cfg = ObsConfig.from_env()
+    assert cfg.xray and cfg.xray_reports == 64
+    assert cfg.profile_dir == "/tmp/p"
+    monkeypatch.setenv("DHQR_OBS_XRAY", "off")
+    monkeypatch.setenv("DHQR_OBS_PROFILE", "")
+    cfg = ObsConfig.from_env()
+    assert not cfg.xray and cfg.profile_dir is None
+
+
+def test_table_rendering(tiny_key_and_cache):
+    _cache, _key, reports, _stats = tiny_key_and_cache
+    rows = xray.rows_from_json(
+        [{"xray": reports[0].to_json(), "stage": "s"}])
+    assert len(rows) == 1
+    text = xray.format_table(rows)
+    assert "analytic" in text.splitlines()[0]
+    assert len(text.splitlines()) == 3  # header, rule, one row
+
+
+def test_bench_summary_carries_xray_block():
+    """bench.py's stage path stamps the xray block (the CPU smoke the
+    committed-artifact acceptance rides on the serving side)."""
+    import sys
+
+    sys.modules.pop("bench", None)
+    import bench
+
+    A = jnp.zeros((24, 24), jnp.float32)
+    from dhqr_tpu.ops.blocked import _blocked_qr_impl
+
+    compiled = _blocked_qr_impl.lower(A, 8, precision="highest",
+                                      pallas=False, norm="fast",
+                                      panel_impl="loop").compile()
+    block = bench._xray_block("qr_24", compiled, 24, "cpu",
+                              compile_s=0.1)
+    assert block["analytic_flops"] == pytest.approx(
+        oflops.qr_flops(24, 24))
+    assert block["measured_cost_analysis"]["flops"] > 0
+    assert block["roofline_bound"] is None  # cpu: reasoned refusal
+    assert "roofline_reason" in block
+
+
+def test_memory_refusal_carries_its_own_reason():
+    """cost_analysis and memory_analysis can fail INDEPENDENTLY; a
+    missing memory block must carry memory_unavailable even when the
+    cost analysis succeeded (null-with-reason, per field)."""
+    class HalfExe:
+        def cost_analysis(self):
+            return [{"flops": 10.0, "bytes accessed": 5.0}]
+
+        def memory_analysis(self):
+            raise RuntimeError("UNIMPLEMENTED: no memory stats here")
+
+    rep = xray.report_for("half", HalfExe(), analytic_flops=10.0)
+    assert rep.measured is not None
+    assert rep.memory is None
+    row = rep.to_json()
+    assert row["memory"] is None
+    assert "UNIMPLEMENTED" in row["memory_unavailable"]
+
+
+def test_table_renders_prewarm_summary_report_list():
+    """bench's prewarm summary stamps xray as a LIST of reports; the
+    CLI's row extraction must render every entry."""
+    reports = [
+        xray.XrayReport(key=f"stage_{i}", analytic_flops=1e6 * (i + 1))
+        for i in range(3)
+    ]
+    summary = {"prewarm": "done", "xray": [r.to_json() for r in reports]}
+    rows = xray.rows_from_json([summary])
+    assert [r["key"] for r in rows] == ["stage_0", "stage_1", "stage_2"]
+    text = xray.format_table(rows)
+    assert len(text.splitlines()) == 5  # header + rule + 3 rows

@@ -28,3 +28,17 @@ JAX_PLATFORMS=cpu \
 XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
 python -m dhqr_tpu.analysis check dhqr_tpu tests \
     --baseline tools/lint_baseline.json
+
+# Perf-regression gate (dhqr-regress, round 15): the committed bench
+# trajectory (BENCH_r*.json + benchmarks/results/*.jsonl) against the
+# committed tolerance rules. Invoked as a FILE, not -m: regress.py is
+# stdlib-only, and running the file skips the dhqr_tpu package import
+# (which pulls jax) — the gate stays green even on a host where jax
+# cannot import (`python -m dhqr_tpu.obs regress` is the convenience
+# spelling when the package is importable). Deliberate trade-offs are
+# WAIVED with a reason in benchmarks/regress_waivers.json, never
+# absorbed silently; exit 1 on any unwaived regression
+# (docs/OPERATIONS.md "Triaging a red regress gate").
+python dhqr_tpu/obs/regress.py \
+    --rules benchmarks/regress_rules.json \
+    --waivers benchmarks/regress_waivers.json
