@@ -223,9 +223,11 @@ def _stage_profile(stage: str):
 
 def _arm_obs_from_env() -> None:
     """Arm observability in a bench child exactly as the environment
-    asks (DHQR_OBS / DHQR_OBS_XRAY — the ROADMAP item-1/2 TPU replays
-    set these): a no-op with nothing set, and never fatal — a broken
-    obs arm must not cost a hardware window."""
+    asks (DHQR_OBS / DHQR_OBS_XRAY / DHQR_OBS_PULSE — the supervisor
+    sets all three on TPU attempts by default since round 16, so the
+    ROADMAP item-1/2 replays capture compute AND comms evidence): a
+    no-op with nothing set, and never fatal — a broken obs arm must
+    not cost a hardware window."""
     try:
         from dhqr_tpu import obs as _obs
 
@@ -685,6 +687,14 @@ def _supervise() -> int:
         # direction by the time the measuring attempt launches.
         init_deadline = 120 if _relay_recently_wedged() else None
     tpu_env = dict(os.environ, DHQR_BENCH_SUPERVISED="1")
+    # Observability armed BY DEFAULT on the TPU attempt (round 16):
+    # the ROADMAP item-1/2 replays must come back with compute (xray)
+    # AND comms (pulse) evidence without the operator remembering the
+    # env — the benchmarks/README TPU-preflight rule names the same
+    # triple. setdefault, so an explicit DHQR_OBS*=0 still wins (an
+    # operator chasing a wedge can disarm everything).
+    for var in ("DHQR_OBS", "DHQR_OBS_XRAY", "DHQR_OBS_PULSE"):
+        tpu_env.setdefault(var, "1")
     # Default tee for the TPU child: every completed stage lands in a
     # durable artifact even if the relay wedges later in the escalation
     # (the CPU fallback is not teed — it is not hardware evidence).

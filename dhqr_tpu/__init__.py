@@ -72,7 +72,7 @@ from dhqr_tpu.tune import Plan, PlanDB, resolve_plan
 # ride the facade; the arming/tracing/capture API stays namespaced at
 # dhqr_tpu.obs (arm, observed, flight_dump, registry, xray, ...) so
 # the module attribute is not shadowed.
-from dhqr_tpu.obs import MetricsRegistry, XrayReport
+from dhqr_tpu.obs import MetricsRegistry, PulseReport, XrayReport
 from dhqr_tpu.utils.config import (
     DHQRConfig,
     FaultConfig,
@@ -122,6 +122,7 @@ __all__ = [
     "FaultConfig",
     "ObsConfig",
     "MetricsRegistry",
+    "PulseReport",
     "XrayReport",
     "ServeConfig",
     "SchedulerConfig",

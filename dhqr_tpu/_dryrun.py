@@ -400,6 +400,61 @@ def run(n_devices: int) -> None:
           f"measured flops {['%.1f MF' % (f / 1e6) if f else 'n/a' for f in mflops]}, "
           "warm repeat 0 captures)", flush=True)
 
+    # Runtime comms observability / dhqr-pulse (round 16): an armed
+    # sharded dispatch on the dry run's own multi-device mesh must
+    # yield a PulseReport with a MEASURED per-collective census (this
+    # is a real P-device CPU topology — a null here means the profiler
+    # seam broke), a per-shard skew block, a green DHQR306 verdict
+    # (skip-with-reason on CPU: no published interconnect), comms.*
+    # registry names, and a warm repeat that re-measures NOTHING (the
+    # capture-once discipline the armed-overhead bar rests on).
+    if n_devices >= 2:
+        from dhqr_tpu.obs import pulse as _pulse_mod
+        from dhqr_tpu.parallel.sharded_qr import sharded_blocked_qr
+
+        with _pulse_mod.pulsed() as pstore:
+            Hp, ap = sharded_blocked_qr(A, cmesh, block_size=block_size)
+            jax.block_until_ready((Hp, ap))
+            preps = pstore.reports()
+            assert preps, "armed pulse capture recorded no reports"
+            prep = preps[0]
+            assert prep.measured is not None, (
+                "no measured collective census on the dryrun mesh",
+                prep.measured_unavailable)
+            assert "psum" in prep.measured, prep.measured
+            assert prep.analytic and prep.analytic.get("psum"), (
+                "analytic census lost the blocked engine's psum",
+                prep.analytic)
+            assert prep.measured["psum"]["launches"] == \
+                prep.analytic["psum"]["launches"], (
+                    "measured and traced psum launch counts disagree",
+                    prep.measured, prep.analytic)
+            assert prep.skew is not None and prep.skew["lanes"] >= 2, (
+                "per-shard skew block missing", prep.skew,
+                prep.skew_unavailable)
+            assert prep.dhqr306_pass, ("DHQR306 red on the dry run",
+                                       prep.dhqr306)
+            pcaptures = pstore.stats()["captures"]
+            Hp2, _ = sharded_blocked_qr(A, cmesh, block_size=block_size)
+            jax.block_until_ready(Hp2)
+            assert pstore.stats()["captures"] == pcaptures, (
+                "warm armed repeat re-measured", pstore.stats())
+            psnap = _obs_mod.registry().snapshot()
+            for dotted in ("comms.captures", "comms.reports",
+                           "comms.dhqr306_failures"):
+                assert dotted in psnap, (dotted, sorted(psnap))
+        print(f"dryrun: pulse ok (measured "
+              f"{prep.measured['psum']['launches']} psum launches x "
+              f"{prep.measured['psum']['time_s'] * 1e3:.2f} ms/device vs "
+              f"{prep.analytic['psum']['launches']} traced, shard skew "
+              f"{prep.skew['max_over_median']:.2f}x over {prep.skew['lanes']} "
+              f"lanes, DHQR306 {prep.dhqr306['status']}, warm repeat 0 "
+              "re-measures)", flush=True)
+    else:
+        print("dryrun: pulse SKIPPED (needs >= 2 devices for a "
+              "measured collective census; run tools/lint.sh for the "
+              "DHQR402 smoke)", flush=True)
+
     # Plan autotuner (round 9): a tiny-grid on-device search must run end
     # to end on CPU — tune, persist, resolve through the PUBLIC lstsq
     # plan="auto" path — with the tuned answer held to the same 8x LAPACK

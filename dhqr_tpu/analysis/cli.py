@@ -3,11 +3,12 @@
 Exit status 0 iff no unsuppressed, un-baselined findings. The AST pass
 runs on every named path; the jaxpr sanitizer, the API-consistency
 check, the multi-device comms-contract audit (dhqr-audit,
-``analysis/comms_pass.py``), and the xray introspection smoke
-(``analysis/xray_smoke.py``, DHQR401) run whenever the dhqr_tpu
-package itself is among the scan targets (they validate the package,
-not arbitrary files), unless disabled with ``--no-jaxpr`` /
-``--no-api`` / ``--no-comms`` / ``--no-xray``. ``comms`` is the audit alone (the subprocess vehicle
+``analysis/comms_pass.py``), the xray introspection smoke
+(``analysis/xray_smoke.py``, DHQR401), and the pulse runtime-comms
+smoke (``analysis/pulse_smoke.py``, DHQR402) run whenever the
+dhqr_tpu package itself is among the scan targets (they validate the
+package, not arbitrary files), unless disabled with ``--no-jaxpr`` /
+``--no-api`` / ``--no-comms`` / ``--no-xray`` / ``--no-pulse``. ``comms`` is the audit alone (the subprocess vehicle
 ``check`` uses when the backend initialized before the multi-device CPU
 topology could be forced). ``--list-rules`` prints the full DHQR rule
 catalogue so the docs table cannot drift from the code
@@ -69,8 +70,12 @@ def rule_catalogue() -> "list[tuple[str, str, str]]":
          "aliasing", "comms"),
         ("DHQR305", "jaxpr differs across two traces of one cache key",
          "comms"),
+        ("DHQR306", "measured collective time unexplainable by volume "
+         "/ interconnect bandwidth x slack", "pulse"),
         ("DHQR401", "compiled-program xray introspection smoke failed",
          "xray"),
+        ("DHQR402", "pulse runtime-comms profiling smoke failed",
+         "pulse"),
     ]
     return rows
 
@@ -132,6 +137,8 @@ def main(argv=None) -> int:
                        help="skip the multi-device comms-contract audit")
     check.add_argument("--no-xray", action="store_true",
                        help="skip the xray introspection smoke (DHQR401)")
+    check.add_argument("--no-pulse", action="store_true",
+                       help="skip the pulse runtime-comms smoke (DHQR402)")
     check.add_argument(
         "--preset", action="append", default=None,
         help="restrict the jaxpr/comms passes to these policy presets "
@@ -234,6 +241,10 @@ def main(argv=None) -> int:
         from dhqr_tpu.analysis.xray_smoke import run_xray_smoke
 
         findings.extend(run_xray_smoke())
+    if _scans_package(paths) and not args.no_pulse:
+        from dhqr_tpu.analysis.pulse_smoke import run_pulse_smoke
+
+        findings.extend(run_pulse_smoke())
 
     if args.write_baseline:
         write_baseline(args.write_baseline, findings)

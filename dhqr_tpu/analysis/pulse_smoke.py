@@ -1,0 +1,107 @@
+"""DHQR402: the pulse runtime-comms smoke (round 16).
+
+DHQR401 proves the DEVICE-observability seam (xray capture at the
+serve compile entry) produces evidence before a TPU window; this is
+its comms twin: one tiny sharded dispatch with pulse capture armed
+must yield a :class:`~dhqr_tpu.obs.pulse.PulseReport` whose measured /
+analytic / skew / DHQR306 fields are populated (or null WITH a
+reason), and whose accounting registers under the ``comms.*`` dotted
+names. A refactor that silently disconnects the seam (drops the
+``observed_dispatch`` hook from an engine, breaks the trace parser,
+unregisters the provider) fails lint here instead of costing ROADMAP
+item 3's compressed-collectives work its before/after evidence.
+
+The smoke adapts to the backend's width: with >= 2 CPU devices (the
+tools/lint.sh topology) it dispatches on a P=2 mesh and REQUIRES a
+measured collective census; on a 1-device backend it still exercises
+the full seam and accepts the reasoned null (XLA elides P=1
+collectives) — a narrow backend weakens the assertion, it never
+false-greens a disconnected seam.
+"""
+
+from __future__ import annotations
+
+from dhqr_tpu.analysis.findings import Finding
+
+_PATH = "dhqr_tpu/obs/pulse.py"
+
+
+def run_pulse_smoke() -> "list[Finding]":
+    """Dispatch one tiny sharded factorization with pulse armed; every
+    broken invariant is one DHQR402 finding (an infrastructure crash
+    is one finding too — a smoke that cannot run must not pass)."""
+    findings = []
+
+    def bad(msg: str) -> None:
+        findings.append(Finding("DHQR402", _PATH, 0, msg))
+
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from dhqr_tpu.obs import pulse as _pulse
+        from dhqr_tpu.obs import registry
+        from dhqr_tpu.parallel.mesh import column_mesh
+        from dhqr_tpu.parallel.sharded_qr import sharded_blocked_qr
+
+        P = 2 if len(jax.devices()) >= 2 else 1
+        mesh = column_mesh(P)
+        A = jnp.ones((16, 8), jnp.float32)
+        with _pulse.pulsed() as store:
+            H, alpha = sharded_blocked_qr(A, mesh, block_size=4)
+            jax.block_until_ready((H, alpha))
+            reports = store.reports()
+            if not reports:
+                bad("armed pulse capture recorded no report for a "
+                    "sharded dispatch — the observed_dispatch seam is "
+                    "disconnected from the engine")
+                return findings
+            report = reports[0]
+            if report.measured is None and not report.measured_unavailable:
+                bad("measured collective census is None WITHOUT a "
+                    "reason — the null-with-reason contract dropped")
+            if P >= 2 and report.measured is None:
+                bad("no measured collective census on a P=2 CPU "
+                    "topology (the profiler/trace-parse path is "
+                    f"broken: {report.measured_unavailable})")
+            if report.analytic is None and not report.analytic_unavailable:
+                bad("analytic census is None without a reason — the "
+                    "comms_pass.collect_comms bridge dropped")
+            if P >= 2 and not (report.analytic or {}).get("psum"):
+                bad("the traced analytic census lost the blocked "
+                    "engine's psum family")
+            if report.dhqr306 is None or "status" not in report.dhqr306:
+                bad("DHQR306 verdict block missing from the report")
+            elif not report.dhqr306_pass:
+                # The runtime contract itself gets its own rule id: a
+                # red measured-vs-analytic verdict is a comms
+                # regression, not a broken seam.
+                findings.append(Finding(
+                    "DHQR306", _PATH, 0,
+                    "measured collective time is not explainable by "
+                    "traced volume / interconnect bandwidth x slack "
+                    f"on the smoke dispatch: {report.dhqr306}"))
+            row = report.to_json()
+            for field in ("measured", "analytic", "skew", "dhqr306",
+                          "dhqr306_pass"):
+                if field not in row:
+                    bad(f"PulseReport.to_json() lost the {field!r} "
+                        "field the artifact rows and the pulse CLI "
+                        "key on")
+            # Warm repeat: the same label must NOT re-measure (the
+            # armed-overhead contract lives on capture-once).
+            captures = store.stats()["captures"]
+            H2, _ = sharded_blocked_qr(A, mesh, block_size=4)
+            jax.block_until_ready(H2)
+            if store.stats()["captures"] != captures:
+                bad("a warm repeat of the same label re-measured — "
+                    "the capture-once discipline (and with it the "
+                    ">= 0.95 armed-overhead bar) is broken")
+            snap = registry().snapshot()
+            if not snap.get("comms.captures"):
+                bad("the metrics registry snapshot carries no armed "
+                    "comms.captures — the pulse provider is "
+                    "unregistered")
+    except Exception as e:
+        bad(f"pulse smoke crashed: {type(e).__name__}: {e}")
+    return findings

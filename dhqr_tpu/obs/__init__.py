@@ -52,6 +52,15 @@ Three pieces (each its own module):
   (wired into tools/lint.sh) applies ``benchmarks/regress_rules.json``
   and exits nonzero with a per-key verdict table on any unwaived
   regression.
+* ``obs.pulse`` + ``obs.netmodel`` (round 16, dhqr-pulse) — runtime
+  collective profiling of the sharded tier: an armed sharded dispatch
+  runs once under a ``jax.profiler`` trace, parsed to per-collective-
+  family wall clock + launch counts and per-shard skew, cross-checked
+  against the dhqr-audit traced volumes and the interconnect table as
+  the DHQR306 runtime contract (measured time explainable by volume ÷
+  bandwidth × slack); armed via ``ObsConfig.pulse`` /
+  ``DHQR_OBS_PULSE``, rendered by ``python -m dhqr_tpu.obs pulse``,
+  exported under ``comms.*`` registry names.
 
 Armed behind :class:`~dhqr_tpu.utils.config.ObsConfig` / ``DHQR_OBS``
 with the faults-harness discipline: zero overhead disarmed (one
@@ -62,8 +71,9 @@ flight-recorder dump after a typed error".
 
 from __future__ import annotations
 
-from dhqr_tpu.obs import recorder, xray
+from dhqr_tpu.obs import netmodel, pulse, recorder, xray
 from dhqr_tpu.obs.metrics import MetricsRegistry, registry, reset_registry
+from dhqr_tpu.obs.pulse import PulseReport
 from dhqr_tpu.obs.xray import XrayReport
 from dhqr_tpu.obs.trace import (
     Span,
@@ -95,9 +105,12 @@ def flight_dump_error(exc: BaseException) -> "list[dict]":
 __all__ = [
     "MetricsRegistry",
     "ObsConfig",
+    "PulseReport",
     "Span",
     "TraceRecorder",
     "XrayReport",
+    "netmodel",
+    "pulse",
     "xray",
     "active",
     "arm",

@@ -2,16 +2,28 @@
 
 The observability CLIs:
 
-* ``dump [FILE ...] [--trace-id N] [--json]`` — render flight-recorder
-  dump files (the JSONL the ``on_error`` hook writes when
-  ``ObsConfig.auto_dump`` names a directory — docs/OPERATIONS.md
-  "Reading a flight-recorder dump after a typed error"). With no FILE,
-  every ``flight_*.jsonl`` under ``DHQR_OBS_DUMP`` (when it names a
-  directory) is rendered, newest first.
+* ``dump [FILE ...] [--trace-id N] [--tenant T] [--bucket B]
+  [--json]`` — render flight-recorder dump files (the JSONL the
+  ``on_error`` hook writes when ``ObsConfig.auto_dump`` names a
+  directory — docs/OPERATIONS.md "Reading a flight-recorder dump
+  after a typed error"). With no FILE, every ``flight_*.jsonl`` under
+  ``DHQR_OBS_DUMP`` (when it names a directory) is rendered, newest
+  first. ``--tenant``/``--bucket`` keep only traces whose span path
+  carries the attribute (a noisy multi-tenant dump file narrows to
+  the tenant or bucket being triaged).
 * ``xray [FILE ...] [--json]`` — the per-cache-key cost/memory table
   (round 15): renders the ``xray`` blocks found in bench summary JSON,
   artifact ``*.jsonl`` rows, or ``XrayStore.export_jsonl`` files
   (docs/OPERATIONS.md "Reading an xray table").
+* ``pulse [FILE ...] [--json]`` — the per-label runtime-comms table
+  (round 16): renders the ``pulse`` blocks (measured per-collective
+  timing, shard skew, DHQR306 verdicts) found in artifact rows or
+  ``PulseStore.export_jsonl`` files (docs/OPERATIONS.md "Reading a
+  pulse report").
+
+``--json`` on both table commands emits one JSON object per row
+(JSONL) instead of the rendered table — the machine-readable surface
+TPU session tooling scrapes without parsing aligned text.
 * ``regress [--rules FILE] [--waivers FILE] [--repo DIR] [--json]`` —
   the perf-regression gate over the committed bench trajectory
   (``dhqr_tpu.obs.regress``; wired into tools/lint.sh). Exit 0 green,
@@ -44,6 +56,16 @@ def _default_files() -> "list[str]":
     return sorted(files, key=os.path.getmtime, reverse=True)
 
 
+def _span_attr_match(record: dict, attr: str, wanted: str) -> bool:
+    """Does any span in the record carry ``attr == wanted``? The
+    recorder indexes per-trace; tenant/bucket live as span attributes
+    (submit stamps the tenant, flush/dispatch the bucket label), so a
+    CLI filter is a walk over the span path."""
+    return any(str(span.get(attr)) == wanted
+               for span in record.get("spans", [])
+               if isinstance(span, dict) and attr in span)
+
+
 def _cmd_dump(args) -> int:
     files = args.files or _default_files()
     if not files:
@@ -61,6 +83,12 @@ def _cmd_dump(args) -> int:
             if args.trace_id is not None \
                     and rec.get("trace_id") != args.trace_id:
                 continue
+            if args.tenant is not None \
+                    and not _span_attr_match(rec, "tenant", args.tenant):
+                continue
+            if args.bucket is not None \
+                    and not _span_attr_match(rec, "bucket", args.bucket):
+                continue
             shown += 1
             if args.json:
                 print(json.dumps(rec))
@@ -68,8 +96,13 @@ def _cmd_dump(args) -> int:
                 print(format_dump(rec))
                 print()
     if not shown:
-        which = f"trace id {args.trace_id}" if args.trace_id is not None \
-            else "records"
+        filters = [f"trace id {args.trace_id}"
+                   if args.trace_id is not None else None,
+                   f"tenant {args.tenant!r}"
+                   if args.tenant is not None else None,
+                   f"bucket {args.bucket!r}"
+                   if args.bucket is not None else None]
+        which = ", ".join(f for f in filters if f) or "records"
         print(f"no {which} found in {len(files)} file(s)", file=sys.stderr)
         return 1
     return 0
@@ -104,19 +137,25 @@ def _parse_records(path: str) -> "list[dict]":
     return records
 
 
-def _cmd_xray(args) -> int:
-    from dhqr_tpu.obs.xray import format_table, rows_from_json
+def _cmd_table(args, kind: str) -> int:
+    """Shared body of the ``xray`` and ``pulse`` table commands: parse
+    the named files, extract the blocks, render the aligned table or
+    (``--json``) one JSON object per row."""
+    if kind == "xray":
+        from dhqr_tpu.obs.xray import format_table, rows_from_json
+    else:
+        from dhqr_tpu.obs.pulse import format_table, rows_from_json
 
     if not args.files:
-        print("obs xray: name the file(s) to render — a bench summary "
-              "JSON, an artifact *.jsonl, or an XrayStore export",
+        print(f"obs {kind}: name the file(s) to render — a bench "
+              "summary JSON, an artifact *.jsonl, or a store export",
               file=sys.stderr)
         return 2
     rows = []
     for path in args.files:
         rows.extend(rows_from_json(_parse_records(path)))
     if not rows:
-        print(f"no xray blocks found in {len(args.files)} file(s)",
+        print(f"no {kind} blocks found in {len(args.files)} file(s)",
               file=sys.stderr)
         return 1
     if args.json:
@@ -141,6 +180,12 @@ def main(argv: "list[str] | None" = None) -> int:
                       "flight_*.jsonl under $DHQR_OBS_DUMP")
     dump.add_argument("--trace-id", type=int, default=None,
                       help="only this trace id")
+    dump.add_argument("--tenant", default=None,
+                      help="only traces whose span path names this "
+                      "tenant (the submit span's tenant attribute)")
+    dump.add_argument("--bucket", default=None,
+                      help="only traces whose span path touches this "
+                      "bucket label (e.g. 64x16:float32)")
     dump.add_argument("--json", action="store_true",
                       help="raw JSON records instead of formatted paths")
 
@@ -151,6 +196,14 @@ def main(argv: "list[str] | None" = None) -> int:
     xray.add_argument("--json", action="store_true",
                       help="one JSON row per key instead of the table")
 
+    pulse = sub.add_parser(
+        "pulse", help="render the per-label runtime-comms table "
+        "(measured collectives, shard skew, DHQR306) from artifact "
+        "rows / PulseStore exports")
+    pulse.add_argument("files", nargs="*", metavar="FILE")
+    pulse.add_argument("--json", action="store_true",
+                       help="one JSON row per label instead of the table")
+
     regress = sub.add_parser(
         "regress", help="perf-regression gate over the committed bench "
         "trajectory (exit 1 on regressions)")
@@ -158,12 +211,16 @@ def main(argv: "list[str] | None" = None) -> int:
     regress.add_argument("--rules", default=None)
     regress.add_argument("--waivers", default=None)
     regress.add_argument("--json", action="store_true")
+    regress.add_argument("--prune-waivers", action="store_true",
+                         help="rewrite the waivers file dropping stale "
+                         "entries (matching no current failure), then "
+                         "gate against the pruned file")
 
     args = parser.parse_args(argv)
     if args.command == "dump":
         return _cmd_dump(args)
-    if args.command == "xray":
-        return _cmd_xray(args)
+    if args.command in ("xray", "pulse"):
+        return _cmd_table(args, args.command)
     if args.command == "regress":
         from dhqr_tpu.obs import regress as _regress
 
@@ -173,8 +230,10 @@ def main(argv: "list[str] | None" = None) -> int:
                 argv2 += [f"--{flag}", getattr(args, flag)]
         if args.json:
             argv2.append("--json")
+        if args.prune_waivers:
+            argv2.append("--prune-waivers")
         return _regress.main(argv2)
-    parser.error("a command is required (dump | xray | regress)")
+    parser.error("a command is required (dump | xray | pulse | regress)")
     return 2
 
 

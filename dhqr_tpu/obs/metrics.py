@@ -128,7 +128,8 @@ class MetricsRegistry:
     #: worst-instance reading, which is what an SLO check wants).
     #: Everything else (counters, occupancy, queue depth) sums.
     _MAX_MERGED_SUFFIXES = ("max_size", "capacity", "demote_after",
-                            "p50_ms", "p99_ms", "mean_ms")
+                            "p50_ms", "p99_ms", "mean_ms",
+                            "skew_max_over_median")
 
     def snapshot(self) -> "dict[str, float]":
         """One consistent-per-source cut of every registered metric,
@@ -296,6 +297,19 @@ def _xray_provider() -> dict:
     return store.stats()
 
 
+def _pulse_provider() -> dict:
+    """The armed pulse store's runtime-comms accounting
+    (``comms.captures`` / ``comms.dhqr306_failures`` /
+    ``comms.skew_max_over_median`` / ``comms.measured_collective_s``
+    ...), empty when pulse profiling is disarmed (round 16)."""
+    from dhqr_tpu.obs import pulse as _pulse
+
+    store = _pulse.active()
+    if store is None:
+        return {}
+    return store.stats()
+
+
 _REGISTRY: "MetricsRegistry | None" = None
 _REGISTRY_LOCK = threading.Lock()
 
@@ -307,6 +321,7 @@ def _new_default_registry() -> MetricsRegistry:
     reg.register("numeric", _numeric_provider)
     reg.register("obs", _obs_provider)
     reg.register("xray", _xray_provider)
+    reg.register("comms", _pulse_provider)
     # serve.cache.* / serve.sched.* have no lazy provider: every
     # ExecutableCache and AsyncScheduler instance self-registers at
     # construction (weakly — test instances evaporate with GC).

@@ -1,0 +1,195 @@
+"""Analytic network-cost model — the comms half of the roofline.
+
+``obs.flops`` pins the useful-work numerator of every MFU claim; this
+module pins the *wire* numerator of every comms claim: how long a
+collective of a given family and payload SHOULD take on a known
+interconnect, what effective bandwidth a measured collective achieved,
+and where a program sits on the comms-vs-compute roofline. It is the
+model behind the DHQR306 runtime contract (``obs.pulse``): measured
+collective time must be explainable by traced volume ÷ interconnect
+bandwidth × slack — the runtime counterpart of dhqr-audit's static
+DHQR302 volume budget, and the before/after scale ROADMAP item 3's
+compressed collectives (EQuARX, arXiv 2506.17615) will be judged on.
+
+Algorithm factors follow the standard ring/bidirectional accounting
+(the redistribution paper, arXiv 2112.01075, makes collective *choice*
+the decisive cost): with the repo's volume convention — a collective's
+payload is its OUTPUT aval bytes on one device (analysis/cost_model.py
+docstring) — an all-reduce of an N-byte result moves ``2·(P-1)/P · N``
+bytes over the slowest link, an all-gather of an N-byte gathered
+result ``(P-1)/P · N``, a permute exactly ``N``.
+
+Deliberately **stdlib-only** (no jax): the pulse CLI table and the
+regress gate import this in any python, and the model must be
+unit-testable without a backend.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "ALGO_FACTORS",
+    "FAMILY_TOKENS",
+    "classify_event",
+    "collective_time_s",
+    "comms_roofline",
+    "effective_gbps",
+    "explain_measured",
+    "wire_bytes",
+]
+
+#: XLA HLO instruction-name tokens -> jax collective family, the
+#: vocabulary shared by profiler trace events (``all-reduce.12``) and
+#: the jaxpr census (``psum``). Longest-match-first where tokens nest
+#: (``reduce-scatter`` contains neither of the others; ``all-to-all``
+#: must win over nothing). ``collective-permute`` covers ppermute and
+#: pshuffle lowerings.
+FAMILY_TOKENS = (
+    ("reduce-scatter", "reduce_scatter"),
+    ("all-reduce", "psum"),
+    ("all-gather", "all_gather"),
+    ("all-to-all", "all_to_all"),
+    ("collective-permute", "ppermute"),
+    ("collective-broadcast", "pbroadcast"),
+)
+
+#: jaxpr primitive name -> the family key used above (the reduction
+#: variants all lower to all-reduce; psum_scatter to reduce-scatter).
+PRIMITIVE_FAMILY = {
+    "psum": "psum", "pmin": "psum", "pmax": "psum",
+    "all_gather": "all_gather",
+    "reduce_scatter": "reduce_scatter", "psum_scatter": "reduce_scatter",
+    "all_to_all": "all_to_all",
+    "ppermute": "ppermute", "pshuffle": "ppermute",
+    "pbroadcast": "pbroadcast",
+}
+
+
+def classify_event(name: str) -> "str | None":
+    """Collective family of one profiler event name (an HLO
+    instruction like ``all-reduce.8`` or a fusion embedding one), or
+    None for non-collective events."""
+    low = str(name).lower()
+    for token, family in FAMILY_TOKENS:
+        if token in low:
+            return family
+    return None
+
+
+#: Per-family wire multipliers f(P): ``wire_bytes = f(P) * payload``
+#: under the repo's output-aval payload convention. A family not listed
+#: (a future collective) conservatively uses 1.0.
+ALGO_FACTORS = {
+    # all-reduce = reduce-scatter + all-gather over the same N bytes.
+    "psum": lambda P: 2.0 * (P - 1) / P,
+    # payload is the GATHERED (P*local) result; the wire moves the
+    # other devices' (P-1) local shares.
+    "all_gather": lambda P: (P - 1) / P,
+    "reduce_scatter": lambda P: (P - 1) / P,
+    # each device sends/receives (P-1)/P of its payload.
+    "all_to_all": lambda P: (P - 1) / P,
+    "ppermute": lambda P: 1.0,
+    "pbroadcast": lambda P: (P - 1) / P,
+}
+
+
+def wire_bytes(family: str, payload_bytes: float, P: int) -> float:
+    """Bytes a ``family`` collective of ``payload_bytes`` actually puts
+    on the slowest link of a P-device ring (0 at P <= 1: nothing
+    leaves the chip)."""
+    if P <= 1:
+        return 0.0
+    factor = ALGO_FACTORS.get(family, lambda _p: 1.0)
+    return factor(int(P)) * float(payload_bytes)
+
+
+def collective_time_s(family: str, payload_bytes: float, P: int,
+                      link_gbps: float) -> "float | None":
+    """Lower-bound wall time of one collective on a ``link_gbps`` GB/s
+    interconnect (bandwidth term only — latency is absorbed by the
+    DHQR306 slack), or None without a known link speed."""
+    if not link_gbps:
+        return None
+    return wire_bytes(family, payload_bytes, P) / (link_gbps * 1e9)
+
+
+def effective_gbps(wire_bytes_moved: float,
+                   seconds: float) -> "float | None":
+    """Achieved wire bandwidth of a measured collective (GB/s), or
+    None for a degenerate measurement."""
+    if not seconds or seconds <= 0:
+        return None
+    return wire_bytes_moved / seconds / 1e9
+
+
+def explain_measured(family: str, measured_s: float,
+                     volume_bytes: float, P: int, link_gbps: float,
+                     slack: float) -> dict:
+    """The DHQR306 per-family check: is ``measured_s`` explainable by
+    ``volume ÷ interconnect bandwidth × slack``?
+
+    Returns ``{"status": "ok" | "fail" | "skip", "reason", "bound_s",
+    "effective_gbps", "bandwidth_pct"}`` — ``skip`` (with the reason)
+    when no link speed is published (CPU topologies) or the volume is
+    zero; a measurement FASTER than the wire bound is fine (overlap,
+    in-node shortcuts), only slower-than-explainable fails."""
+    out: dict = {"family": family, "measured_s": round(measured_s, 6),
+                 "volume_bytes": int(volume_bytes)}
+    moved = wire_bytes(family, volume_bytes, P)
+    eff = effective_gbps(moved, measured_s)
+    if eff is not None:
+        out["effective_gbps"] = round(eff, 3)
+    if not link_gbps:
+        out["status"] = "skip"
+        out["reason"] = ("no published interconnect bandwidth for this "
+                         "device_kind (CPU topologies move words through "
+                         "host memory)")
+        return out
+    if volume_bytes <= 0 or moved <= 0:
+        out["status"] = "skip"
+        out["reason"] = "no traced wire volume for this family"
+        return out
+    bound = moved / (link_gbps * 1e9)
+    out["bound_s"] = round(bound, 6)
+    out["bandwidth_pct"] = round(100.0 * (eff or 0.0) / link_gbps, 2)
+    if measured_s <= bound * slack:
+        out["status"] = "ok"
+    else:
+        out["status"] = "fail"
+        out["reason"] = (
+            f"measured {measured_s:.6f}s exceeds the wire explanation "
+            f"{bound:.6f}s x slack {slack:g} — the collective is slower "
+            "than volume / bandwidth accounts for (serialization, "
+            "congestion, or a schedule regression)")
+    return out
+
+
+def comms_roofline(comms_s: "float | None", compute_s: "float | None",
+                   link_gbps: "float | None" = None,
+                   wire_bytes_moved: "float | None" = None) -> dict:
+    """The comms side of the roofline for one executable: which side
+    dominates, the comms fraction of the critical path, and the
+    overlap headroom (how much of the collective time a perfect
+    schedule could hide under compute). Degrades field-by-field to
+    null-with-reason — the xray table renders whatever subset exists."""
+    out: dict = {}
+    if comms_s is None or compute_s is None:
+        out["comms_bound"] = None
+        out["comms_reason"] = ("no measured comms/compute split for this "
+                               "program")
+        return out
+    total = comms_s + compute_s
+    out["comms_s"] = round(comms_s, 6)
+    out["compute_s"] = round(compute_s, 6)
+    out["comms_fraction"] = round(comms_s / total, 4) if total else 0.0
+    out["comms_bound"] = "comms" if comms_s > compute_s else "compute"
+    # A schedule can hide min(comms, compute) of the collective time
+    # behind MXU work; what remains is the exposed floor.
+    hideable = min(comms_s, compute_s)
+    out["overlap_headroom_s"] = round(hideable, 6)
+    out["exposed_floor_s"] = round(max(comms_s - compute_s, 0.0), 6)
+    if link_gbps and wire_bytes_moved:
+        eff = effective_gbps(wire_bytes_moved, comms_s)
+        if eff is not None:
+            out["effective_gbps"] = round(eff, 3)
+            out["bandwidth_pct"] = round(100.0 * eff / link_gbps, 2)
+    return out

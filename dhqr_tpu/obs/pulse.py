@@ -1,0 +1,777 @@
+"""dhqr-pulse: runtime collective profiling for the sharded tier.
+
+dhqr-xray (round 15) answers *where the flops and bytes go inside one
+compiled executable*; this module answers the question the sharded
+tier could not until now: *what do the collectives actually cost at
+runtime*. PR 5's comms contracts audit the TRACED byte volume against
+analytic budgets (static, DHQR301-305); nothing measured what an
+``all-reduce`` spends on the wire, how evenly the shards arrive, or
+how much of the collective time the schedule hides — the before/after
+evidence ROADMAP item 3's compressed collectives (EQuARX,
+arXiv 2506.17615) and the portable-redistribution schedules
+(arXiv 2112.01075) both need.
+
+One :class:`PulseReport` per measured sharded dispatch pairs three
+sources:
+
+* **measured per-collective timing** — the dispatch runs once under a
+  ``jax.profiler`` trace; the trace's per-device HLO-op events are
+  parsed into per-collective-family wall clock + launch counts and a
+  per-shard busy-time spread (max/median skew). Backends whose
+  profiler refuses (or whose trace carries no device events) degrade
+  to null WITH a reason — the xray compat discipline, never a raised
+  exception on a dispatch path;
+* **the traced analytic census** — the same jaxpr walk dhqr-audit
+  uses (``analysis/comms_pass.collect_comms``), giving per-family
+  launch counts and byte volumes, with while-loop opacity flagged
+  exactly as in PR 5;
+* **the interconnect table** — ``utils/platform.device_ici_gbps``;
+  with a known wire speed the two sides close into the **DHQR306
+  runtime contract**: measured collective time must be explainable by
+  volume ÷ interconnect bandwidth × slack (``obs.netmodel``). CPU
+  topologies have no published wire and read ``skip`` with the
+  reason spelled out.
+
+Capture discipline (the faults/xray pattern): arming is via
+``ObsConfig.pulse`` / ``DHQR_OBS_PULSE`` + ``dhqr_tpu.obs.arm`` (or
+the :func:`pulsed` scope); disarmed, every instrumented dispatch pays
+one module-global ``None`` check. Armed, each LABEL is measured once
+— the first dispatch pays one profiler trace (~ms warm; the very
+first trace in a process pays the profiler's one-time init) and every
+later dispatch of the same label runs the plain path, so warm
+serving/benching holds the >= 0.95 armed-over-disarmed bar by
+construction (pinned by benchmarks/serving_pulse.py).
+
+Module-level imports stay jax-free (table rendering and report maths
+must work in any python); only :func:`measure` touches jax, and only
+when handed a live dispatch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import gzip
+import json
+import os
+import shutil
+import statistics
+import tempfile
+import threading
+from typing import Callable, Optional
+
+from dhqr_tpu.obs import netmodel as _net
+
+__all__ = [
+    "DEFAULT_SLACK",
+    "PulseReport",
+    "PulseStore",
+    "active",
+    "arm",
+    "collective_census",
+    "disarm",
+    "format_table",
+    "measure",
+    "observed_dispatch",
+    "parse_trace_dir",
+    "pulsed",
+    "rows_from_json",
+]
+
+#: DHQR306 slack over the pure bandwidth bound. Deliberately wide: the
+#: wire term models bandwidth only, and a real collective pays launch
+#: latency, sync skew and ring hops the slack must absorb — 8x still
+#: catches an order-of-magnitude schedule regression (a serialized
+#: gather, a congested link) while never flagging healthy jitter.
+DEFAULT_SLACK = 8.0
+
+
+# ------------------------------------------------------------ trace parse
+
+def parse_trace_dir(logdir: str) -> "list[dict]":
+    """Every complete ('X') trace event from the ``*.trace.json.gz``
+    files a ``jax.profiler.trace(logdir)`` session wrote (the
+    TensorBoard layout: ``plugins/profile/<run>/<host>.trace.json.gz``).
+    Returns ``[]`` — never raises — when the profiler wrote nothing."""
+    events: "list[dict]" = []
+    pattern = os.path.join(logdir, "plugins", "profile", "*",
+                           "*.trace.json.gz")
+    for path in sorted(glob.glob(pattern)):
+        try:
+            with gzip.open(path, "rt", encoding="utf-8") as fh:
+                data = json.load(fh)
+        # dhqr: ignore[DHQR006] a truncated/foreign trace file degrades to "no events" (null-with-reason downstream), never a dispatch-path crash
+        except Exception:
+            continue
+        for event in data.get("traceEvents", []):
+            if isinstance(event, dict) and event.get("ph") == "X":
+                events.append(event)
+    return events
+
+
+def collective_census(events: "list[dict]") -> dict:
+    """Per-collective-family timing + per-lane (per-shard) busy time
+    from parsed trace events.
+
+    Device-execution events are identified by their ``args.hlo_op``
+    annotation (what the XLA CPU/TPU runtimes stamp on op-level
+    events); if a backend's trace carries none, every complete event
+    is considered instead (fallback — better a noisy census than a
+    silent null). Returns::
+
+        {"families": {family: {"events": N, "time_us": T}},
+         "lanes": {lane_label: {"busy_us": B, "collective_us": C}},
+         "hlo_events": total_device_op_events}
+    """
+    def walk(require_hlo: bool) -> dict:
+        families: "dict[str, dict]" = {}
+        lanes: "dict[str, dict]" = {}
+        n_hlo = 0
+        for event in events:
+            args = event.get("args") or {}
+            if require_hlo and "hlo_op" not in args:
+                continue
+            n_hlo += 1
+            lane = f"{event.get('pid', '?')}/{event.get('tid', '?')}"
+            dur = float(event.get("dur", 0.0) or 0.0)
+            lane_row = lanes.setdefault(
+                lane, {"busy_us": 0.0, "collective_us": 0.0})
+            lane_row["busy_us"] += dur
+            family = _net.classify_event(event.get("name", ""))
+            if family:
+                lane_row["collective_us"] += dur
+                fam = families.setdefault(
+                    family, {"events": 0, "time_us": 0.0})
+                fam["events"] += 1
+                fam["time_us"] += dur
+        return {"families": families, "lanes": lanes, "hlo_events": n_hlo}
+
+    census = walk(require_hlo=True)
+    if not census["hlo_events"]:
+        census = walk(require_hlo=False)
+        census["hlo_events"] = 0  # keep the "no annotated ops" signal
+    return census
+
+
+# ---------------------------------------------------------------- report
+
+@dataclasses.dataclass(frozen=True)
+class PulseReport:
+    """Runtime comms profile of ONE sharded dispatch.
+
+    ``measured`` maps collective family -> per-DEVICE launch count and
+    wall seconds (trace totals normalized by the lane count), or None
+    with the refusal in ``measured_unavailable``. ``analytic`` is the
+    jaxpr census (per-device launches + payload volume, dhqr-audit's
+    convention), or None with a reason. ``skew`` carries the per-shard
+    busy-second spread. ``dhqr306`` is the measured-vs-analytic
+    contract verdict (``status`` ok/fail/skip + per-family checks).
+    ``comms`` is the roofline block :class:`~dhqr_tpu.obs.xray
+    .XrayReport` embeds so both sides of the roofline render in one
+    table."""
+
+    label: str
+    n_devices: int = 1
+    device_kind: "str | None" = None
+    wall_s: "float | None" = None
+    measured: "dict | None" = None
+    measured_unavailable: "str | None" = None
+    analytic: "dict | None" = None
+    analytic_unavailable: "str | None" = None
+    opaque_families: "tuple[str, ...]" = ()
+    skew: "dict | None" = None
+    skew_unavailable: "str | None" = None
+    ici_gbps: "float | None" = None
+    dhqr306: "dict | None" = None
+    comms: "dict | None" = None
+
+    @property
+    def dhqr306_pass(self) -> bool:
+        """Green = not red: an ``ok`` or a reasoned ``skip`` both count
+        (the acceptance convention for null-with-reason backends)."""
+        return (self.dhqr306 or {}).get("status") != "fail"
+
+    def measured_collective_s(self) -> "float | None":
+        if self.measured is None:
+            return None
+        return sum(f["time_s"] for f in self.measured.values())
+
+    def to_json(self) -> dict:
+        """JSON-ready record — the shape the artifact rows and the
+        ``obs pulse`` table speak (null WITH reason, never silently
+        absent)."""
+        out: dict = {"label": self.label, "n_devices": self.n_devices,
+                     "device_kind": self.device_kind}
+        if self.wall_s is not None:
+            out["wall_s"] = round(self.wall_s, 6)
+        out["measured"] = self.measured
+        if self.measured is None:
+            out["measured_unavailable"] = (
+                self.measured_unavailable or "no measurement captured")
+        out["analytic"] = self.analytic
+        if self.analytic is None:
+            out["analytic_unavailable"] = (
+                self.analytic_unavailable or "no traced census captured")
+        if self.opaque_families:
+            out["opaque_families"] = list(self.opaque_families)
+        out["skew"] = self.skew
+        if self.skew is None:
+            out["skew_unavailable"] = (
+                self.skew_unavailable or "no per-shard lanes captured")
+        if self.ici_gbps is not None:
+            out["ici_gbps"] = self.ici_gbps
+        out["dhqr306"] = self.dhqr306
+        out["dhqr306_pass"] = self.dhqr306_pass
+        if self.comms is not None:
+            out["comms"] = self.comms
+        return out
+
+
+def _analytic_census(abstract: "Callable[[], object] | None",
+                     n_devices: int):
+    """(families dict, opaque tuple, reason) from dhqr-audit's jaxpr
+    walk over the closed jaxpr ``abstract()`` returns. Lazy import:
+    analysis imports the engine matrix, and pulse must stay importable
+    without it."""
+    if abstract is None:
+        return None, (), "no abstract trace provided for this dispatch"
+    try:
+        from dhqr_tpu.analysis.comms_pass import collect_comms
+
+        stats = collect_comms(abstract())
+    # dhqr: ignore[DHQR006] the census rides a dispatch path: a trace failure costs the analytic side of the report, never the dispatch
+    except Exception as e:
+        return None, (), f"abstract trace failed: {type(e).__name__}: {e}"
+    families: "dict[str, dict]" = {}
+    launches, volumes = stats.launches(), stats.volume()
+    for prim in set(launches) | set(volumes):
+        family = _net.PRIMITIVE_FAMILY.get(prim, prim)
+        row = families.setdefault(
+            family, {"launches": 0, "volume_bytes": 0})
+        row["launches"] += launches.get(prim, 0)
+        row["volume_bytes"] += volumes.get(prim, 0)
+    opaque = tuple(sorted(
+        {_net.PRIMITIVE_FAMILY.get(p, p)
+         for p in stats.opaque_loop_collectives}))
+    return families, opaque, None
+
+
+#: Measured family -> traced source families whose lowering can emit
+#: it (XLA decomposes all-reduce into reduce-scatter + all-gather on
+#: some backends/sizes); consulted before failing a measured family
+#: with no analytic counterpart.
+_DECOMPOSITION_SOURCES = {
+    "reduce_scatter": ("psum",),
+    "all_gather": ("psum",),
+}
+
+
+def _check_dhqr306(measured: "dict | None", analytic: "dict | None",
+                   opaque: "tuple[str, ...]", n_devices: int,
+                   ici_gbps: "float | None", slack: float,
+                   contract_families: "tuple | None" = None) -> dict:
+    """The runtime contract verdict. Per measured family: the
+    :func:`~dhqr_tpu.obs.netmodel.explain_measured` wire check against
+    the analytic volume (skip with reason when no wire speed is
+    published); a measured family with NO analytic counterpart — or
+    outside an explicit ``contract_families`` allow-list — fails (a
+    collective executing that the traced census cannot account for is
+    the runtime twin of DHQR301). While-loop-opaque families skip, as
+    in PR 5 (an unboundable volume cannot bound a time)."""
+    verdict: dict = {"slack": slack, "checks": []}
+    if measured is None:
+        verdict["status"] = "skip"
+        verdict["reason"] = "no measured collective timing"
+        return verdict
+    failed = ok = 0
+    for family in sorted(measured):
+        meas = measured[family]
+        if contract_families is not None \
+                and family not in contract_families:
+            verdict["checks"].append({
+                "family": family, "status": "fail",
+                "reason": f"measured collective family '{family}' is "
+                "outside the dispatch's contract "
+                f"({sorted(contract_families) or 'none'}) — a collective "
+                "executed at runtime that the contract forbids"})
+            failed += 1
+            continue
+        if family in opaque:
+            verdict["checks"].append({
+                "family": family, "status": "skip",
+                "reason": "family launches inside a while-loop: volume "
+                "unboundable (the PR-5 opacity rule)"})
+            continue
+        row = (analytic or {}).get(family)
+        note = None
+        if row is None:
+            # XLA may DECOMPOSE an all-reduce into reduce-scatter +
+            # all-gather phases at lowering; a measured phase family
+            # whose source family is in the census is explained by the
+            # source's volume, not a runtime contract breach.
+            for source in _DECOMPOSITION_SOURCES.get(family, ()):
+                row = (analytic or {}).get(source)
+                if row is not None:
+                    note = (f"explained as an XLA decomposition phase "
+                            f"of traced '{source}'")
+                    break
+        if row is None:
+            verdict["checks"].append({
+                "family": family, "status": "fail",
+                "reason": f"measured collective family '{family}' has no "
+                "traced analytic counterpart — the runtime executed a "
+                "collective the jaxpr census cannot account for"})
+            failed += 1
+            continue
+        check = _net.explain_measured(
+            family, meas["time_s"], row["volume_bytes"], n_devices,
+            ici_gbps or 0.0, slack)
+        if note:
+            check["note"] = note
+        verdict["checks"].append(check)
+        if check["status"] == "fail":
+            failed += 1
+        elif check["status"] == "ok":
+            ok += 1
+    if failed:
+        verdict["status"] = "fail"
+    elif ok:
+        verdict["status"] = "ok"
+    else:
+        verdict["status"] = "skip"
+        verdict["reason"] = (
+            "no per-family check could run (no published interconnect "
+            "bandwidth, or no measured collectives)"
+            if verdict["checks"] else "no collectives measured")
+    return verdict
+
+
+def measure(label: str, thunk: Callable[[], object], *,
+            abstract: "Callable[[], object] | None" = None,
+            n_devices: int = 1,
+            device_kind: "str | None" = None,
+            slack: float = DEFAULT_SLACK,
+            contract_families: "tuple | None" = None,
+            keep_trace_dir: "str | None" = None):
+    """Run ``thunk`` warm (once untraced — absorbing any cold compile
+    — then once under a ``jax.profiler`` trace) and build its
+    :class:`PulseReport`. Returns ``(thunk's result, report)``.
+
+    Degradation contract: the dispatch ALWAYS runs and its result is
+    always returned — a profiler that refuses to start (unsupported
+    backend, a trace already active from ``DHQR_OBS_PROFILE``) or a
+    trace with no device events costs only the measured side of the
+    report, null WITH the reason. ``abstract`` (optional) returns the
+    dispatch's closed jaxpr for the analytic census; ``keep_trace_dir``
+    preserves the raw trace for offline tooling instead of deleting
+    the temp dir."""
+    import time as _time
+
+    import jax
+
+    if device_kind is None:
+        from dhqr_tpu.obs.xray import _default_device_kind
+
+        device_kind, _platform = _default_device_kind()
+    from dhqr_tpu.utils.platform import device_ici_gbps
+
+    ici = device_ici_gbps(device_kind) if device_kind else None
+
+    tmpdir = keep_trace_dir or tempfile.mkdtemp(prefix="dhqr_pulse_")
+    events: "list[dict]" = []
+    reason: "str | None" = None
+    # Warm the dispatch OUTSIDE the trace first: a cold first dispatch
+    # spends seconds in XLA compile, and tracing that floods the
+    # profiler with host-side compile events (measured: the device-op
+    # events get truncated away entirely and a compile thread reads as
+    # a fake 60-second shard lane). The traced run below is the WARM
+    # program — the steady-state collective cost the report claims.
+    # A thunk that raises here raises to the caller: a failing
+    # dispatch is the engine's error path, not a measurement problem.
+    out = jax.block_until_ready(thunk())
+    # dhqr: ignore[DHQR008] the dispatch wall clock IS the measurement (profiler event time is cross-checked against it)
+    t0 = _time.perf_counter()
+    try:
+        with jax.profiler.trace(tmpdir):
+            out = jax.block_until_ready(thunk())
+    # dhqr: ignore[DHQR006] profiler refusal (unsupported backend, nested trace) must cost the report, never the dispatch — the warm result above already stands
+    except Exception as e:
+        reason = (f"profiler capture failed: {type(e).__name__}: {e} "
+                  "(backend profiler unsupported, or a trace was "
+                  "already active)")
+    # dhqr: ignore[DHQR008] closing read of the dispatch wall clock
+    wall_s = _time.perf_counter() - t0
+    if reason is None:
+        events = parse_trace_dir(tmpdir)
+        if not events:
+            reason = ("profiler trace contained no events on this "
+                      "backend")
+    if keep_trace_dir is None:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+    analytic, opaque, analytic_reason = _analytic_census(
+        abstract, n_devices)
+
+    measured = skew = None
+    skew_reason = reason
+    if reason is None:
+        census = collective_census(events)
+        lanes = census["lanes"]
+        if census["families"]:
+            measured = {}
+            for family, row in sorted(census["families"].items()):
+                # Normalize by the DEVICE count, not the lane count:
+                # every participating device executes the collective
+                # once per launch, but the CPU client runs device
+                # programs on a shared thread POOL — in a long-lived
+                # process one device's ops hop threads, so lanes can
+                # outnumber devices and a lane-normalized count would
+                # silently under-read (observed: 12 lanes for an
+                # 8-device mesh in the dry run).
+                n_dev = max(int(n_devices), 1)
+                measured[family] = {
+                    "launches": max(1, round(row["events"] / n_dev)),
+                    "time_s": round(row["time_us"] / n_dev / 1e6, 9),
+                }
+        else:
+            reason = ("no collective events in the profiler trace "
+                      + ("(no annotated device ops on this backend)"
+                         if not census["hlo_events"]
+                         else "(the program launched no collectives, or "
+                         "XLA elided them at this device count)"))
+        # Shard lanes = lanes that joined a collective (every shard of
+        # a collective program does); stray near-idle lanes would read
+        # as fake skew. Collective-free programs keep every lane.
+        shard_lanes = {k: v for k, v in lanes.items()
+                       if v["collective_us"] > 0} or lanes
+        if len(shard_lanes) >= 2:
+            busy = sorted(r["busy_us"] / 1e6
+                          for r in shard_lanes.values())
+            coll = sorted(r["collective_us"] / 1e6
+                          for r in shard_lanes.values())
+            med = statistics.median(busy)
+            skew = {
+                "lanes": len(shard_lanes),
+                "n_devices": int(n_devices),
+                "per_shard_busy_s": [round(b, 6) for b in busy],
+                "max_over_median": round(busy[-1] / med, 4)
+                if med > 0 else None,
+                "collective_max_over_median": round(
+                    coll[-1] / statistics.median(coll), 4)
+                if coll and statistics.median(coll) > 0 else None,
+            }
+            if len(shard_lanes) != int(n_devices):
+                # Thread-pool execution (lanes hop threads in long-
+                # lived processes): the spread is still evidence of
+                # imbalance, but "lane" != "shard" 1:1 — say so.
+                skew["lane_caveat"] = (
+                    f"{len(shard_lanes)} execution lanes for "
+                    f"{n_devices} devices — thread-pool scheduling; "
+                    "read the spread as busy-time imbalance, not a "
+                    "per-device identification")
+            skew_reason = None
+        else:
+            skew_reason = (f"trace exposed {len(shard_lanes)} shard "
+                           "execution lane(s): per-shard spread "
+                           "needs >= 2")
+
+    dhqr306 = _check_dhqr306(measured, analytic, opaque, n_devices,
+                             ici, slack,
+                             contract_families=contract_families)
+
+    comms: "dict | None" = None
+    if measured is not None and skew is not None:
+        comms_s = sum(f["time_s"] for f in measured.values())
+        # Per-DEVICE busy seconds, same normalization as comms_s
+        # (trace total ÷ device count): mixing per-lane busy with
+        # per-device collective time flips the roofline verdict
+        # whenever lanes outnumber devices (the thread-pool case).
+        busy_dev = sum(skew["per_shard_busy_s"]) / max(
+            int(n_devices), 1)
+        # Wire bytes from the TRACED census — the lowering-independent
+        # quantity. Summing over MEASURED families instead would zero
+        # this out exactly on backends that decompose all-reduce into
+        # reduce-scatter + all-gather phases (no analytic row under
+        # the phase names).
+        moved = sum(
+            _net.wire_bytes(f, row.get("volume_bytes", 0), n_devices)
+            for f, row in (analytic or {}).items())
+        comms = _net.comms_roofline(
+            comms_s, max(busy_dev - comms_s, 0.0),
+            link_gbps=ici, wire_bytes_moved=moved or None)
+    report = PulseReport(
+        label=str(label), n_devices=int(n_devices),
+        device_kind=device_kind, wall_s=wall_s,
+        measured=measured, measured_unavailable=reason,
+        analytic=analytic, analytic_unavailable=analytic_reason,
+        opaque_families=opaque, skew=skew, skew_unavailable=skew_reason,
+        ici_gbps=ici, dhqr306=dhqr306, comms=comms,
+    )
+    return out, report
+
+
+# ----------------------------------------------------------------- store
+
+class PulseStore:
+    """Bounded label -> report store for one armed pulse session.
+
+    ``begin(label)`` is the hot-path test the instrumented dispatches
+    use: a label already measured (or currently being measured by a
+    concurrent thread) runs the plain path — each label pays its
+    profiler trace exactly once per armed session. Eviction bounds the
+    resident REPORTS only; an evicted label stays claimed (the
+    ``_seen`` set keeps the label string), so a busy store can never
+    silently re-pay a profiler trace on the warm path — capture-once
+    is a session property, not a residency property."""
+
+    def __init__(self, max_reports: int = 256,
+                 slack: float = DEFAULT_SLACK) -> None:
+        if max_reports < 1:
+            raise ValueError(
+                f"max_reports must be >= 1, got {max_reports}")
+        self.max_reports = int(max_reports)
+        self.slack = float(slack)
+        self._lock = threading.Lock()
+        self._reports: "dict[str, PulseReport]" = {}
+        self._seen: "set[str]" = set()
+        self._captures = 0
+        self._unsupported = 0
+        self._failed_306 = 0
+        self._evicted = 0
+
+    def begin(self, label: str) -> bool:
+        """Claim ``label`` for measurement (False = already measured,
+        claimed, or measured-then-evicted — run the plain path)."""
+        label = str(label)
+        with self._lock:
+            if label in self._seen:
+                return False
+            self._seen.add(label)
+            return True
+
+    def capture(self, label: str, report: PulseReport) -> None:
+        with self._lock:
+            self._captures += 1
+            if report.measured is None:
+                self._unsupported += 1
+            if not report.dhqr306_pass:
+                self._failed_306 += 1
+            self._seen.add(str(label))  # direct captures (no begin)
+            self._reports[str(label)] = report
+            while len(self._reports) > self.max_reports:
+                self._reports.pop(next(iter(self._reports)))
+                self._evicted += 1
+
+    def reports(self) -> "list[PulseReport]":
+        with self._lock:
+            return list(self._reports.values())
+
+    def report(self, label: str) -> Optional[PulseReport]:
+        with self._lock:
+            return self._reports.get(str(label))
+
+    def stats(self) -> dict:
+        """The ``comms.*`` numbers the metrics registry exports."""
+        with self._lock:
+            reports = list(self._reports.values())
+            skews = [r.skew["max_over_median"] for r in reports
+                     if r.skew and r.skew.get("max_over_median")]
+            coll = [r.measured_collective_s() for r in reports]
+            return {
+                "captures": self._captures,
+                "reports": len(reports),
+                "unsupported": self._unsupported,
+                "dhqr306_failures": self._failed_306,
+                "evicted": self._evicted,
+                "capacity": self.max_reports,
+                "measured_collective_s": round(
+                    sum(c for c in coll if c), 6),
+                "skew_max_over_median": round(max(skews), 4)
+                if skews else 0.0,
+            }
+
+    def export_jsonl(self, path: str) -> int:
+        """Append every resident report as one ``{"pulse": {...}}``
+        JSON line (what ``python -m dhqr_tpu.obs pulse`` renders)."""
+        reports = self.reports()
+        with open(path, "a", encoding="utf-8") as fh:
+            for rep in reports:
+                fh.write(json.dumps({"pulse": rep.to_json()}) + "\n")
+        return len(reports)
+
+
+# The one armed store (or None — the fast path); same module-global
+# discipline as faults.harness / obs.trace / obs.xray.
+_ACTIVE: "PulseStore | None" = None
+_ARM_LOCK = threading.Lock()
+
+
+def arm(max_reports: int = 256, slack: float = DEFAULT_SLACK,
+        store: "PulseStore | None" = None) -> PulseStore:
+    """Arm process-wide pulse capture (normally reached via
+    ``dhqr_tpu.obs.arm`` with ``ObsConfig.pulse`` / ``DHQR_OBS_PULSE``).
+    ``store`` re-installs an existing store instead of creating a fresh
+    one — the A/B-overhead benchmarks re-arm the store whose labels are
+    already measured, so the armed arm exercises the warm (seen-label)
+    path rather than paying a re-capture."""
+    global _ACTIVE
+    with _ARM_LOCK:
+        _ACTIVE = store if store is not None \
+            else PulseStore(max_reports=max_reports, slack=slack)
+        return _ACTIVE
+
+
+def disarm() -> None:
+    global _ACTIVE
+    with _ARM_LOCK:
+        _ACTIVE = None
+
+
+def active() -> Optional[PulseStore]:
+    """The armed store, or None — THE hot-path read (the sharded
+    dispatch seams consult it once per call)."""
+    return _ACTIVE
+
+
+class pulsed:
+    """Scope a pulse session (arm on entry, restore the previous store
+    on exit; scopes nest):
+
+    >>> with pulse.pulsed() as store:
+    ...     sharded_blocked_qr(A, mesh, block_size=nb)
+    ...     store.reports()
+    """
+
+    def __init__(self, max_reports: int = 256,
+                 slack: float = DEFAULT_SLACK) -> None:
+        self._store = PulseStore(max_reports=max_reports, slack=slack)
+        self._previous: "PulseStore | None" = None
+
+    def __enter__(self) -> PulseStore:
+        global _ACTIVE
+        with _ARM_LOCK:
+            self._previous = _ACTIVE
+            _ACTIVE = self._store
+        return self._store
+
+    def __exit__(self, *exc) -> None:
+        global _ACTIVE
+        with _ARM_LOCK:
+            _ACTIVE = self._previous
+
+
+def observed_dispatch(label: str, thunk: Callable[[], object], *,
+                      abstract: "Callable[[], object] | None" = None,
+                      n_devices: int = 1,
+                      contract_families: "tuple | None" = None,
+                      on_report=None):
+    """The sharded tier's instrumentation seam: run ``thunk`` plainly
+    when pulse is disarmed or ``label`` was already measured; measure
+    it (once) when armed and new. The dispatch's result is returned
+    either way, and measurement failure can never fail the dispatch
+    (:func:`measure`'s degradation contract). A dispatch reached
+    UNDER an active jax trace (the comms audit / jaxpr lint
+    abstractly trace the same entry points) runs plain: profiling
+    tracers is meaningless and ``block_until_ready`` on them is
+    undefined. ``on_report(report)`` fires exactly once, right after
+    a label's capture (the serve seam pairs the comms block into the
+    xray store there) — never on the warm path, and never fatally."""
+    store = _ACTIVE
+    if store is None:
+        return thunk()
+    try:
+        from jax.core import trace_state_clean
+
+        if not trace_state_clean():
+            return thunk()
+    # dhqr: ignore[DHQR006] a jax without the probe (future rename) loses only the abstract-trace guard, never the dispatch
+    except ImportError:
+        pass
+    if not store.begin(label):
+        return thunk()
+    out, report = measure(label, thunk, abstract=abstract,
+                          n_devices=n_devices, slack=store.slack,
+                          contract_families=contract_families)
+    store.capture(label, report)
+    if on_report is not None:
+        try:
+            on_report(report)
+        # dhqr: ignore[DHQR006] pairing is best-effort evidence: a callback bug must cost the pairing, never the dispatch
+        except Exception:
+            pass
+    return out
+
+
+# ------------------------------------------------------------------ table
+
+def rows_from_json(records) -> "list[dict]":
+    """Extract pulse blocks from parsed JSON records (artifact rows,
+    ``export_jsonl`` lines, bench summaries): any dict carrying a
+    ``"pulse"`` sub-dict or sub-list, or that IS a report (has
+    ``dhqr306_pass``)."""
+    rows = []
+    for rec in records:
+        if not isinstance(rec, dict):
+            continue
+        blk = rec.get("pulse")
+        blocks = blk if isinstance(blk, list) else [blk]
+        matched = False
+        for one in blocks:
+            if isinstance(one, dict):
+                matched = True
+                row = dict(one)
+                row.setdefault("label", rec.get("stage")
+                               or rec.get("metric") or "?")
+                rows.append(row)
+        if not matched and "dhqr306_pass" in rec:
+            rows.append(dict(rec))
+    return rows
+
+
+def _fmt_ms(value) -> str:
+    if not isinstance(value, (int, float)):
+        return "-"
+    return f"{value * 1e3:.3f}"
+
+
+def format_table(rows: "list[dict]") -> str:
+    """Aligned per-label table of pulse rows (the ``obs pulse`` CLI
+    output): label, device count, measured per-family launches x time,
+    total collective ms, comms fraction, per-shard skew, effective
+    GB/s, DHQR306 status."""
+    header = ("label", "P", "collectives", "comms_ms", "f(comms)",
+              "skew", "effGB/s", "DHQR306")
+    table = [header]
+    for row in rows:
+        measured = row.get("measured") or {}
+        fams = " ".join(
+            f"{fam}:{m.get('launches', '?')}x"
+            for fam, m in sorted(measured.items())) or "-"
+        comms_ms = sum(m.get("time_s", 0.0) for m in measured.values())
+        comms = row.get("comms") or {}
+        skew = (row.get("skew") or {}).get("max_over_median")
+        verdict = (row.get("dhqr306") or {}).get("status") or (
+            "ok" if row.get("dhqr306_pass") else "fail")
+        table.append((
+            str(row.get("label", "?"))[:48],
+            str(row.get("n_devices", "?")),
+            fams[:36],
+            _fmt_ms(comms_ms) if measured else "-",
+            (f"{comms['comms_fraction']:.2f}"
+             if isinstance(comms.get("comms_fraction"), (int, float))
+             else "-"),
+            f"{skew:.2f}" if isinstance(skew, (int, float)) else "-",
+            (f"{comms['effective_gbps']:.2f}"
+             if isinstance(comms.get("effective_gbps"), (int, float))
+             else "-"),
+            verdict,
+        ))
+    widths = [max(len(r[i]) for r in table) for i in range(len(header))]
+    lines = []
+    for i, r in enumerate(table):
+        lines.append("  ".join(
+            c.ljust(w) if j in (0, 2) else c.rjust(w)
+            for j, (c, w) in enumerate(zip(r, widths))))
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)

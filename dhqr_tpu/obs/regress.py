@@ -46,7 +46,9 @@ Deliberate trade-offs are WAIVED, not deleted:
 entries — the dhqr-lint-baseline mechanism transplanted — and the
 verdict table prints the reason next to every WAIVED key, so an
 accepted regression stays visible in every run instead of silently
-absorbed. Stale waivers (matching nothing) are reported.
+absorbed. Stale waivers (matching nothing) are reported, and
+``--prune-waivers`` (round 16) rewrites the file without them — the
+``analysis check --prune-baseline`` hygiene, transplanted.
 
 Row vintage: rows missing ``schema_version`` are treated as v0 (the
 pre-round-15 artifact shape); rows missing ``round`` inherit the
@@ -374,6 +376,39 @@ def apply_waivers(verdicts: "list[Verdict]",
             if not u]
 
 
+def prune_waivers(waivers_path: str,
+                  verdicts: "list[Verdict]") -> "tuple[int, int]":
+    """Rewrite the waivers file dropping entries that match no FAILING
+    or WAIVED verdict — the ``findings.prune_baseline`` mechanism
+    transplanted to the perf gate (round 16): a regression that was
+    re-measured away leaves its waiver stale, and a stale waiver is a
+    loaded gun (it would silently absorb the NEXT regression of that
+    key). Returns ``(kept, removed)``. The comment block and any other
+    top-level fields are preserved; a missing file is ``(0, 0)``."""
+    if not os.path.exists(waivers_path):
+        return 0, 0
+    with open(waivers_path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    entries = list(data.get("waivers", []))
+    # A waiver is LIVE iff some verdict it names is FAIL or WAIVED
+    # (apply_waivers flips matched FAILs to WAIVED, so after a gate
+    # run the live ones read WAIVED; pruning from raw verdicts —
+    # before waivers applied — sees them as FAIL).
+    live_keys = {(v.rule_id, v.key) for v in verdicts
+                 if v.status in ("FAIL", "WAIVED")}
+    kept = [e for e in entries
+            if (e.get("rule"), e.get("key")) in live_keys]
+    removed = len(entries) - len(kept)
+    if removed:
+        data["waivers"] = kept
+        tmp = waivers_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(data, fh, indent=2)
+            fh.write("\n")
+        os.replace(tmp, waivers_path)
+    return len(kept), removed
+
+
 def format_verdicts(verdicts: "list[Verdict]") -> str:
     """The readable per-key verdict table (FAILs first, then WAIVED,
     then PASS, SKIPs last)."""
@@ -399,8 +434,12 @@ def format_verdicts(verdicts: "list[Verdict]") -> str:
 def run_gate(repo: str, rules_path: str,
              waivers_path: "str | None" = None,
              as_json: bool = False,
+             prune: bool = False,
              out=None) -> int:
-    """The CLI body: 0 green, 1 regression(s), 2 malformed inputs."""
+    """The CLI body: 0 green, 1 regression(s), 2 malformed inputs.
+    ``prune=True`` first rewrites the waivers file dropping stale
+    entries (:func:`prune_waivers`), then gates against the pruned
+    file — mirroring ``analysis check --prune-baseline``."""
     out = out or sys.stdout
     try:
         with open(rules_path, "r", encoding="utf-8") as fh:
@@ -409,6 +448,34 @@ def run_gate(repo: str, rules_path: str,
         print(f"regress: cannot load rules {rules_path}: {e}",
               file=sys.stderr)
         return 2
+    rows = collect_trajectory(repo)
+    if not rows:
+        print(f"regress: no trajectory rows under {repo} "
+              "(BENCH_r*.json / benchmarks/results/*.jsonl)",
+              file=sys.stderr)
+        return 2
+    try:
+        verdicts = evaluate(rules, rows)
+    except RuleError as e:
+        print(f"regress: {e}", file=sys.stderr)
+        return 2
+    if prune:
+        if not waivers_path:
+            print("regress: --prune-waivers requires a waivers file",
+                  file=sys.stderr)
+            return 2
+        try:
+            # The same verdicts feed the prune and the gate below
+            # (apply_waivers only flips FAIL -> WAIVED afterwards, and
+            # the prune treats both as live).
+            kept, removed = prune_waivers(waivers_path, verdicts)
+        except (ValueError, OSError) as e:
+            print(f"regress: cannot prune waivers {waivers_path}: {e}",
+                  file=sys.stderr)
+            return 2
+        print(f"regress: waivers pruned — {removed} stale "
+              f"entr{'y' if removed == 1 else 'ies'} removed, "
+              f"{kept} kept", file=sys.stderr)
     waivers = {}
     if waivers_path and os.path.exists(waivers_path):
         try:
@@ -418,14 +485,7 @@ def run_gate(repo: str, rules_path: str,
             print(f"regress: cannot load waivers {waivers_path}: {e}",
                   file=sys.stderr)
             return 2
-    rows = collect_trajectory(repo)
-    if not rows:
-        print(f"regress: no trajectory rows under {repo} "
-              "(BENCH_r*.json / benchmarks/results/*.jsonl)",
-              file=sys.stderr)
-        return 2
     try:
-        verdicts = evaluate(rules, rows)
         stale = apply_waivers(verdicts, waivers)
     except RuleError as e:
         print(f"regress: {e}", file=sys.stderr)
@@ -471,13 +531,18 @@ def main(argv=None) -> int:
                         "present)")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable verdicts")
+    parser.add_argument("--prune-waivers", action="store_true",
+                        help="rewrite the waivers file dropping entries "
+                        "that match no current failure, then gate "
+                        "against the pruned file (mirrors `analysis "
+                        "check --prune-baseline`)")
     args = parser.parse_args(argv)
     rules = args.rules or os.path.join(args.repo, "benchmarks",
                                        "regress_rules.json")
     waivers = args.waivers or os.path.join(args.repo, "benchmarks",
                                            "regress_waivers.json")
     return run_gate(args.repo, rules, waivers_path=waivers,
-                    as_json=args.json)
+                    as_json=args.json, prune=args.prune_waivers)
 
 
 if __name__ == "__main__":

@@ -267,10 +267,13 @@ def arm(config: "ObsConfig | None" = None,
     """Arm process-wide observability from ``config`` (default: the
     environment's ``DHQR_OBS*``), DECLARATIVELY: tracing iff
     ``config.enabled``, xray capture (``dhqr_tpu.obs.xray``, round 15)
-    iff ``config.xray`` — each field disarms its subsystem when false,
-    so ``obs.arm()`` with no env set is a no-op, exactly like
-    ``faults.install()`` with no sites. Returns the armed trace
-    recorder, or None when tracing is left disarmed."""
+    iff ``config.xray``, pulse collective profiling
+    (``dhqr_tpu.obs.pulse``, round 16) iff ``config.pulse`` — each
+    field disarms its subsystem when false, so ``obs.arm()`` with no
+    env set is a no-op, exactly like ``faults.install()`` with no
+    sites. Returns the armed trace recorder, or None when tracing is
+    left disarmed."""
+    from dhqr_tpu.obs import pulse as _pulse
     from dhqr_tpu.obs import xray as _xray
 
     cfg = config if config is not None else ObsConfig.from_env()
@@ -281,17 +284,24 @@ def arm(config: "ObsConfig | None" = None,
         _xray.arm(max_reports=cfg.xray_reports)
     else:
         _xray.disarm()
+    if cfg.pulse:
+        _pulse.arm(max_reports=cfg.pulse_reports)
+    else:
+        _pulse.disarm()
     return recorder
 
 
 def disarm() -> None:
     """Back to the zero-overhead path (the ring and its spans are
-    dropped with the recorder; the xray store with its reports)."""
+    dropped with the recorder; the xray store with its reports; the
+    pulse store with its measurements)."""
+    from dhqr_tpu.obs import pulse as _pulse
     from dhqr_tpu.obs import xray as _xray
 
     with _ARM_LOCK:
         _swap_active_locked(None)
     _xray.disarm()
+    _pulse.disarm()
 
 
 def active() -> Optional[TraceRecorder]:

@@ -93,6 +93,13 @@ class XrayReport:
     roofline_reason: "str | None" = None
     ceiling_gflops: "float | None" = None
     compile_seconds: "float | None" = None
+    # Round 16 (dhqr-pulse): the COMMS side of the roofline — the
+    # ``netmodel.comms_roofline`` block a paired pulse measurement
+    # fills (comms_s / compute_s / comms_fraction / comms_bound /
+    # effective_gbps). None for programs with no comms measurement;
+    # to_json then stamps the reason so artifact rows stay
+    # null-with-reason on both halves of the roofline.
+    comms: "dict | None" = None
 
     def mfu(self, seconds: float) -> "float | None":
         """Analytic-flops MFU for one execution taking ``seconds``
@@ -135,6 +142,14 @@ class XrayReport:
         if self.roofline_bound is None and "roofline_reason" not in out:
             out["roofline_reason"] = "no roofline basis captured"
         out.setdefault("roofline_bound", None)
+        if self.comms is not None:
+            out["comms"] = dict(self.comms)
+        else:
+            out["comms"] = None
+            out["comms_reason"] = ("no paired pulse measurement for this "
+                                   "program (no collectives measured, "
+                                   "single-device, or DHQR_OBS_PULSE "
+                                   "disarmed)")
         return out
 
 
@@ -205,7 +220,8 @@ def _default_device_kind() -> "tuple[str | None, str | None]":
 def report_for(key, compiled, *, analytic_flops: "float | None" = None,
                device_kind: "str | None" = None,
                dtype: "str | None" = None,
-               compile_seconds: "float | None" = None) -> XrayReport:
+               compile_seconds: "float | None" = None,
+               comms: "dict | None" = None) -> XrayReport:
     """Build the :class:`XrayReport` for one compiled executable.
 
     ``key`` is any display-able cache key (serve ``CacheKey``\\ s get
@@ -240,6 +256,7 @@ def report_for(key, compiled, *, analytic_flops: "float | None" = None,
         roofline_reason=roof_reason, ceiling_gflops=ceiling,
         compile_seconds=(round(compile_seconds, 4)
                          if compile_seconds is not None else None),
+        comms=comms,
     )
 
 
@@ -292,6 +309,19 @@ class XrayStore:
     def report(self, key) -> Optional[XrayReport]:
         with self._lock:
             return self._reports.get(str(key))
+
+    def attach_comms(self, key, comms: dict) -> None:
+        """Pair a pulse measurement's comms-roofline block into the
+        resident report for ``key`` (round 16 — the serve dispatch
+        seam calls this once, right after a label's pulse capture, so
+        one table shows both sides of the roofline). A key with no
+        resident report is a no-op: pairing is best-effort evidence,
+        never a failure path."""
+        with self._lock:
+            rep = self._reports.get(str(key))
+            if rep is not None:
+                self._reports[str(key)] = dataclasses.replace(
+                    rep, comms=dict(comms))
 
     def stats(self) -> dict:
         """The ``xray.*`` numbers the metrics registry exports."""
@@ -413,13 +443,16 @@ def format_table(rows: "list[dict]") -> str:
 
     Columns: key, analytic flops, measured flops, bytes accessed,
     intensity (flop/byte), roofline bound, ceiling GF/s, MFU (when the
-    row carries one), compile seconds."""
+    row carries one), compile seconds, and — since round 16 — the comms
+    side of the roofline (the paired pulse measurement's comms
+    fraction, "-" for rows without one)."""
     header = ("key", "analytic", "measured", "bytes", "f/B", "bound",
-              "ceilGF", "mfu", "compile_s")
+              "ceilGF", "mfu", "compile_s", "f(comms)")
     table = [header]
     for row in rows:
         meas = row.get("measured_cost_analysis") or {}
         mfu = row.get("mfu")
+        comms = row.get("comms") or {}
         table.append((
             str(row.get("key", "?"))[:48],
             _fmt_flops(row.get("analytic_flops")),
@@ -435,6 +468,9 @@ def format_table(rows: "list[dict]") -> str:
             (f"{mfu:.4f}" if isinstance(mfu, (int, float)) else "-"),
             (f"{row['compile_seconds']:.2f}"
              if isinstance(row.get("compile_seconds"), (int, float))
+             else "-"),
+            (f"{comms['comms_fraction']:.2f}"
+             if isinstance(comms.get("comms_fraction"), (int, float))
              else "-"),
         ))
     widths = [max(len(r[i]) for r in table) for i in range(len(header))]

@@ -21,6 +21,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dhqr_tpu.utils.compat import shard_map
 
+# dhqr-pulse (round 16) runtime comms seam — acyclic, one None check
+# disarmed (see parallel/sharded_qr.py).
+from dhqr_tpu.obs import pulse as _pulse
+
 from dhqr_tpu.ops.cholqr import _cholqr_passes
 from dhqr_tpu.ops.solve import as_matrix_rhs
 from dhqr_tpu.ops.householder import DEFAULT_PRECISION
@@ -82,7 +86,14 @@ def sharded_cholqr_lstsq(
         raise ValueError(f"m={m} must be divisible by mesh size {nproc}")
     A = jax.device_put(A, NamedSharding(mesh, P(axis_name, None)))
     b = jax.device_put(b, NamedSharding(mesh, P(axis_name)))
-    return _build_cholqr(mesh, axis_name, precision, bool(shift))(A, b)
+    fn = _build_cholqr(mesh, axis_name, precision, bool(shift))
+    if _pulse.active() is None:
+        return fn(A, b)
+    return _pulse.observed_dispatch(
+        f"cholqr_lstsq[P={nproc},{m}x{n}" + (",shift" if shift else "")
+        + "]",
+        lambda: fn(A, b), abstract=lambda: jax.make_jaxpr(fn)(A, b),
+        n_devices=nproc)
 
 
 # Comms contract (dhqr-audit): psum only, 2*n^2 + n*nrhs words per

@@ -37,6 +37,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from dhqr_tpu.utils.compat import shard_map
 
+# dhqr-pulse (round 16): the runtime collective-profiling seam. The
+# import is acyclic (obs only reads utils/*; its providers import
+# their subjects lazily) and the disarmed cost at each dispatch below
+# is one module-global None check — the faults/obs discipline.
+from dhqr_tpu.obs import pulse as _pulse
+
 from dhqr_tpu.ops.blocked import (
     MAX_UNROLLED_PANELS,
     _factor_group,
@@ -791,9 +797,16 @@ def sharded_householder_qr(
     _check_divisibility(m, n, nproc, None, layout)
     A = _to_store_layout(A, n, nproc, store_nb, layout)
     A = jax.device_put(A, column_sharding(mesh, axis_name))
-    H, alpha = _build_unblocked(
+    fn = _build_unblocked(
         mesh, axis_name, n, precision, layout, store_nb, norm
-    )(A)
+    )
+    if _pulse.active() is None:
+        H, alpha = fn(A)
+    else:
+        H, alpha = _pulse.observed_dispatch(
+            f"unblocked_qr[P={nproc},{m}x{n},{layout}]",
+            lambda: fn(A), abstract=lambda: jax.make_jaxpr(fn)(A),
+            n_devices=nproc)
     if not _store_layout_output:
         H = _to_natural_layout(H, n, nproc, store_nb, layout)
     return H, alpha
@@ -908,11 +921,21 @@ def sharded_blocked_qr(
     from dhqr_tpu.ops.blocked import _pallas_cache_guard
 
     with _pallas_cache_guard(interp):
-        H, alpha = _build_blocked(
+        fn = _build_blocked(
             mesh, axis_name, n, nb, precision, layout, norm, pallas, interp,
             panel_impl, PALLAS_FLAT_WIDTH, trailing_precision, lookahead,
             agg_panels,
-        )(A)
+        )
+        if _pulse.active() is None:
+            H, alpha = fn(A)
+        else:
+            sched = ("la" if lookahead else "") + (
+                f"agg{agg_panels}" if agg_panels else "")
+            H, alpha = _pulse.observed_dispatch(
+                f"blocked_qr[P={nproc},{m}x{n},nb={nb},{layout}"
+                + (f",{sched}" if sched else "") + "]",
+                lambda: fn(A), abstract=lambda: jax.make_jaxpr(fn)(A),
+                n_devices=nproc)
     if not _store_layout_output:
         H = _to_natural_layout(H, n, nproc, nb, layout)
     return H, alpha

@@ -30,6 +30,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from dhqr_tpu.utils.compat import shard_map
 
+# dhqr-pulse (round 16) runtime comms seam — acyclic, one None check
+# disarmed (see parallel/sharded_qr.py).
+from dhqr_tpu.obs import pulse as _pulse
+
 from dhqr_tpu.ops.blocked import (
     MAX_UNROLLED_PANELS,
     _panels_schedule,
@@ -283,7 +287,14 @@ def sharded_solve(
     H = jax.device_put(H, column_sharding(mesh, axis_name))
     alpha = jax.device_put(alpha, replicated_sharding(mesh))
     b = jax.device_put(b, replicated_sharding(mesh))
-    return _build_solve(mesh, axis_name, n, nb, precision, layout)(H, alpha, b)
+    fn = _build_solve(mesh, axis_name, n, nb, precision, layout)
+    if _pulse.active() is None:
+        return fn(H, alpha, b)
+    return _pulse.observed_dispatch(
+        f"sharded_solve[P={nproc},{m}x{n},nb={nb},{layout}]",
+        lambda: fn(H, alpha, b),
+        abstract=lambda: jax.make_jaxpr(fn)(H, alpha, b),
+        n_devices=nproc)
 
 
 def sharded_lstsq(

@@ -24,6 +24,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dhqr_tpu.utils.compat import shard_map
 
+# dhqr-pulse (round 16) runtime comms seam — acyclic, one None check
+# disarmed (see parallel/sharded_qr.py).
+from dhqr_tpu.obs import pulse as _pulse
+
 from dhqr_tpu.ops.householder import DEFAULT_PRECISION
 from dhqr_tpu.ops.solve import as_matrix_rhs
 from dhqr_tpu.ops.tsqr import _combine_solve, _leaf_factor
@@ -120,8 +124,14 @@ def sharded_tsqr_lstsq(
     from dhqr_tpu.ops.blocked import _pallas_cache_guard
 
     with _pallas_cache_guard(interpret):
-        return _build_tsqr(mesh, axis_name, n, nb, precision, pallas,
-                           interpret, PALLAS_FLAT_WIDTH)(A, b)
+        fn = _build_tsqr(mesh, axis_name, n, nb, precision, pallas,
+                         interpret, PALLAS_FLAT_WIDTH)
+        if _pulse.active() is None:
+            return fn(A, b)
+        return _pulse.observed_dispatch(
+            f"tsqr_lstsq[P={nproc},{m}x{n},nb={nb}]",
+            lambda: fn(A, b),
+            abstract=lambda: jax.make_jaxpr(fn)(A, b), n_devices=nproc)
 
 
 # Comms contract (dhqr-audit): exactly one all_gather pair per solve —

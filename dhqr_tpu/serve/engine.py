@@ -41,7 +41,9 @@ import jax.numpy as jnp
 
 from dhqr_tpu.faults import harness as _faults
 from dhqr_tpu.numeric import guards as _nguards
+from dhqr_tpu.obs import pulse as _pulse
 from dhqr_tpu.obs import trace as _obs
+from dhqr_tpu.obs import xray as _obs_xray
 from dhqr_tpu.numeric.errors import Breakdown
 from dhqr_tpu.ops import blocked as _blocked
 from dhqr_tpu.ops import solve as _solve
@@ -476,9 +478,41 @@ def _dispatch_groups(kind, As, bs, cfg, scfg, cache, consume, pol=None,
             try:
                 _faults.fire("serve.dispatch")
                 if kind == "lstsq":
-                    outs = compiled(jnp.asarray(A_buf), jnp.asarray(b_buf))
+                    def launch(A_buf=A_buf, b_buf=b_buf):
+                        return compiled(jnp.asarray(A_buf),
+                                        jnp.asarray(b_buf))
                 else:
-                    outs = compiled(jnp.asarray(A_buf))
+                    def launch(A_buf=A_buf, b_buf=None):
+                        return compiled(jnp.asarray(A_buf))
+                # dhqr-pulse (round 16): the bucket dispatch is
+                # contracted COLLECTIVE-FREE (the EOF comms note below);
+                # armed, the first dispatch of each key is profiled once
+                # and any measured collective fails its DHQR306 verdict
+                # — the runtime twin of the static DHQR301 contract.
+                # Disarmed: one module-global None check. The label is
+                # the FULL CacheKey (knobs included): two programs
+                # sharing a bucket but differing in block_size/
+                # precision/plan are distinct executables and each gets
+                # its own runtime check. When a pulse measurement
+                # carries a comms block, it is paired into the armed
+                # xray store's report for the same key so one table
+                # shows both sides of the roofline.
+                if _pulse.active() is None:
+                    outs = launch()
+                else:
+                    def pair(report, key=key):
+                        # Fires once, at capture time only (the warm
+                        # path never reaches it): a measured comms
+                        # block pairs into the armed xray store's
+                        # report for the same program.
+                        if report.comms is not None:
+                            xstore = _obs_xray.active()
+                            if xstore is not None:
+                                xstore.attach_comms(key, report.comms)
+                    outs = _pulse.observed_dispatch(
+                        "serve:" + ":".join(str(f) for f in key),
+                        launch, contract_families=(), n_devices=1,
+                        on_report=pair)
             except ServeError:
                 raise
             except Exception as e:

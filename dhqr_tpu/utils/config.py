@@ -532,6 +532,17 @@ class ObsConfig:
         module-global None check and warm dispatch reads nothing.
       xray_reports: bound on resident xray reports per armed store
         (``DHQR_OBS_XRAY_REPORTS``); oldest evicted past it.
+      pulse: arm runtime collective profiling of the sharded tier
+        (``dhqr_tpu.obs.pulse``, round 16; ``DHQR_OBS_PULSE``). Armed,
+        the FIRST dispatch of each sharded-engine label runs once
+        under a ``jax.profiler`` trace and its per-collective-family
+        timing, per-shard skew and DHQR306 measured-vs-analytic
+        verdict are captured into a :class:`PulseReport`; every later
+        dispatch of that label runs the plain path. Disarmed (the
+        default), every instrumented dispatch pays one module-global
+        None check.
+      pulse_reports: bound on resident pulse reports per armed store
+        (``DHQR_OBS_PULSE_REPORTS``); oldest evicted past it.
       profile_dir: directory for optional ``jax.profiler`` timeline
         captures of bench stages (``DHQR_OBS_PROFILE``). None (the
         default) = off, zero overhead — bench.py only wraps a stage's
@@ -544,6 +555,8 @@ class ObsConfig:
     auto_dump: "str | None" = None
     xray: bool = False
     xray_reports: int = 512
+    pulse: bool = False
+    pulse_reports: int = 256
     profile_dir: "str | None" = None
 
     def __post_init__(self):
@@ -553,6 +566,9 @@ class ObsConfig:
         if self.xray_reports < 1:
             raise ValueError(
                 f"xray_reports must be >= 1, got {self.xray_reports}")
+        if self.pulse_reports < 1:
+            raise ValueError(
+                f"pulse_reports must be >= 1, got {self.pulse_reports}")
         if self.auto_dump is not None and not str(self.auto_dump).strip():
             object.__setattr__(self, "auto_dump", None)
         if self.profile_dir is not None \
@@ -577,6 +593,12 @@ class ObsConfig:
                 not in ("0", "false", "no", "off", "n", "")
         if "DHQR_OBS_XRAY_REPORTS" in os.environ:
             env["xray_reports"] = int(os.environ["DHQR_OBS_XRAY_REPORTS"])
+        if "DHQR_OBS_PULSE" in os.environ:
+            env["pulse"] = os.environ["DHQR_OBS_PULSE"].strip().lower() \
+                not in ("0", "false", "no", "off", "n", "")
+        if "DHQR_OBS_PULSE_REPORTS" in os.environ:
+            env["pulse_reports"] = int(
+                os.environ["DHQR_OBS_PULSE_REPORTS"])
         if "DHQR_OBS_PROFILE" in os.environ:
             raw = os.environ["DHQR_OBS_PROFILE"].strip()
             env["profile_dir"] = raw or None

@@ -65,12 +65,33 @@ def enable_compile_cache(cache_dir: str | None = None,
 # CPU hosts are deliberately ABSENT: container CPU peaks vary by
 # machine and a made-up denominator would manufacture fake MFU — the
 # helpers return None and callers degrade to null-with-reason fields.
+#
+# Round 16 (dhqr-pulse) adds the COMMS denominators alongside the
+# compute/memory ones:
+#
+# * ``ici_gbps`` is the per-chip aggregate one-way inter-chip-
+#   interconnect bandwidth in GB/s (vendor-published: v4 six 50 GB/s
+#   links; v5e 1600 Gbit/s; v5p 4800 Gbit/s; v6e 3584 Gbit/s). It is
+#   the wire term of the DHQR306 runtime comms contract: a measured
+#   collective slower than ``volume / ici_gbps`` x slack is not
+#   explainable by the interconnect and flags a schedule/overlap
+#   regression (obs/netmodel.py carries the per-family algorithm
+#   factors).
+# * ``dcn_gbps`` is the per-host data-center-network bandwidth the
+#   multi-slice tier would cross (v4/v5e/v5p hosts ship 200 Gbit/s
+#   NICs; v6e 400 Gbit/s) — unused until a DCN mesh exists, recorded
+#   now so the comms roofline has both denominators in ONE table.
 _DEVICE_PEAKS = {
-    "TPU v4": {"peak_tflops": 275.0, "hbm_gbps": 1228.0},
-    "TPU v5 lite": {"peak_tflops": 197.0, "hbm_gbps": 819.0},  # v5e (axon)
-    "TPU v5": {"peak_tflops": 459.0, "hbm_gbps": 2765.0},      # v5p
-    "TPU v5p": {"peak_tflops": 459.0, "hbm_gbps": 2765.0},
-    "TPU v6 lite": {"peak_tflops": 918.0, "hbm_gbps": 1640.0},  # v6e
+    "TPU v4": {"peak_tflops": 275.0, "hbm_gbps": 1228.0,
+               "ici_gbps": 300.0, "dcn_gbps": 25.0},
+    "TPU v5 lite": {"peak_tflops": 197.0, "hbm_gbps": 819.0,   # v5e (axon)
+                    "ici_gbps": 200.0, "dcn_gbps": 25.0},
+    "TPU v5": {"peak_tflops": 459.0, "hbm_gbps": 2765.0,       # v5p
+               "ici_gbps": 600.0, "dcn_gbps": 25.0},
+    "TPU v5p": {"peak_tflops": 459.0, "hbm_gbps": 2765.0,
+                "ici_gbps": 600.0, "dcn_gbps": 25.0},
+    "TPU v6 lite": {"peak_tflops": 918.0, "hbm_gbps": 1640.0,  # v6e
+                    "ici_gbps": 448.0, "dcn_gbps": 50.0},
 }
 
 #: The convention string every MFU-carrying record stamps (bench rows
@@ -94,6 +115,25 @@ def device_hbm_gbps(device_kind: str):
     """Per-chip HBM bandwidth in GB/s, or None when unknown."""
     entry = _DEVICE_PEAKS.get(str(device_kind))
     return entry["hbm_gbps"] if entry else None
+
+
+def device_ici_gbps(device_kind: str):
+    """Per-chip aggregate ICI bandwidth in GB/s, or None when unknown
+    (CPU, unlisted chips) — the wire denominator of the DHQR306 runtime
+    comms contract and the comms roofline (obs/netmodel.py). CPU hosts
+    are deliberately absent: a virtual CPU "mesh" moves words through
+    host memcpy, and a made-up wire number would manufacture a fake
+    effective-bandwidth percentage."""
+    entry = _DEVICE_PEAKS.get(str(device_kind))
+    return entry.get("ici_gbps") if entry else None
+
+
+def device_dcn_gbps(device_kind: str):
+    """Per-host DCN bandwidth in GB/s, or None when unknown — recorded
+    alongside ICI so the comms roofline's two denominators live in one
+    table (unused until a multi-slice mesh exists)."""
+    entry = _DEVICE_PEAKS.get(str(device_kind))
+    return entry.get("dcn_gbps") if entry else None
 
 
 def mfu_fields(gflops: float, device_kind: str) -> dict:

@@ -387,6 +387,47 @@ def test_dump_cli_renders_and_filters(tmp_path, capsys):
     assert cli_main(["dump", os.path.join(tmp_path, "nope.jsonl")]) == 2
 
 
+def test_dump_cli_tenant_and_bucket_filters(tmp_path, capsys):
+    """The recorder indexes per-trace; --tenant/--bucket narrow a
+    noisy multi-tenant dump file by span attributes (round 16 — the
+    filter paths the CLI grew in round 14's design but never tested)."""
+    from dhqr_tpu.obs.__main__ import main as cli_main
+
+    path = os.path.join(tmp_path, "flight_2.jsonl")
+    with open(path, "w") as fh:
+        fh.write(json.dumps({
+            "trace_id": 11, "error": "DispatchFailed", "message": "boom",
+            "spans": [
+                {"trace_id": 11, "seq": 1, "t": 1.0, "name": "submit",
+                 "tenant": "acme", "bucket": "64x16:float32"},
+                {"trace_id": 11, "seq": 2, "t": 1.2, "name": "resolve"},
+            ]}) + "\n")
+        fh.write(json.dumps({
+            "trace_id": 12, "spans": [
+                {"trace_id": 12, "seq": 1, "t": 2.0, "name": "submit",
+                 "tenant": "globex", "bucket": "128x48:float32"},
+            ]}) + "\n")
+    # tenant filter selects exactly the matching trace
+    assert cli_main(["dump", path, "--tenant", "acme", "--json"]) == 0
+    recs = [json.loads(line)
+            for line in capsys.readouterr().out.splitlines()]
+    assert [r["trace_id"] for r in recs] == [11]
+    # bucket filter likewise
+    assert cli_main(["dump", path, "--bucket", "128x48:float32",
+                     "--json"]) == 0
+    recs = [json.loads(line)
+            for line in capsys.readouterr().out.splitlines()]
+    assert [r["trace_id"] for r in recs] == [12]
+    # filters compose (AND): tenant acme + globex's bucket -> nothing,
+    # exit 1 with both filters named in the diagnostic
+    assert cli_main(["dump", path, "--tenant", "acme",
+                     "--bucket", "128x48:float32"]) == 1
+    err = capsys.readouterr().err
+    assert "acme" in err and "128x48:float32" in err
+    # a tenant no trace carries -> exit 1
+    assert cli_main(["dump", path, "--tenant", "initech"]) == 1
+
+
 def test_auto_dump_stderr(capsys):
     with obs.observed(ObsConfig(enabled=True, auto_dump="stderr")):
         bad = A8.at[2, 3].set(math.inf)

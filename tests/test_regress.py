@@ -122,6 +122,64 @@ def test_waiver_converts_fail_and_stale_is_reported(tmp_path):
     assert "STALE waiver" in out and "no|such|key" in out
 
 
+def test_prune_waivers_drops_only_stale(tmp_path):
+    """--prune-waivers (round 16): the waiver-no-longer-matches path —
+    a live waiver (still masking a FAIL) survives the prune, a stale
+    one (its regression re-measured away) is removed from the file,
+    and the comment block is preserved."""
+    _write_fixture(str(tmp_path), planted_residual=False)
+    waivers_path = os.path.join(str(tmp_path), "waivers.json")
+    with open(waivers_path, "w") as fh:
+        json.dump({"comment": ["keep me"], "waivers": [
+            {"rule": "tpu-floor",
+             "key": "qr_gflops_per_chip_f32_1024x1024|tpu|TPU v5 lite",
+             "reason": "live: still masks the planted collapse"},
+            {"rule": "tpu-floor", "key": "no|such|key",
+             "reason": "stale: its regression is gone"},
+        ]}, fh)
+    rules_path = os.path.join(str(tmp_path), "rules.json")
+    with open(rules_path, "w") as fh:
+        json.dump(RULES, fh)
+    import io
+
+    rc = regress.run_gate(str(tmp_path), rules_path,
+                          waivers_path=waivers_path, prune=True,
+                          out=io.StringIO())
+    assert rc == 0          # the live waiver still absorbs the FAIL
+    with open(waivers_path) as fh:
+        data = json.load(fh)
+    assert data["comment"] == ["keep me"]
+    assert [w["key"] for w in data["waivers"]] == [
+        "qr_gflops_per_chip_f32_1024x1024|tpu|TPU v5 lite"]
+
+    # Re-measure the regression away: the remaining waiver is now the
+    # waiver-no-longer-matches case and the next prune empties the file.
+    import shutil
+
+    shutil.rmtree(os.path.join(str(tmp_path), "benchmarks"))
+    os.remove(os.path.join(str(tmp_path), "BENCH_r01.json"))
+    _write_fixture(str(tmp_path), planted_regression=False,
+                   planted_residual=False)
+    rc = regress.run_gate(str(tmp_path), rules_path,
+                          waivers_path=waivers_path, prune=True,
+                          out=io.StringIO())
+    assert rc == 0
+    with open(waivers_path) as fh:
+        assert json.load(fh)["waivers"] == []
+
+
+def test_prune_waivers_requires_waivers_file(tmp_path):
+    _write_fixture(str(tmp_path))
+    rules_path = os.path.join(str(tmp_path), "rules.json")
+    with open(rules_path, "w") as fh:
+        json.dump(RULES, fh)
+    import io
+
+    rc = regress.run_gate(str(tmp_path), rules_path, waivers_path=None,
+                          prune=True, out=io.StringIO())
+    assert rc == 2
+
+
 def test_vintage_defaults(tmp_path):
     """Rows missing round/schema_version/device_kind get the documented
     v0/zero/v5e defaults."""

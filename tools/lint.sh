@@ -23,7 +23,11 @@ fi
 # initializes the backend early. The committed contracts
 # (dhqr_tpu/analysis/comms_contracts.json) and the EMPTY baseline gate
 # together: any new collective, volume blow-up, lost donation alias or
-# trace instability fails this script.
+# trace instability fails this script. The same 8-device topology is
+# what the DHQR402 pulse smoke (runtime collective profiling, round
+# 16) dispatches under, so the measured-census assertion runs at full
+# strength here — `check` runs DHQR401 (xray) and DHQR402 (pulse)
+# whenever the package is a scan target.
 JAX_PLATFORMS=cpu \
 XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
 python -m dhqr_tpu.analysis check dhqr_tpu tests \
