@@ -63,7 +63,12 @@ from dhqr_tpu.serve import (
     ServeError,
     batched_lstsq,
     batched_qr,
+    batched_sketched_lstsq,
 )
+# New-workload solver families (round 17): the randomized sketched
+# engine and the updatable factorization ride the facade; the operator/
+# program helpers stay namespaced at dhqr_tpu.solvers.
+from dhqr_tpu.solvers import UpdatableQR, sketched_lstsq
 # NOTE: the tune() search function itself stays at dhqr_tpu.tune.tune —
 # re-exporting it here would shadow the `dhqr_tpu.tune` submodule
 # attribute with a function (breaking `import dhqr_tpu.tune as t`).
@@ -79,10 +84,11 @@ from dhqr_tpu.utils.config import (
     ObsConfig,
     SchedulerConfig,
     ServeConfig,
+    SketchConfig,
     TuneConfig,
 )
 
-__version__ = "0.4.0"
+__version__ = "0.5.0"
 
 __all__ = [
     "QRFactorization",
@@ -104,6 +110,9 @@ __all__ = [
     "alphafactor",
     "batched_qr",
     "batched_lstsq",
+    "batched_sketched_lstsq",
+    "sketched_lstsq",
+    "UpdatableQR",
     "AsyncScheduler",
     "BackpressureError",
     "ServeError",
@@ -126,6 +135,7 @@ __all__ = [
     "XrayReport",
     "ServeConfig",
     "SchedulerConfig",
+    "SketchConfig",
     "TuneConfig",
     "Plan",
     "PlanDB",

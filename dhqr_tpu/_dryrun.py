@@ -500,6 +500,63 @@ def run(n_devices: int) -> None:
           f"{tres.speedup:.2f}x vs static default, residual within 8x, "
           "warm repeat 0 recompiles)", flush=True)
 
+    # New workloads / dhqr-sketch (round 17): the randomized sketched
+    # engine must answer a tiny tall-skinny solve within the 8x LAPACK
+    # criterion (count-sketch AND SRHT operators), a live UpdatableQR
+    # must survive an update/downdate round trip with its solves inside
+    # the same criterion, and warm repeats of both families must
+    # compile NOTHING (all four jitted impls are shape-cached — the
+    # same steady-state contract as every other tier).
+    from dhqr_tpu.solvers import UpdatableQR, sketched_lstsq
+    from dhqr_tpu.solvers.sketch import (
+        _count_sketch_lstsq_impl,
+        _srht_lstsq_impl,
+    )
+    from dhqr_tpu.solvers.update import _update_state_impl, _usolve_impl
+
+    def _solver_compiles():
+        return sum(f._cache_size() for f in
+                   (_count_sketch_lstsq_impl, _srht_lstsq_impl,
+                    _update_state_impl, _usolve_impl))
+
+    Ask = jnp.asarray(rng.random((768, 12)), jnp.float32)   # m/n = 64
+    bsk = jnp.asarray(rng.random(768), jnp.float32)
+    ref_sk = oracle_residual(np.asarray(Ask), np.asarray(bsk))
+    worst_sk = 0.0
+    for op in ("countsketch", "srht"):
+        xsk = sketched_lstsq(Ask, bsk, operator=op)
+        res = normal_equations_residual(Ask, np.asarray(xsk), bsk)
+        assert res < TOLERANCE_FACTOR * ref_sk, ("sketch", op, res, ref_sk)
+        worst_sk = max(worst_sk, res / ref_sk)
+    ufact = UpdatableQR(jnp.asarray(rng.random((192, 8)), jnp.float32))
+    ub = jnp.asarray(rng.random(192), jnp.float32)
+    uu_ = jnp.asarray(rng.standard_normal(192).astype(np.float32))
+    uv_ = jnp.asarray(rng.standard_normal(8).astype(np.float32))
+    x_before = ufact.solve(ub)
+    ufact.update(uu_, uv_)
+    An_live = np.asarray(ufact.matrix)
+    res = normal_equations_residual(An_live, np.asarray(ufact.solve(ub)),
+                                    ub)
+    assert res < TOLERANCE_FACTOR * oracle_residual(
+        An_live, np.asarray(ub)), ("update solve", res)
+    ufact.downdate(uu_, uv_)
+    x_after = ufact.solve(ub)
+    res = normal_equations_residual(np.asarray(ufact.matrix),
+                                    np.asarray(x_after), ub)
+    assert res < TOLERANCE_FACTOR * oracle_residual(
+        np.asarray(ufact.matrix), np.asarray(ub)), ("roundtrip", res)
+    del x_before
+    n_solver = _solver_compiles()
+    xsk2 = sketched_lstsq(Ask, bsk)
+    ufact.update(uu_, uv_)
+    ufact.solve(ub)
+    assert _solver_compiles() == n_solver, "warm solver repeat recompiled"
+    assert bool(jnp.all(xsk2 == sketched_lstsq(Ask, bsk))), \
+        "warm sketched repeat diverged"
+    print(f"dryrun: sketch ok (768x12 within 8x on both operators, "
+          f"worst {worst_sk:.2f}x of oracle; update/downdate round trip "
+          "within 8x, warm repeat 0 recompiles)", flush=True)
+
     # Comms-contract audit (dhqr-audit, analysis/comms_pass): the same
     # multi-device virtual CPU topology the dry run already runs under is
     # exactly what the audit needs, so a collective-shaped regression

@@ -259,6 +259,34 @@ def _entry_points(preset: str, pol):
             "lstsq", block_size=_NB, policy=preset))(As, bs)
 
     yield (f"async_lstsq[{preset}]", async_thunk, ())
+    # The round-17 solver families, BOTH traced under every preset
+    # (the ISSUE-13 acceptance bar): the sketched engine through its
+    # ops-level entry (operator drawn host-side at trace time — the
+    # trace stays abstract, nothing executes) and through the serve
+    # tier's "sketch" bucket program; the updatable-QR family through
+    # its exposed solve/update program builders (an UpdatableQR
+    # CONSTRUCTION would execute a guarded factorization — the program
+    # builders exist precisely so this pass never has to).
+    from dhqr_tpu.solvers.sketch import sketched_lstsq as _sketched
+    from dhqr_tpu.solvers.update import solve_program, update_program
+
+    At_ = jnp.zeros((_M_TALL, _N_TALL), jnp.float32)
+    bt_ = jnp.zeros((_M_TALL,), jnp.float32)
+    yield (f"sketched_lstsq[{preset}]",
+           jx(lambda A, b: _sketched(A, b, policy=preset), At_, bt_), ())
+    Ask = jnp.zeros((2, _M_TALL, _N_TALL), jnp.float32)
+    bsk = jnp.zeros((2, _M_TALL), jnp.float32)
+    yield (f"batched_sketch[{preset}]",
+           jx(bucket_program("sketch", policy=preset), Ask, bsk), ())
+    Gu = jnp.zeros((_N_TALL, _N_TALL), jnp.float32)
+    uu_ = jnp.zeros((_M_TALL,), jnp.float32)
+    vv_ = jnp.zeros((_N_TALL,), jnp.float32)
+    sg_ = jnp.zeros((), jnp.float32)
+    yield (f"update_solve[{preset}]",
+           jx(solve_program(refine=max(1, pol.refine),
+                            precision=pol.panel), At_, Gu, bt_), ())
+    yield (f"update_rank1[{preset}]",
+           jx(update_program(), At_, Gu, Gu, uu_, vv_, sg_), ())
     yield (f"sharded_blocked_qr[{preset}]",
            jx(lambda A: sharded_blocked_qr(A, cmesh, block_size=_NB,
                                            policy=preset), A),

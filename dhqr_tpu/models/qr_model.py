@@ -31,7 +31,7 @@ from dhqr_tpu.ops import householder as _hh
 from dhqr_tpu.ops import solve as _solve
 from dhqr_tpu.utils.config import DHQRConfig
 
-LSTSQ_ENGINES = ("householder", "tsqr", "cholqr2", "cholqr3")
+LSTSQ_ENGINES = ("householder", "tsqr", "cholqr2", "cholqr3", "sketch")
 
 
 def _check_sched_knobs(cfg: DHQRConfig, mesh=None) -> None:
@@ -406,7 +406,7 @@ def qr(
         raise ValueError(
             f"qr() supports only engine='householder' (got {cfg.engine!r}): "
             "the factorization object stores packed reflectors; the "
-            "tsqr/cholqr engines are lstsq-only fast paths"
+            "tsqr/cholqr/sketch engines are lstsq-only fast paths"
         )
     _check_panel_impl(cfg)
     _check_sched_knobs(cfg, mesh)
@@ -580,6 +580,72 @@ def _validate_alt_engine_cfg(cfg: DHQRConfig) -> None:
             "agg_panels applies to the blocked householder engines only "
             f"(engine={cfg.engine!r})"
         )
+
+
+def _lstsq_sketch(A, b, cfg: DHQRConfig, mesh):
+    """Route ``lstsq`` to the randomized sketched engine
+    (``dhqr_tpu.solvers.sketch``, round 17): compress to an s x n core,
+    QR the core, recover accuracy with R-preconditioned CGLS against the
+    true A. Single-device only — the sketch's point is that the core is
+    SMALL; shard upstream and sketch the shards if m outgrows a device.
+
+    Knob mapping: ``precision``/``trailing_precision``/``norm`` steer
+    the CORE factorization (it runs the blocked engine); ``block_size``
+    its panel width; ``refine`` — when explicitly > 0 (or set via a
+    policy) — ADDS CGLS iterations on top of the
+    :class:`~dhqr_tpu.utils.config.SketchConfig` baseline (the baseline
+    is what holds the 8x gate; extra sweeps buy margin)."""
+    from dhqr_tpu.solvers.sketch import sketched_lstsq
+    from dhqr_tpu.utils.config import SketchConfig
+
+    if mesh is not None:
+        raise ValueError(
+            "engine='sketch' is single-device: the sketch core is "
+            "already small — shard the stream, not the sketch"
+        )
+    if cfg.layout != "block":
+        raise ValueError(
+            f"layout applies only to the householder engines "
+            f"(engine='sketch', layout={cfg.layout!r})"
+        )
+    if cfg.use_pallas != "auto":
+        raise ValueError(
+            "use_pallas applies to engines with single-problem panel "
+            f"loops (got use_pallas={cfg.use_pallas!r} with "
+            "engine='sketch'; the sketch core runs the vmapped-scale "
+            "XLA path)"
+        )
+    if cfg.apply_precision is not None:
+        raise ValueError(
+            "apply_precision applies to the householder engines only "
+            "(engine='sketch')"
+        )
+    if cfg.panel_impl != "loop":
+        raise ValueError(
+            "panel_impl applies to the blocked householder engines "
+            f"(engine='sketch', panel_impl={cfg.panel_impl!r})"
+        )
+    if cfg.lookahead or cfg.agg_panels:
+        raise ValueError(
+            "lookahead/agg_panels apply to the blocked householder "
+            "engines only (engine='sketch')"
+        )
+    if not cfg.blocked:
+        raise ValueError(
+            "engine='sketch' factors its core with the blocked engine "
+            "(got blocked=False)"
+        )
+    if cfg.refine < 0:
+        raise ValueError(f"refine must be >= 0, got {cfg.refine}")
+    scfg = SketchConfig.from_env()
+    return sketched_lstsq(
+        A, b, scfg,
+        precision=cfg.precision,
+        trailing_precision=cfg.trailing_precision,
+        norm=cfg.norm,
+        refine=scfg.refine + cfg.refine,
+        block_size=cfg.block_size,
+    )
 
 
 def _lstsq_refined(A, b, cfg: DHQRConfig, mesh):
@@ -915,6 +981,17 @@ def lstsq(
         # same component precision (f32), runs on the MXU path.
         return _lstsq_via_real_embedding(A, b, cfg, mesh)
     ensure_complex_supported(A.dtype)
+    if cfg.engine == "sketch":
+        # Routed BEFORE the block_size default resolution: block_size
+        # stays None here so the sketch engine applies its own
+        # core-sized default (SKETCH_DEFAULT_BLOCK — the s x n core is
+        # serve-bucket sized, where narrow panels measured fastest).
+        if A.shape[0] < A.shape[1]:
+            raise ValueError(
+                f"m < n (got {A.shape}) is supported only on the "
+                "single-device householder path (minimum-norm solve)"
+            )
+        return _lstsq_sketch(A, b, cfg, mesh)
     if cfg.block_size is None:
         # Same resolution rule as qr(): auto width only where the Pallas
         # kernel can actually take the panels — the single-device blocked

@@ -87,6 +87,11 @@ ENGINE_LADDER = {
     "cholqr3": ("tsqr", "householder"),
     "tsqr": ("householder",),
     "householder": (),
+    # Round 17: a sketched solve that breaks down (or fails the
+    # residual probe — a pathological embedding draw) escalates
+    # straight to the stable direct engine; there is no intermediate
+    # randomized rung worth paying for.
+    "sketch": ("householder",),
 }
 
 GUARD_MODES = ("screen", "fallback", "full")

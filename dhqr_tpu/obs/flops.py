@@ -32,7 +32,10 @@ __all__ = [
     "cholqr_flops",
     "lstsq_flops",
     "qr_flops",
+    "qr_update_flops",
+    "sketched_lstsq_flops",
     "tsqr_flops",
+    "updatable_solve_flops",
 ]
 
 
@@ -89,6 +92,51 @@ def cholqr_flops(m: int, n: int, passes: int = 2) -> float:
     m, n = float(m), float(n)
     per_pass = 2.0 * m * n * n + (n ** 3) / 3.0
     return max(1, int(passes)) * per_pass
+
+
+def sketched_lstsq_flops(m: int, n: int, s: int, refine: int = 0) -> float:
+    """Sketch-and-precondition least squares on (m, n) with an s-row
+    count-sketch core (round 17, ``dhqr_tpu.solvers.sketch``): sketch
+    application ``2mn + 2m`` (sign multiply + bucket add per entry of A
+    and b), the CholeskyQR core — Gram syrk ``s n^2`` (symmetric half,
+    the :func:`cholqr_flops` counting convention) + Cholesky
+    ``n^3/3`` — the semi-normal x0 (``2sn`` for ``(SA)^H Sb`` + two
+    n x n triangular solves), then ``refine`` R-preconditioned CGLS
+    iterations — each one A-matvec + one A^H-matvec (``4mn``) + two
+    n x n triangular solves (``2n^2``) + ``~6m`` vector updates. The
+    SRHT variant pays ``2 p n log2 p`` butterflies instead of the 2mn
+    sketch application; the model deliberately counts the count-sketch
+    (default) form — one closed form per engine family, like
+    :func:`tsqr_flops` counting the binary tree."""
+    m, n, s = float(m), float(n), float(s)
+    base = (2.0 * m * n + 2.0 * m                 # sketch application
+            + s * n * n + (n ** 3) / 3.0         # Gram syrk + Cholesky
+            + 2.0 * s * n + 2.0 * n * n)         # semi-normal x0
+    sweep = 4.0 * m * n + 2.0 * n * n + 6.0 * m
+    return base + max(0, int(refine)) * sweep
+
+
+def qr_update_flops(m: int, n: int) -> float:
+    """One rank-1 update/downdate of a live (m, n) factorization
+    (round 17, ``dhqr_tpu.solvers.update.UpdatableQR``): the Gram-side
+    matvec ``w = A^H u`` (``2mn``), the data update ``A += u v^H``
+    (``2mn``), the ``u . u`` dot (``2m``), three rank-1 symmetric Gram
+    updates (``6n^2``), and the n x n Cholesky refresh (``n^3/3``).
+    The m/n-fold gap to :func:`qr_flops` is the engine family's reason
+    to exist."""
+    m, n = float(m), float(n)
+    return 4.0 * m * n + 2.0 * m + 6.0 * n * n + (n ** 3) / 3.0
+
+
+def updatable_solve_flops(m: int, n: int, refine: int = 1) -> float:
+    """One CSNE solve against a live (m, n) factorization: ``A^H b``
+    (``2mn``) + two n x n triangular solves (``2n^2``), plus ``refine``
+    corrected sweeps (residual matvec + Gram-side matvec ``4mn`` + two
+    more triangular solves)."""
+    m, n = float(m), float(n)
+    base = 2.0 * m * n + 2.0 * n * n
+    sweep = 4.0 * m * n + 2.0 * n * n
+    return base + max(0, int(refine)) * sweep
 
 
 def batched_qr_flops(batch: int, m: int, n: int) -> float:

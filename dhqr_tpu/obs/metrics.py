@@ -310,6 +310,24 @@ def _pulse_provider() -> dict:
     return store.stats()
 
 
+def _solvers_provider() -> dict:
+    """The round-17 solver families' module counters
+    (``solvers.sketch_calls`` / ``solvers.update_refactors`` / ... —
+    ``dhqr_tpu.solvers.{sketch,update}.COUNTERS``). Known names emitted
+    as zeros before the first bump, like ``numeric.*`` — scrapers want
+    stable series."""
+    from dhqr_tpu.solvers.sketch import COUNTERS as _sk_counters
+    from dhqr_tpu.solvers.update import COUNTERS as _up_counters
+
+    out: dict = {name: 0 for name in (
+        "sketch_calls", "sketch_operator_draws", "update_steps",
+        "downdate_steps", "update_solves", "update_refactors",
+        "update_breakdowns", "update_screen_rejects")}
+    out.update(_sk_counters.snapshot())
+    out.update(_up_counters.snapshot())
+    return out
+
+
 _REGISTRY: "MetricsRegistry | None" = None
 _REGISTRY_LOCK = threading.Lock()
 
@@ -322,6 +340,7 @@ def _new_default_registry() -> MetricsRegistry:
     reg.register("obs", _obs_provider)
     reg.register("xray", _xray_provider)
     reg.register("comms", _pulse_provider)
+    reg.register("solvers", _solvers_provider)
     # serve.cache.* / serve.sched.* have no lazy provider: every
     # ExecutableCache and AsyncScheduler instance self-registers at
     # construction (weakly — test instances evaporate with GC).

@@ -191,6 +191,14 @@ def _analytic_for_key(key) -> "float | None":
     if kind == "lstsq":
         return _flops.batched_lstsq_flops(
             batch, m, n, refine=getattr(key, "refine", 0) or 0)
+    if kind == "sketch":
+        # Round 17: the sketched serve kind — the key's sketch triple
+        # carries s, and refine is the CGLS iteration count.
+        sk = getattr(key, "sketch", None)
+        if not sk:
+            return None
+        return batch * _flops.sketched_lstsq_flops(
+            m, n, sk[0], refine=getattr(key, "refine", 0) or 0)
     return None
 
 

@@ -49,6 +49,7 @@ from dhqr_tpu.serve.cache import (
 from dhqr_tpu.serve.engine import (
     batched_lstsq,
     batched_qr,
+    batched_sketched_lstsq,
     bucket_program,
     prewarm,
 )
@@ -76,6 +77,7 @@ __all__ = [
     "default_cache",
     "batched_lstsq",
     "batched_qr",
+    "batched_sketched_lstsq",
     "bucket_batch",
     "bucket_dim",
     "bucket_program",

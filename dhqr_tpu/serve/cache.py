@@ -57,11 +57,17 @@ from dhqr_tpu.utils.profiling import Counters, PhaseTimer
 class CacheKey(NamedTuple):
     """Everything that selects a distinct serve program.
 
-    ``kind`` is the program family ("lstsq" | "qr"); ``batch``/``m``/
-    ``n``/``dtype`` the bucketed stacked shape; the rest the engine
-    knobs that are static arguments of the underlying jit (a knob that
-    changed the traced program but not the key would silently serve
-    stale executables — keep this in sync with ``engine._lower_for_key``).
+    ``kind`` is the program family ("lstsq" | "qr" | "sketch");
+    ``batch``/``m``/``n``/``dtype`` the bucketed stacked shape; the rest
+    the engine knobs that are static arguments of the underlying jit (a
+    knob that changed the traced program but not the key would silently
+    serve stale executables — keep this in sync with
+    ``engine._lower_for_key``). ``sketch`` (round 17) is the sketched
+    kind's ``(s, seed, operator)`` triple — the operator arrays are
+    drawn deterministically from it and baked into the program as
+    constants, so two processes agreeing on the key agree on the
+    executable bit-for-bit; None for the direct kinds (the default
+    keeps every pre-round-17 key spelling valid).
     """
 
     kind: str
@@ -76,6 +82,7 @@ class CacheKey(NamedTuple):
     refine: int
     norm: str
     panel_impl: str
+    sketch: "tuple | None" = None
 
 
 class ExecutableCache:

@@ -128,9 +128,12 @@ def _resolve_serve_cfg(config: Optional[DHQRConfig],
     # an EXPLICIT refine=). Each entry point places it.
     if cfg.engine != "householder":
         raise ValueError(
-            f"the serving tier batches the blocked householder engine only "
-            f"(got engine={cfg.engine!r}); the tsqr/cholqr families are "
-            "single-problem fast paths"
+            f"the serving tier's configs keep engine='householder' (got "
+            f"engine={cfg.engine!r}): program families are selected by "
+            "the KIND — batched_lstsq/batched_qr batch the blocked "
+            "householder engine, batched_sketched_lstsq is the sketched "
+            "kind (its knobs steer the sketch core) — while the "
+            "tsqr/cholqr families are single-problem fast paths"
         )
     if not cfg.blocked:
         raise ValueError(
@@ -215,6 +218,23 @@ def _plan_key(kind: str, count: int, m: int, n: int, dtype,
     bucket = plan_bucket(m, n, dtype, scfg)
     batch = bucket_batch(count, scfg)
     nb = min(cfg.block_size or SERVE_DEFAULT_BLOCK, bucket.n)
+    if kind == "sketch":
+        # Round 17: the sketched kind's program is fully determined by
+        # the bucket shape + the (s, seed, operator) triple — derived
+        # HERE, the one key mint, from SketchConfig + the bucket, so
+        # prewarm and live dispatch (and every process sharing the
+        # seed) agree on the executable by construction.
+        from dhqr_tpu.solvers import sketch as _sketch
+        from dhqr_tpu.utils.config import SketchConfig
+
+        skcfg = SketchConfig.from_env()
+        s = _sketch.sketch_dim(bucket.m, bucket.n, factor=skcfg.factor)
+        op = _sketch.resolve_operator(skcfg.operator, bucket.m)
+        key = CacheKey(kind, batch, bucket.m, bucket.n, bucket.dtype, nb,
+                       cfg.precision, cfg.trailing_precision, None,
+                       cfg.refine, cfg.norm, "loop",
+                       sketch=(s, skcfg.seed, op))
+        return key, bucket
     if kind == "qr":
         # refine/apply live in the solve stage; a factor-only program is
         # identical across them — keep them out of the key so policy
@@ -235,6 +255,17 @@ def _lower_for_key(key: CacheKey):
     the ``.compile()``)."""
     dtype = jnp.dtype(key.dtype)
     A = jax.ShapeDtypeStruct((key.batch, key.m, key.n), dtype)
+    if key.kind == "sketch":
+        from dhqr_tpu.solvers import sketch as _sketch
+
+        s, seed, op = key.sketch
+        fn = _sketch.batched_sketch_program(
+            key.m, key.n, s, seed, op, key.block_size,
+            precision=key.precision,
+            trailing_precision=key.trailing_precision, norm=key.norm,
+            refine=key.refine, dtype=key.dtype)
+        b = jax.ShapeDtypeStruct((key.batch, key.m), dtype)
+        return jax.jit(fn).lower(A, b)
     if key.kind == "qr":
         return _blocked._batched_qr_impl_donate.lower(
             A, key.block_size, precision=key.precision, norm=key.norm,
@@ -287,7 +318,26 @@ def bucket_program(kind: str, config: Optional[DHQRConfig] = None,
         return lstsq_fn
     if kind == "qr":
         return qr_fn
-    raise ValueError(f"kind must be 'lstsq' or 'qr', got {kind!r}")
+    if kind == "sketch":
+        from dhqr_tpu.solvers import sketch as _sketch
+        from dhqr_tpu.utils.config import SketchConfig
+
+        skcfg = SketchConfig.from_env()
+
+        def sketch_fn(A, b):
+            _, m, n = A.shape
+            s = _sketch.sketch_dim(m, n, factor=skcfg.factor)
+            op = _sketch.resolve_operator(skcfg.operator, m)
+            nb = min(cfg.block_size or SERVE_DEFAULT_BLOCK, n)
+            prog = _sketch.batched_sketch_program(
+                m, n, s, skcfg.seed, op, nb, precision=cfg.precision,
+                trailing_precision=cfg.trailing_precision, norm=cfg.norm,
+                refine=skcfg.refine + cfg.refine, dtype=A.dtype)
+            return prog(A, b)
+
+        return sketch_fn
+    raise ValueError(
+        f"kind must be 'lstsq', 'qr' or 'sketch', got {kind!r}")
 
 
 def _resolve_dispatch_cfg(kind: str, config: Optional[DHQRConfig],
@@ -312,8 +362,27 @@ def _resolve_dispatch_cfg(kind: str, config: Optional[DHQRConfig],
         if pol is not None and pol.refine:
             cfg = dataclasses.replace(cfg, refine=pol.refine)
         return cfg, pol, None
+    if kind == "sketch":
+        # Round 17: the sketched kind. The TOTAL CGLS iteration count
+        # is resolved HERE — SketchConfig baseline + the caller's
+        # policy/refine extra — so the cache key's ``refine`` field and
+        # the compiled program agree wherever the key is minted
+        # (prewarm, sync dispatch, the async scheduler).
+        from dhqr_tpu.utils.config import SketchConfig
+
+        if cfg.panel_impl != "loop":
+            raise ValueError(
+                "panel_impl applies to the blocked householder kinds "
+                f"(kind='sketch', panel_impl={cfg.panel_impl!r}: the "
+                "sketch core's panel interior is fixed)"
+            )
+        extra = pol.refine if pol is not None else cfg.refine
+        cfg = dataclasses.replace(
+            cfg, refine=SketchConfig.from_env().refine + extra)
+        return cfg, pol, None
     if kind != "qr":
-        raise ValueError(f"kind must be 'lstsq' or 'qr', got {kind!r}")
+        raise ValueError(
+            f"kind must be 'lstsq', 'qr' or 'sketch', got {kind!r}")
     if cfg.refine:
         raise ValueError(
             "refine applies to batched_lstsq only — batched_qr returns raw "
@@ -477,13 +546,13 @@ def _dispatch_groups(kind, As, bs, cfg, scfg, cache, consume, pol=None,
             _faults.latency("serve.latency")
             try:
                 _faults.fire("serve.dispatch")
-                if kind == "lstsq":
+                if kind == "qr":
+                    def launch(A_buf=A_buf, b_buf=None):
+                        return compiled(jnp.asarray(A_buf))
+                else:       # lstsq / sketch: stacked (A, b) programs
                     def launch(A_buf=A_buf, b_buf=b_buf):
                         return compiled(jnp.asarray(A_buf),
                                         jnp.asarray(b_buf))
-                else:
-                    def launch(A_buf=A_buf, b_buf=None):
-                        return compiled(jnp.asarray(A_buf))
                 # dhqr-pulse (round 16): the bucket dispatch is
                 # contracted COLLECTIVE-FREE (the EOF comms note below);
                 # armed, the first dispatch of each key is profiled once
@@ -518,8 +587,8 @@ def _dispatch_groups(kind, As, bs, cfg, scfg, cache, consume, pol=None,
             except Exception as e:
                 raise DispatchFailed(key, e) from e
             if cfg.guards is not None:
-                bad = (_nguards.any_nonfinite(outs) if kind == "lstsq"
-                       else _nguards.any_nonfinite(*outs))
+                bad = (_nguards.any_nonfinite(*outs) if kind == "qr"
+                       else _nguards.any_nonfinite(outs))
                 if bad:
                     raise Breakdown(
                         f"non-finite rows in the stacked {kind} dispatch "
@@ -595,6 +664,41 @@ def batched_lstsq(
     consume = _scatter_lstsq(As, lambda i, x: out.__setitem__(i, x))
     with _trace_sync_resolve(rec, tid):
         _dispatch_groups("lstsq", As, bs, cfg, scfg, cache, consume,
+                         pol=pol, trace_id=tid)
+    return out
+
+
+def batched_sketched_lstsq(
+    As: Sequence,
+    bs: Sequence,
+    config: Optional[DHQRConfig] = None,
+    serve_config: Optional[ServeConfig] = None,
+    cache: Optional[ExecutableCache] = None,
+    **overrides,
+) -> List[jax.Array]:
+    """Sketched least squares for a heterogeneous batch — the serve
+    tier's ``"sketch"`` kind (round 17): same bucketing/padding/cache/
+    scatter pipeline as :func:`batched_lstsq`, but each bucket compiles
+    the vmapped sketch-and-precondition program
+    (``dhqr_tpu.solvers.sketch.batched_sketch_program``) instead of the
+    direct factorization — the tall-skinny fast path, served.
+
+    The sketch operator is derived from ``DHQR_SKETCH_*`` (seed,
+    operator family, size factor) per bucket and rides the cache key,
+    so prewarmed keys are the keys live dispatch hits and two
+    processes sharing the seed agree on every compiled program.
+    ``policy=``'s refine ADDS CGLS iterations on top of the
+    ``SketchConfig`` baseline; precision knobs steer the core QR.
+    """
+    scfg = serve_config or ServeConfig.from_env()
+    cache = cache if cache is not None else default_cache()
+    cfg, pol, _ = _resolve_dispatch_cfg("sketch", config, overrides)
+    _validate_requests(As, bs)
+    rec, tid = _trace_sync_call("sketch", len(As))
+    out: "list[jax.Array | None]" = [None] * len(As)
+    consume = _scatter_lstsq(As, lambda i, x: out.__setitem__(i, x))
+    with _trace_sync_resolve(rec, tid):
+        _dispatch_groups("sketch", As, bs, cfg, scfg, cache, consume,
                          pol=pol, trace_id=tid)
     return out
 

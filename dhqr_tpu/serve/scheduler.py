@@ -138,17 +138,26 @@ class _Pending:
 
 class _Group:
     """Pending requests sharing (kind, bucket, resolved config) — the
-    unit the dispatcher flushes as one stacked micro-batch."""
+    unit the dispatcher flushes as one stacked micro-batch. For the
+    ``"update"`` kind (round 17) the group is keyed on the LIVE
+    :class:`~dhqr_tpu.solvers.update.UpdatableQR` instead: its ops are
+    ordered state mutations, so the group holds the session, flushes
+    strictly FIFO, and is serialized via ``busy`` (two workers must
+    never interleave ops against one factorization)."""
 
     __slots__ = ("kind", "bucket", "cfg", "pol", "qr_solve_args", "queue",
-                 "credits", "not_before")
+                 "credits", "not_before", "session", "busy", "gkey")
 
-    def __init__(self, kind, bucket, cfg, pol, qr_solve_args):
+    def __init__(self, kind, bucket, cfg, pol, qr_solve_args,
+                 session=None, gkey=None):
         self.kind = kind
         self.bucket = bucket
         self.cfg = cfg
         self.pol = pol
         self.qr_solve_args = qr_solve_args
+        self.session = session      # "update" kind: the UpdatableQR
+        self.busy = False           # "update" kind: one flush at a time
+        self.gkey = gkey            # "update" kind: for idle pruning
         self.queue: "collections.deque[_Pending]" = collections.deque()
         # Smooth-WRR credit per tenant, persisted ACROSS flushes (a light
         # tenant that loses an oversubscribed flush is ahead next flush).
@@ -289,15 +298,46 @@ class AsyncScheduler:
         :class:`BackpressureError` past the queue-depth high-water mark
         and ``RuntimeError`` after :meth:`shutdown`.
         """
-        cfg, pol, qr_solve_args = self._resolve(policy, plan, kind)
-        if kind == "lstsq":
-            if b is None:
-                raise ValueError("kind='lstsq' needs a right-hand side b")
-            _engine._validate_requests([A], [b])
+        if kind == "update":
+            # Round 17: ops against a live UpdatableQR. ``A`` is the
+            # session, ``b`` the op payload ("update"/"downdate", u, v)
+            # or ("solve", rhs). No config resolution — the session
+            # already owns its numerics — and no stacked program: the
+            # flush runs the ops host-side, in submission order,
+            # serialized per session (_Group.busy).
+            from dhqr_tpu.solvers.update import UpdatableQR
+
+            if not isinstance(A, UpdatableQR):
+                raise ValueError(
+                    "kind='update' takes an UpdatableQR session as its "
+                    f"first argument, got {type(A).__name__}"
+                )
+            if policy is not None or plan is not None:
+                raise ValueError(
+                    "kind='update' ops take no policy=/plan= — the "
+                    "session's numerics were fixed at construction"
+                )
+            if (not isinstance(b, tuple) or not b
+                    or b[0] not in ("update", "downdate", "solve")
+                    or (b[0] == "solve" and len(b) != 2)
+                    or (b[0] in ("update", "downdate") and len(b) != 3)):
+                raise ValueError(
+                    "kind='update' payload must be ('update', u, v), "
+                    "('downdate', u, v) or ('solve', rhs), got "
+                    f"{b!r:.120}"
+                )
+            cfg = pol = qr_solve_args = None
         else:
-            if b is not None:
-                raise ValueError("kind='qr' takes no right-hand side")
-            _engine._validate_requests([A], None)
+            cfg, pol, qr_solve_args = self._resolve(policy, plan, kind)
+            if kind in ("lstsq", "sketch"):
+                if b is None:
+                    raise ValueError(
+                        f"kind={kind!r} needs a right-hand side b")
+                _engine._validate_requests([A], [b])
+            else:
+                if b is not None:
+                    raise ValueError("kind='qr' takes no right-hand side")
+                _engine._validate_requests([A], None)
         bucket = plan_bucket(A.shape[0], A.shape[1], A.dtype, self._scfg)
         if deadline is None:
             deadline = self._kcfg.slo_ms / 1e3
@@ -354,11 +394,14 @@ class AsyncScheduler:
                               est_s=round(est, 6),
                               retry_after=round(retry, 6))
                 raise err
-            gkey = (kind, bucket, cfg, qr_solve_args)
+            gkey = (kind, id(A)) if kind == "update" else \
+                (kind, bucket, cfg, qr_solve_args)
             group = self._groups.get(gkey)
             if group is None:
                 group = self._groups[gkey] = _Group(
-                    kind, bucket, cfg, pol, qr_solve_args)
+                    kind, bucket, cfg, pol, qr_solve_args,
+                    session=A if kind == "update" else None,
+                    gkey=gkey if kind == "update" else None)
             self._seq += 1
             # The submit span is recorded BEFORE the queue entry becomes
             # visible (append + notify): with live dispatcher workers, a
@@ -435,6 +478,12 @@ class AsyncScheduler:
         for group in self._groups.values():
             if not group.queue:
                 continue
+            if group.busy:
+                # An update session mid-flush: its queue will be
+                # re-examined when the flush completes (poll loops), so
+                # it must not drive the wake horizon to "now" — that
+                # would busy-spin the dispatcher against the busy gate.
+                continue
             oldest = group.queue[0]
             t = min(
                 oldest.deadline_at - self._lead_s(group.bucket),
@@ -456,6 +505,8 @@ class AsyncScheduler:
         for group in self._groups.values():
             if not group.queue:
                 continue
+            if group.busy:
+                continue    # update session mid-flush: ordering gate
             reason = "drain" if drain else self._flush_reason(group, now)
             if reason is None:
                 continue
@@ -481,6 +532,13 @@ class AsyncScheduler:
         is plain FIFO interleaving; with 3:1 a flooding tenant keeps 3/4
         of an oversubscribed flush and the light tenant still lands
         1/4."""
+        if group.kind == "update":
+            # Ops are ordered state mutations: strict FIFO, tenant
+            # arbitration never reorders a session's op stream.
+            taken = [group.queue.popleft()
+                     for _ in range(min(count, len(group.queue)))]
+            self._depth -= len(taken)
+            return taken
         by_tenant: "dict[str, collections.deque[_Pending]]" = {}
         for p in group.queue:
             by_tenant.setdefault(p.tenant, collections.deque()).append(p)
@@ -586,6 +644,9 @@ class AsyncScheduler:
         its result on success, raises (typed where the engine/cache
         classified it) on failure WITHOUT touching the futures — the
         caller decides between retry, bisect and typed failure."""
+        if group.kind == "update":
+            self._dispatch_update_ops(group, batch)
+            return
         self.counters.bump("dispatches")
         self._span_batch(batch, "dispatch", bucket=group.bucket.label,
                          batch=len(batch))
@@ -593,7 +654,7 @@ class AsyncScheduler:
         resolved: "list[tuple[int, object]]" = []
         raw_outs: "list[object]" = []
         emit = lambda i, val: resolved.append((i, val))  # noqa: E731
-        if group.kind == "lstsq":
+        if group.kind in ("lstsq", "sketch"):
             bs = [p.b for p in batch]
             consume_inner = _engine._scatter_lstsq(As, emit)
         else:
@@ -663,6 +724,53 @@ class AsyncScheduler:
                          compile_s=round(compile_s, 6), chunks=chunks)
         for p, val in zip(batch, out):
             self._resolve_success(p, val, done)
+
+    def _dispatch_update_ops(self, group: _Group,
+                             batch: "list[_Pending]") -> None:
+        """The ``"update"`` kind's flush (round 17): run each op
+        against the group's live UpdatableQR, in submission order,
+        resolving per op as it commits. No stacked program, no cache —
+        the ops ARE host-orchestrated state mutations — but the fault
+        sites (``serve.dispatch``/``serve.latency``), the typed-error
+        contract, the spans and the latency accounting all apply
+        exactly as on the batched kinds.
+
+        Failure routing: a :class:`NumericalError` is a property of
+        the op's DATA (a poisoned vector, a refactor the PR-8 ladder
+        refused) — it resolves THAT op typed and the stream continues
+        (the session rolled the op back, so neighbors are safe). Any
+        other failure raises out of the flush with the already-resolved
+        ops excluded, so ``_handle_failure`` retries only the remainder
+        — requeued at the front, order preserved — and a transient
+        injected fault behaves exactly as on a batched dispatch."""
+        self.counters.bump("dispatches")
+        self._span_batch(batch, "dispatch", bucket=group.bucket.label,
+                         batch=len(batch))
+        session = group.session
+        for p in sorted(batch, key=lambda q: q.seq):
+            _faults.latency("serve.latency")
+            try:
+                _faults.fire("serve.dispatch")
+                op = p.b[0]
+                if op == "solve":
+                    val = session.solve(p.b[1])
+                elif op == "update":
+                    val = session.update(p.b[1], p.b[2])
+                else:
+                    val = session.downdate(p.b[1], p.b[2])
+            except NumericalError as e:
+                self.counters.bump("numeric_failures")
+                self.counters.bump("poisoned")
+                self._span_batch([p], "numeric_isolate",
+                                 cause=type(e).__name__, batch=1)
+                self._fail(p, e)
+                continue
+            except ServeError:
+                raise
+            except Exception as e:
+                raise DispatchFailed(
+                    ("update", group.bucket.label, p.b[0]), e) from e
+            self._resolve_success(p, val, self._clock())
 
     def _resolve_success(self, p: _Pending, val, done: float) -> None:
         self.latency.record(done - p.submitted_at)
@@ -825,6 +933,32 @@ class AsyncScheduler:
         # immediate isolation pass (a group bisects now, a lone request
         # re-dispatches once and fails typed only if it fails alone
         # again).
+        #
+        # EXCEPT for the "update" kind (round 17): its requests are
+        # ORDERED state mutations against one live factorization, and a
+        # per-request split could re-dispatch op k+1 now (escalate)
+        # while op k waits out a backoff — a state no submission order
+        # produces. The whole remainder moves as one unit: requeue ALL
+        # alive ops (front, original order) when the HEAD op still has
+        # budget and deadline room, else escalate ALL in order (the
+        # update isolation path dispatches sequentially).
+        if group.kind == "update":
+            for p in alive:
+                p.attempts += 1
+            head = alive[0]
+            backoff = (self._kcfg.retry_base_ms / 1e3
+                       * (2 ** (head.attempts - 1)))
+            if head.attempts <= self._kcfg.max_retries and \
+                    now + backoff < head.deadline_at:
+                self.counters.bump("retries")
+                self._span_batch(alive, "retry", t=now,
+                                 cause=type(err).__name__,
+                                 backoff_s=round(backoff, 6),
+                                 per=lambda p, _: {"attempt": p.attempts})
+                self._requeue(group, alive, now + backoff)
+            else:
+                self._isolate_now(group, alive, err)
+            return
         for p in alive:
             p.attempts += 1
         base = self._kcfg.retry_base_ms / 1e3
@@ -929,9 +1063,12 @@ class AsyncScheduler:
                         self._idle.notify_all()
                     return flushed
                 group, reason = pick
-                count = self._flush_count(reason, len(group.queue))
+                count = len(group.queue) if group.kind == "update" \
+                    else self._flush_count(reason, len(group.queue))
                 taken = self._take_locked(group, count)
                 self._inflight += len(taken)
+                if group.kind == "update":
+                    group.busy = True   # serialize ops per session
             try:
                 self._flush(group, taken, reason)
             except BaseException:
@@ -949,6 +1086,19 @@ class AsyncScheduler:
             finally:
                 with self._lock:
                     self._inflight -= len(taken)
+                    if group.kind == "update":
+                        group.busy = False
+                        if not group.queue:
+                            # Idle update groups are PRUNED: they are
+                            # keyed per live session (id), so unlike
+                            # the bounded bucket-group set they would
+                            # otherwise pin every session ever
+                            # submitted (and its m x n state arrays)
+                            # for the scheduler's lifetime. A later
+                            # submit for the same session simply mints
+                            # a fresh group.
+                            self._groups.pop(group.gkey, None)
+                        self._work.notify()  # re-examine its queue
                     self._idle.notify_all()
             flushed += 1
 

@@ -1,0 +1,53 @@
+"""dhqr-sketch — new-workload solver families on the QR core (round 17).
+
+Two engine families that reuse the ``qr()``/``lstsq()`` plumbing end to
+end, opening workloads no direct engine covers:
+
+* :mod:`dhqr_tpu.solvers.sketch` — randomized **sketch-and-precondition
+  least squares**: a seeded count-sketch (or SRHT) compresses a
+  tall-skinny ``m x n`` system to an ``s x n`` core (``s = O(n log
+  n)``), the repo's own blocked QR factors the core, and
+  iterative-refinement sweeps against the TRUE A bring the answer
+  inside the reference 8x-LAPACK criterion — a speed regime the direct
+  engines cannot reach at ``m/n >= 64``. Routed by ``lstsq(A, b,
+  engine="sketch")``, tuned as ``Plan(engine="sketch")``
+  (admissibility decided by tune's accuracy gate), served as the serve
+  tier's ``"sketch"`` kind.
+* :mod:`dhqr_tpu.solvers.update` — **updatable QR**:
+  :class:`UpdatableQR` holds a live factorization with rank-1
+  ``update(u, v)`` / ``downdate(u, v)`` at amortized ``O(mn + n^3)``
+  per step (vs ``O(m n^2)`` fresh), CSNE solves through the numeric
+  guard screen, and a refactor-threshold policy that rebuilds through
+  the PR-8 guarded ladder — the serving story for streaming
+  regression, exposed through ``AsyncScheduler.submit`` as the
+  ``"update"`` kind.
+
+See docs/DESIGN.md "New workloads" for the design rationale and
+docs/OPERATIONS.md for the sketch-admissibility runbook.
+"""
+
+from dhqr_tpu.solvers.sketch import (
+    batched_sketch_program,
+    count_sketch_operator,
+    resolve_operator,
+    sketch_dim,
+    sketched_lstsq,
+    srht_operator,
+)
+from dhqr_tpu.solvers.update import (
+    UpdatableQR,
+    solve_program,
+    update_program,
+)
+
+__all__ = [
+    "UpdatableQR",
+    "batched_sketch_program",
+    "count_sketch_operator",
+    "resolve_operator",
+    "sketch_dim",
+    "sketched_lstsq",
+    "solve_program",
+    "srht_operator",
+    "update_program",
+]

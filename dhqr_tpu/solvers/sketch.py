@@ -1,0 +1,480 @@
+"""Sketch-and-precondition least squares — the compressed-core engine.
+
+A tall-skinny system (m x n, m/n >= 64) spends almost all of its direct
+cost on the m-long dimension: cholqr2 pays ``4 m n^2`` GEMM flops,
+householder ``2 m n^2``. A randomized sketch compresses the system to an
+``s x n`` core with ``s = O(n log n)`` rows FIRST — one pass over A that
+costs O(mn) adds (count-sketch) or O(p n log p) butterflies (SRHT) —
+then factors only the core (one CholeskyQR pass — a BLAS-grade syrk +
+``checked_cholesky``, independent of m), and buys the answer's accuracy
+back with R-preconditioned CGLS iterations against the TRUE A (4mn per
+iteration). Total ~``O(mn (1 + 4 k)) + O(s n^2)`` vs the direct
+engines' ``O(m n^2)``: a different speed regime, the sketch-and-
+precondition construction of Rokhlin-Tygert / Blendenpik on the repo's
+CholeskyQR/Gram machinery (precision policies apply: panel precision
+runs the core contractions, a trailing split steers the Gram syrk —
+exactly PrecisionPolicy.trailing's documented role for the row
+engines).
+
+Accuracy story — identical gate, no new criterion: the sketched R
+satisfies ``R^H R ~ A^H A`` up to the embedding distortion, so ``A
+R^{-1}`` has a small constant condition number and conjugate gradients
+on the preconditioned normal equations contract the error by
+``(sqrt(kappa)-1)/(sqrt(kappa)+1)`` per iteration — twelve iterations
+(the default, ``DHQR_SKETCH_REFINE``) reach the f32 LAPACK level the
+reference 8x residual criterion is measured against. (A plain
+semi-normal-equations Richardson sweep would NOT do: an O(n log n)
+sketch's distortion spectrum strays outside (0, 2) and the iteration
+diverges — measured, which is why this is CG.) ADMISSIBILITY IS
+DECIDED BY TUNE'S ACCURACY GATE, not by a flag: the autotuner times
+``Plan(engine="sketch")`` like any candidate and disqualifies it
+wherever the gate fails (tune/search.py rule 5; benchmarks/
+sketched_lstsq.py re-verifies every committed cell the same way).
+
+Seeded determinism: both operators are drawn from
+``numpy.random.default_rng([seed, m, s, ...])`` on the host — the SAME
+seed yields the bit-identical operator (and therefore the identical
+serve cache key) in every process, which is what lets a prewarmed
+serving fleet agree on its compiled programs
+(tests/test_solvers.py pins this across a real subprocess).
+
+Operators:
+
+* **count-sketch** (default): row i of A lands in bucket ``h(i)`` with
+  sign ``sigma(i)`` — ``S A`` is one ``segment_sum``, O(mn) adds, no
+  flops on the m axis beyond the sign. Works for every m.
+* **SRHT** (``operator="srht"``, or auto-selected when m is already a
+  power of two — the "power-of-two-friendly pad" case where the
+  Walsh-Hadamard butterfly needs no padding): ``sqrt(p/s)/sqrt(p) * P H
+  D``, better-conditioned embeddings at the same s, O(p n log2 p) adds.
+
+Scope: single-device, vector RHS, m >= n (the tall regime the gate
+admits it for). ``lstsq(A, b, engine="sketch")`` routes here;
+``dhqr_tpu.serve`` dispatches the vmapped twin as its ``"sketch"`` kind
+(`batched_sketch_program`).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from collections import OrderedDict
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dhqr_tpu.utils.config import SketchConfig
+from dhqr_tpu.utils.profiling import Counters
+
+#: Process-wide sketch accounting, exported by the metrics registry as
+#: ``solvers.sketch_*`` (``dhqr_tpu.obs.metrics``): calls into the
+#: public entry point and operator draws (one per novel (m, s, seed,
+#: operator) tuple — a warm stream re-draws nothing).
+COUNTERS = Counters()
+
+#: Default compact-WY panel width for the CORE factorization. The sketch
+#: core is s x n with s = O(n log n) — serve-bucket sized, so the serve
+#: tier's measured narrow-panel optimum applies, not the single-problem
+#: wide default.
+SKETCH_DEFAULT_BLOCK = 32
+
+OPERATORS = ("countsketch", "srht")
+
+
+def sketch_dim(m: int, n: int, factor: float = 1.0) -> int:
+    """Sketch rows ``s = O(n log n)``: ``factor * n * (1 + log2 n)``,
+    floored at ``n + 8`` (the core must stay comfortably overdetermined),
+    snapped up to the 8-row sublane, capped at m (a "sketch" with more
+    rows than A compresses nothing — the aspect gate keeps real callers
+    far from the cap)."""
+    if n < 1 or m < n:
+        raise ValueError(
+            f"sketching covers tall problems (m >= n >= 1), got ({m}, {n})"
+        )
+    base = factor * n * (1.0 + math.log2(max(n, 2)))
+    s = max(n + 8, int(math.ceil(base)))
+    s = -(-s // 8) * 8
+    return min(s, m)
+
+
+def resolve_operator(operator: str, m: int) -> str:
+    """``"auto"`` -> "srht" when m is already a power of two (the
+    butterfly needs no pad rows), "countsketch" otherwise (one
+    segment_sum at any m). Explicit names pass through validated."""
+    if operator == "auto":
+        return "srht" if m >= 2 and (m & (m - 1)) == 0 else "countsketch"
+    if operator not in OPERATORS:
+        raise ValueError(
+            f"sketch operator must be one of {OPERATORS} or 'auto', "
+            f"got {operator!r}"
+        )
+    return operator
+
+
+def count_sketch_operator(m: int, s: int, seed: int):
+    """Seeded count-sketch operator for m rows into s buckets:
+    ``(rows int32 (m,), signs int8 (m,))``. Deterministic across
+    processes: numpy's PCG64 seeded from the ``[seed, m, s]`` entropy
+    sequence yields bit-identical draws everywhere."""
+    rng = np.random.default_rng([int(seed), int(m), int(s)])
+    rows = rng.integers(0, s, size=m, dtype=np.int32)
+    signs = (rng.integers(0, 2, size=m, dtype=np.int8) * 2 - 1).astype(
+        np.int8)
+    return rows, signs
+
+
+def srht_operator(m: int, s: int, seed: int):
+    """Seeded SRHT operator: ``(signs int8 (p,), idx int32 (s,))`` with
+    ``p`` the next power of two >= m. ``idx`` samples s of the p
+    Hadamard rows without replacement (sorted for gather locality);
+    the trailing ``4`` in the entropy sequence keeps the draw
+    independent of the count-sketch stream for the same (seed, m, s)."""
+    p = 1 << max(0, (int(m) - 1).bit_length())
+    rng = np.random.default_rng([int(seed), int(m), int(s), 4])
+    signs = (rng.integers(0, 2, size=p, dtype=np.int8) * 2 - 1).astype(
+        np.int8)
+    idx = np.sort(rng.choice(p, size=s, replace=False)).astype(np.int32)
+    return signs, idx
+
+
+def _fwht(x):
+    """Unnormalized fast Walsh-Hadamard transform over axis 0 of a
+    (p, ...) array, p a power of two: log2(p) vectorized
+    butterfly passes (adds/subs only — no matmul, nothing for DHQR002
+    to annotate)."""
+    p = x.shape[0]
+    h = 1
+    while h < p:
+        y = x.reshape((p // (2 * h), 2, h) + x.shape[1:])
+        a, b = y[:, 0], y[:, 1]
+        x = jnp.stack([a + b, a - b], axis=1).reshape(x.shape)
+        h *= 2
+    return x
+
+
+def _safe_div(num, den):
+    """``num / den`` with the converged-iterate guard: once CGLS hits
+    the exact solution a Krylov scalar goes to 0 and the bare quotient
+    would mint a NaN — a zero step keeps the iterate fixed instead."""
+    return jnp.where(den > 0, num / jnp.where(den > 0, den, 1.0), 0.0)
+
+
+def _mhv(M, v):
+    """``M^H v`` spelled as the vec-mat product ``(v^H M)^H``: the
+    reduction streams M row-contiguously, which XLA CPU executes >20x
+    faster than the transposed matvec ``M.T @ v`` (measured 0.9 ms vs
+    23 ms on a 16500 x 256 f32 buffer — the difference between this
+    engine winning and losing its A/B). Full precision: these are the
+    refinement-path contractions whose accuracy is the point."""
+    return jnp.conj(jnp.matmul(jnp.conj(v), M, precision="highest"))
+
+
+def _sketch_solve(A, b, SA, Sb, block_size, precision,
+                  trailing_precision, norm, refine):
+    """Shared core: CholeskyQR the sketch for R, semi-normal solve for
+    x0, then ``refine`` iterations of R-preconditioned CGLS against the
+    TRUE A.
+
+    The core "QR" is the CholeskyQR R-factor — one BLAS-grade syrk
+    ``(SA)^H SA`` plus one n x n :func:`checked_cholesky` — because the
+    preconditioner only needs R, never Q, and a panel-looped
+    factorization of the core measured 5-10x slower than the syrk at
+    core sizes (it was the whole budget). The Gram squaring inherits
+    the CholeskyQR conditioning window (ops/cholqr.py): past
+    ``cond(SA) ~ 1/sqrt(eps)`` the Cholesky goes NaN-loud, the answer
+    goes non-finite, and the accuracy gate / guarded ladder refuses or
+    escalates TYPED — the same breakdown contract as the tuned cholqr2
+    fast path, not a new hazard. ``trailing_precision`` steers the
+    syrk (the bulk-GEMM analogue, exactly PrecisionPolicy.trailing's
+    documented role for the row engines); ``block_size``/``norm`` ride
+    the signature for key parity but the core has no panel loop to
+    apply them to.
+
+    The refinement is the Blendenpik construction: the sketched R
+    makes ``A R^{-1}`` near-orthonormal — preconditioned condition a
+    small constant — so conjugate gradients on the preconditioned
+    normal equations contract the error by that constant's square root
+    per step; a handful of steps reach the f32 LAPACK level the 8x
+    gate is measured against. The true-A matvecs run at full
+    precision — their accuracy is the point of refining against A
+    rather than against the sketch."""
+    del block_size, norm    # no panel loop in the Gram core
+    from dhqr_tpu.numeric.guards import checked_cholesky
+
+    gram_prec = trailing_precision or precision
+    G = jnp.matmul(jnp.conj(SA.T), SA, precision=gram_prec)
+    # Shifted Cholesky (the cholqr3 trick, ops/cholqr.py): a tiny
+    # spectral shift keeps the factor finite when the SKETCH is
+    # rank-deficient even though A is not. The structural case is the
+    # serve tier's identity-pad embedding: a padded lane's 1-sparse
+    # identity columns hashed into the same count-sketch bucket are
+    # EXACTLY dependent in SA (an exactly-zero Cholesky pivot -> NaN
+    # lane -> the armed guard would fail a healthy batch typed;
+    # reproduced at ~80% of seeds for n=32). The shift costs a
+    # marginally weaker preconditioner in the collided directions only
+    # — CGLS still iterates against the TRUE (full-rank) A, so
+    # correctness stays with the accuracy gate.
+    eps = float(jnp.finfo(jnp.zeros((), SA.dtype).real.dtype).eps)
+    lam = 32.0 * eps * jnp.max(jnp.real(jnp.diagonal(G)))
+    L = checked_cholesky(G + lam * jnp.eye(G.shape[0], dtype=G.dtype))
+    R = jnp.conj(L.T)
+
+    def sns0(g):        # (R^H R)^{-1} g — the semi-normal solve
+        y = jax.lax.linalg.triangular_solve(
+            R, g[:, None], left_side=True, lower=False,
+            transpose_a=True, conjugate_a=True)
+        z = jax.lax.linalg.triangular_solve(
+            R, y, left_side=True, lower=False)
+        return z[:, 0]
+
+    x = sns0(_mhv(SA, Sb))
+    if not refine:
+        return x
+
+    def rinv(p):        # R z = p
+        return jax.lax.linalg.triangular_solve(
+            R, p[:, None], left_side=True, lower=False)[:, 0]
+
+    def rinv_t(p):      # R^H z = p
+        return jax.lax.linalg.triangular_solve(
+            R, p[:, None], left_side=True, lower=False,
+            transpose_a=True, conjugate_a=True)[:, 0]
+
+    r = b - jnp.matmul(A, x, precision="highest")
+    g = rinv_t(_mhv(A, r))
+    p = g
+    gg = jnp.real(jnp.vdot(g, g, precision="highest"))
+    for _ in range(refine):
+        z = rinv(p)
+        q = jnp.matmul(A, z, precision="highest")
+        alpha_k = _safe_div(gg, jnp.real(jnp.vdot(q, q,
+                                                  precision="highest")))
+        x = x + alpha_k * z
+        r = r - alpha_k * q
+        g = rinv_t(_mhv(A, r))
+        gg_next = jnp.real(jnp.vdot(g, g, precision="highest"))
+        p = g + _safe_div(gg_next, gg) * p
+        gg = gg_next
+    return x
+
+
+@partial(jax.jit, static_argnames=(
+    "s", "block_size", "precision", "trailing_precision", "norm",
+    "refine"))
+def _count_sketch_lstsq_impl(A, b, rows, signs, s, block_size,
+                             precision="highest", trailing_precision=None,
+                             norm="accurate", refine=12):
+    """One count-sketch solve. ``rows``/``signs`` are runtime inputs, so
+    a seed change never recompiles — the program is cached per
+    (shape, s, knobs)."""
+    SA = jax.ops.segment_sum(signs[:, None] * A, rows, num_segments=s)
+    Sb = jax.ops.segment_sum(signs * b, rows, num_segments=s)
+    return _sketch_solve(A, b, SA, Sb, block_size, precision,
+                         trailing_precision, norm, refine)
+
+
+@partial(jax.jit, static_argnames=(
+    "block_size", "precision", "trailing_precision", "norm", "refine"))
+def _srht_lstsq_impl(A, b, signs, idx, block_size, precision="highest",
+                     trailing_precision=None, norm="accurate", refine=12):
+    """One SRHT solve: pad rows to p = signs.shape[0], sign-flip,
+    Hadamard butterfly, sample s rows, scale by 1/sqrt(s) (the
+    orthonormal-embedding normalization — H/sqrt(p) is orthogonal and
+    the row sample rescales by sqrt(p/s))."""
+    m = A.shape[0]
+    p = signs.shape[0]
+    Ap = jnp.pad(A, ((0, p - m), (0, 0))) * signs[:, None]
+    bp = jnp.pad(b, (0, p - m)) * signs
+    scale = 1.0 / math.sqrt(idx.shape[0])
+    SA = _fwht(Ap)[idx] * scale
+    Sb = _fwht(bp)[idx] * scale
+    return _sketch_solve(A, b, SA, Sb, block_size, precision,
+                         trailing_precision, norm, refine)
+
+
+# Bounded memo of drawn operator arrays: a warm stream re-draws (and
+# re-casts) nothing — the counter below counts NOVEL draws only, which
+# is what makes ``solvers.sketch_operator_draws`` a redraw-regression
+# signal rather than a mirror of ``sketch_calls``. True LRU (hits
+# refresh recency, so a hot operator survives a drip of cold tuples);
+# each entry is O(m) host memory.
+_OPERATOR_CACHE: "OrderedDict[tuple, tuple]" = OrderedDict()
+_OPERATOR_CACHE_MAX = 64
+_OPERATOR_LOCK = threading.Lock()
+
+
+def _operator_arrays(operator: str, m: int, s: int, seed: int, dtype):
+    """Host numpy operator arrays for one (operator, m, s, seed, dtype)
+    tuple, signs pre-cast to the matrix dtype (an int8 sign would
+    promote the whole sketch under x64 semantics). Memoized per tuple."""
+    key = (operator, int(m), int(s), int(seed), np.dtype(dtype).name)
+    with _OPERATOR_LOCK:
+        hit = _OPERATOR_CACHE.get(key)
+        if hit is not None:
+            _OPERATOR_CACHE.move_to_end(key)
+            return hit
+    COUNTERS.bump("sketch_operator_draws")
+    if operator == "countsketch":
+        rows, signs = count_sketch_operator(m, s, seed)
+        entry = (rows, np.asarray(signs, dtype=np.dtype(dtype)))
+    else:
+        signs, idx = srht_operator(m, s, seed)
+        entry = (np.asarray(signs, dtype=np.dtype(dtype)), idx)
+    with _OPERATOR_LOCK:
+        _OPERATOR_CACHE[key] = entry
+        _OPERATOR_CACHE.move_to_end(key)
+        while len(_OPERATOR_CACHE) > _OPERATOR_CACHE_MAX:
+            _OPERATOR_CACHE.popitem(last=False)
+    return entry
+
+
+def sketched_lstsq(
+    A,
+    b,
+    config: Optional[SketchConfig] = None,
+    *,
+    policy=None,
+    precision: str = "highest",
+    trailing_precision: "str | None" = None,
+    norm: str = "accurate",
+    refine: "int | None" = None,
+    s: "int | None" = None,
+    operator: "str | None" = None,
+    seed: "int | None" = None,
+    block_size: "int | None" = None,
+):
+    """Randomized sketched least squares ``x ~ argmin ||A x - b||``.
+
+    ``config`` (or ``DHQR_SKETCH_*`` in the environment) carries the
+    sketch knobs — seed, operator choice, size factor, baseline
+    refinement count; the keyword arguments override per call. ``s``
+    defaults to :func:`sketch_dim`'s ``O(n log n)`` rule.
+
+    ``policy=`` composes like the other ops-level engines
+    (``tsqr_lstsq``, ``cholesky_qr_lstsq``): the policy's panel
+    precision runs the core factorization, its trailing split applies
+    to the core's trailing GEMMs, and its ``refine`` ADDS sweeps on top
+    of the sketch's own baseline (a sketch needs its baseline sweeps to
+    reach the gate at all — a policy's sweep buys extra accuracy, it
+    does not replace them). Mutually exclusive with passing
+    ``precision``/``trailing_precision``/``refine`` explicitly.
+
+    Returns x (n,). Accuracy is NOT certified here — route through
+    ``lstsq(A, b, engine="sketch", guards=...)`` for the typed
+    residual-gate screen, or let the autotuner's accuracy gate decide
+    admissibility per shape (tune/search.py).
+    """
+    scfg = config or SketchConfig.from_env()
+    if policy is not None:
+        from dhqr_tpu.precision import resolve_policy
+
+        if (precision != "highest" or trailing_precision is not None
+                or refine is not None):
+            raise ValueError(
+                "pass either policy= or explicit "
+                "precision/trailing_precision/refine, not both"
+            )
+        pol = resolve_policy(policy)
+        precision = pol.panel
+        trailing_precision = pol.split_trailing()
+        refine = scfg.refine + pol.refine
+    A = jnp.asarray(A)
+    b = jnp.asarray(b)
+    if A.ndim != 2 or A.shape[0] <= A.shape[1] or A.shape[1] < 1:
+        # Strictly tall (m > n): the sketch must have FEWER rows than A
+        # while staying overdetermined (n < s <= m), which a square
+        # problem cannot satisfy — say so here rather than blaming a
+        # derived sketch size the caller never passed.
+        raise ValueError(
+            f"sketched_lstsq needs a genuinely tall problem "
+            f"(m > n >= 1 — there is nothing to compress at m == n), "
+            f"got shape {getattr(A, 'shape', None)}"
+        )
+    if b.shape != (A.shape[0],):
+        raise ValueError(
+            f"b must be a length-m vector matching A (A is {A.shape}, "
+            f"b has shape {b.shape}); block right-hand sides are not "
+            "sketched yet"
+        )
+    m, n = A.shape
+    s = sketch_dim(m, n, factor=scfg.factor) if s is None else int(s)
+    if not n < s <= m:
+        raise ValueError(
+            f"sketch size s must satisfy n < s <= m, got s={s} for "
+            f"shape ({m}, {n})"
+        )
+    seed = scfg.seed if seed is None else int(seed)
+    op = resolve_operator(operator or scfg.operator, m)
+    refine = scfg.refine if refine is None else int(refine)
+    if refine < 0:
+        raise ValueError(f"refine must be >= 0, got {refine}")
+    nb = block_size or SKETCH_DEFAULT_BLOCK
+    COUNTERS.bump("sketch_calls")
+    a0, a1 = _operator_arrays(op, m, s, seed, A.dtype)
+    if op == "countsketch":
+        return _count_sketch_lstsq_impl(
+            A, b, jnp.asarray(a0), jnp.asarray(a1), s=s, block_size=nb,
+            precision=precision, trailing_precision=trailing_precision,
+            norm=norm, refine=refine)
+    return _srht_lstsq_impl(
+        A, b, jnp.asarray(a0), jnp.asarray(a1), block_size=nb,
+        precision=precision, trailing_precision=trailing_precision,
+        norm=norm, refine=refine)
+
+
+def batched_sketch_program(m: int, n: int, s: int, seed: int,
+                           operator: str, block_size: int,
+                           precision: str = "highest",
+                           trailing_precision: "str | None" = None,
+                           norm: str = "accurate", refine: int = 12,
+                           dtype="float32"):
+    """The traced callable one serve "sketch" bucket dispatch compiles:
+    ``fn(A, b)`` over stacked ``(B, m, n)`` / ``(B, m)`` arrays, the
+    operator arrays baked in as program constants (every request in a
+    bucket shares one m, hence one operator — the program is fully
+    determined by its :class:`~dhqr_tpu.serve.cache.CacheKey`, sketch
+    field included, which is what lets prewarm and live dispatch meet
+    on the same executable)."""
+    op = resolve_operator(operator, m)
+    a0, a1 = _operator_arrays(op, m, s, seed, dtype)
+    c0, c1 = jnp.asarray(a0), jnp.asarray(a1)
+    nb = min(block_size, n)
+
+    if op == "countsketch":
+        def one(a, rhs):
+            SA = jax.ops.segment_sum(c1[:, None] * a, c0, num_segments=s)
+            Sb = jax.ops.segment_sum(c1 * rhs, c0, num_segments=s)
+            return _sketch_solve(a, rhs, SA, Sb, nb, precision,
+                                 trailing_precision, norm, refine)
+    else:
+        p = c0.shape[0]
+        scale = 1.0 / math.sqrt(s)
+
+        def one(a, rhs):
+            ap = jnp.pad(a, ((0, p - m), (0, 0))) * c0[:, None]
+            bp = jnp.pad(rhs, (0, p - m)) * c0
+            SA = _fwht(ap)[c1] * scale
+            Sb = _fwht(bp)[c1] * scale
+            return _sketch_solve(a, rhs, SA, Sb, nb, precision,
+                                 trailing_precision, norm, refine)
+
+    def fn(A, b):
+        return jax.vmap(one)(A, b)
+
+    return fn
+
+
+__all__ = [
+    "COUNTERS",
+    "OPERATORS",
+    "SKETCH_DEFAULT_BLOCK",
+    "batched_sketch_program",
+    "count_sketch_operator",
+    "resolve_operator",
+    "sketch_dim",
+    "sketched_lstsq",
+    "srht_operator",
+]

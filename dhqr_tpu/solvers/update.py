@@ -1,0 +1,366 @@
+"""Updatable QR — a live factorization for streaming regression.
+
+Serving users whose data CHANGES between requests (online regression,
+sliding-window models) re-factors from scratch today: every rank-1
+change of A pays the full ``2 m n^2``. :class:`UpdatableQR` keeps one
+factorization LIVE instead:
+
+* state is ``(A, G, R)`` — the data matrix, its Gram matrix
+  ``G = A^H A``, and R, the upper-triangular Cholesky factor of G
+  (which IS the R of QR(A) up to column signs — same diagonal
+  magnitudes, so the repo's R-diagonal condition machinery applies
+  unchanged);
+* :meth:`update`/:meth:`downdate` apply ``A <- A ± u v^H`` by updating
+  G exactly (one ``A^H u`` matvec, 2mn) and re-Cholesky-ing the n x n
+  Gram (``n^3/3``) — amortized ``O(mn + n^3)`` per step vs a fresh
+  factorization's ``O(m n^2)``, the m/n-fold win the streaming tier
+  exists for;
+* :meth:`solve` answers ``argmin ||A x - b||`` through the corrected
+  semi-normal equations (``x = (R^H R)^{-1} A^H b`` plus refinement
+  sweeps against the true A — Björck's CSNE), which holds the
+  reference 8x-LAPACK criterion for the conditioning window the
+  refactor policy enforces.
+
+The Gram route squares conditioning — exactly the hazard the PR-8
+ladder documents for CholeskyQR — so the refactor-threshold POLICY is
+load-bearing, not a nicety: after ``refactor_after`` accumulated
+updates, or when the R-diagonal condition bound trips the CholeskyQR
+window, or when the Cholesky goes NaN (breakdown is LOUD, the
+``checked_cholesky`` contract), the stale factor is thrown away and
+rebuilt from the live A **through the PR-8 guarded ladder**
+(:func:`dhqr_tpu.numeric.ladder.guarded_qr`): policy escalation applies,
+a structurally singular A refuses TYPED (:class:`IllConditioned` et
+al.), and the taken path is recorded on :attr:`last_refactor`. A
+refactor that refuses rolls the rank-1 data change back — the live
+factorization never silently diverges from its state.
+
+Zero-recompile steady state: the update and solve programs are two
+shape-cached jitted impls (sign is a runtime scalar, so update and
+downdate share one program); a 64-step stream compiles on step one and
+never again (pinned by tests/test_solvers.py and the ``_dryrun`` sketch
+stage).
+
+Deterministic chaos: the ``numeric.breakdown`` fault site fires inside
+:meth:`update`/:meth:`downdate` (as if the refreshed Cholesky had come
+back NaN), so every refactor path replays without crafting a matrix
+for it — the same discipline as the PR-8 ladder.
+
+Async serving: ``AsyncScheduler.submit("update", fact, (op, ...))``
+queues ops against a live factorization with futures / fault injection
+/ tracing applying exactly as for the batched kinds; ops for one
+factorization are serialized in submission order (serve/scheduler.py).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from dhqr_tpu.faults import harness as _faults
+from dhqr_tpu.numeric import guards as _guards
+from dhqr_tpu.numeric.errors import NonFiniteInput
+from dhqr_tpu.utils.profiling import Counters
+
+#: Process-wide updatable-QR accounting, exported by the metrics
+#: registry as ``solvers.*``: ``update_steps`` / ``downdate_steps`` /
+#: ``update_solves`` / ``update_refactors`` (every ladder rebuild,
+#: whatever triggered it) / ``update_breakdowns`` (NaN/injected
+#: Cholesky refreshes) / ``update_screen_rejects``.
+COUNTERS = Counters()
+
+#: Updates absorbed before a scheduled refactor (the threshold half of
+#: the policy; the condition-bound trip is the other half).
+DEFAULT_REFACTOR_AFTER = 32
+
+
+@jax.jit
+def _update_state_impl(A, G, R, u, v, sgn):
+    """One rank-1 step: ``A' = A + sgn * u v^H``, G updated exactly,
+    R refreshed by Cholesky. ``sgn`` is a runtime scalar so update and
+    downdate share one compiled program. R rides through unused so the
+    impl signature matches the state tuple (and a future Givens-based
+    incremental refresh can use it without re-keying callers).
+
+    Gram-side matvecs are spelled as vec-mat products (``(u^H A)^H``):
+    XLA CPU's transposed matvec on the row-major buffer measured >20x
+    slower (see ``solvers.sketch._mhv``)."""
+    del R
+    from dhqr_tpu.solvers.sketch import _mhv
+
+    w = _mhv(A, u)
+    uu = jnp.real(jnp.vdot(u, u, precision="highest"))
+    vh = jnp.conj(v)
+    A2 = A + sgn * jnp.outer(u, vh)
+    cross = jnp.outer(w, vh)
+    G2 = G + sgn * (cross + jnp.conj(cross.T)) + uu * jnp.outer(v, vh)
+    L = _guards.checked_cholesky(G2)
+    return A2, G2, jnp.conj(L.T)
+
+
+@partial(jax.jit, static_argnames=("refine", "precision"))
+def _usolve_impl(A, R, b, refine=1, precision="highest"):
+    """Corrected semi-normal equations: ``x0 = (R^H R)^{-1} A^H b``,
+    then ``refine`` sweeps ``x += (R^H R)^{-1} A^H (b - A x)`` with the
+    residual matvec at full precision (its accuracy is the point —
+    CSNE's stability hinges on it)."""
+    from dhqr_tpu.solvers.sketch import _mhv
+
+    def sns(g):
+        y = jax.lax.linalg.triangular_solve(
+            R, g[:, None], left_side=True, lower=False,
+            transpose_a=True, conjugate_a=True)
+        z = jax.lax.linalg.triangular_solve(
+            R, y, left_side=True, lower=False)
+        return z[:, 0]
+
+    # Vec-mat spelling for the Gram-side matvecs (solvers.sketch._mhv
+    # has the measured rationale). The x0 contraction honors the
+    # caller's apply precision; the refinement residual runs at full
+    # precision by contract.
+    x = sns(jnp.conj(jnp.matmul(jnp.conj(b), A, precision=precision)))
+    for _ in range(refine):
+        r = b - jnp.matmul(A, x, precision="highest")
+        x = x + sns(_mhv(A, r))
+    return x
+
+
+def update_program():
+    """The rank-1 state-update program as a plain traced callable
+    ``(A, G, R, u, v, sgn) -> (A', G', R')`` — the analysis jaxpr pass
+    traces the update family through this (no state object, no
+    execution), the same pattern as ``serve.engine.bucket_program``."""
+    return lambda A, G, R, u, v, sgn: _update_state_impl(
+        A, G, R, u, v, sgn)
+
+
+def solve_program(refine: int = 1, precision: str = "highest"):
+    """The CSNE solve program as a plain traced callable
+    ``(A, R, b) -> x`` for the jaxpr pass."""
+    return lambda A, R, b: _usolve_impl(A, R, b, refine=refine,
+                                        precision=precision)
+
+
+class UpdatableQR:
+    """A live, rank-1-updatable QR factorization of a tall matrix.
+
+    >>> fact = UpdatableQR(A)                  # guarded fresh factor
+    >>> fact.update(u, v)                      # A <- A + u v^H
+    >>> x = fact.solve(b)                      # CSNE within the 8x gate
+    >>> fact.downdate(u, v)                    # A <- A - u v^H
+
+    Construction and every refactor run the PR-8 guarded ladder
+    (``guards=`` mode, default "fallback"): a matrix no engine can
+    answer refuses TYPED (:class:`~dhqr_tpu.numeric.NumericalError`
+    family) instead of minting a silent-garbage factorization.
+
+    ``refactor_after``/``cond_window`` are the refactor policy: a
+    rebuild fires after that many accumulated rank-1 steps, when the
+    R-diagonal condition lower bound exceeds the window (default: the
+    CholeskyQR window ``~1/sqrt(eps)`` from ``ops.cholqr`` — the Gram
+    route shares its squaring hazard), or when a refreshed Cholesky
+    comes back non-finite. :attr:`last_refactor` records the trigger
+    and the ladder path taken.
+    """
+
+    def __init__(self, A, *, block_size: "int | None" = None,
+                 precision: str = "highest", refine: int = 1,
+                 refactor_after: int = DEFAULT_REFACTOR_AFTER,
+                 cond_window: "float | None" = None,
+                 guards: str = "fallback"):
+        A = jnp.asarray(A)
+        if A.ndim != 2 or A.shape[0] < A.shape[1] or A.shape[1] < 1:
+            raise ValueError(
+                f"UpdatableQR factors tall problems (m >= n >= 1), got "
+                f"shape {getattr(A, 'shape', None)}"
+            )
+        if refactor_after < 1:
+            raise ValueError(
+                f"refactor_after must be >= 1, got {refactor_after}")
+        if refine < 0:
+            raise ValueError(f"refine must be >= 0, got {refine}")
+        bad_A, zero_col, _ = _guards.screen_input(A)
+        if bad_A:
+            COUNTERS.bump("update_screen_rejects")
+            raise NonFiniteInput(
+                "UpdatableQR input carries non-finite entries; clean the "
+                "stream before factoring", engine="update")
+        del zero_col  # a zero column refuses typed inside the ladder
+        self._A = A
+        self._precision = precision
+        self._block_size = block_size
+        self._refine = int(refine)
+        self._refactor_after = int(refactor_after)
+        self._guards = guards
+        if cond_window is None:
+            from dhqr_tpu.ops.cholqr import cholqr_max_cond
+
+            cond_window = cholqr_max_cond(A.dtype)
+        self._cond_window = float(cond_window)
+        self._k = 0
+        self.refactor_count = 0
+        self.last_refactor: "dict | None" = None
+        self._refactor("initial")
+
+    # ------------------------------------------------------------ state
+    @property
+    def shape(self):
+        return self._A.shape
+
+    @property
+    def dtype(self):
+        return self._A.dtype
+
+    @property
+    def matrix(self):
+        """The live data matrix A (immutable jax array)."""
+        return self._A
+
+    @property
+    def updates_since_refactor(self) -> int:
+        return self._k
+
+    def r_matrix(self):
+        """The current n x n upper-triangular R (Cholesky of the Gram
+        after updates; the guarded QR's R right after a refactor)."""
+        return self._R
+
+    def cond_estimate(self) -> float:
+        """Cheap LOWER bound on cond_2(A) from the current R diagonal
+        (:func:`dhqr_tpu.numeric.guards.diag_condition_bound` — the
+        same rule the refactor policy trips on)."""
+        return _guards.diag_condition_bound(jnp.diagonal(self._R))
+
+    # -------------------------------------------------------- refactor
+    def _refactor(self, reason: str) -> None:
+        """Rebuild (G, R) from the live A through the PR-8 guarded
+        ladder. Typed refusals propagate to the caller — the ladder
+        already classified them (IllConditioned / Breakdown / ...)."""
+        from dhqr_tpu.numeric.ladder import guarded_qr
+
+        res = guarded_qr(self._A, guards=self._guards,
+                         precision=self._precision,
+                         block_size=self._block_size)
+        fact = res.factorization
+        R = fact.r_matrix()
+        self._G = jnp.matmul(jnp.conj(R.T), R, precision="highest")
+        self._R = R
+        self._k = 0
+        self.refactor_count += 1
+        COUNTERS.bump("update_refactors")
+        self.last_refactor = {
+            "reason": reason,
+            "engine": res.engine,
+            "escalations": res.escalations,
+            "attempts": [a.outcome for a in res.attempts],
+            "trace_id": res.trace_id,
+        }
+
+    # ------------------------------------------------------- rank-1 ops
+    def _screen_vectors(self, u, v):
+        u = jnp.asarray(u, self.dtype)
+        v = jnp.asarray(v, self.dtype)
+        m, n = self._A.shape
+        if u.shape != (m,) or v.shape != (n,):
+            raise ValueError(
+                f"rank-1 vectors must be u (m,) = ({m},) and v (n,) = "
+                f"({n},), got {u.shape} and {v.shape}"
+            )
+        if _guards.any_nonfinite(u, v):
+            COUNTERS.bump("update_screen_rejects")
+            raise NonFiniteInput(
+                "rank-1 update vectors carry non-finite entries; no "
+                "factorization survives a poisoned update — drop it",
+                engine="update")
+        return u, v
+
+    def _rank1(self, u, v, sgn: float, op: str) -> dict:
+        u, v = self._screen_vectors(u, v)
+        COUNTERS.bump(f"{op}_steps")
+        injected = False
+        try:
+            _faults.fire("numeric.breakdown")
+        except _faults.FaultInjected:
+            injected = True
+        import numpy as np
+
+        real_dt = np.finfo(np.dtype(self.dtype)).dtype
+        A2, G2, R2 = _update_state_impl(
+            self._A, self._G, self._R, u, v,
+            jnp.asarray(sgn, dtype=real_dt))
+        broken = injected or _guards.any_nonfinite(R2)
+        cond = math.inf if broken else _guards.diag_condition_bound(
+            jnp.diagonal(R2))
+        reason = None
+        if broken:
+            reason = "injected_breakdown" if injected else "breakdown"
+            COUNTERS.bump("update_breakdowns")
+        elif cond > self._cond_window:
+            reason = "condition"
+        elif self._k + 1 >= self._refactor_after:
+            reason = "threshold"
+        if reason is None:
+            self._A, self._G, self._R = A2, G2, R2
+            self._k += 1
+        else:
+            # Commit the DATA change, then rebuild the factor through
+            # the guarded ladder; a typed refusal rolls the data back
+            # so the live state never diverges from its factorization.
+            old_A = self._A
+            self._A = A2
+            try:
+                self._refactor(reason)
+            except Exception:
+                self._A = old_A
+                raise
+            cond = self.cond_estimate()
+        return {
+            "op": op,
+            "refactored": reason is not None,
+            "reason": reason,
+            "cond_estimate": float(cond),
+            "updates_since_refactor": self._k,
+        }
+
+    def update(self, u, v) -> dict:
+        """``A <- A + u v^H``; returns the step's provenance dict
+        (``refactored``/``reason``/``cond_estimate``/...)."""
+        return self._rank1(u, v, 1.0, "update")
+
+    def downdate(self, u, v) -> dict:
+        """``A <- A - u v^H`` (the inverse of :meth:`update` with the
+        same vectors — the round-trip restores the factorization to
+        working precision; pinned by test)."""
+        return self._rank1(u, v, -1.0, "downdate")
+
+    # ------------------------------------------------------------ solve
+    def solve(self, b, refine: "int | None" = None):
+        """Least squares against the LIVE A, through the numeric guard
+        screen (a non-finite b refuses typed before any compute):
+        CSNE with ``refine`` sweeps (default: the constructor's)."""
+        b = jnp.asarray(b, self.dtype)
+        if b.shape != (self._A.shape[0],):
+            raise ValueError(
+                f"b must be a length-m vector (m = {self._A.shape[0]}), "
+                f"got shape {b.shape}"
+            )
+        if _guards.any_nonfinite(b):
+            COUNTERS.bump("update_screen_rejects")
+            raise NonFiniteInput(
+                "right-hand side carries non-finite entries",
+                engine="update")
+        COUNTERS.bump("update_solves")
+        steps = self._refine if refine is None else int(refine)
+        return _usolve_impl(self._A, self._R, b, refine=steps,
+                            precision=self._precision)
+
+
+__all__ = [
+    "COUNTERS",
+    "DEFAULT_REFACTOR_AFTER",
+    "UpdatableQR",
+    "solve_program",
+    "update_program",
+]

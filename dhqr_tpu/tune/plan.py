@@ -24,8 +24,11 @@ from typing import Optional
 # Engine families a plan may name. cholqr3 is deliberately absent: the
 # shifted window exists for near-rank-deficient problems, which a timing
 # search cannot detect — routing there is an accuracy decision the
-# caller must make via engine=.
-PLAN_ENGINES = ("householder", "tsqr", "cholqr2")
+# caller must make via engine=. "sketch" (round 17) is the randomized
+# compressed-core engine; like the other alt engines its admissibility
+# is decided by the search's accuracy gate per candidate, and the grid
+# only offers it past the SketchConfig.min_aspect aspect ratio.
+PLAN_ENGINES = ("householder", "tsqr", "cholqr2", "sketch")
 
 _PANEL_IMPLS = ("loop", "recursive", "reconstruct")
 
@@ -38,8 +41,8 @@ class Plan:
 
     Attributes:
       engine: "householder" (the packed-reflector default; the only
-        engine ``qr()`` accepts), "tsqr" or "cholqr2" (lstsq-only fast
-        paths for tall-skinny problems).
+        engine ``qr()`` accepts), "tsqr", "cholqr2" or "sketch"
+        (lstsq-only fast paths for tall-skinny problems).
       block_size: compact-WY panel width nb; None keeps the engine's
         auto resolution (``ops.blocked.auto_block_size`` single-device).
       panel_impl: panel-interior algorithm on the blocked XLA path

@@ -23,13 +23,18 @@ autotuner" carries the same table):
    collapses to native f32 — a split is pure key noise) and only when
    the caller did NOT fix precision via a policy (a plan must not
    silently move the error bar a policy pinned).
-5. Alt engines (``tsqr``, ``cholqr2``) are lstsq-only, policy-free
-   candidates, gated on aspect ratio: ``cholqr2`` at ``m/n >= 8``
-   (all-GEMM wins once the trailing update dominates; its conditioning
-   window is the caller's responsibility — see DESIGN), ``tsqr`` at
-   ``m/n >= 32`` (the communication-avoiding tree needs genuinely tall
-   blocks). The serve kinds never route engines (the serving tier
-   batches the blocked householder engine only).
+5. Alt engines (``tsqr``, ``cholqr2``, ``sketch``) are lstsq-only,
+   policy-free candidates, gated on aspect ratio: ``cholqr2`` at
+   ``m/n >= 8`` (all-GEMM wins once the trailing update dominates; its
+   conditioning window is the caller's responsibility — see DESIGN),
+   ``tsqr`` at ``m/n >= 32`` (the communication-avoiding tree needs
+   genuinely tall blocks), ``sketch`` at ``m/n >=
+   SketchConfig.min_aspect`` (default 64 — the randomized compressed
+   core only amortizes its O(mn) pass + CGLS sweeps past that; round
+   17). The serve kinds never route engines (``serve_qr``/
+   ``serve_lstsq`` batch the blocked householder engine;
+   ``serve_sketch`` is its own program family whose ladder tunes the
+   CORE QR's panel width).
 6. Mesh schedule levers (``lookahead``, ``agg_panels``, their grouped
    composition) only when the mesh axis has ``nproc > 1`` devices — on
    one device there is no collective to hide (the same degenerate case
@@ -66,7 +71,7 @@ from typing import Callable, List, Optional
 from dhqr_tpu.tune.db import PlanDB, default_db, plan_key, policy_tag
 from dhqr_tpu.tune.plan import DEFAULT_PLAN, Plan
 
-TUNE_KINDS = ("qr", "lstsq", "serve_qr", "serve_lstsq")
+TUNE_KINDS = ("qr", "lstsq", "serve_qr", "serve_lstsq", "serve_sketch")
 
 #: Gate failures on one plan key before ``resolve_plan`` demotes the
 #: stored plan (falls back to the static default instead of replaying
@@ -193,6 +198,13 @@ def candidate_plans(kind: str, m: int, n: int, dtype="float32",
         from dhqr_tpu.utils.config import TuneConfig
 
         budget = TuneConfig.from_env().budget
+    if kind == "serve_sketch":
+        # The sketched bucket program has no panel loop — its core is
+        # one Gram syrk + Cholesky, so nb is not a knob and a ladder
+        # would time identical programs. One candidate: plan="auto" on
+        # the sketch kind resolves fast and the DB records a measured
+        # baseline rather than a fake grid.
+        return [DEFAULT_PLAN]
     out: List[Plan] = [DEFAULT_PLAN]
     serve = kind.startswith("serve_")
     # Rule 2 — nb ladder. The serve tier's measured optimum lives at the
@@ -219,6 +231,16 @@ def candidate_plans(kind: str, m: int, n: int, dtype="float32",
             out.append(Plan(engine="cholqr2"))
         if aspect >= TSQR_MIN_ASPECT:
             out.append(Plan(engine="tsqr"))
+        # Round 17: the randomized sketched engine, gated at
+        # SketchConfig.min_aspect (default 64 — below it the O(mn)
+        # sketch pass + CGLS sweeps cannot amortize against the direct
+        # GEMMs, so the grid should not pay a timed candidate finding
+        # that out per key). The accuracy gate below decides per-shape
+        # admissibility like for every other candidate.
+        from dhqr_tpu.utils.config import SketchConfig
+
+        if aspect >= SketchConfig.from_env().min_aspect:
+            out.append(Plan(engine="sketch"))
     # Rule 6 — mesh schedule levers.
     if not serve and nproc > 1:
         base_nb = ladder[-1] if ladder else None
@@ -295,6 +317,31 @@ def _build_runner(kind: str, plan: Plan, policy, mesh) -> Callable:
     # None-block winner would replay as a never-measured program.
     nb = plan.block_size if plan.block_size is not None \
         else SERVE_DEFAULT_BLOCK
+    if kind == "serve_sketch":
+        # Round 17: the serve tier's sketched bucket program. Shapes
+        # arrive with the arrays, and the sketch operator is baked into
+        # the program per (m, s, seed), so programs are memoized per
+        # stacked shape — the timing loop's repeats hit one compile.
+        from dhqr_tpu.solvers import sketch as _sk
+        from dhqr_tpu.utils.config import SketchConfig
+
+        skcfg = SketchConfig.from_env()
+        refine = skcfg.refine + (pol.refine if pol is not None else 0)
+        progs: dict = {}
+
+        def runner(A, b):
+            pk = (A.shape, str(A.dtype))
+            if pk not in progs:
+                _, pm, pn = A.shape
+                s = _sk.sketch_dim(pm, pn, factor=skcfg.factor)
+                op = _sk.resolve_operator(skcfg.operator, pm)
+                progs[pk] = jax.jit(_sk.batched_sketch_program(
+                    pm, pn, s, skcfg.seed, op, nb,
+                    precision=panel_prec, trailing_precision=trailing,
+                    refine=refine, dtype=A.dtype))
+            return progs[pk](A, b)
+
+        return runner
     if kind == "serve_lstsq":
         refine = pol.refine if pol is not None else 0
         # Same None-when-unsplit resolution the serve config performs,
@@ -359,6 +406,14 @@ def _analytic_flops(kind: str, m: int, n: int) -> "float | None":
         return _oflops.batched_qr_flops(TUNE_SERVE_BATCH, m, n)
     if kind == "serve_lstsq":
         return _oflops.batched_lstsq_flops(TUNE_SERVE_BATCH, m, n)
+    if kind == "serve_sketch":
+        from dhqr_tpu.solvers.sketch import sketch_dim
+        from dhqr_tpu.utils.config import SketchConfig
+
+        skcfg = SketchConfig.from_env()
+        return TUNE_SERVE_BATCH * _oflops.sketched_lstsq_flops(
+            m, n, sketch_dim(m, n, factor=skcfg.factor),
+            refine=skcfg.refine)
     return None
 
 
@@ -396,7 +451,7 @@ def _verify(kind: str, out, args, baseline_err: "float | None"):
         oracle_residual,
     )
 
-    if kind in ("lstsq", "serve_lstsq"):
+    if kind in ("lstsq", "serve_lstsq", "serve_sketch"):
         if kind == "lstsq":
             rows = [(args[0], args[1], out)]
         else:

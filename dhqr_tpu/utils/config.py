@@ -55,7 +55,10 @@ class DHQRConfig:
         factorization object stores packed reflectors), "tsqr"
         (communication-avoiding row-parallel tree for m >> n), "cholqr2" /
         "cholqr3" (all-GEMM Cholesky passes; cholqr3 is the shifted
-        wide-window form — see ops/cholqr.py for conditioning windows).
+        wide-window form — see ops/cholqr.py for conditioning windows),
+        or "sketch" (randomized sketch-and-precondition lstsq for
+        m/n >= 64 — ``dhqr_tpu.solvers.sketch``, knobs on
+        :class:`SketchConfig` / ``DHQR_SKETCH_*``).
       panel_impl: panel-interior algorithm on the XLA path — "loop" (one
         masked GEMV + rank-1 per column, the reference-shaped numerics),
         "recursive" (geqrt3-style divide and conquer: the panel interior
@@ -604,6 +607,89 @@ class ObsConfig:
             env["profile_dir"] = raw or None
         env.update(overrides)
         return ObsConfig(**env)
+
+
+@dataclasses.dataclass(frozen=True)
+class SketchConfig:
+    """Knobs for the randomized sketched-lstsq engine
+    (``dhqr_tpu.solvers.sketch``, round 17), all overridable from
+    ``DHQR_SKETCH_*`` environment variables.
+
+    These shape the SKETCH (operator choice, size, seed) and the
+    baseline accuracy recovery, not the core factorization's numerics —
+    the sketch core is factored by the blocked engine under whatever
+    precision knobs/policy the caller passed.
+
+    Attributes:
+      seed: base seed for the sketch operator draw
+        (``DHQR_SKETCH_SEED``). The operator is derived from
+        ``(seed, m, s)`` via numpy's PCG64 on the host, so the SAME
+        seed yields the bit-identical operator — and the identical
+        serve cache key — in every process (prewarmed fleets agree on
+        their compiled programs by construction).
+      operator: "countsketch" (one segment_sum, any m — the default
+        fast path), "srht" (subsampled randomized Hadamard transform —
+        better-conditioned embeddings, wants a power-of-two row count)
+        or "auto" (srht exactly when m is already a power of two, the
+        pad-free case; countsketch otherwise). ``DHQR_SKETCH_OPERATOR``.
+      factor: multiplier on the ``O(n log n)`` sketch-size rule
+        (``dhqr_tpu.solvers.sketch.sketch_dim``): ``s ~ factor * n *
+        (1 + log2 n)``. Larger = tighter embedding = faster refinement
+        convergence; the default 2.0 paired with ``refine=12`` holds
+        the 8x gate with margin on the committed CPU grid
+        (``DHQR_SKETCH_FACTOR``).
+      refine: baseline R-preconditioned CGLS iterations against the
+        true A (``DHQR_SKETCH_REFINE``). The sketch-and-solve x0 alone
+        is an embedding-distortion-grade answer; the CG iterations are
+        what carry it to the reference criterion (each costs one
+        A-matvec + one A^H-matvec + two n x n triangular solves). A
+        caller's ``policy.refine`` ADDS to this baseline rather than
+        replacing it.
+      min_aspect: the m/n gate under which the autotuner never offers
+        the sketch candidate (``DHQR_SKETCH_MIN_ASPECT``): below it the
+        sketch cannot amortize its O(mn) pass + sweeps against the
+        direct engines' GEMMs, and the grid should not waste a timed
+        candidate finding that out per key.
+    """
+
+    seed: int = 0
+    operator: str = "auto"
+    factor: float = 2.0
+    refine: int = 12
+    min_aspect: float = 64.0
+
+    def __post_init__(self):
+        if self.operator not in ("auto", "countsketch", "srht"):
+            raise ValueError(
+                f"operator must be 'auto', 'countsketch' or 'srht', "
+                f"got {self.operator!r}")
+        if not self.factor > 0:
+            raise ValueError(f"factor must be > 0, got {self.factor}")
+        if self.refine < 0:
+            raise ValueError(f"refine must be >= 0, got {self.refine}")
+        if not self.min_aspect >= 1:
+            raise ValueError(
+                f"min_aspect must be >= 1, got {self.min_aspect}")
+
+    @staticmethod
+    def from_env(**overrides) -> "SketchConfig":
+        """Build a sketch config from ``DHQR_SKETCH_*`` variables +
+        overrides."""
+        env = {}
+        if "DHQR_SKETCH_SEED" in os.environ:
+            env["seed"] = int(os.environ["DHQR_SKETCH_SEED"])
+        if "DHQR_SKETCH_OPERATOR" in os.environ:
+            env["operator"] = os.environ["DHQR_SKETCH_OPERATOR"].strip() \
+                .lower()
+        if "DHQR_SKETCH_FACTOR" in os.environ:
+            env["factor"] = float(os.environ["DHQR_SKETCH_FACTOR"])
+        if "DHQR_SKETCH_REFINE" in os.environ:
+            env["refine"] = int(os.environ["DHQR_SKETCH_REFINE"])
+        if "DHQR_SKETCH_MIN_ASPECT" in os.environ:
+            env["min_aspect"] = float(
+                os.environ["DHQR_SKETCH_MIN_ASPECT"])
+        env.update(overrides)
+        return SketchConfig(**env)
 
 
 def _parse_fault_sites(raw: str) -> "tuple[tuple[str, float, int | None], ...]":

@@ -70,6 +70,36 @@ def test_batched_flops_golden(b, m, n):
         b * oflops.lstsq_flops(m, n, refine=1))
 
 
+@pytest.mark.parametrize("m,n,s", [(1024, 16, 160), (8192, 128, 2048),
+                                   (2048, 32, 384)])
+def test_sketched_lstsq_flops_golden(m, n, s):
+    # Round 17: sketch application + the CholeskyQR core (Gram syrk +
+    # n^3/3 Cholesky) + semi-normal x0, plus refine CGLS iterations
+    # (A-matvec + A^H-matvec + two triangular solves + vector
+    # updates) — re-derived literally, not imported.
+    base = (2 * m * n + 2 * m + s * n**2 + n**3 / 3
+            + 2 * s * n + 2 * n**2)
+    assert oflops.sketched_lstsq_flops(m, n, s) == pytest.approx(base)
+    sweep = 4 * m * n + 2 * n**2 + 6 * m
+    assert oflops.sketched_lstsq_flops(m, n, s, refine=8) == \
+        pytest.approx(base + 8 * sweep)
+
+
+@pytest.mark.parametrize("m,n", [(512, 16), (4096, 64), (256, 8)])
+def test_qr_update_flops_golden(m, n):
+    # Round 17: rank-1 update of a live factorization — Gram matvec +
+    # data update + dot + three rank-1 Gram updates + n^3/3 Cholesky.
+    assert oflops.qr_update_flops(m, n) == pytest.approx(
+        4 * m * n + 2 * m + 6 * n**2 + n**3 / 3)
+    # CSNE solve: A^H b + two triangular solves, plus corrected sweeps.
+    base = 2 * m * n + 2 * n**2
+    sweep = 4 * m * n + 2 * n**2
+    assert oflops.updatable_solve_flops(m, n, refine=0) == \
+        pytest.approx(base)
+    assert oflops.updatable_solve_flops(m, n, refine=2) == \
+        pytest.approx(base + 2 * sweep)
+
+
 # ------------------------------------------------------------ platform table
 
 def test_device_peak_table():
