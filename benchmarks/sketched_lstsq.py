@@ -27,8 +27,14 @@ Ends with a ``sketched_lstsq_verdict`` row (geomean >= 2x bar, no
 silent garbage, zero recompiles, update-stream flags) that the regress
 gate (`python -m dhqr_tpu.obs regress`) enforces from then on.
 
-Usage:  python benchmarks/sketched_lstsq.py
+Usage:  python benchmarks/sketched_lstsq.py [--stream-only]
 Writes: benchmarks/results/sketched_lstsq_<platform>.jsonl (append)
+
+``--stream-only`` (round 18) re-runs ONLY the 64-step update-stream
+cell — the vehicle for re-measuring the Givens-based incremental R
+refresh (``update-givens-floor`` regress rule) without re-rolling the
+sketch A/B grid whose cross-round floors compare against the committed
+round-17 cells.
 """
 
 from __future__ import annotations
@@ -64,9 +70,9 @@ def _stage(name: str) -> None:
     print(f"::stage {name} t={time.time():.1f}", file=sys.stderr, flush=True)
 
 
-def main() -> None:
+def main(stream_only: bool = False) -> None:
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(3))
-    rnd = int(os.environ.get("DHQR_ROUND", "17"))
+    rnd = int(os.environ.get("DHQR_ROUND", "18" if stream_only else "17"))
     _stage("import")
     import jax
     import jax.numpy as jnp
@@ -125,6 +131,131 @@ def main() -> None:
         return best, out
 
     rng = np.random.default_rng(0)
+
+    # Update stream: 64 rank-1 steps, gated per step, amortized cost vs
+    # a fresh factorization. Round 18: the rank-1 refresh is the O(n^2)
+    # Givens/hyperbolic sweep pair (solvers/update) — the row stamps
+    # ``refresh`` so the regress gate can pin the improved floor
+    # (``update-givens-floor``) without re-litigating the round-17
+    # re-Cholesky rows, and additionally times the UPDATE step alone
+    # (solve excluded) — the number the refresh actually moved.
+    def update_stream_cell():
+        _stage("update_stream")
+        mu, nu = 4096, 64
+        Au = jnp.asarray(rng.random((mu, nu)), jnp.float32)
+        bu = jnp.asarray(rng.random(mu), jnp.float32)
+        fresh_s, _ = timed(lambda: qr(Au))
+        fact = UpdatableQR(Au)
+        fact.update(jnp.asarray(rng.standard_normal(mu).astype(np.float32)),
+                    jnp.asarray(rng.standard_normal(nu).astype(np.float32)))
+        fact.solve(bu)                  # warm both programs
+        step_secs = []
+        upd_secs = []
+        stream_worst = 0.0
+        stream_ok = True
+        for _ in range(64):
+            u = jnp.asarray(
+                (0.1 * rng.standard_normal(mu)).astype(np.float32))
+            v = jnp.asarray(
+                (0.1 * rng.standard_normal(nu)).astype(np.float32))
+            t0 = time.perf_counter()
+            fact.update(u, v)
+            sync(fact.r_matrix())
+            t1 = time.perf_counter()
+            upd_secs.append(t1 - t0)
+            x = fact.solve(bu)
+            sync(x)
+            step_secs.append(time.perf_counter() - t0)
+            live = np.asarray(fact.matrix)
+            ratio = normal_equations_residual(live, np.asarray(x), bu) \
+                / oracle_residual(live, np.asarray(bu))
+            stream_worst = max(stream_worst, ratio)
+            stream_ok = stream_ok and ratio < TOLERANCE_FACTOR
+        step_secs.sort()
+        upd_secs.sort()
+        per_update = step_secs[len(step_secs) // 2]
+        upd_only = upd_secs[len(upd_secs) // 2]
+        emit({
+            "metric": "updatable_qr_stream",
+            "steps": 64,
+            "value": round(per_update / fresh_s, 4),
+            "unit": "median (update+solve) s / fresh factorization s",
+            "refresh": "givens",
+            "per_update_s": round(per_update, 6),
+            "update_only_s": round(upd_only, 6),
+            "update_only_over_fresh": round(upd_only / fresh_s, 4),
+            "fresh_factor_s": round(fresh_s, 6),
+            "worst_ratio_vs_lapack": round(stream_worst, 4),
+            "residual_criterion": TOLERANCE_FACTOR,
+            "refactors": fact.refactor_count,
+            "every_step_within_8x": stream_ok,
+        })
+
+        # n-heavy twin (round 18): at 4096x64 the step is Gram-matvec
+        # bound and the refresh choice barely shows; at 2048x512 the
+        # old n^3/3 re-Cholesky IS the step (44.7 MF vs the 4.2 MF
+        # matvec pair), so this cell times the Givens sweep against a
+        # directly-measured re-Cholesky of the SAME live Gram — the
+        # comparator the ``update-givens-floor`` regress rule pins.
+        _stage("update_stream_nheavy")
+        mh, nh, steps_h = 2048, 512, 16
+        Ah = jnp.asarray(rng.random((mh, nh)), jnp.float32)
+        bh = jnp.asarray(rng.random(mh), jnp.float32)
+        fact_h = UpdatableQR(Ah)
+        uh = jnp.asarray((0.1 * rng.standard_normal(mh)).astype(np.float32))
+        vh = jnp.asarray((0.1 * rng.standard_normal(nh)).astype(np.float32))
+        fact_h.update(uh, vh)
+        fact_h.solve(bh)            # warm programs
+        from dhqr_tpu.numeric.guards import checked_cholesky
+        sync(checked_cholesky(fact_h._G))  # warm the comparator
+        upd_h, chol_h = [], []
+        ok_h = True
+        for _ in range(steps_h):
+            u = jnp.asarray(
+                (0.1 * rng.standard_normal(mh)).astype(np.float32))
+            v = jnp.asarray(
+                (0.1 * rng.standard_normal(nh)).astype(np.float32))
+            t0 = time.perf_counter()
+            fact_h.update(u, v)
+            sync(fact_h.r_matrix())
+            upd_h.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            sync(checked_cholesky(fact_h._G))   # the round-17 refresh
+            chol_h.append(time.perf_counter() - t0)
+            x = fact_h.solve(bh)
+            live = np.asarray(fact_h.matrix)
+            ratio = normal_equations_residual(live, np.asarray(x), bh) \
+                / oracle_residual(live, np.asarray(bh))
+            ok_h = ok_h and ratio < TOLERANCE_FACTOR
+        upd_h.sort()
+        chol_h.sort()
+        med_upd = upd_h[len(upd_h) // 2]
+        med_chol = chol_h[len(chol_h) // 2]
+        fresh_h, _ = timed(lambda: qr(Ah))
+        emit({
+            "metric": "updatable_qr_stream_nheavy",
+            "steps": steps_h,
+            "m": mh, "n": nh,
+            "value": round(med_upd / (med_upd + med_chol), 4),
+            "unit": "givens step s / re-Cholesky-era step s (>= upper "
+                    "bound on the true ratio: the denominator still "
+                    "contains the sweeps)",
+            "refresh": "givens",
+            "update_only_s": round(med_upd, 6),
+            "recholesky_refresh_s": round(med_chol, 6),
+            "update_over_fresh": round(med_upd / fresh_h, 4),
+            "fresh_factor_s": round(fresh_h, 6),
+            "every_step_within_8x": ok_h,
+            "refactors": fact_h.refactor_count,
+        })
+        return per_update, fresh_s, stream_ok
+
+
+    if stream_only:
+        update_stream_cell()
+        _stage("done")
+        return
+
     speedups = []
     refused = 0
     worst_gate = 0.0
@@ -249,49 +380,7 @@ def main() -> None:
         "recompiles_after_prewarm": serve_recompiles,
     })
 
-    # Update stream: 64 rank-1 steps, gated per step, amortized cost vs
-    # a fresh factorization.
-    _stage("update_stream")
-    mu, nu = 4096, 64
-    Au = jnp.asarray(rng.random((mu, nu)), jnp.float32)
-    bu = jnp.asarray(rng.random(mu), jnp.float32)
-    fresh_s, _ = timed(lambda: qr(Au))
-    fact = UpdatableQR(Au)
-    fact.update(jnp.asarray(rng.standard_normal(mu).astype(np.float32)),
-                jnp.asarray(rng.standard_normal(nu).astype(np.float32)))
-    fact.solve(bu)                  # warm both programs
-    step_secs = []
-    stream_worst = 0.0
-    stream_ok = True
-    for _ in range(64):
-        u = jnp.asarray(
-            (0.1 * rng.standard_normal(mu)).astype(np.float32))
-        v = jnp.asarray(
-            (0.1 * rng.standard_normal(nu)).astype(np.float32))
-        t0 = time.perf_counter()
-        fact.update(u, v)
-        x = fact.solve(bu)
-        sync(x)
-        step_secs.append(time.perf_counter() - t0)
-        live = np.asarray(fact.matrix)
-        ratio = normal_equations_residual(live, np.asarray(x), bu) \
-            / oracle_residual(live, np.asarray(bu))
-        stream_worst = max(stream_worst, ratio)
-        stream_ok = stream_ok and ratio < TOLERANCE_FACTOR
-    step_secs.sort()
-    per_update = step_secs[len(step_secs) // 2]
-    emit({
-        "metric": "updatable_qr_stream",
-        "steps": 64,
-        "value": round(per_update / fresh_s, 4),
-        "unit": "median (update+solve) s / fresh factorization s",
-        "per_update_s": round(per_update, 6),
-        "fresh_factor_s": round(fresh_s, 6),
-        "worst_ratio_vs_lapack": round(stream_worst, 4),
-        "residual_criterion": TOLERANCE_FACTOR,
-        "refactors": fact.refactor_count,
-        "every_step_within_8x": stream_ok,
-    })
+    per_update, fresh_s, stream_ok = update_stream_cell()
 
     geomean = math.exp(sum(math.log(s) for s in speedups)
                        / max(1, len(speedups))) if speedups else 0.0
@@ -321,4 +410,4 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    main(stream_only="--stream-only" in sys.argv[1:])

@@ -455,6 +455,95 @@ def run(n_devices: int) -> None:
               "measured collective census; run tools/lint.sh for the "
               "DHQR402 smoke)", flush=True)
 
+    # Communication-compressed collectives / dhqr-wire (round 18): on a
+    # real multi-device mesh the bf16 wire must (a) cut the TRACED
+    # collective byte volume of the panel-broadcast path by >= 1.8x
+    # against the uncompressed twin (the same census DHQR302 budgets,
+    # machine-checked here end to end), (b) keep a compressed lstsq
+    # inside the 8x LAPACK criterion, (c) leave the comms=None program
+    # BIT-IDENTICAL to the plain spelling (the accurate-tier contract),
+    # and (d) compile each mode exactly once — a warm compressed repeat
+    # recompiles nothing.
+    if n_devices >= 2:
+        from dhqr_tpu.analysis.comms_pass import collect_comms
+        from dhqr_tpu.parallel.sharded_qr import (
+            sharded_blocked_qr as _wire_qr,
+        )
+        from dhqr_tpu.parallel.sharded_solve import sharded_lstsq
+
+        def _traced_vol(comms):
+            closed = jax.make_jaxpr(
+                lambda A_: _wire_qr(A_, cmesh, block_size=block_size,
+                                    comms=comms))(A)
+            return collect_comms(closed).total_volume_bytes()
+
+        vol_f32 = _traced_vol(None)
+        vol_bf16 = _traced_vol("bf16")
+        ratio = vol_f32 / max(vol_bf16, 1)
+        assert ratio >= 1.8, (
+            "bf16 wire volume reduction regressed", vol_f32, vol_bf16)
+        # The passthrough contract, checked STRUCTURALLY (comparing
+        # comms=None against the default spelling would be a tautology
+        # — both resolve to the same lru-cached program): the
+        # uncompressed trace must carry no bf16 wire ops while the
+        # compressed twin must. The jaxpr-level identity against a raw
+        # lax.psum oracle is pinned by tests/test_wire.py.
+        jx_plain = str(jax.make_jaxpr(
+            lambda A_: _wire_qr(A_, cmesh, block_size=block_size,
+                                comms=None))(A))
+        jx_bf16 = str(jax.make_jaxpr(
+            lambda A_: _wire_qr(A_, cmesh, block_size=block_size,
+                                comms="bf16"))(A))
+        assert "bf16" not in jx_plain, (
+            "comms=None traced a bf16 wire op — the passthrough broke")
+        assert "bf16" in jx_bf16, "the bf16 twin compressed nothing"
+        Hw0, aw0 = _wire_qr(A, cmesh, block_size=block_size)
+        Hw1, aw1 = _wire_qr(A, cmesh, block_size=block_size,
+                            policy="accurate")
+        assert bool(jnp.all(Hw0 == Hw1)) and bool(jnp.all(aw0 == aw1)), (
+            "the accurate preset is not bit-identical to the plain "
+            "spelling")
+        bw_ = jnp.asarray(rng.random(A.shape[0]), jnp.float32)
+        # A compressed-wire mesh lstsq carries CSNE recovery by
+        # contract (qr_model floors refine at wire.CSNE_SWEEPS), so
+        # the bare comms spelling must already hold the 8x bar.
+        from dhqr_tpu.models.qr_model import lstsq as _model_lstsq
+
+        xw = _model_lstsq(A, bw_, mesh=cmesh, block_size=block_size,
+                          comms="bf16")
+        res = normal_equations_residual(A, np.asarray(xw), bw_)
+        ref = oracle_residual(np.asarray(A), np.asarray(bw_))
+        assert res < TOLERANCE_FACTOR * ref, ("wire bf16 lstsq", res, ref)
+        # The row engines recover through their in-body CSNE sweeps
+        # (comms-gated — parallel/wire.CSNE_SWEEPS): the compressed
+        # combine must hold the same 8x bar with no model-tier help.
+        Atw = jnp.asarray(rng.random((64 * n_devices, 8)), jnp.float32)
+        btw = jnp.asarray(rng.random(64 * n_devices), jnp.float32)
+        xtw = sharded_tsqr_lstsq(Atw, btw, row_mesh(n_devices),
+                                 block_size=8, comms="bf16")
+        res_t = normal_equations_residual(Atw, np.asarray(xtw), btw)
+        ref_t = oracle_residual(np.asarray(Atw), np.asarray(btw))
+        assert res_t < TOLERANCE_FACTOR * ref_t, (
+            "wire bf16 tsqr", res_t, ref_t)
+        from dhqr_tpu.parallel.sharded_qr import _build_blocked
+
+        n_built = _build_blocked.cache_info().currsize
+        Hw2, _ = _wire_qr(A, cmesh, block_size=block_size, comms="bf16")
+        jax.block_until_ready(Hw2)
+        assert _build_blocked.cache_info().currsize == n_built, (
+            "warm compressed repeat rebuilt its program",
+            _build_blocked.cache_info())
+        print(f"dryrun: wire ok (traced panel-broadcast volume "
+              f"{vol_f32} B -> {vol_bf16} B = {ratio:.2f}x under bf16, "
+              "compressed lstsq within 8x, accurate bit-identical, "
+              "warm compressed repeat 0 rebuilds)", flush=True)
+    else:
+        print("dryrun: wire SKIPPED (needs >= 2 devices: a 1-device "
+              "mesh launches no collectives, so there is no wire "
+              "volume to compress — rerun with "
+              "XLA_FLAGS=--xla_force_host_platform_device_count=8)",
+              flush=True)
+
     # Plan autotuner (round 9): a tiny-grid on-device search must run end
     # to end on CPU — tune, persist, resolve through the PUBLIC lstsq
     # plan="auto" path — with the tuned answer held to the same 8x LAPACK

@@ -1,4 +1,4 @@
-"""Pass 1 — AST lint rules DHQR001-DHQR008.
+"""Pass 1 — AST lint rules DHQR001-DHQR009.
 
 Each rule is a small class with an id, a scope predicate over the
 (posix) file path, and a ``check(module)`` hook receiving a
@@ -685,6 +685,82 @@ class RawWallClock(Rule):
         return out
 
 
+class RawCollectiveOutsideSeam(Rule):
+    """DHQR009 — a raw data-moving ``lax`` collective in the sharded
+    tier (``dhqr_tpu/parallel/``) bypasses the dhqr-wire compression
+    seam (``parallel/wire.py``, round 18). The seam is the ONE place a
+    collective's wire format is chosen: ``wire_psum``/``wire_all_gather``
+    are verbatim passthroughs at ``comms=None`` (the accurate tier
+    stays bit-identical by construction) and bf16/int8 quantizers on
+    the compressed rungs, priced by the DHQR302 compressed-mode
+    budgets. A raw ``lax.psum``/``lax.all_gather`` on a panel-broadcast
+    or combine path is a collective the ``comms`` policy field can
+    never compress — the engine silently drops out of the compressed
+    contract while the plan grid keeps offering the mode. The seam
+    module itself is the sanctioned call site; ``axis_index`` moves no
+    words and stays DHQR005's business."""
+
+    id = "DHQR009"
+    title = "raw lax collective in the sharded tier outside the wire seam"
+
+    # Data-moving collectives only (COMMS_COLLECTIVES minus nothing —
+    # axis_index is excluded by construction).
+    _WIRE_COLLECTIVES = {
+        "psum", "pmean", "pmax", "pmin", "psum_scatter", "reduce_scatter",
+        "all_gather", "all_to_all", "ppermute", "pshuffle", "pbroadcast",
+    }
+
+    def applies(self, path: str) -> bool:
+        return ("parallel/" in path
+                and _in_package(path)
+                and not path.endswith("parallel/wire.py"))
+
+    def check(self, ctx):
+        # Same spelling coverage as DHQR007: dotted lax.<name> through
+        # any module alias of jax.lax, and bare names bound by
+        # `from jax.lax import psum [as p]`.
+        flagged_names: "set[str]" = set()
+        lax_aliases: "set[str]" = {"lax"}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for alias in node.names:
+                    if mod.endswith("lax") \
+                            and alias.name in self._WIRE_COLLECTIVES:
+                        flagged_names.add(alias.asname or alias.name)
+                    elif alias.name == "lax" and alias.asname:
+                        lax_aliases.add(alias.asname)
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.endswith(".lax") and alias.asname:
+                        lax_aliases.add(alias.asname)
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node.func)
+            if name not in self._WIRE_COLLECTIVES \
+                    and name not in flagged_names:
+                continue
+            dotted = _dotted(node.func)
+            prefix, _, _attr = dotted.rpartition(".")
+            via_module = prefix.split(".")[-1] in lax_aliases if prefix \
+                else False
+            bare = isinstance(node.func, ast.Name) and name in flagged_names
+            if not via_module and not bare:
+                continue  # wire_psum-style wrappers pass
+            out.append(self._finding(
+                ctx, node,
+                f"raw collective {dotted}() on a sharded-tier path: "
+                "route through dhqr_tpu.parallel.wire "
+                "(wire_psum/wire_all_gather — a verbatim passthrough at "
+                "comms=None) so the comms policy field can compress it "
+                "and the DHQR302 compressed budgets can price it, or "
+                "suppress with the reason the wire format cannot apply",
+            ))
+        return out
+
+
 AST_RULES = (
     PrivateJaxImports(),
     UnannotatedContractions(),
@@ -694,6 +770,7 @@ AST_RULES = (
     SwallowedException(),
     UnguardedCholesky(),
     RawWallClock(),
+    RawCollectiveOutsideSeam(),
 )
 
 

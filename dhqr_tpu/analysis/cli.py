@@ -49,6 +49,8 @@ def rule_catalogue() -> "list[tuple[str, str, str]]":
     rows = [("DHQR000", "source file failed to parse (syntax error)",
              "ast")]
     rows += [(r.id, r.title, "ast") for r in AST_RULES]
+    # (DHQR009 — the dhqr-wire seam rule — rides in AST_RULES like the
+    # other pass-1 rows; listed here only as a cross-reference.)
     rows += [
         ("DHQR101", "f64/c128 intermediate traced from f32 inputs",
          "jaxpr"),

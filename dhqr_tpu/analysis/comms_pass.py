@@ -224,18 +224,24 @@ def check_comms(closed_jaxpr, label: str, contract: dict,
             "regression until the contract is re-derived",
             snippet=prim,
         ))
+    comms = contract.get("comms")
     budget = budget_bytes(contract["model"], params.m, params.n, params.nb,
-                          params.P, params.itemsize, nrhs=params.nrhs)
+                          params.P, params.itemsize, nrhs=params.nrhs,
+                          comms=comms)
     slack = float(contract.get("slack", 1.5))
     traced = stats.total_volume_bytes()
     if traced > budget * slack:
+        wire = f", wire={comms}" if comms else ""
         findings.append(Finding(
             "DHQR302", label, 0,
             f"traced collective volume {traced} B exceeds the analytic "
             f"budget {budget} B (model '{contract['model']}' at m="
-            f"{params.m}, n={params.n}, nb={params.nb}, P={params.P}) "
-            f"x slack {slack}: the engine moves more words than its "
-            "communication pattern is contracted to",
+            f"{params.m}, n={params.n}, nb={params.nb}, P={params.P}"
+            f"{wire}) x slack {slack}: the engine moves more "
+            + ("bytes than its compressed wire format is contracted to "
+               "— the claimed volume reduction regressed"
+               if comms else
+               "words than its communication pattern is contracted to"),
             snippet="volume",
         ))
     for prim in sorted(set(stats.opaque_loop_collectives)):
@@ -441,6 +447,57 @@ def _engine_specs(P: int, preset: str, pol, sweep_presets: bool):
                                                 precision=pol.panel),
               At, bt),
            row)
+    # dhqr-wire (round 18): the compressed engine matrix. Each entry
+    # re-traces an engine with the seam armed and checks it against a
+    # COMPRESSED-mode contract (analysis/comms_contracts.json entries
+    # carrying "comms"): the tightened bf16 slack (1.1 on the
+    # exact-to-the-word engines) is what machine-enforces the >= 1.8x
+    # traced-volume reduction — 4 bytes / (2 bytes x 1.1) = 1.82. The
+    # bucket program is traced under a bf16-wire policy against its
+    # ZERO-collective contract: compression must never introduce a
+    # collective into the embarrassingly-parallel serving dispatch.
+    from dhqr_tpu.serve.engine import bucket_program
+    from jax.sharding import NamedSharding, PartitionSpec
+    from dhqr_tpu.parallel.mesh import DEFAULT_AXIS
+
+    wire_specs = (
+        ("blocked_qr_wire_bf16", "bf16",
+         lambda c: jx(lambda A: sharded_blocked_qr(
+             A, cmesh, block_size=nb, comms=c), A), col),
+        ("blocked_qr_wire_int8", "int8",
+         lambda c: jx(lambda A: sharded_blocked_qr(
+             A, cmesh, block_size=nb, comms=c), A), col),
+        ("blocked_qr_agg_wire_bf16", "bf16",
+         lambda c: jx(lambda A: sharded_blocked_qr(
+             A, cmesh, block_size=nb, agg_panels=2, comms=c), A), col),
+        ("unblocked_qr_wire_bf16", "bf16",
+         lambda c: jx(lambda A: sharded_householder_qr(
+             A, cmesh, comms=c), A), col),
+        ("sharded_solve_wire_bf16", "bf16",
+         lambda c: jx(lambda H, a, b: sharded_solve(
+             H, a, b, cmesh, block_size=nb, comms=c), H, alpha, b), col),
+        ("tsqr_lstsq_wire_bf16", "bf16",
+         lambda c: jx(lambda A, b: sharded_tsqr_lstsq(
+             A, b, rmesh, block_size=_ROW_NB, comms=c), At, bt), row),
+        ("tsqr_lstsq_wire_int8", "int8",
+         lambda c: jx(lambda A, b: sharded_tsqr_lstsq(
+             A, b, rmesh, block_size=_ROW_NB, comms=c), At, bt), row),
+        ("cholqr_lstsq_wire_bf16", "bf16",
+         lambda c: jx(lambda A, b: sharded_cholqr_lstsq(
+             A, b, rmesh, comms=c), At, bt), row),
+    )
+    for engine, mode, mk, params in wire_specs:
+        yield (engine, f"comms::{engine}{tag}", mk(mode), params)
+    from dhqr_tpu.precision import PrecisionPolicy
+
+    As = jnp.zeros((_BATCH_B, _BATCH_M, _BATCH_N), jnp.float32)
+    bs = jnp.zeros((_BATCH_B, _BATCH_M), jnp.float32)
+    sh = NamedSharding(cmesh, PartitionSpec(DEFAULT_AXIS))
+    wfn = bucket_program("lstsq", block_size=_BATCH_NB,
+                         policy=PrecisionPolicy(comms="bf16"))
+    yield ("batched_lstsq", f"comms::batched_lstsq_wire_bf16{tag}",
+           jx(jax.jit(wfn, in_shardings=(sh, sh)), As, bs),
+           EngineParams(_BATCH_M, _BATCH_N, _BATCH_NB, P))
 
 
 def trace_engine(engine: str, P: int, preset: str = "accurate"):

@@ -65,20 +65,72 @@ def no_comms_words(m: int, n: int, nb: int, P: int, nrhs: int = 1) -> int:
     return 0
 
 
+#: CSNE correction sweeps the row engines run per COMPRESSED solve
+#: (parallel/wire.CSNE_SWEEPS — kept in sync by test): each sweep adds
+#: one (n, nrhs) correction psum on top of the combine exchange.
+CSNE_SWEEPS = 2
+
+
+def tsqr_lstsq_wire_words(m: int, n: int, nb: int, P: int,
+                          nrhs: int = 1) -> int:
+    """Compressed TSQR (dhqr-wire): the one all_gather pair of
+    :func:`tsqr_lstsq_words` plus :data:`CSNE_SWEEPS` corrected-semi-
+    normal (n, nrhs) psums (sharded_tsqr._tsqr_shard_body, comms set).
+    The correction psums stay on the F32 wire by design, so their words
+    are counted DOUBLE here: ``budget_bytes`` prices every word of a
+    bf16 contract at 2 bytes, and 2 x 2 B = the 4 B the f32 correction
+    actually moves (int8 contracts under-price them 2x — absorbed by
+    their slack). They are O(1/(P*n)) of the gather at real shapes;
+    the model carries them so audit-scale shapes stay exact."""
+    return (tsqr_lstsq_words(m, n, nb, P, nrhs=nrhs)
+            + 2 * CSNE_SWEEPS * n * nrhs)
+
+
+def cholqr_lstsq_wire_words(m: int, n: int, nb: int, P: int,
+                            nrhs: int = 1) -> int:
+    """Compressed CholeskyQR2 (dhqr-wire): the Gram/Q^Hb psums of
+    :func:`cholqr_lstsq_words` plus :data:`CSNE_SWEEPS` corrected-semi-
+    normal (n, nrhs) psums — f32-wire, double-counted exactly as in
+    :func:`tsqr_lstsq_wire_words` (sharded_cholqr._cholqr_shard_body)."""
+    return (cholqr_lstsq_words(m, n, nb, P, nrhs=nrhs)
+            + 2 * CSNE_SWEEPS * n * nrhs)
+
+
 MODELS = {
     "unblocked_qr": unblocked_qr_words,
     "blocked_qr": blocked_qr_words,
     "sharded_solve": sharded_solve_words,
     "tsqr_lstsq": tsqr_lstsq_words,
     "cholqr_lstsq": cholqr_lstsq_words,
+    "tsqr_lstsq_wire": tsqr_lstsq_wire_words,
+    "cholqr_lstsq_wire": cholqr_lstsq_wire_words,
     "none": no_comms_words,
 }
 
 
+#: Wire bytes per word under each dhqr-wire comms mode (round 18).
+#: Deliberately a LITERAL COPY of dhqr_tpu.precision.WIRE_ITEMSIZE:
+#: importing precision would pull the package __init__ (and jax) into
+#: the stdlib-only regress tier that imports this module. The copies
+#: are pinned against each other by
+#: tests/test_wire.py::test_wire_modes_validation_and_vocab_parity.
+WIRE_ITEMSIZE = {"bf16": 2, "int8": 1}
+
+
 def budget_bytes(model: str, m: int, n: int, nb: int, P: int,
-                 itemsize: int, nrhs: int = 1) -> int:
+                 itemsize: int, nrhs: int = 1,
+                 comms: "str | None" = None) -> int:
     """Analytic per-device collective budget in bytes for ``model``
-    (a key of :data:`MODELS`) at the given engine parameters."""
+    (a key of :data:`MODELS`) at the given engine parameters.
+
+    ``comms`` (a dhqr-wire mode, round 18) prices the budget at the
+    COMPRESSED wire itemsize instead of the array itemsize — words are
+    schedule-invariant, so the same volume formula covers every wire
+    format. The int8 rung's per-column f32 scale sidecars and the
+    bf16 1-D fallbacks are deliberately NOT modeled (they are O(1/rows)
+    relative); the compressed contracts' slack absorbs them, and a
+    tightened slack on the bf16 entries is exactly what machine-checks
+    the >= 1.8x traced-volume reduction (4 / (2 x 1.1) > 1.8)."""
     try:
         fn = MODELS[model]
     except KeyError:
@@ -86,4 +138,13 @@ def budget_bytes(model: str, m: int, n: int, nb: int, P: int,
             f"unknown comms cost model {model!r} (have {sorted(MODELS)}); "
             "comms_contracts.json names a model this version does not ship"
         ) from None
+    if comms is not None:
+        try:
+            itemsize = WIRE_ITEMSIZE[comms]
+        except KeyError:
+            raise KeyError(
+                f"unknown comms wire format {comms!r} (have "
+                f"{sorted(WIRE_ITEMSIZE)}); comms_contracts.json names a "
+                "wire format this version does not ship"
+            ) from None
     return fn(m, n, nb, P, nrhs=nrhs) * itemsize

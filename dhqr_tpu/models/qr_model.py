@@ -65,6 +65,15 @@ def _resolve_policy_cfg(cfg: DHQRConfig):
     setting the knobs it resolves (a call naming both spellings is
     ambiguous and refuses loudly rather than letting one silently win).
     """
+    from dhqr_tpu.precision import resolve_comms
+
+    # Normalize the classic comms knob FIRST (every qr/lstsq/serve call
+    # passes through here): "f32"/"none" collapse to None and an
+    # invalid wire format refuses loudly on EVERY path — without this,
+    # a bad DHQR_COMMS only surfaced on the mesh tier, and the "f32"
+    # spelling read as truthy to the CSNE-floor logic downstream.
+    if cfg.comms is not None:
+        cfg = dataclasses.replace(cfg, comms=resolve_comms(cfg.comms))
     if cfg.policy is None:
         return cfg, None
     from dhqr_tpu.precision import (apply_policy_to_factor_args,
@@ -87,11 +96,16 @@ def _resolve_policy_cfg(cfg: DHQRConfig):
             "pass either policy= or apply_precision=, not both "
             f"(policy resolves apply to {pol.resolved_apply()!r})"
         )
+    if cfg.comms is not None:
+        raise ValueError(
+            "pass either policy= or comms=, not both "
+            f"(policy sets the wire format to {pol.comms!r})"
+        )
     apply = pol.resolved_apply()
     cfg = dataclasses.replace(
         cfg, precision=precision, trailing_precision=trailing,
         apply_precision=None if apply == pol.panel else apply,
-        policy=None,
+        comms=pol.comms, policy=None,
     )
     return cfg, pol
 
@@ -190,6 +204,28 @@ def _check_panel_impl(cfg: DHQRConfig) -> None:
         )
 
 
+def _csne_refine(A, R, x, b, steps: int):
+    """Corrected semi-normal refinement: ``x += (R^H R)^{-1} A^H (b -
+    A x)``, residual and Gram-side matvecs at full precision. No
+    ``M r*`` fixed-point bias (``A^H r* = 0`` exactly at the
+    least-squares solution), so it converges for factorizations whose
+    R carries wire-level rounding — the compressed-comms recovery path
+    (dhqr-wire, round 18; Björck's CSNE as in ``solvers.update``)."""
+    from jax import lax
+
+    vec = x.ndim == 1
+    X = x[:, None] if vec else x
+    B = b[:, None] if vec else b
+    for _ in range(steps):
+        resid = B - jnp.matmul(A, X, precision="highest")
+        G = jnp.matmul(jnp.conj(A.T), resid, precision="highest")
+        Y = lax.linalg.triangular_solve(R, G, left_side=True, lower=False,
+                                        transpose_a=True, conjugate_a=True)
+        X = X + lax.linalg.triangular_solve(R, Y, left_side=True,
+                                            lower=False)
+    return X[:, 0] if vec else X
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class QRFactorization:
@@ -220,6 +256,12 @@ class QRFactorization:
         factor's own Q R (whose defect is exactly the error being
         corrected). A pytree leaf when present; None otherwise (arrays
         are immutable, so keeping the reference costs nothing).
+      comms: collective wire format for mesh solves (dhqr-wire, round
+        18): the solve stage's panel broadcasts ride the same
+        compressed wire the factor stage used, so a bf16-wire
+        factorization's solves stay on the bf16-wire program (one
+        compiled program per mode; single-device solves launch no
+        collectives and ignore it by contract).
     """
 
     H: jax.Array
@@ -230,6 +272,7 @@ class QRFactorization:
     layout: str = "block"
     refine: int = 0
     matrix: Optional[jax.Array] = None
+    comms: "str | None" = None
 
     # -- pytree plumbing ---------------------------------------------------
     def tree_flatten(self):
@@ -237,7 +280,7 @@ class QRFactorization:
         # so presence lives in the treedef and jit caching stays correct.
         return (self.H, self.alpha, self.matrix), (
             self.block_size, self.mesh, self.precision, self.layout,
-            self.refine,
+            self.refine, self.comms,
         )
 
     @classmethod
@@ -246,7 +289,7 @@ class QRFactorization:
         return cls(
             H, alpha,
             block_size=aux[0], mesh=aux[1], precision=aux[2], layout=aux[3],
-            refine=aux[4], matrix=matrix,
+            refine=aux[4], comms=aux[5], matrix=matrix,
         )
 
     # -- derived quantities ------------------------------------------------
@@ -310,7 +353,7 @@ class QRFactorization:
             return sharded_solve(
                 self.H, self.alpha, b, self.mesh,
                 block_size=self.block_size, precision=self.precision,
-                layout=self.layout,
+                layout=self.layout, comms=self.comms,
             )
         c = _blocked.blocked_apply_qt(
             self.H, self.alpha, b, self.block_size, precision=self.precision
@@ -339,6 +382,19 @@ class QRFactorization:
                     "qr(A, policy=...) (policy.refine > 0 keeps A on the "
                     "factorization), or pass refine=0"
                 )
+            if self.comms is not None:
+                # dhqr-wire (round 18): a compressed-wire factorization
+                # carries ~wire-eps error, and plain residual refinement
+                # stalls at its fixed-point bias M r* (the solve's
+                # perturbed Q^H does not annihilate the TRUE residual,
+                # which is O(1) for inconsistent systems). Corrected
+                # semi-normal sweeps have no such bias — A^H r* = 0
+                # exactly at the solution — so refine through the
+                # normal equations with this factorization's R instead
+                # (Björck's CSNE, the same recovery solvers.update and
+                # the compressed row engines use).
+                return _csne_refine(self.matrix, _solve.r_matrix(
+                    self.H, self.alpha), x, b, steps)
             for _ in range(steps):
                 r = b - jnp.matmul(self.matrix, x, precision="highest")
                 x = x + self._solve_once(r)
@@ -458,18 +514,19 @@ def qr(
                 use_pallas=cfg.use_pallas, panel_impl=cfg.panel_impl,
                 trailing_precision=cfg.trailing_precision,
                 lookahead=cfg.lookahead, agg_panels=cfg.agg_panels,
+                comms=cfg.comms,
             )
         else:
             _reject_nonblocked_knobs(cfg.use_pallas, cfg.trailing_precision,
                                      cfg.lookahead, cfg.agg_panels)
             H, alpha = _sharded.sharded_householder_qr(
                 A, mesh, axis_name=col_axis, precision=cfg.precision,
-                layout=cfg.layout, norm=cfg.norm,
+                layout=cfg.layout, norm=cfg.norm, comms=cfg.comms,
             )
         return QRFactorization(
             H, alpha, block_size=nb, mesh=mesh, precision=apply_prec,
             layout=cfg.layout, refine=solve_refine,
-            matrix=A if solve_refine else None,
+            matrix=A if solve_refine else None, comms=cfg.comms,
         )
     if cfg.blocked:
         H, alpha = _blocked.blocked_householder_qr(
@@ -692,6 +749,12 @@ def _lstsq_refined(A, b, cfg: DHQRConfig, mesh):
     # solve precision, so the refinement loop inherits it.
     fact = qr(A, config=dataclasses.replace(cfg, refine=0), mesh=mesh)
     x = fact.solve(b)
+    if cfg.comms is not None:
+        # Compressed wire: plain residual refinement stalls at its
+        # M r* bias (see QRFactorization.solve) — refine through the
+        # normal equations with the factorization's R instead.
+        return _csne_refine(A, _solve.r_matrix(fact.H, fact.alpha), x, b,
+                            cfg.refine)
     for _ in range(cfg.refine):
         r = b - jnp.matmul(A, x, precision="highest")
         x = x + fact.solve(r)
@@ -744,7 +807,7 @@ def _lstsq_alt_engine(A, b, cfg: DHQRConfig, mesh):
             return sharded_tsqr_lstsq(
                 A, b, mesh, block_size=cfg.block_size,
                 axis_name=axis, precision=cfg.precision,
-                use_pallas=cfg.use_pallas,
+                use_pallas=cfg.use_pallas, comms=cfg.comms,
             )
         n_blocks = max(1, min(8, A.shape[0] // max(A.shape[1], 1)))
         while n_blocks > 1 and A.shape[0] % n_blocks:
@@ -760,7 +823,7 @@ def _lstsq_alt_engine(A, b, cfg: DHQRConfig, mesh):
 
             return sharded_cholqr_lstsq(
                 A, b, mesh, axis_name=axis,
-                precision=cfg.precision, shift=shift,
+                precision=cfg.precision, shift=shift, comms=cfg.comms,
             )
         from dhqr_tpu.ops.cholqr import cholesky_qr_lstsq
 
@@ -1028,6 +1091,20 @@ def lstsq(
         return _minimum_norm_impl(
             A, b, cfg.block_size, cfg.precision, norm=cfg.norm
         )
+    if (cfg.comms is not None and mesh is not None
+            and cfg.engine == "householder"):
+        # dhqr-wire (round 18): a compressed-wire mesh solve includes
+        # CSNE recovery BY CONTRACT — the same in-body sweeps the
+        # compressed row engines run (parallel/wire.CSNE_SWEEPS), so
+        # lstsq holds the 8x normal-equations bar at every rung and a
+        # tuned comms plan is admissible under the accuracy gate. A
+        # caller's refine only ever adds margin on top of the floor
+        # (per-mode: int8's coarser step needs more contractions).
+        from dhqr_tpu.parallel.wire import CSNE_MODEL_SWEEPS
+
+        floor = CSNE_MODEL_SWEEPS.get(cfg.comms, 2)
+        if cfg.refine < floor:
+            cfg = dataclasses.replace(cfg, refine=floor)
     if cfg.refine:
         return _lstsq_refined(A, b, cfg, mesh)
     if cfg.engine != "householder":
@@ -1057,13 +1134,13 @@ def lstsq(
             H, alpha = sharded_householder_qr(
                 A, mesh, axis_name=col_axis, precision=cfg.precision,
                 layout=cfg.layout, store_nb=nb, _store_layout_output=True,
-                norm=cfg.norm,
+                norm=cfg.norm, comms=cfg.comms,
             )
             x = sharded_solve(
                 H, alpha, b, mesh,
                 block_size=nb, axis_name=col_axis,
                 precision=cfg.apply_precision or cfg.precision,
-                layout=cfg.layout, _H_in_store_layout=True,
+                layout=cfg.layout, _H_in_store_layout=True, comms=cfg.comms,
             )
             return x[:n]
         return sharded_lstsq(
@@ -1073,7 +1150,7 @@ def lstsq(
             use_pallas=cfg.use_pallas, panel_impl=cfg.panel_impl,
             trailing_precision=cfg.trailing_precision,
             lookahead=cfg.lookahead, agg_panels=cfg.agg_panels,
-            apply_precision=cfg.apply_precision,
+            apply_precision=cfg.apply_precision, comms=cfg.comms,
         )
     with _blocked._pallas_cache_guard(_lstsq_interp(A, cfg)):
         return _lstsq_impl(

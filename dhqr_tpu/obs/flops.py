@@ -118,14 +118,16 @@ def sketched_lstsq_flops(m: int, n: int, s: int, refine: int = 0) -> float:
 
 def qr_update_flops(m: int, n: int) -> float:
     """One rank-1 update/downdate of a live (m, n) factorization
-    (round 17, ``dhqr_tpu.solvers.update.UpdatableQR``): the Gram-side
-    matvec ``w = A^H u`` (``2mn``), the data update ``A += u v^H``
-    (``2mn``), the ``u . u`` dot (``2m``), three rank-1 symmetric Gram
-    updates (``6n^2``), and the n x n Cholesky refresh (``n^3/3``).
-    The m/n-fold gap to :func:`qr_flops` is the engine family's reason
-    to exist."""
+    (``dhqr_tpu.solvers.update.UpdatableQR``): the Gram-side matvec
+    ``w = A^H u`` (``2mn``), the data update ``A += u v^H`` (``2mn``),
+    the ``u . u`` dot (``2m``), three rank-1 symmetric Gram updates
+    (``6n^2``), and — round 18 — the incremental R refresh as one
+    Givens append plus one hyperbolic removal sweep (n rotations of
+    two n-vectors each, ``6n^2`` per sweep = ``12n^2``), replacing the
+    round-17 ``n^3/3`` full re-Cholesky that was the amortization
+    floor (ROADMAP item 4). The whole step is now O(mn + n^2)."""
     m, n = float(m), float(n)
-    return 4.0 * m * n + 2.0 * m + 6.0 * n * n + (n ** 3) / 3.0
+    return 4.0 * m * n + 2.0 * m + 18.0 * n * n
 
 
 def updatable_solve_flops(m: int, n: int, refine: int = 1) -> float:

@@ -173,6 +173,7 @@ class PulseReport:
     label: str
     n_devices: int = 1
     device_kind: "str | None" = None
+    wire_format: "str | None" = None
     wall_s: "float | None" = None
     measured: "dict | None" = None
     measured_unavailable: "str | None" = None
@@ -202,6 +203,8 @@ class PulseReport:
         absent)."""
         out: dict = {"label": self.label, "n_devices": self.n_devices,
                      "device_kind": self.device_kind}
+        if self.wire_format is not None:
+            out["wire_format"] = self.wire_format
         if self.wall_s is not None:
             out["wall_s"] = round(self.wall_s, 6)
         out["measured"] = self.measured
@@ -269,7 +272,8 @@ _DECOMPOSITION_SOURCES = {
 def _check_dhqr306(measured: "dict | None", analytic: "dict | None",
                    opaque: "tuple[str, ...]", n_devices: int,
                    ici_gbps: "float | None", slack: float,
-                   contract_families: "tuple | None" = None) -> dict:
+                   contract_families: "tuple | None" = None,
+                   wire_format: "str | None" = None) -> dict:
     """The runtime contract verdict. Per measured family: the
     :func:`~dhqr_tpu.obs.netmodel.explain_measured` wire check against
     the analytic volume (skip with reason when no wire speed is
@@ -279,6 +283,12 @@ def _check_dhqr306(measured: "dict | None", analytic: "dict | None",
     the runtime twin of DHQR301). While-loop-opaque families skip, as
     in PR 5 (an unboundable volume cannot bound a time)."""
     verdict: dict = {"slack": slack, "checks": []}
+    if wire_format is not None:
+        # dhqr-wire (round 18): the analytic census already carries the
+        # COMPRESSED payload avals (bf16/int8 on the wire), so the
+        # explanation bound below is the compressed-volume bound — the
+        # tag records which wire model priced it.
+        verdict["wire_format"] = wire_format
     if measured is None:
         verdict["status"] = "skip"
         verdict["reason"] = "no measured collective timing"
@@ -325,7 +335,7 @@ def _check_dhqr306(measured: "dict | None", analytic: "dict | None",
             continue
         check = _net.explain_measured(
             family, meas["time_s"], row["volume_bytes"], n_devices,
-            ici_gbps or 0.0, slack)
+            ici_gbps or 0.0, slack, wire_format=wire_format)
         if note:
             check["note"] = note
         verdict["checks"].append(check)
@@ -352,7 +362,8 @@ def measure(label: str, thunk: Callable[[], object], *,
             device_kind: "str | None" = None,
             slack: float = DEFAULT_SLACK,
             contract_families: "tuple | None" = None,
-            keep_trace_dir: "str | None" = None):
+            keep_trace_dir: "str | None" = None,
+            wire_format: "str | None" = None):
     """Run ``thunk`` warm (once untraced — absorbing any cold compile
     — then once under a ``jax.profiler`` trace) and build its
     :class:`PulseReport`. Returns ``(thunk's result, report)``.
@@ -477,7 +488,8 @@ def measure(label: str, thunk: Callable[[], object], *,
 
     dhqr306 = _check_dhqr306(measured, analytic, opaque, n_devices,
                              ici, slack,
-                             contract_families=contract_families)
+                             contract_families=contract_families,
+                             wire_format=wire_format)
 
     comms: "dict | None" = None
     if measured is not None and skew is not None:
@@ -502,6 +514,7 @@ def measure(label: str, thunk: Callable[[], object], *,
     report = PulseReport(
         label=str(label), n_devices=int(n_devices),
         device_kind=device_kind, wall_s=wall_s,
+        wire_format=wire_format,
         measured=measured, measured_unavailable=reason,
         analytic=analytic, analytic_unavailable=analytic_reason,
         opaque_families=opaque, skew=skew, skew_unavailable=skew_reason,
@@ -664,7 +677,8 @@ def observed_dispatch(label: str, thunk: Callable[[], object], *,
                       abstract: "Callable[[], object] | None" = None,
                       n_devices: int = 1,
                       contract_families: "tuple | None" = None,
-                      on_report=None):
+                      on_report=None,
+                      wire_format: "str | None" = None):
     """The sharded tier's instrumentation seam: run ``thunk`` plainly
     when pulse is disarmed or ``label`` was already measured; measure
     it (once) when armed and new. The dispatch's result is returned
@@ -691,7 +705,8 @@ def observed_dispatch(label: str, thunk: Callable[[], object], *,
         return thunk()
     out, report = measure(label, thunk, abstract=abstract,
                           n_devices=n_devices, slack=store.slack,
-                          contract_families=contract_families)
+                          contract_families=contract_families,
+                          wire_format=wire_format)
     store.capture(label, report)
     if on_report is not None:
         try:

@@ -56,15 +56,30 @@ def _leaf_factor(Ai, bi, nb, precision, pallas=False, interpret=False,
     return R, c
 
 
-def _combine_solve(Rstack, cstack, nb, precision, pallas=False,
-                   interpret=False, pallas_flat=None,
-                   trailing_precision=None):
-    """Combine stage: QR the stacked heads, then solve R x = (Q^H c)[:n]."""
+def _combine_factor(Rstack, cstack, nb, precision, pallas=False,
+                    interpret=False, pallas_flat=None,
+                    trailing_precision=None):
+    """Combine stage, factored form: QR the stacked heads, reduce the
+    rhs. Returns ``(H2, alpha2, c2)`` — what :func:`_combine_solve`
+    back-substitutes, and what the COMPRESSED sharded combine
+    (parallel/sharded_tsqr, round 18) keeps so its CSNE sweeps can
+    reuse the combine R; one spelling for both so the paths cannot
+    numerically diverge."""
     H2, alpha2 = _blocked_qr_impl(Rstack, nb, precision=precision,
                                   pallas=pallas, pallas_interpret=interpret,
                                   pallas_flat=pallas_flat,
                                   trailing_precision=trailing_precision)
     c2 = _apply_qt_impl(H2, cstack, nb, precision=precision)
+    return H2, alpha2, c2
+
+
+def _combine_solve(Rstack, cstack, nb, precision, pallas=False,
+                   interpret=False, pallas_flat=None,
+                   trailing_precision=None):
+    """Combine stage: QR the stacked heads, then solve R x = (Q^H c)[:n]."""
+    H2, alpha2, c2 = _combine_factor(Rstack, cstack, nb, precision, pallas,
+                                     interpret, pallas_flat,
+                                     trailing_precision)
     return back_substitute(H2, alpha2, c2)
 
 

@@ -25,32 +25,70 @@ from dhqr_tpu.utils.compat import shard_map
 # disarmed (see parallel/sharded_qr.py).
 from dhqr_tpu.obs import pulse as _pulse
 
+# dhqr-wire (round 18) compression seam (DHQR009). The Gram psums are
+# DENSE reductions (every device contributes), so the bf16 rung adds
+# in bf16 at ring depth <= P-1 — same order as the quantization error
+# at P <= 8 — and the int8 rung degrades to bf16 at the seam
+# (per-device scales cannot ride an additive reduction).
+from dhqr_tpu.parallel import wire as _wire
+
 from dhqr_tpu.ops.cholqr import _cholqr_passes
 from dhqr_tpu.ops.solve import as_matrix_rhs
 from dhqr_tpu.ops.householder import DEFAULT_PRECISION
 from dhqr_tpu.parallel.sharded_tsqr import ROW_AXIS
 
 
-def _cholqr_shard_body(Al, bl, *, axis: str, precision: str, shift: bool):
+def _cholqr_shard_body(Al, bl, *, axis: str, precision: str, shift: bool,
+                       comms: "str | None" = None):
     """Per-device rows of A; returns x replicated.
 
     Pass structure is :func:`dhqr_tpu.ops.cholqr._cholqr_passes` — shared
     with the single-device engine — with the Gram matrix reduced by one
     psum per pass (replicated, so the Cholesky is deterministic everywhere).
     """
-    gram = lambda X: lax.psum(
-        jnp.matmul(jnp.conj(X.T), X, precision=precision), axis
+    gram = lambda X: _wire.wire_psum(
+        jnp.matmul(jnp.conj(X.T), X, precision=precision), axis, comms,
+        onehot=False,
     )
     Ql, R = _cholqr_passes(Al, gram, precision, shift)
     Bl, restore = as_matrix_rhs(bl)
-    C = lax.psum(jnp.matmul(jnp.conj(Ql.T), Bl, precision=precision), axis)
-    return restore(lax.linalg.triangular_solve(R, C, left_side=True, lower=False))
+    C = _wire.wire_psum(
+        jnp.matmul(jnp.conj(Ql.T), Bl, precision=precision), axis, comms,
+        onehot=False)
+    x = lax.linalg.triangular_solve(R, C, left_side=True, lower=False)
+    if comms is not None:
+        # Compressed Gram psums round R to ~wire eps, which the raw
+        # solve cannot buy back — run CSNE_SWEEPS corrected-semi-normal
+        # sweeps against the true local rows (residual matvec exact in
+        # f32; the (n, nrhs) correction reduction rides the compressed
+        # wire as a second-order term — cost_model.cholqr_lstsq_wire).
+        def sns(g):
+            y = lax.linalg.triangular_solve(
+                R, g, left_side=True, lower=False, transpose_a=True,
+                conjugate_a=True)
+            return lax.linalg.triangular_solve(R, y, left_side=True,
+                                               lower=False)
+
+        for _ in range(_wire.CSNE_SWEEPS):
+            r_loc = Bl - jnp.matmul(Al, x, precision="highest")
+            # The (n, nrhs) correction reduction stays on the F32 wire
+            # (comms=None is the seam's exact passthrough): quantizing
+            # it would cap the sweep's contraction at the wire eps it
+            # exists to remove; its volume is O(1/n) of the Gram psums
+            # (priced by cost_model.cholqr_lstsq_wire).
+            g = _wire.wire_psum(
+                jnp.matmul(jnp.conj(Al.T), r_loc, precision="highest"),
+                axis, None, onehot=False)
+            x = x + sns(g)
+    return restore(x)
 
 
 @lru_cache(maxsize=None)
-def _build_cholqr(mesh: Mesh, axis_name: str, precision: str, shift: bool):
+def _build_cholqr(mesh: Mesh, axis_name: str, precision: str, shift: bool,
+                  comms: "str | None" = None):
     body = partial(
-        _cholqr_shard_body, axis=axis_name, precision=precision, shift=shift
+        _cholqr_shard_body, axis=axis_name, precision=precision, shift=shift,
+        comms=comms,
     )
     return jax.jit(
         shard_map(
@@ -70,6 +108,7 @@ def sharded_cholqr_lstsq(
     axis_name: str = ROW_AXIS,
     precision: str = DEFAULT_PRECISION,
     shift: bool = False,
+    comms: "str | None" = None,
 ) -> jax.Array:
     """Distributed least squares via CholeskyQR2: rows sharded, three psums
     (four with ``shift=True``, the shifted-CholeskyQR3 wide-window form).
@@ -78,6 +117,7 @@ def sharded_cholqr_lstsq(
     conditioning window as :func:`dhqr_tpu.ops.cholqr.cholesky_qr2` —
     prefer :func:`sharded_tsqr_lstsq` for ill-conditioned problems.
     """
+    comms = _wire.resolve_comms(comms)
     m, n = A.shape
     if m < n:
         raise ValueError(f"lstsq requires m >= n, got {A.shape}")
@@ -86,16 +126,18 @@ def sharded_cholqr_lstsq(
         raise ValueError(f"m={m} must be divisible by mesh size {nproc}")
     A = jax.device_put(A, NamedSharding(mesh, P(axis_name, None)))
     b = jax.device_put(b, NamedSharding(mesh, P(axis_name)))
-    fn = _build_cholqr(mesh, axis_name, precision, bool(shift))
+    fn = _build_cholqr(mesh, axis_name, precision, bool(shift), comms)
     if _pulse.active() is None:
         return fn(A, b)
     return _pulse.observed_dispatch(
         f"cholqr_lstsq[P={nproc},{m}x{n}" + (",shift" if shift else "")
-        + "]",
+        + (f",w{comms}" if comms else "") + "]",
         lambda: fn(A, b), abstract=lambda: jax.make_jaxpr(fn)(A, b),
-        n_devices=nproc)
+        n_devices=nproc, wire_format=comms)
 
 
 # Comms contract (dhqr-audit): psum only, 2*n^2 + n*nrhs words per
 # solve (analysis/cost_model.py `cholqr_lstsq`) — the m-independence IS
 # the engine's value, so a volume regression here is a DHQR302 finding.
+# The COMPRESSED variant adds CSNE_SWEEPS correction psums and halves
+# the wire bytes (round 18 — `cholqr_lstsq_wire` model).

@@ -43,6 +43,12 @@ from dhqr_tpu.utils.compat import shard_map
 # is one module-global None check — the faults/obs discipline.
 from dhqr_tpu.obs import pulse as _pulse
 
+# dhqr-wire (round 18): EVERY collective below routes through the
+# compression seam — comms=None is a verbatim lax passthrough, so the
+# accurate tier stays bit-identical by construction; dhqr-lint DHQR009
+# rejects raw lax collectives in this package.
+from dhqr_tpu.parallel import wire as _wire
+
 from dhqr_tpu.ops.blocked import (
     MAX_UNROLLED_PANELS,
     _factor_group,
@@ -107,7 +113,7 @@ def _panel_owner_traced(kb, P: int, nloc: int, nb: int, layout: str):
 def _unblocked_shard_body(
     Al, *, n: int, axis: str,
     precision: str = DEFAULT_PRECISION, layout: str = "block", store_nb: int = 1,
-    norm: str = "accurate",
+    norm: str = "accurate", comms: "str | None" = None,
 ):
     """Per-device body: Al is the local (m, nloc) column block.
 
@@ -133,8 +139,11 @@ def _unblocked_shard_body(
             mine = (j >= delta_j) & (j < delta_j + nloc)
         col_local = lax.dynamic_slice_in_dim(Al, jl, 1, axis=1)[:, 0]
         # Broadcast = all-reduce of a one-hot contribution (reference's
-        # per-column Hj serialization to every worker, src:138-143).
-        col = lax.psum(jnp.where(mine, col_local, jnp.zeros_like(col_local)), axis)
+        # per-column Hj serialization to every worker, src:138-143),
+        # over the comms wire format (exact accumulation: zeros).
+        col = _wire.wire_psum(
+            jnp.where(mine, col_local, jnp.zeros_like(col_local)), axis,
+            comms)
         v, alpha_j = householder_reflector(col, j, norm)
         newcol = jnp.where(rows >= j, v, col)
         Al_upd = lax.dynamic_update_slice_in_dim(Al, newcol[:, None], jl, axis=1)
@@ -157,7 +166,7 @@ def _blocked_shard_body(
     norm: str = "accurate", pallas: bool = False, pallas_interpret: bool = False,
     panel_impl: str = "loop", pallas_flat: "int | None" = None,
     trailing_precision: "str | None" = None, lookahead: bool = False,
-    agg_panels: "int | None" = None,
+    agg_panels: "int | None" = None, comms: "str | None" = None,
 ):
     """Per-device body for the compact-WY engine.
 
@@ -200,7 +209,8 @@ def _blocked_shard_body(
         return _panel_factor(panel, off, precision, norm, panel_impl)
 
     def _psum_owner(x, mine):
-        return lax.psum(jnp.where(mine, x, jnp.zeros_like(x)), axis)
+        return _wire.wire_psum(jnp.where(mine, x, jnp.zeros_like(x)),
+                               axis, comms)
 
     if agg_panels and agg_panels > 1 and num_panels > 1:
         # With lookahead too, this is the GROUPED-lookahead composition
@@ -209,6 +219,7 @@ def _blocked_shard_body(
             Al, n=n, nb=nb, k=agg_panels, axis=axis, precision=precision,
             layout=layout, factor=_factor, done_cols=_done_cols, tprec=tprec,
             gidx_base=gidx_base, p=p, nproc=nproc, lookahead=lookahead,
+            comms=comms,
         )
 
     if lookahead and num_panels > 1:
@@ -242,9 +253,10 @@ def _blocked_shard_body(
                 pf, alpha_k = _panel_factor(panel, 0, precision, norm,
                                             panel_impl)
             zero = jnp.zeros_like(pf)
-            pf = lax.psum(jnp.where(mine, pf, zero), axis)
-            alpha_k = lax.psum(
-                jnp.where(mine, alpha_k, jnp.zeros_like(alpha_k)), axis
+            pf = _wire.wire_psum(jnp.where(mine, pf, zero), axis, comms)
+            alpha_k = _wire.wire_psum(
+                jnp.where(mine, alpha_k, jnp.zeros_like(alpha_k)), axis,
+                comms
             )
             alpha = alpha.at[k : k + b].set(alpha_k)
             # Owner writes the factored panel back into its block.
@@ -286,9 +298,11 @@ def _blocked_shard_body(
 
                 pf, alpha_k = _panel_factor(panel, c, precision, norm,
                                             panel_impl)
-            pf = lax.psum(jnp.where(mine, pf, jnp.zeros_like(pf)), axis)
-            alpha_k = lax.psum(
-                jnp.where(mine, alpha_k, jnp.zeros_like(alpha_k)), axis
+            pf = _wire.wire_psum(jnp.where(mine, pf, jnp.zeros_like(pf)),
+                                 axis, comms)
+            alpha_k = _wire.wire_psum(
+                jnp.where(mine, alpha_k, jnp.zeros_like(alpha_k)), axis,
+                comms
             )
             Sl_upd = lax.dynamic_update_slice(Sl, pf, (jnp.int32(0), kl))
             Sl = jnp.where(mine, Sl_upd, Sl)
@@ -431,7 +445,7 @@ def _blocked_shard_lookahead(
 
 def _blocked_shard_agg(
     Al, *, n, nb, k, axis, precision, layout, factor, done_cols,
-    tprec, gidx_base, p, nproc, lookahead=False,
+    tprec, gidx_base, p, nproc, lookahead=False, comms=None,
 ):
     """Aggregated-trailing-update order for the sharded compact-WY body.
 
@@ -488,7 +502,9 @@ def _blocked_shard_agg(
                 contrib = lax.dynamic_update_slice(
                     contrib, jnp.where(mine, loc, jnp.zeros_like(loc)),
                     (jnp.int32(0), jnp.int32(j * nb)))
-            return lax.psum(contrib, axis)
+            # One-hot per column block: the psum adds zeros, so the
+            # wire format never touches the accumulation.
+            return _wire.wire_psum(contrib, axis, comms)
 
     def scatter(Sl, G, owners):
         """Owners write their factored panels back into the local slice."""
@@ -639,12 +655,12 @@ def _blocked_shard_agg(
 @lru_cache(maxsize=None)
 def _build_unblocked(
     mesh: Mesh, axis_name: str, n: int, precision: str, layout: str,
-    store_nb: int, norm: str = "accurate",
+    store_nb: int, norm: str = "accurate", comms: "str | None" = None,
 ):
     body = partial(
         _unblocked_shard_body,
         n=n, axis=axis_name, precision=precision, layout=layout,
-        store_nb=store_nb, norm=norm,
+        store_nb=store_nb, norm=norm, comms=comms,
     )
     return jax.jit(
         shard_map(
@@ -663,7 +679,7 @@ def _build_blocked(
     norm: str = "accurate", pallas: bool = False, pallas_interpret: bool = False,
     panel_impl: str = "loop", pallas_flat: "int | None" = None,
     trailing_precision: "str | None" = None, lookahead: bool = False,
-    agg_panels: "int | None" = None,
+    agg_panels: "int | None" = None, comms: "str | None" = None,
 ):
     body = partial(
         _blocked_shard_body,
@@ -671,7 +687,7 @@ def _build_blocked(
         norm=norm, pallas=pallas, pallas_interpret=pallas_interpret,
         panel_impl=panel_impl, pallas_flat=pallas_flat,
         trailing_precision=trailing_precision, lookahead=lookahead,
-        agg_panels=agg_panels,
+        agg_panels=agg_panels, comms=comms,
     )
     return jax.jit(
         shard_map(
@@ -746,6 +762,7 @@ def sharded_householder_qr(
     store_nb: int = 1,
     _store_layout_output: bool = False,
     norm: str = "accurate",
+    comms: "str | None" = None,
 ):
     """Unblocked distributed QR: ``(H, alpha)`` with H column-sharded.
 
@@ -761,6 +778,7 @@ def sharded_householder_qr(
     (``store_nb`` sets the cyclic store's block width so a downstream solve
     can share the storage order — see ``lstsq``'s unblocked mesh path).
     """
+    comms = _wire.resolve_comms(comms)
     m, n = A.shape
     nproc = mesh.shape[axis_name]
     if layout == "block":
@@ -779,6 +797,7 @@ def sharded_householder_qr(
         H, alpha = sharded_householder_qr(
             _pad_cols_orthogonal(A, n_pad), mesh, axis_name=axis_name,
             precision=precision, layout=layout, store_nb=store_nb, norm=norm,
+            comms=comms,
         )
         return H[:m, :n], alpha[:n]
     if n > 512:
@@ -798,15 +817,16 @@ def sharded_householder_qr(
     A = _to_store_layout(A, n, nproc, store_nb, layout)
     A = jax.device_put(A, column_sharding(mesh, axis_name))
     fn = _build_unblocked(
-        mesh, axis_name, n, precision, layout, store_nb, norm
+        mesh, axis_name, n, precision, layout, store_nb, norm, comms
     )
     if _pulse.active() is None:
         H, alpha = fn(A)
     else:
         H, alpha = _pulse.observed_dispatch(
-            f"unblocked_qr[P={nproc},{m}x{n},{layout}]",
+            f"unblocked_qr[P={nproc},{m}x{n},{layout}"
+            + (f",w{comms}" if comms else "") + "]",
             lambda: fn(A), abstract=lambda: jax.make_jaxpr(fn)(A),
-            n_devices=nproc)
+            n_devices=nproc, wire_format=comms)
     if not _store_layout_output:
         H = _to_natural_layout(H, n, nproc, store_nb, layout)
     return H, alpha
@@ -826,6 +846,7 @@ def sharded_blocked_qr(
     trailing_precision: "str | None" = None,
     lookahead: bool = False,
     agg_panels: "int | None" = None,
+    comms: "str | None" = None,
     policy=None,
 ):
     """Compact-WY distributed QR: one psum per panel, GEMM trailing updates.
@@ -850,14 +871,22 @@ def sharded_blocked_qr(
     GEMM) — allowed HERE, on the mesh, where the overlap has a collective
     to hide; the single-device tiers keep rejecting the pair.
 
-    ``policy`` (a :class:`dhqr_tpu.precision.PrecisionPolicy`, preset name
-    or spec string) sets ``precision``/``trailing_precision`` together,
-    mutually exclusive with passing them explicitly; the solve-stage
-    fields (``apply``, ``refine``) do not apply to a factor-only entry
-    point and are ignored by contract.
-    """
-    from dhqr_tpu.precision import apply_policy_to_factor_args
+    ``comms`` (usually set via ``policy``) names the collective wire
+    format: ``"bf16"``/``"int8"`` compress every panel-broadcast psum
+    through :mod:`dhqr_tpu.parallel.wire` (accumulation exact — the
+    broadcasts are one-hot), ``None`` keeps the program bit-identical
+    to the uncompressed tier.
 
+    ``policy`` (a :class:`dhqr_tpu.precision.PrecisionPolicy`, preset name
+    or spec string) sets ``precision``/``trailing_precision``/``comms``
+    together, mutually exclusive with passing them explicitly; the
+    solve-stage fields (``apply``, ``refine``) do not apply to a
+    factor-only entry point and are ignored by contract.
+    """
+    from dhqr_tpu.precision import (apply_policy_to_comms_arg,
+                                    apply_policy_to_factor_args)
+
+    comms = apply_policy_to_comms_arg(policy, comms)
     precision, trailing_precision = apply_policy_to_factor_args(
         policy, precision, trailing_precision,
         default_precision=DEFAULT_PRECISION)
@@ -900,7 +929,7 @@ def sharded_blocked_qr(
             axis_name=axis_name, precision=precision, layout=layout,
             norm=norm, use_pallas=use_pallas, panel_impl=panel_impl,
             trailing_precision=trailing_precision, lookahead=lookahead,
-            agg_panels=agg_panels,
+            agg_panels=agg_panels, comms=comms,
         )
         return H[:m, :n], alpha[:n]
     _check_divisibility(m, n, nproc, nb, layout)
@@ -924,18 +953,19 @@ def sharded_blocked_qr(
         fn = _build_blocked(
             mesh, axis_name, n, nb, precision, layout, norm, pallas, interp,
             panel_impl, PALLAS_FLAT_WIDTH, trailing_precision, lookahead,
-            agg_panels,
+            agg_panels, comms,
         )
         if _pulse.active() is None:
             H, alpha = fn(A)
         else:
             sched = ("la" if lookahead else "") + (
                 f"agg{agg_panels}" if agg_panels else "")
+            tags = (f",{sched}" if sched else "") + (
+                f",w{comms}" if comms else "")
             H, alpha = _pulse.observed_dispatch(
-                f"blocked_qr[P={nproc},{m}x{n},nb={nb},{layout}"
-                + (f",{sched}" if sched else "") + "]",
+                f"blocked_qr[P={nproc},{m}x{n},nb={nb},{layout}{tags}]",
                 lambda: fn(A), abstract=lambda: jax.make_jaxpr(fn)(A),
-                n_devices=nproc)
+                n_devices=nproc, wire_format=comms)
     if not _store_layout_output:
         H = _to_natural_layout(H, n, nproc, nb, layout)
     return H, alpha
@@ -968,4 +998,7 @@ def _check_divisibility(m, n, nproc, nb, layout="block"):
 # panel-broadcast budget in analysis/cost_model.py. A gather of the
 # trailing matrix, an all_to_all from a layout change, or a replicated
 # intermediate past the per-shard working set fails tools/lint.sh
-# (DHQR301/302/303) before it can burn a TPU session.
+# (DHQR301/302/303) before it can burn a TPU session. With a comms
+# wire format the SAME psums cross as bf16/int8 and the compressed
+# contracts (blocked/unblocked_qr_wire_*) hold the volume at the wire
+# itemsize x tight slack — the >= 1.8x reduction, machine-enforced.

@@ -34,6 +34,10 @@ from dhqr_tpu.utils.compat import shard_map
 # disarmed (see parallel/sharded_qr.py).
 from dhqr_tpu.obs import pulse as _pulse
 
+# dhqr-wire (round 18) compression seam — every collective below
+# routes through it (DHQR009); comms=None is a verbatim passthrough.
+from dhqr_tpu.parallel import wire as _wire
+
 from dhqr_tpu.ops.blocked import (
     MAX_UNROLLED_PANELS,
     _panels_schedule,
@@ -47,6 +51,7 @@ from dhqr_tpu.parallel.mesh import DEFAULT_AXIS, column_sharding, replicated_sha
 def _apply_qt_shard_body(
     Hl, b, *, n: int, nb: int, axis: str,
     precision: str = DEFAULT_PRECISION, layout: str = "block",
+    comms: "str | None" = None,
 ):
     """b <- Q^H b, panel by panel; Hl is the local (m, nloc) block.
 
@@ -72,7 +77,8 @@ def _apply_qt_shard_body(
             owner, kl = _panel_owner(k, n, nloc, nb, layout)
             mine = p == owner
             panel = jnp.tril(lax.slice(Hl, (k, kl), (m, kl + bsz)))
-            panel = lax.psum(jnp.where(mine, panel, jnp.zeros_like(panel)), axis)
+            panel = _wire.wire_psum(
+                jnp.where(mine, panel, jnp.zeros_like(panel)), axis, comms)
             tail = lax.slice(B, (k, 0), B.shape)
             B = B.at[k:, :].set(apply_block_reflector_h(panel, tail, precision))
         return B[:, 0] if vec else B
@@ -97,7 +103,8 @@ def _apply_qt_shard_body(
             Y = shifted_tril(
                 lax.dynamic_slice(Hl, (jnp.int32(K), kl), (ms, nb)), c
             )
-            Y = lax.psum(jnp.where(mine, Y, jnp.zeros_like(Y)), axis)
+            Y = _wire.wire_psum(jnp.where(mine, Y, jnp.zeros_like(Y)),
+                                axis, comms)
             # Y is zero above row c, so only rows c: of Bs change.
             return apply_block_reflector_h(Y, Bs, precision), None
 
@@ -109,6 +116,7 @@ def _apply_qt_shard_body(
 def _backsub_shard_body(
     Hl, alpha, c, *, n: int, nb: int, axis: str,
     precision: str = DEFAULT_PRECISION, layout: str = "block",
+    comms: "str | None" = None,
 ):
     """Solve R x = c[:n]; R packed in (Hl strict upper, alpha). Returns x.
 
@@ -152,7 +160,8 @@ def _backsub_shard_body(
             packed = jnp.concatenate(
                 [delta, xp, jnp.zeros((n - k - bsz, xp.shape[1]), C.dtype)]
             )
-            packed = lax.psum(jnp.where(mine, packed, jnp.zeros_like(packed)), axis)
+            packed = _wire.wire_psum(
+                jnp.where(mine, packed, jnp.zeros_like(packed)), axis, comms)
             x = jnp.where((rows_n >= k) & (rows_n < k + bsz), packed, x)
             C = jnp.where(rows_n < k, C - packed, C)
         return x[:, 0] if vec else x
@@ -188,8 +197,8 @@ def _backsub_shard_body(
             above = jnp.where(rows_e < k, strip, jnp.zeros_like(strip))
             delta = jnp.matmul(above, xp, precision=precision)  # (Ke, nrhs)
             packed = lax.dynamic_update_slice(delta, xp, (k, jnp.int32(0)))
-            packed = lax.psum(
-                jnp.where(mine, packed, jnp.zeros_like(packed)), axis
+            packed = _wire.wire_psum(
+                jnp.where(mine, packed, jnp.zeros_like(packed)), axis, comms
             )
             xs = jnp.where((rows_e >= k) & (rows_e < k + nb), packed, xs)
             Cs = jnp.where(rows_e < k, Cs - packed, Cs)
@@ -206,15 +215,18 @@ def _backsub_shard_body(
 
 @lru_cache(maxsize=None)
 def _build_solve(
-    mesh: Mesh, axis_name: str, n: int, nb: int, precision: str, layout: str
+    mesh: Mesh, axis_name: str, n: int, nb: int, precision: str, layout: str,
+    comms: "str | None" = None,
 ):
     def full(Hl, alpha, b):
         cb = _apply_qt_shard_body(
-            Hl, b, n=n, nb=nb, axis=axis_name, precision=precision, layout=layout
+            Hl, b, n=n, nb=nb, axis=axis_name, precision=precision,
+            layout=layout, comms=comms,
         )
         return _backsub_shard_body(
             Hl, alpha, cb,
             n=n, nb=nb, axis=axis_name, precision=precision, layout=layout,
+            comms=comms,
         )
 
     return jax.jit(
@@ -238,6 +250,7 @@ def sharded_solve(
     precision: str = DEFAULT_PRECISION,
     layout: str = "block",
     _H_in_store_layout: bool = False,
+    comms: "str | None" = None,
 ) -> jax.Array:
     """x = argmin ||A x - b|| from the sharded packed factorization.
 
@@ -253,6 +266,7 @@ def sharded_solve(
         _to_store_layout,
     )
 
+    comms = _wire.resolve_comms(comms)
     m, n = H.shape
     nproc = mesh.shape[axis_name]
     nb, n_pad = plan_padding(n, nproc, block_size)
@@ -278,7 +292,7 @@ def sharded_solve(
             b = jnp.pad(b, pad_b)
         x = sharded_solve(
             H, alpha, b, mesh, block_size=nb, axis_name=axis_name,
-            precision=precision, layout=layout,
+            precision=precision, layout=layout, comms=comms,
         )
         return x[:n]
     _check_divisibility(m, n, nproc, nb, layout)
@@ -287,14 +301,15 @@ def sharded_solve(
     H = jax.device_put(H, column_sharding(mesh, axis_name))
     alpha = jax.device_put(alpha, replicated_sharding(mesh))
     b = jax.device_put(b, replicated_sharding(mesh))
-    fn = _build_solve(mesh, axis_name, n, nb, precision, layout)
+    fn = _build_solve(mesh, axis_name, n, nb, precision, layout, comms)
     if _pulse.active() is None:
         return fn(H, alpha, b)
     return _pulse.observed_dispatch(
-        f"sharded_solve[P={nproc},{m}x{n},nb={nb},{layout}]",
+        f"sharded_solve[P={nproc},{m}x{n},nb={nb},{layout}"
+        + (f",w{comms}" if comms else "") + "]",
         lambda: fn(H, alpha, b),
         abstract=lambda: jax.make_jaxpr(fn)(H, alpha, b),
-        n_devices=nproc)
+        n_devices=nproc, wire_format=comms)
 
 
 def sharded_lstsq(
@@ -312,6 +327,7 @@ def sharded_lstsq(
     lookahead: bool = False,
     agg_panels: "int | None" = None,
     apply_precision: "str | None" = None,
+    comms: "str | None" = None,
     policy=None,
 ) -> jax.Array:
     """One-shot distributed least squares: factor + solve on the mesh.
@@ -339,9 +355,11 @@ def sharded_lstsq(
         _pad_cols_orthogonal,
         sharded_blocked_qr,
     )
-    from dhqr_tpu.precision import (apply_policy_to_factor_args,
+    from dhqr_tpu.precision import (apply_policy_to_comms_arg,
+                                    apply_policy_to_factor_args,
                                     resolve_policy)
 
+    comms = apply_policy_to_comms_arg(policy, comms)
     if policy is not None:
         if apply_precision is not None:
             raise ValueError(
@@ -373,16 +391,18 @@ def sharded_lstsq(
         layout=layout, _store_layout_output=True, norm=norm,
         use_pallas=use_pallas, panel_impl=panel_impl,
         trailing_precision=trailing_precision, lookahead=lookahead,
-        agg_panels=agg_panels,
+        agg_panels=agg_panels, comms=comms,
     )
     x = sharded_solve(
         H, alpha, b, mesh,
         block_size=nb, axis_name=axis_name, precision=apply_precision,
-        layout=layout, _H_in_store_layout=True,
+        layout=layout, _H_in_store_layout=True, comms=comms,
     )
     return x[:n]
 
 
 # Comms contract (dhqr-audit): psum only — one shrinking (m-k, nb)
 # panel psum per apply panel plus one packed (n, nrhs) psum per
-# back-substitution panel (analysis/cost_model.py `sharded_solve`).
+# back-substitution panel (analysis/cost_model.py `sharded_solve`);
+# compressed: the same psums at the wire itemsize
+# (sharded_solve_wire_bf16, round 18).

@@ -28,6 +28,10 @@ from dhqr_tpu.utils.compat import shard_map
 # disarmed (see parallel/sharded_qr.py).
 from dhqr_tpu.obs import pulse as _pulse
 
+# dhqr-wire (round 18) compression seam (DHQR009): the combine-tree
+# gather may cross the wire as bf16/int8; comms=None is a passthrough.
+from dhqr_tpu.parallel import wire as _wire
+
 from dhqr_tpu.ops.householder import DEFAULT_PRECISION
 from dhqr_tpu.ops.solve import as_matrix_rhs
 from dhqr_tpu.ops.tsqr import _combine_solve, _leaf_factor
@@ -48,32 +52,75 @@ def row_mesh(
 
 def _tsqr_shard_body(Al, bl, *, n: int, nb: int, axis: str, precision: str,
                      pallas: bool = False, interpret: bool = False,
-                     pallas_flat: "int | None" = None):
+                     pallas_flat: "int | None" = None,
+                     comms: "str | None" = None):
     """Per-device: local QR + Q^H b, then replicated combine of the R heads.
 
     Leaf and combine stages are shared with the single-device tree
     (ops/tsqr) so the two paths cannot numerically diverge.
     """
+    import jax.numpy as jnp
+
     Bl, restore = as_matrix_rhs(bl)
     R, c = _leaf_factor(Al, Bl, nb, precision, pallas, interpret,
                         pallas_flat)
-    # ONE collective: gather every device's heads (P*n rows — tiny traffic).
-    Rstack = lax.all_gather(R, axis).reshape(-1, n)
-    cstack = lax.all_gather(c, axis).reshape(-1, c.shape[1])
+    # ONE collective: gather every device's heads (P*n rows — tiny
+    # traffic), over the comms wire format (a gather concatenates — no
+    # accumulation at any rung; the combine QR below stays f32).
+    Rstack = _wire.wire_all_gather(R, axis, comms).reshape(-1, n)
+    cstack = _wire.wire_all_gather(c, axis, comms).reshape(-1, c.shape[1])
     # Combine stage, replicated on every device (cheaper than a second
     # collective to scatter the result — same trade as the reference making
     # alpha a SharedArray, src:302).
-    return restore(_combine_solve(Rstack, cstack, nb, precision, pallas,
-                                  interpret, pallas_flat))
+    if comms is None:
+        return restore(_combine_solve(Rstack, cstack, nb, precision,
+                                      pallas, interpret, pallas_flat))
+    # Compressed wire: the gathered heads carry ~wire-eps rounding, so
+    # the raw combine solve cannot hold the 8x normal-equations bar on
+    # its own. Run the combine through the SHARED factored form
+    # (ops/tsqr._combine_factor — same spelling as _combine_solve, so
+    # the paths cannot numerically diverge) keeping its R, then
+    # CSNE_SWEEPS corrected-semi-normal sweeps against the TRUE local
+    # rows: x += (R^H R)^{-1} A^H (b - A x) — residual matvec exact in
+    # f32, the (n, nrhs) correction reduction priced by
+    # cost_model.tsqr_lstsq_wire.
+    from dhqr_tpu.ops.solve import back_substitute, r_matrix
+    from dhqr_tpu.ops.tsqr import _combine_factor
+
+    H2, alpha2, c2 = _combine_factor(Rstack, cstack, nb, precision,
+                                     pallas, interpret, pallas_flat)
+    x = back_substitute(H2, alpha2, c2)
+    Rt = r_matrix(H2, alpha2)
+
+    def sns(g):
+        y = lax.linalg.triangular_solve(Rt, g, left_side=True, lower=False,
+                                        transpose_a=True, conjugate_a=True)
+        return lax.linalg.triangular_solve(Rt, y, left_side=True,
+                                           lower=False)
+
+    for _ in range(_wire.CSNE_SWEEPS):
+        r_loc = Bl - jnp.matmul(Al, x, precision="highest")
+        # The (n, nrhs) correction reduction stays on the F32 wire
+        # (comms=None is the seam's exact passthrough): quantizing the
+        # correction would cap the sweep's contraction at the wire eps
+        # it exists to remove, and its volume is O(1/(P*n)) of the
+        # combine exchange (priced by the *_wire cost models).
+        g = _wire.wire_psum(
+            jnp.matmul(jnp.conj(Al.T), r_loc, precision="highest"),
+            axis, None, onehot=False)
+        x = x + sns(g)
+    return restore(x)
 
 
 @lru_cache(maxsize=None)
 def _build_tsqr(mesh: Mesh, axis_name: str, n: int, nb: int, precision: str,
                 pallas: bool = False, interpret: bool = False,
-                pallas_flat: "int | None" = None):
+                pallas_flat: "int | None" = None,
+                comms: "str | None" = None):
     body = partial(
         _tsqr_shard_body, n=n, nb=nb, axis=axis_name, precision=precision,
         pallas=pallas, interpret=interpret, pallas_flat=pallas_flat,
+        comms=comms,
     )
     return jax.jit(
         shard_map(
@@ -94,6 +141,7 @@ def sharded_tsqr_lstsq(
     axis_name: str = ROW_AXIS,
     precision: str = DEFAULT_PRECISION,
     use_pallas: str = "auto",
+    comms: "str | None" = None,
 ) -> jax.Array:
     """Distributed tall-skinny least squares: rows sharded, one all-gather.
 
@@ -106,6 +154,7 @@ def sharded_tsqr_lstsq(
     from dhqr_tpu.utils.platform import ensure_complex_supported
 
     ensure_complex_supported(A.dtype)
+    comms = _wire.resolve_comms(comms)
     m, n = A.shape
     nproc = mesh.shape[axis_name]
     if m % nproc != 0:
@@ -125,16 +174,20 @@ def sharded_tsqr_lstsq(
 
     with _pallas_cache_guard(interpret):
         fn = _build_tsqr(mesh, axis_name, n, nb, precision, pallas,
-                         interpret, PALLAS_FLAT_WIDTH)
+                         interpret, PALLAS_FLAT_WIDTH, comms)
         if _pulse.active() is None:
             return fn(A, b)
         return _pulse.observed_dispatch(
-            f"tsqr_lstsq[P={nproc},{m}x{n},nb={nb}]",
+            f"tsqr_lstsq[P={nproc},{m}x{n},nb={nb}"
+            + (f",w{comms}" if comms else "") + "]",
             lambda: fn(A, b),
-            abstract=lambda: jax.make_jaxpr(fn)(A, b), n_devices=nproc)
+            abstract=lambda: jax.make_jaxpr(fn)(A, b), n_devices=nproc,
+            wire_format=comms)
 
 
 # Comms contract (dhqr-audit): exactly one all_gather pair per solve —
 # P*n*(n + nrhs) words, independent of m (analysis/cost_model.py
 # `tsqr_lstsq`); any psum/all_to_all here, or a second gather, is a
-# DHQR301/302 finding.
+# DHQR301/302 finding. The COMPRESSED variant (comms set) additionally
+# allows the CSNE_SWEEPS (n, nrhs) correction psums (round 18 —
+# `tsqr_lstsq_wire` model, wire bytes halved/quartered at the gather).

@@ -1,0 +1,198 @@
+"""dhqr-wire — the communication-compression seam under every sharded
+collective (ROADMAP item 3; EQuARX, arXiv 2506.17615; the
+redistribution paper, arXiv 2112.01075).
+
+The sharded engines spend their scaling budget on two collective
+patterns: **one-hot broadcasts** (the owner's panel/column rides a
+``psum`` where every other device contributes exact zeros —
+parallel/sharded_qr, parallel/sharded_solve) and **combine exchanges**
+(TSQR's R-head ``all_gather``, CholeskyQR's dense Gram ``psum``). Both
+move f32 words whose low mantissa bits the downstream math does not
+need at the cheap end of the accuracy ladder. This module is the ONE
+place a collective's *wire format* is chosen:
+
+* ``comms=None`` — the seam is a **verbatim passthrough** to the raw
+  ``lax`` collective: same primitive, same operand, same jaxpr. The
+  ``accurate`` policy keeps ``comms=None``, so its programs are
+  bit-identical to the uncompressed tier *by construction* (pinned by
+  tests/test_wire.py's jaxpr-identity test).
+* ``comms="bf16"`` — the payload crosses the wire as bfloat16 (2
+  bytes/word, ~2x volume cut) and is decompressed to the compute dtype
+  on arrival; every flop before and after the collective stays f32.
+  On the one-hot broadcast paths the reduction adds exact zeros, so
+  the *accumulation is exact* and the only error is the one f32->bf16
+  rounding of the payload itself. On dense reductions (the CholeskyQR
+  Gram psum) the ring adds in bf16 at depth <= P-1 — the same order
+  as the quantization error at the P <= 8 meshes this tier targets,
+  and the existing 8x-LAPACK gates decide admissibility exactly as
+  for the trailing-precision split.
+* ``comms="int8"`` — the second rung: payloads are quantized to int8
+  with **per-(32-row-block, column) f32 scales** (absmax/127 per
+  :data:`INT8_BLOCK_ROWS`-row block of each column — whole-column
+  scales measured eta ~ 1e-2, see the constant's note; a scalar scale
+  for 1-D payloads), riding sidecar collectives of bounded volume
+  (4/:data:`INT8_BLOCK_ROWS` = 12.5% of the payload, absorbed by the
+  int8 contracts' slack). One-hot
+  reductions of int8 are exact (sums of zeros, no overflow —
+  contributions are zero except the owner's); **dense reductions
+  refuse the int8 rung and cap at bf16** (per-device scales cannot
+  ride an additive reduction), as do complex dtypes on either rung
+  (no bf16 complex storage format) — both degrade LOUDLY in the
+  traced volume the DHQR302 budgets check, never silently in
+  accuracy.
+
+dhqr-audit enforces the claimed reduction (compressed-mode budgets in
+``analysis/comms_contracts.json`` with tightened slack: DHQR302 fails
+if a compressed engine stops moving ~2x fewer traced bytes), dhqr-lint
+DHQR009 keeps every sharded collective in ``dhqr_tpu/parallel/``
+routed through this seam, and dhqr-pulse's DHQR306 runtime contract
+reads the compressed avals straight from the traced census (the wire
+volume IS the compressed volume — obs/netmodel).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+# The mode vocabulary lives in the jax-free precision module (shared
+# with the stdlib-only analysis tier); re-exported here so the seam is
+# self-contained for its callers.
+from dhqr_tpu.precision import COMMS_MODES, WIRE_ITEMSIZE, resolve_comms
+
+__all__ = [
+    "COMMS_MODES",
+    "CSNE_SWEEPS",
+    "WIRE_ITEMSIZE",
+    "resolve_comms",
+    "wire_all_gather",
+    "wire_psum",
+]
+
+#: Corrected-semi-normal-equation sweeps the row-sharded engines run
+#: when (and only when) their combine exchange is compressed: the
+#: quantized R factor alone cannot hold the repo's 8x normal-equations
+#: bar (wire rounding is ~bf16 eps), so each compressed solve is
+#: followed by this many ``x += (R^H R)^{-1} A^H (b - A x)`` sweeps —
+#: the residual matvec exact in f32 on the local rows, the tiny (n,
+#: nrhs) correction reduction riding the compressed wire as a
+#: SECOND-order term. Two sweeps contract the error by (cond * eta)^2,
+#: the same recovery budget Björck's CSNE gives ``solvers.update``.
+#: The column-sharded engines do not need this knob: their refinement
+#: lives in the model tier (``qr(policy.refine)`` loops the sharded
+#: solve against the true A).
+CSNE_SWEEPS = 2
+
+#: Model-tier recovery floor per wire format (``qr_model.lstsq`` on a
+#: mesh): the compressed column engines refine by at least this many
+#: CSNE sweeps. int8's quantization step is coarser than bf16's
+#: rounding even with block scales, so its stationary iteration needs
+#: two more contractions to hold the 8x bar at the cond ~ 40 matrices
+#: the acceptance grid sweeps (measured: bf16 converges in 2, int8 in
+#: 4). The row engines keep the flat in-body :data:`CSNE_SWEEPS` —
+#: their combine exchange quantizes once (no per-panel accumulation of
+#: wire error), and both rungs measured within the bar at 2.
+CSNE_MODEL_SWEEPS = {"bf16": 2, "int8": 4}
+
+
+def _compressible(x) -> bool:
+    """Only real floating payloads compress: complex has no bf16
+    storage format, and integer payloads never ride these paths."""
+    return jnp.issubdtype(x.dtype, jnp.floating)
+
+
+#: Rows per int8 scale block (EQuARX-style block scaling). A factored
+#: panel mixes O(sqrt(m))-magnitude R rows with O(1) reflector rows in
+#: the same column; one whole-column scale quantizes the reflectors
+#: against the R magnitude (measured: eta ~ 1e-2, CSNE recovery
+#: diverging at cond ~ 40), while per-32-row blocks keep every scale
+#: local (eta back at the ~1/254 step, bf16-level) for a 4/32 = 12.5%
+#: scale-sidecar overhead the int8 contract slack absorbs.
+INT8_BLOCK_ROWS = 32
+
+
+def _quant_int8(x):
+    """Symmetric int8 quantization with per-(row-block, column) scales
+    for matrices (a scalar scale for 1-D payloads): absmax/127 per
+    :data:`INT8_BLOCK_ROWS`-row block so the full int8 range is used
+    locally. Returns ``(q int8, scale f32-like)``; ``scale`` has shape
+    ``(ceil(rows/B), cols)`` for 2-D ``x``."""
+    if x.ndim == 2:
+        r, c = x.shape
+        # Clamp the block to the row count: padding an r-row payload to
+        # a full 32-row block would inflate the dequant intermediate up
+        # to 4x for small heads — exactly the shard_map-body blow-up
+        # DHQR303 bounds. With the clamp the padded height is < 2r.
+        block = min(INT8_BLOCK_ROWS, max(r, 1))
+        blocks = -(-r // block)
+        pad = blocks * block - r
+        xb = jnp.pad(x, ((0, pad), (0, 0))).reshape(blocks, block, c)
+        absmax = jnp.max(jnp.abs(xb), axis=1)          # (blocks, c)
+        scale = absmax / 127.0
+        safe = jnp.where(scale > 0, scale, jnp.ones_like(scale))
+        q = jnp.clip(jnp.round(xb / safe[:, None, :]), -127, 127)
+        q = q.reshape(blocks * block, c)[:r].astype(jnp.int8)
+        return q, scale
+    absmax = jnp.max(jnp.abs(x)) if x.ndim == 1 else jnp.max(
+        jnp.abs(x), axis=tuple(range(x.ndim - 1)))
+    scale = absmax / 127.0
+    safe = jnp.where(scale > 0, scale, jnp.ones_like(scale))
+    q = jnp.clip(jnp.round(x / safe), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _dequant_int8(q, scale, dtype):
+    if q.ndim == 2 and scale.ndim == 2:
+        r, c = q.shape
+        block = min(INT8_BLOCK_ROWS, max(r, 1))   # same clamp as _quant
+        blocks = scale.shape[0]
+        pad = blocks * block - r
+        qb = jnp.pad(q.astype(dtype), ((0, pad), (0, 0))).reshape(
+            blocks, block, c)
+        out = qb * scale.astype(dtype)[:, None, :]
+        return out.reshape(blocks * block, c)[:r]
+    return q.astype(dtype) * scale.astype(dtype)
+
+
+def wire_psum(x, axis_name, comms=None, *, onehot: bool = True):
+    """``lax.psum`` with the payload compressed to the ``comms`` wire
+    format (decompressed to ``x.dtype`` on return).
+
+    ``onehot=True`` declares the engine invariant that at most ONE
+    device contributes a non-zero ``x`` (the owner's panel broadcast):
+    there the reduction adds exact zeros, so any wire format keeps the
+    accumulation exact and int8's per-column scales can ride their own
+    one-hot psum. ``onehot=False`` (dense reductions — the CholeskyQR
+    Gram) reduces in the wire dtype; the int8 rung is refused there
+    (per-device scales cannot be summed) and degrades to bf16.
+    """
+    if comms is None or not _compressible(x):
+        return lax.psum(x, axis_name)
+    if comms == "int8" and onehot:
+        q, scale = _quant_int8(x)
+        q = lax.psum(q, axis_name)
+        scale = lax.psum(scale, axis_name)
+        return _dequant_int8(q, scale, x.dtype)
+    # bf16 — and int8's dense-reduction fallback.
+    return lax.psum(x.astype(jnp.bfloat16), axis_name).astype(x.dtype)
+
+
+def wire_all_gather(x, axis_name, comms=None):
+    """``lax.all_gather`` with the payload compressed to the ``comms``
+    wire format. A gather is pure concatenation — no accumulation at
+    any rung — so int8 per-column scales apply cleanly: each device
+    quantizes its own share, the (tiny) scales gather alongside, and
+    decompression is local."""
+    if comms is None or not _compressible(x):
+        return lax.all_gather(x, axis_name)
+    if comms == "int8":
+        import jax
+
+        q, scale = _quant_int8(x)
+        qg = lax.all_gather(q, axis_name)
+        sg = lax.all_gather(scale, axis_name)
+        # qg: (P, *x.shape); sg: (P, *scale.shape) — each device's
+        # share decompresses against its own (block, column) scales.
+        return jax.vmap(lambda qq, ss: _dequant_int8(qq, ss, x.dtype))(
+            qg, sg)
+    return lax.all_gather(x.astype(jnp.bfloat16), axis_name).astype(x.dtype)

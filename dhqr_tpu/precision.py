@@ -49,6 +49,29 @@ TRAILING_PRECISIONS = ("highest", "high", "default")
 # effective-FLOP-ceiling model of docs/DESIGN.md (peak_bf16 / passes).
 MXU_PASSES = {"highest": 6, "high": 3, "default": 1, "float32": 6}
 
+# Collective wire formats (dhqr-wire, round 18). Defined HERE — the
+# jax-free module — so the stdlib-only analysis tier (cost_model, the
+# regress gate) and the seam itself (parallel/wire.py, which needs jax)
+# share one vocabulary without an import cycle: precision <- wire <-
+# engines. WIRE_ITEMSIZE is the bytes-per-f32-word factor the
+# compressed DHQR302 budgets are priced with (int8's per-block scale
+# sidecars are absorbed by the contract slack).
+COMMS_MODES = ("bf16", "int8")
+WIRE_ITEMSIZE = {None: None, "bf16": 2, "int8": 1}
+
+
+def resolve_comms(comms) -> "str | None":
+    """Validate/normalize a collective wire format: None (also the
+    explicit "none"/"f32" spellings) keeps the uncompressed wire."""
+    if comms is None or comms in ("none", "f32"):
+        return None
+    if comms not in COMMS_MODES:
+        raise ValueError(
+            f"comms must be one of {COMMS_MODES} or None (uncompressed), "
+            f"got {comms!r}"
+        )
+    return comms
+
 
 @dataclasses.dataclass(frozen=True)
 class PrecisionPolicy:
@@ -69,12 +92,24 @@ class PrecisionPolicy:
         sweep reuses the stored factorization (one full-precision residual
         matvec + one extra solve); the factor-only entry points ignore it
         by contract (a factorization has nothing to refine).
+      comms: wire format of the sharded tier's collectives (dhqr-wire,
+        round 18) — ``None`` keeps the uncompressed f32 wire (programs
+        bit-identical to the pre-seam tier by construction), ``"bf16"``
+        halves the traced collective volume with f32 accumulation
+        everywhere outside the wire, ``"int8"`` quarters it with
+        per-(32-row-block, column) scales on the one-hot
+        broadcast/gather paths (see ``dhqr_tpu.parallel.wire``). Programs with no collectives
+        (single-device engines, the batched serving dispatch) are
+        unaffected by contract. The presets all keep ``comms=None``;
+        compressed comms is selected explicitly, or per-platform by a
+        tuned :class:`dhqr_tpu.tune.Plan` under the 8x-LAPACK gate.
     """
 
     panel: str = "highest"
     trailing: "str | None" = None
     apply: "str | None" = None
     refine: int = 0
+    comms: "str | None" = None
 
     def __post_init__(self):
         for field, value in (("panel", self.panel),
@@ -87,6 +122,7 @@ class PrecisionPolicy:
                 )
         if self.refine < 0:
             raise ValueError(f"refine must be >= 0, got {self.refine}")
+        object.__setattr__(self, "comms", resolve_comms(self.comms))
 
     # -- resolution helpers -------------------------------------------------
     def resolved_trailing(self) -> str:
@@ -127,9 +163,12 @@ def resolve_policy(policy) -> PrecisionPolicy:
     """Accept a policy name, a :class:`PrecisionPolicy`, or a spec string.
 
     Spec strings name the fields positionally, slash-separated:
-    ``"panel"``, ``"panel/trailing"``, ``"panel/trailing/rN"`` — e.g.
-    ``"highest/default/r1"`` is the bf16-trailing + one-refine point. This
-    is the ``DHQR_POLICY`` environment spelling (utils/config.py).
+    ``"panel"``, ``"panel/trailing"``, ``"panel/trailing/rN"``, and —
+    round 18 — a fourth comms-wire segment ``"panel/trailing/rN/bf16"``
+    (a :data:`COMMS_MODES` member; e.g. ``"highest/default/r1/bf16"``
+    is the bf16-trailing + one-refine + bf16-wire point, and
+    ``"highest/bf16"`` compresses the wire only). This is the
+    ``DHQR_POLICY`` environment spelling (utils/config.py).
     """
     if isinstance(policy, PrecisionPolicy):
         return policy
@@ -142,19 +181,27 @@ def resolve_policy(policy) -> PrecisionPolicy:
     if policy in PRECISION_POLICIES:
         return PRECISION_POLICIES[policy]
     parts = policy.split("/")
+    # The comms segment is popped FIRST (it is the last segment when
+    # present); the wire-format names never collide with the MXU
+    # precision names or the rN spelling, so the grammar stays
+    # position-free at the tail.
+    comms = None
+    if parts and parts[-1] in COMMS_MODES:
+        comms = parts.pop()
     refine = 0
     if parts and parts[-1][:1] == "r" and parts[-1][1:].isdigit():
         refine = int(parts.pop()[1:])
     if not parts or len(parts) > 2 or not all(parts):
         raise ValueError(
             f"unknown policy {policy!r}: expected a preset name "
-            f"{sorted(PRECISION_POLICIES)} or 'panel[/trailing][/rN]'"
+            f"{sorted(PRECISION_POLICIES)} or 'panel[/trailing][/rN][/comms]'"
         )
     panel = parts[0]
     trailing = parts[1] if len(parts) == 2 else None
     if trailing == panel:
         trailing = None
-    return PrecisionPolicy(panel=panel, trailing=trailing, refine=refine)
+    return PrecisionPolicy(panel=panel, trailing=trailing, refine=refine,
+                           comms=comms)
 
 
 def escalation_policies(policy=None, *, base_refine: int = 0,
@@ -175,7 +222,8 @@ def escalation_policies(policy=None, *, base_refine: int = 0,
     refine = pol.refine if pol is not None else int(base_refine)
     if cheap is None:
         cheap = pol is not None and bool(
-            pol.trailing or pol.apply or pol.panel != "highest")
+            pol.trailing or pol.apply or pol.comms
+            or pol.panel != "highest")
     out = []
     if cheap and refine == 0:
         out.append(PRECISION_POLICIES["accurate"])
@@ -207,3 +255,20 @@ def apply_policy_to_factor_args(policy, precision, trailing_precision,
             f"(policy sets the panel precision to {pol.panel!r})"
         )
     return pol.panel, pol.split_trailing()
+
+
+def apply_policy_to_comms_arg(policy, comms):
+    """Shared sharded-tier merge: map ``policy`` onto the classic
+    ``comms`` wire-format argument (same refuse-loudly contract as
+    :func:`apply_policy_to_factor_args` — a call naming both spellings
+    is ambiguous). ``policy=None`` validates and passes ``comms``
+    through."""
+    if policy is None:
+        return resolve_comms(comms)
+    pol = resolve_policy(policy)
+    if comms is not None:
+        raise ValueError(
+            "pass either policy= or comms=, not both "
+            f"(policy sets the wire format to {pol.comms!r})"
+        )
+    return pol.comms

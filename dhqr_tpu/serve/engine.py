@@ -195,11 +195,13 @@ def _resolve_bucket_plan(kind: str, cfg: DHQRConfig, bucket: Bucket, pol):
             f"plan must be 'auto', 'default', None or a dhqr_tpu.tune.Plan,"
             f" got {spec!r}"
         )
-    if plan.engine != "householder" or plan.lookahead or plan.agg_panels:
+    if plan.engine != "householder" or plan.lookahead or plan.agg_panels \
+            or plan.comms:
         raise ValueError(
             "serve plans carry block_size/panel_impl/trailing_precision "
             "only (the serving tier batches the blocked householder "
-            f"engine, no schedule levers); got {plan.describe()!r}"
+            "engine — no schedule levers, and no collectives for a "
+            f"comms wire format to compress); got {plan.describe()!r}"
         )
     if plan.trailing_precision and cfg.trailing_precision is not None:
         raise ValueError(
@@ -218,6 +220,11 @@ def _plan_key(kind: str, count: int, m: int, n: int, dtype,
     bucket = plan_bucket(m, n, dtype, scfg)
     batch = bucket_batch(count, scfg)
     nb = min(cfg.block_size or SERVE_DEFAULT_BLOCK, bucket.n)
+    # cfg.comms (dhqr-wire, round 18) is deliberately NOT a key field:
+    # the bucket programs launch zero collectives (the comms audit's
+    # batched_lstsq contract), so a policy naming a wire format must
+    # share the uncompressed executable — same rule as qr dropping
+    # refine/apply from its factor-only key below.
     if kind == "sketch":
         # Round 17: the sketched kind's program is fully determined by
         # the bucket shape + the (s, seed, operator) triple — derived

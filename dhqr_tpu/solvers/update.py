@@ -76,18 +76,92 @@ COUNTERS = Counters()
 DEFAULT_REFACTOR_AFTER = 32
 
 
+def _givens_append(R, z):
+    """Re-triangularize ``[R; z^H]``: returns upper R' with
+    ``R'^H R' = R^H R + z z^H`` via n complex Givens rotations — the
+    LINPACK ``chud`` sweep, O(n^2) total. Row k of R and the carried
+    z-row rotate in the (k, n+1) plane; entries left of k are
+    structural zeros in both, and the masks keep them EXACTLY zero.
+
+    Spelled as a ``lax.scan`` CONSUMING the rows of R and emitting the
+    rotated rows, with only the O(n) z-row as carry: a fori_loop
+    updating R in place measured ~20x slower at n=512 on XLA CPU (the
+    dynamic_update_slice carry copies the full matrix every
+    iteration), which would hand back the very O(n^3)-shaped wall
+    clock this sweep replaces."""
+    n = R.shape[0]
+    cols = jax.lax.iota(jnp.int32, n)
+
+    def step(y, row_k):
+        rk, k = row_k
+        a = jax.lax.dynamic_index_in_dim(rk, k, keepdims=False)
+        b = jax.lax.dynamic_index_in_dim(y, k, keepdims=False)
+        rho = jnp.sqrt(jnp.abs(a) ** 2 + jnp.abs(b) ** 2)
+        safe = rho > 0
+        rho_s = jnp.where(safe, rho, jnp.ones_like(rho))
+        rk_new = (jnp.conj(a) * rk + jnp.conj(b) * y) / rho_s
+        y_new = (-b * rk + a * y) / rho_s
+        rk_new = jnp.where(safe, jnp.where(cols >= k, rk_new, 0), rk)
+        y_new = jnp.where(safe, jnp.where(cols > k, y_new, 0), y)
+        return y_new, rk_new
+
+    _, rows = jax.lax.scan(step, jnp.conj(z),
+                           (R, jax.lax.iota(jnp.int32, n)))
+    return rows
+
+
+def _hyperbolic_remove(R, z):
+    """Downdate twin of :func:`_givens_append`: upper R' with
+    ``R'^H R' = R^H R - z z^H`` via n hyperbolic rotations (the
+    ``chdd`` sweep; same row-scan spelling). Breakdown is LOUD by
+    construction: when the downdated Gram stops being positive
+    definite, ``|a|^2 - |b|^2`` goes non-positive, the sqrt mints a
+    NaN (0 divides to NaN too), and the NaN propagates through every
+    later row — exactly the breakdown signal ``_rank1`` already
+    watches for."""
+    n = R.shape[0]
+    cols = jax.lax.iota(jnp.int32, n)
+
+    def step(y, row_k):
+        rk, k = row_k
+        a = jax.lax.dynamic_index_in_dim(rk, k, keepdims=False)
+        b = jax.lax.dynamic_index_in_dim(y, k, keepdims=False)
+        rho = jnp.sqrt(jnp.abs(a) ** 2 - jnp.abs(b) ** 2)  # NaN = breakdown
+        rk_new = (jnp.conj(a) * rk - jnp.conj(b) * y) / rho
+        y_new = (-b * rk + a * y) / rho
+        rk_new = jnp.where(cols >= k, rk_new, 0)
+        y_new = jnp.where(cols > k, y_new, 0)
+        return y_new, rk_new
+
+    _, rows = jax.lax.scan(step, jnp.conj(z),
+                           (R, jax.lax.iota(jnp.int32, n)))
+    return rows
+
+
 @jax.jit
 def _update_state_impl(A, G, R, u, v, sgn):
     """One rank-1 step: ``A' = A + sgn * u v^H``, G updated exactly,
-    R refreshed by Cholesky. ``sgn`` is a runtime scalar so update and
-    downdate share one compiled program. R rides through unused so the
-    impl signature matches the state tuple (and a future Givens-based
-    incremental refresh can use it without re-keying callers).
+    R refreshed INCREMENTALLY by an O(n^2) Givens/hyperbolic sweep
+    pair (round 18 — previously an O(n^3/3) full re-Cholesky of G',
+    the amortization floor ROADMAP item 4 named). ``sgn`` is a runtime
+    scalar so update and downdate share one compiled program.
+
+    The Gram change decomposes into one append and one removal:
+    ``ΔG = sgn (w v^H + v w^H) + (u^H u) v v^H`` with ``w = A^H u``;
+    writing ``p = w + sgn (u^H u / 2) v`` gives ``ΔG = sgn (p v^H +
+    v p^H) = sgn/2 [(p+v)(p+v)^H - (p-v)(p-v)^H]`` — so the update
+    appends ``(p+v)/sqrt(2)`` and removes ``(p-v)/sqrt(2)`` (roles
+    swap for the downdate; one ``jnp.where`` keeps the single
+    program). The removal's hyperbolic sweep mints NaN on breakdown,
+    which the caller's health check turns into a guarded refactor —
+    same contract as the NaN-loud ``checked_cholesky`` it replaces.
+    R drifts from chol(G) only by the sweeps' own rounding, bounded
+    by the ``refactor_after`` policy; the CSNE solve refines against
+    the true A regardless.
 
     Gram-side matvecs are spelled as vec-mat products (``(u^H A)^H``):
     XLA CPU's transposed matvec on the row-major buffer measured >20x
     slower (see ``solvers.sketch._mhv``)."""
-    del R
     from dhqr_tpu.solvers.sketch import _mhv
 
     w = _mhv(A, u)
@@ -96,8 +170,29 @@ def _update_state_impl(A, G, R, u, v, sgn):
     A2 = A + sgn * jnp.outer(u, vh)
     cross = jnp.outer(w, vh)
     G2 = G + sgn * (cross + jnp.conj(cross.T)) + uu * jnp.outer(v, vh)
-    L = _guards.checked_cholesky(G2)
-    return A2, G2, jnp.conj(L.T)
+    half = jnp.asarray(0.5, dtype=uu.dtype)
+    p = w + (sgn * half * uu).astype(A.dtype) * v
+    # Balanced split (beta = sqrt(||v||/||p||)): (p/b)(bv)^H + (bv)(p/b)^H
+    # = p v^H + v p^H for ANY beta, and equal norms minimize the
+    # cancellation between the append and removal vectors — without it
+    # a large-magnitude rank-1 (||p|| >> ||v||) subtracts two huge
+    # nearly-equal rank-1s and the sweep error scales with their size
+    # instead of with ||dG|| (measured: round-trip R drift O(1)).
+    pn = jnp.linalg.norm(p)
+    vn = jnp.linalg.norm(v)
+    beta = jnp.sqrt(jnp.where((pn > 0) & (vn > 0), pn / jnp.where(
+        vn > 0, vn, jnp.ones_like(vn)), jnp.ones_like(pn)))
+    pb = p / beta.astype(A.dtype)
+    vb = v * beta.astype(A.dtype)
+    inv_sqrt2 = jnp.asarray(0.7071067811865476, dtype=uu.dtype).astype(
+        A.dtype)
+    z_plus = (pb + vb) * inv_sqrt2
+    z_minus = (pb - vb) * inv_sqrt2
+    pos = sgn > 0
+    z_add = jnp.where(pos, z_plus, z_minus)
+    z_sub = jnp.where(pos, z_minus, z_plus)
+    R2 = _hyperbolic_remove(_givens_append(R, z_add), z_sub)
+    return A2, G2, R2
 
 
 @partial(jax.jit, static_argnames=("refine", "precision"))

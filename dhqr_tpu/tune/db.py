@@ -77,11 +77,15 @@ def plan_key(kind: str, m: int, n: int, dtype, nproc: int = 1,
 def policy_tag(pol) -> str:
     """Canonical tag for the policy component of a key ("-" = no policy).
     Tags the RESOLVED precision tuple, not the preset name, so two
-    spellings of the same tuple share their tuned plans."""
+    spellings of the same tuple share their tuned plans. A comms wire
+    format (dhqr-wire, round 18) appends a ``/w<mode>`` segment — only
+    when set, so every pre-round-18 key (and the shipped seed DB)
+    keeps matching."""
     if pol is None:
         return "-"
     return (f"{pol.panel}/{pol.trailing or '-'}/"
-            f"{pol.apply or '-'}/r{pol.refine}")
+            f"{pol.apply or '-'}/r{pol.refine}"
+            + (f"/w{pol.comms}" if getattr(pol, "comms", None) else ""))
 
 
 def _check_entry(entry: dict) -> Plan:

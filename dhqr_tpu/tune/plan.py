@@ -52,6 +52,17 @@ class Plan:
         via a policy — see ``search.candidate_plans``.
       lookahead / agg_panels: mesh schedule levers (1-device plans keep
         the defaults; the pair composes only on multi-device meshes).
+      comms: collective wire format on the sharded tier (dhqr-wire,
+        round 18): None = uncompressed, "bf16"/"int8" route every
+        sharded collective through the compression seam
+        (``dhqr_tpu.parallel.wire``). Like ``trailing_precision`` it
+        CAN move the error bar, so the grid only offers it when the
+        caller did not pin precision via a policy, and the search's
+        8x-LAPACK accuracy gate decides admissibility per candidate —
+        a compressed plan can only be recorded after beating the bar
+        on this backend. Applies to every engine family with a mesh
+        (householder panels, tsqr combine, cholqr Gram); meaningless
+        (and rejected by the serve tier) where no collectives launch.
     """
 
     engine: str = "householder"
@@ -60,6 +71,7 @@ class Plan:
     trailing_precision: Optional[str] = None
     lookahead: bool = False
     agg_panels: Optional[int] = None
+    comms: Optional[str] = None
 
     def __post_init__(self):
         if self.engine not in PLAN_ENGINES:
@@ -86,10 +98,15 @@ class Plan:
             raise ValueError(
                 f"Plan.agg_panels must be >= 2 or None, got {self.agg_panels}"
             )
+        from dhqr_tpu.precision import resolve_comms
+
+        object.__setattr__(self, "comms", resolve_comms(self.comms))
         if self.engine != "householder":
             # The alt engines have no panel loop / trailing split /
             # schedule to steer; a plan carrying those knobs anyway would
             # be rejected downstream with a confusing per-knob error.
+            # (comms IS allowed: the sharded tsqr/cholqr routes have a
+            # combine gather / Gram psum to compress.)
             if (self.panel_impl != "loop" or self.trailing_precision
                     or self.lookahead or self.agg_panels):
                 raise ValueError(
@@ -101,7 +118,7 @@ class Plan:
     # -- serialization -----------------------------------------------------
     def to_dict(self) -> dict:
         """JSON-ready dict (the plan-DB entry payload)."""
-        return {
+        out = {
             "engine": self.engine,
             "block_size": self.block_size,
             "panel_impl": self.panel_impl,
@@ -109,6 +126,12 @@ class Plan:
             "lookahead": self.lookahead,
             "agg_panels": self.agg_panels,
         }
+        # Written only when set: plan payloads without a wire format
+        # stay byte-identical to the pre-round-18 schema, so shipped
+        # seed DBs and older readers keep working.
+        if self.comms is not None:
+            out["comms"] = self.comms
+        return out
 
     @classmethod
     def from_dict(cls, d: dict) -> "Plan":
@@ -143,6 +166,8 @@ class Plan:
             parts.append("la")
         if self.agg_panels:
             parts.append(f"agg{self.agg_panels}")
+        if self.comms:
+            parts.append(f"w{self.comms}")
         return "+".join(parts)
 
 

@@ -38,7 +38,14 @@ autotuner" carries the same table):
 6. Mesh schedule levers (``lookahead``, ``agg_panels``, their grouped
    composition) only when the mesh axis has ``nproc > 1`` devices — on
    one device there is no collective to hide (the same degenerate case
-   ``sharded_blocked_qr`` warns about).
+   ``sharded_blocked_qr`` warns about). Round 18 adds the
+   compressed-comms rungs here (``comms="bf16"``/``"int8"``, plain and
+   composed with ``agg_panels``, plus bf16 twins of the aspect-gated
+   alt engines for lstsq): offered only when the caller did NOT pin
+   precision via a policy — the same contract as rule 4 — with the
+   accuracy gate deciding admissibility per candidate, so a plan can
+   select compressed comms per-platform only after beating the
+   8x-LAPACK bar on that backend.
 7. The grid is truncated at ``TuneConfig.budget`` candidates — from the
    END (defaults and the nb ladder come first, so a tight budget still
    measures the highest-value axis).
@@ -250,6 +257,26 @@ def candidate_plans(kind: str, m: int, n: int, dtype="float32",
             Plan(block_size=base_nb, agg_panels=4),
             Plan(block_size=base_nb, agg_panels=2, lookahead=True),
         ])
+        # Rule 6b (round 18) — compressed collectives (dhqr-wire),
+        # lstsq-only (the solve surfaces carry CSNE recovery by
+        # contract, so a compressed candidate can actually hold the
+        # accuracy gate; a factor-only compressed plan would be refused
+        # every time) and only when the caller did not pin precision
+        # via a policy (the rule-4 contract). The gate still decides
+        # admissibility per candidate/backend. Composed with agg: fewer
+        # launches AND fewer bytes per launch is the schedule
+        # EQuARX-style wire compression rewards most.
+        if policy is None and kind == "lstsq":
+            out.extend([
+                Plan(block_size=base_nb, comms="bf16"),
+                Plan(block_size=base_nb, agg_panels=2, comms="bf16"),
+                Plan(block_size=base_nb, comms="int8"),
+            ])
+            aspect = m / n
+            if aspect >= CHOLQR_MIN_ASPECT:
+                out.append(Plan(engine="cholqr2", comms="bf16"))
+            if aspect >= TSQR_MIN_ASPECT:
+                out.append(Plan(engine="tsqr", comms="bf16"))
     # Dedupe preserving order (Plan() and the ladder can collide at tiny
     # n), then rule 7 — budget truncation from the end.
     seen = set()
@@ -271,10 +298,12 @@ def apply_plan_to_config(cfg, plan: Plan):
     trailing = (cfg.trailing_precision
                 if cfg.trailing_precision is not None
                 else plan.trailing_precision)
+    comms = cfg.comms if cfg.comms is not None else plan.comms
     return dataclasses.replace(
         cfg, engine=plan.engine, block_size=plan.block_size,
         panel_impl=plan.panel_impl, trailing_precision=trailing,
-        lookahead=plan.lookahead, agg_panels=plan.agg_panels, plan=None,
+        lookahead=plan.lookahead, agg_panels=plan.agg_panels,
+        comms=comms, plan=None,
     )
 
 

@@ -113,15 +113,30 @@ class DHQRConfig:
         applies (the blocked householder engines' solve paths). None
         (the default) follows ``precision``. Usually set via ``policy``
         rather than directly.
+      comms: collective wire format for the SHARDED tier (dhqr-wire,
+        round 18) — None (default) keeps the uncompressed wire
+        (programs bit-identical to the pre-seam tier), "bf16" halves
+        the traced collective volume, "int8" quarters it with
+        per-(32-row-block, column) scales on the one-hot
+        broadcast/gather paths
+        (``dhqr_tpu.parallel.wire``; accumulation stays f32-exact on
+        those paths — the psums add zeros). Programs with no
+        collectives (single-device engines, the batched serving
+        dispatch) are unaffected by contract, and the serve cache key
+        deliberately excludes it. Usually set via ``policy`` (the
+        fourth ``DHQR_POLICY`` segment) or a tuned plan rather than
+        directly.
       policy: a :class:`dhqr_tpu.precision.PrecisionPolicy`, preset name
         ("accurate", "balanced", "fast") or spec string
-        ("panel[/trailing][/rN]", e.g. "highest/default/r1") naming the
-        whole precision tuple at once — panel precision, trailing-GEMM
-        precision, solve-apply precision, and refinement count. Resolved
-        by ``qr()``/``lstsq()`` into the individual knobs below, so it is
-        mutually exclusive with setting ``trailing_precision`` or
-        ``refine`` (and with a non-default ``precision``) explicitly.
-        None (the default) leaves the classic knobs in charge.
+        ("panel[/trailing][/rN][/comms]", e.g. "highest/default/r1" or
+        "highest/default/r1/bf16") naming the whole precision tuple at
+        once — panel precision, trailing-GEMM precision, solve-apply
+        precision, refinement count, and (round 18) the collective
+        wire format. Resolved by ``qr()``/``lstsq()`` into the
+        individual knobs below, so it is mutually exclusive with
+        setting ``trailing_precision``, ``refine`` or ``comms`` (and
+        with a non-default ``precision``) explicitly. None (the
+        default) leaves the classic knobs in charge.
       refine: iterative-refinement steps for ``lstsq`` (0 = off). Each
         step reuses the factorization: ``r = b - A x; x += solve(r)`` —
         one matvec plus one extra solve, a few percent of the
@@ -177,6 +192,7 @@ class DHQRConfig:
     lookahead: bool = False
     agg_panels: "int | None" = None
     apply_precision: "str | None" = None
+    comms: "str | None" = None
     policy: object = None
     plan: object = None
     guards: "str | None" = None
@@ -217,6 +233,18 @@ class DHQRConfig:
             env["agg_panels"] = int(raw) if raw and raw != "0" else None
         if "DHQR_APPLY_PRECISION" in os.environ:
             env["apply_precision"] = os.environ["DHQR_APPLY_PRECISION"]
+        if "DHQR_COMMS" in os.environ:
+            raw = os.environ["DHQR_COMMS"].strip().lower()
+            if raw:
+                from dhqr_tpu.precision import resolve_comms
+
+                # Normalized HERE (not just at the sharded engines):
+                # "f32"/"none" collapse to None and a typo refuses at
+                # config build, before it can steer the CSNE-floor
+                # logic or surface only on the mesh tier.
+                env["comms"] = resolve_comms(raw)
+            else:
+                env["comms"] = None
         if "DHQR_POLICY" in os.environ:
             raw = os.environ["DHQR_POLICY"].strip()
             env["policy"] = raw or None

@@ -1,0 +1,24 @@
+"""DHQR009 fixture: raw lax collectives on a sharded-tier path."""
+
+import jax.numpy as jnp
+from jax import lax
+import jax.lax as jlax
+from jax.lax import psum
+from jax.lax import all_gather as gather_all
+
+
+def broadcast_panel(panel, mine, axis):
+    contrib = jnp.where(mine, panel, jnp.zeros_like(panel))
+    return lax.psum(contrib, axis)  # line 12: finding (dotted call)
+
+
+def broadcast_alias(panel, axis):
+    return jlax.psum(panel, axis)  # line 16: finding (module-alias call)
+
+
+def combine_heads(R, axis):
+    return psum(R, axis)  # line 20: finding (bare imported name)
+
+
+def combine_gather(R, axis):
+    return gather_all(R, axis)  # line 24: finding (aliased import)

@@ -1,0 +1,25 @@
+"""DHQR009 fixture: collectives routed through the dhqr-wire seam."""
+
+import jax.numpy as jnp
+from jax import lax
+
+from dhqr_tpu.parallel import wire as _wire
+
+
+def broadcast_panel(panel, mine, axis, comms=None):
+    contrib = jnp.where(mine, panel, jnp.zeros_like(panel))
+    return _wire.wire_psum(contrib, axis, comms)  # seam call: clean
+
+
+def combine_heads(R, axis, comms=None):
+    return _wire.wire_all_gather(R, axis, comms)  # seam call: clean
+
+
+def mesh_position(axis):
+    return lax.axis_index(axis)  # axis_index moves no words: clean
+
+
+def local_wrapper(x, axis):
+    def psum(v, a):  # a local helper shadowing the name: clean
+        return v
+    return psum(x, axis)

@@ -167,6 +167,39 @@ def test_dhqr008_raw_wall_clock_reads():
     assert all("wall seconds" in f.reason for f in suppressed)
 
 
+def test_dhqr009_raw_collectives_outside_wire_seam():
+    # Every spelling: dotted lax.psum, a jax.lax module alias, the bare
+    # `from jax.lax import psum`, and an aliased all_gather import —
+    # all reach raw collectives on a sharded-tier path, all flagged.
+    findings = _scan_fixture("dhqr009_bad.py",
+                             virtual_path="dhqr_tpu/parallel/_fixture.py")
+    assert _hits(findings, "DHQR009") == [12, 16, 20, 24]
+    good = _scan_fixture("dhqr009_good.py",
+                         virtual_path="dhqr_tpu/parallel/_fixture.py")
+    # Seam calls, axis_index (moves no words) and a local shadowing
+    # helper are all clean.
+    assert _hits(good, "DHQR009") == []
+
+
+def test_dhqr009_scope_is_the_sharded_tier():
+    with open(os.path.join(FIXTURES, "dhqr009_bad.py")) as fh:
+        text = fh.read()
+    # The seam module is the one sanctioned call site; ops-tier and
+    # test code are out of the rule's scope (single-device code has no
+    # wire to compress).
+    assert _hits(scan_source(text, "dhqr_tpu/parallel/wire.py"),
+                 "DHQR009") == []
+    assert _hits(scan_source(text, "dhqr_tpu/ops/blocked.py"),
+                 "DHQR009") == []
+    assert _hits(scan_source(text, "tests/test_something.py"),
+                 "DHQR009") == []
+    # The live seam module itself must stay clean under its own path.
+    wire_src = os.path.join(REPO, "dhqr_tpu", "parallel", "wire.py")
+    with open(wire_src) as fh:
+        assert _hits(scan_source(fh.read(), "dhqr_tpu/parallel/wire.py"),
+                     "DHQR009") == []
+
+
 def test_dhqr008_out_of_package_paths_exempt():
     with open(os.path.join(FIXTURES, "dhqr008_bad.py")) as fh:
         text = fh.read()

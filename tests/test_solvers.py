@@ -266,6 +266,77 @@ def test_update_downdate_round_trip_within_gate():
     assert float(jnp.linalg.norm(x1 - x0) / jnp.linalg.norm(x0)) < 1e-4
 
 
+def test_givens_refresh_matches_gram_to_working_precision():
+    """Round 18: the O(n^2) Givens/hyperbolic sweep pair must refresh
+    R to the SAME Gram the exactly-updated G carries — update and
+    downdate, real and complex — i.e. numerically equivalent to the
+    round-17 re-Cholesky it replaced, at machine precision."""
+    from dhqr_tpu.solvers.update import _update_state_impl
+
+    rng = np.random.default_rng(11)
+    for dtype in (np.float32, np.complex64):
+        A = rng.standard_normal((96, 24))
+        u = rng.standard_normal(96)
+        v = rng.standard_normal(24)
+        if np.issubdtype(dtype, np.complexfloating):
+            A = A + 1j * rng.standard_normal((96, 24))
+            u = u + 1j * rng.standard_normal(96)
+            v = v + 1j * rng.standard_normal(24)
+        Aj = jnp.asarray(A.astype(dtype))
+        uj = jnp.asarray(u.astype(dtype))
+        vj = jnp.asarray(v.astype(dtype))
+        G = jnp.matmul(jnp.conj(Aj.T), Aj, precision="highest")
+        R = jnp.conj(jnp.linalg.cholesky(G).T)
+        real_dt = np.finfo(np.dtype(dtype)).dtype
+        for sgn in (1.0, -1.0):
+            A2, G2, R2 = _update_state_impl(
+                Aj, G, R, uj, vj, jnp.asarray(sgn, dtype=real_dt))
+            G2n = np.asarray(G2)
+            gram = np.conj(np.asarray(R2)).T @ np.asarray(R2)
+            err = np.linalg.norm(gram - G2n) / np.linalg.norm(G2n)
+            assert err < 5e-6, (np.dtype(dtype).name, sgn, err)
+            # strictly upper triangular (structural zeros held exactly)
+            assert np.all(np.tril(np.asarray(R2), -1) == 0)
+            # G itself stays the EXACT rank-1 algebra
+            gex = np.conj(np.asarray(A2)).T @ np.asarray(A2)
+            assert np.linalg.norm(G2n - gex) / np.linalg.norm(gex) < 5e-6
+
+
+def test_hyperbolic_downdate_breakdown_is_nan_loud_and_refactors():
+    """Removing more mass than a column holds makes |a|^2 - |b|^2 go
+    negative — the sweep must mint NaN (never a silently-wrong finite
+    R), and the UpdatableQR step must convert that into a guarded
+    refactor exactly like the re-Cholesky breakdown it replaced."""
+    from dhqr_tpu.solvers.update import _hyperbolic_remove
+
+    rng = np.random.default_rng(12)
+    A = rng.standard_normal((64, 8)).astype(np.float32)
+    R = jnp.asarray(np.linalg.cholesky(A.T @ A).T.astype(np.float32))
+    z = jnp.asarray((100.0 * rng.standard_normal(8)).astype(np.float32))
+    out = np.asarray(_hyperbolic_remove(R, z))
+    assert not np.all(np.isfinite(out))
+    # end to end: a downdate yanking out more than the matrix holds
+    # refactors through the ladder (reason recorded), data committed
+    from dhqr_tpu.numeric import NumericalError
+
+    fact = UpdatableQR(jnp.asarray(A))
+    v = jnp.asarray(np.eye(8, dtype=np.float32)[0])
+    # yank a column down to ~1e-5 of itself: the refreshed R's
+    # diagonal trips the CholeskyQR condition window (or the sweep
+    # NaN-breaks outright) -> guarded refactor succeeds either way
+    info = fact.downdate(jnp.asarray(A[:, 0] * (1 - 1e-5)), v)
+    assert info["refactored"] and info["reason"] in (
+        "breakdown", "condition"), info
+    # annihilate the (now tiny) column EXACTLY: the ladder refuses
+    # typed and the rank-1 data change is rolled back
+    col = np.asarray(fact.matrix)[:, 0].copy()
+    with pytest.raises(NumericalError):
+        fact.downdate(jnp.asarray(col), v)
+    np.testing.assert_array_equal(np.asarray(fact.matrix)[:, 0], col)
+    x = fact.solve(jnp.asarray(rng.standard_normal(64).astype(np.float32)))
+    assert np.all(np.isfinite(np.asarray(x)))
+
+
 def test_update_stream_64_steps_within_gate_zero_recompile():
     """The ISSUE-13 acceptance stream: 64 rank-1 updates, a solve
     within the 8x criterion at EVERY step, scheduled refactors riding

@@ -1,0 +1,456 @@
+"""dhqr-wire (round 18): the communication-compression seam.
+
+Pins the three contracts the tentpole rests on:
+
+* ``comms=None`` is a VERBATIM passthrough — the accurate tier's
+  programs are bit-identical to the raw-collective spelling, by jaxpr
+  and by value;
+* the compressed rungs cut the traced collective byte volume by the
+  budgeted factors (bf16 exactly 2x on the panel-broadcast paths),
+  enforced end to end through ``check_comms``'s compressed-mode
+  DHQR302 budgets (an uncompressed program checked against a
+  compressed contract MUST go red — the gate bites);
+* accuracy: the bf16-comms backward error is bounded wire-eps-level
+  (not silently worse), compressed mesh solves hold the reference
+  8x-LAPACK criterion through their CSNE recovery, and the policy
+  ladder's new comms rung composes with the precision presets.
+
+The heavy mode x topology sweep runs under ``-m slow``; the tier-1
+cells stay on the 2-device mesh at small shapes (~10 s total).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from dhqr_tpu.parallel import wire
+from dhqr_tpu.parallel.mesh import column_mesh
+from dhqr_tpu.parallel.sharded_qr import sharded_blocked_qr
+from dhqr_tpu.parallel.sharded_solve import sharded_lstsq
+from dhqr_tpu.parallel.sharded_tsqr import row_mesh, sharded_tsqr_lstsq
+from dhqr_tpu.precision import (COMMS_MODES, PrecisionPolicy,
+                                WIRE_ITEMSIZE, resolve_comms,
+                                resolve_policy)
+from dhqr_tpu.utils.compat import shard_map
+from dhqr_tpu.utils.testing import (
+    TOLERANCE_FACTOR,
+    normal_equations_residual,
+    oracle_residual,
+)
+
+
+def _mesh2():
+    return column_mesh(2)
+
+
+# ---------------------------------------------------------------- seam unit
+
+
+def test_wire_psum_none_is_verbatim_passthrough_jaxpr():
+    """The accurate-tier contract at its root: the seam at comms=None
+    traces to EXACTLY the raw lax.psum program."""
+    from dhqr_tpu.parallel.mesh import DEFAULT_AXIS
+
+    mesh = _mesh2()
+
+    def mk(use_seam):
+        def body(x):  # one name for both traces: the jaxpr's name=
+            if use_seam:  # param must not be the only difference
+                return wire.wire_psum(x, DEFAULT_AXIS, None)
+            return lax.psum(x, DEFAULT_AXIS)  # dhqr: ignore[DHQR009] the passthrough-identity oracle this test compares the seam against
+
+        x = jnp.zeros((4, 8), jnp.float32)
+        return str(jax.make_jaxpr(jax.jit(shard_map(
+            body, mesh=mesh, in_specs=P(None, DEFAULT_AXIS),
+            out_specs=P(None, DEFAULT_AXIS), check_vma=False)))(x))
+
+    assert mk(True) == mk(False)
+
+
+def test_wire_modes_validation_and_vocab_parity():
+    assert resolve_comms(None) is None
+    assert resolve_comms("none") is None
+    assert resolve_comms("f32") is None
+    assert resolve_comms("bf16") == "bf16"
+    with pytest.raises(ValueError, match="comms must be one of"):
+        resolve_comms("fp8")
+    # normalization happens at the MODEL tier too (every qr/lstsq/serve
+    # call), not just on the mesh path: a typo refuses on one device,
+    # and the explicit "f32" spelling collapses to None (so it can
+    # never read as truthy to the CSNE-floor logic)
+    from dhqr_tpu.models.qr_model import _resolve_policy_cfg
+    from dhqr_tpu.utils.config import DHQRConfig
+
+    with pytest.raises(ValueError, match="comms must be one of"):
+        _resolve_policy_cfg(DHQRConfig(comms="fp8"))
+    cfg, _ = _resolve_policy_cfg(DHQRConfig(comms="f32"))
+    assert cfg.comms is None
+    # One vocabulary across the jax-free tiers: precision (the policy
+    # surface), the stdlib-only netmodel, and the analysis cost model.
+    from dhqr_tpu.analysis import cost_model
+    from dhqr_tpu.obs import netmodel
+
+    assert netmodel.WIRE_ITEMSIZE == WIRE_ITEMSIZE
+    assert cost_model.WIRE_ITEMSIZE == {
+        k: v for k, v in WIRE_ITEMSIZE.items() if k is not None}
+    assert cost_model.CSNE_SWEEPS == wire.CSNE_SWEEPS
+    assert wire.COMMS_MODES == COMMS_MODES
+
+
+def test_int8_quantization_roundtrip_and_zero_columns():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((32, 6)).astype(np.float32))
+    x = x.at[:, 2].set(0.0)  # a zero column must stay exactly zero
+    q, scale = wire._quant_int8(x)
+    assert q.dtype == jnp.int8 and scale.shape == (1, 6)  # one 32-row block
+    back = wire._dequant_int8(q, scale, x.dtype)
+    err = np.abs(np.asarray(back) - np.asarray(x))
+    colmax = np.max(np.abs(np.asarray(x)), axis=0)
+    # symmetric int8: per-entry error <= half a quantization step
+    assert np.all(err <= colmax / 127.0 * 0.5 + 1e-12)
+    assert np.all(np.asarray(back)[:, 2] == 0.0)
+    # block scaling: a 40-row payload quantizes as two 32-row blocks
+    # with INDEPENDENT per-column scales (the clamp pads < 2x)
+    y = jnp.asarray(rng.standard_normal((40, 3)).astype(np.float32))
+    y = y.at[32:].mul(1e-3)       # second block much smaller
+    q2, s2 = wire._quant_int8(y)
+    assert s2.shape == (2, 3)
+    back2 = np.asarray(wire._dequant_int8(q2, s2, y.dtype))
+    small = np.abs(back2[32:] - np.asarray(y)[32:])
+    # the small block's error follows ITS OWN scale, not the big one's
+    assert np.all(small <= np.asarray(s2)[1] * 0.5 + 1e-12)
+
+
+def test_policy_comms_field_and_fourth_spec_segment():
+    pol = resolve_policy("highest/default/r1/bf16")
+    assert (pol.panel, pol.trailing, pol.refine, pol.comms) == (
+        "highest", "default", 1, "bf16")
+    assert resolve_policy("highest/bf16").comms == "bf16"
+    assert resolve_policy("highest/high/int8").comms == "int8"
+    for preset in ("accurate", "balanced", "fast"):
+        assert resolve_policy(preset).comms is None
+    with pytest.raises(ValueError, match="comms must be one of"):
+        PrecisionPolicy(comms="fp8")
+    # the tune key grows /w<mode> ONLY when compressed (old keys stable)
+    from dhqr_tpu.tune.db import policy_tag
+
+    assert policy_tag(resolve_policy("fast")) == "highest/default/-/r1"
+    assert policy_tag(resolve_policy("highest/default/r1/bf16")) == \
+        "highest/default/-/r1/wbf16"
+
+
+# ------------------------------------------------- bit identity + accuracy
+
+
+def test_accurate_is_bit_identical_to_plain_spelling():
+    mesh = _mesh2()
+    rng = np.random.default_rng(3)
+    A = jnp.asarray(rng.random((32, 16)), jnp.float32)
+    H0, a0 = sharded_blocked_qr(A, mesh, block_size=4)
+    for spelling in ({"policy": "accurate"}, {"comms": None},
+                     {"comms": "none"}):
+        H1, a1 = sharded_blocked_qr(A, mesh, block_size=4, **spelling)
+        np.testing.assert_array_equal(np.asarray(H0), np.asarray(H1))
+        np.testing.assert_array_equal(np.asarray(a0), np.asarray(a1))
+
+
+def test_bf16_comms_backward_error_bounded():
+    """The wire rounding must cost ~bf16 eps on the factor — bounded
+    above (no silent blow-up) AND measurably different from the plain
+    factor (the compression is real, not elided)."""
+    mesh = _mesh2()
+    rng = np.random.default_rng(4)
+    A = jnp.asarray(rng.standard_normal((64, 32)).astype(np.float32))
+    from dhqr_tpu.ops.blocked import blocked_apply_q
+    from dhqr_tpu.ops.solve import r_matrix
+
+    errs = {}
+    for comms in (None, "bf16"):
+        H, alpha = sharded_blocked_qr(A, mesh, block_size=8, comms=comms)
+        R = jnp.zeros_like(A).at[:A.shape[1]].set(r_matrix(H, alpha))
+        QR = blocked_apply_q(H, alpha, R, 8)
+        errs[comms] = float(jnp.linalg.norm(QR - A) / jnp.linalg.norm(A))
+    assert errs[None] < 1e-5
+    assert errs["bf16"] > errs[None]          # really compressed
+    assert errs["bf16"] < 0.05                # bounded at wire-eps level
+
+
+def test_compressed_mesh_lstsq_holds_8x_bar_by_contract():
+    """qr_model floors compressed mesh solves at CSNE_SWEEPS recovery
+    sweeps — the bare comms spelling must already hold the reference
+    criterion, for the column AND row engines, bf16 and int8."""
+    from dhqr_tpu.models.qr_model import lstsq as model_lstsq
+
+    mesh = _mesh2()
+    rmesh = row_mesh(2)
+    rng = np.random.default_rng(5)
+    A = jnp.asarray(rng.random((48, 16)), jnp.float32)
+    b = jnp.asarray(rng.random(48), jnp.float32)
+    At = jnp.asarray(rng.random((128, 8)), jnp.float32)
+    bt = jnp.asarray(rng.random(128), jnp.float32)
+    ref = oracle_residual(np.asarray(A), np.asarray(b))
+    reft = oracle_residual(np.asarray(At), np.asarray(bt))
+    for comms in ("bf16", "int8"):
+        x = model_lstsq(A, b, mesh=mesh, block_size=4, comms=comms)
+        assert normal_equations_residual(A, np.asarray(x), b) < \
+            TOLERANCE_FACTOR * ref, comms
+        xt = sharded_tsqr_lstsq(At, bt, rmesh, block_size=8, comms=comms)
+        assert normal_equations_residual(At, np.asarray(xt), bt) < \
+            TOLERANCE_FACTOR * reft, comms
+
+
+def test_policy_ladder_comms_rung_composes_with_presets():
+    """The comms rung rides the policy ladder: every trailing-precision
+    preset composes with the bf16 wire on the sharded engine, the
+    spec-string and dataclass spellings agree bitwise, and naming both
+    spellings refuses loudly."""
+    from dhqr_tpu.precision import TRAILING_PRECISIONS
+
+    mesh = _mesh2()
+    rng = np.random.default_rng(6)
+    A = jnp.asarray(rng.random((32, 16)), jnp.float32)
+    for tprec in TRAILING_PRECISIONS:
+        pol = PrecisionPolicy(
+            trailing=None if tprec == "highest" else tprec, comms="bf16")
+        H1, a1 = sharded_blocked_qr(A, mesh, block_size=4, policy=pol)
+        spec = ("highest" if tprec == "highest"
+                else f"highest/{tprec}") + "/bf16"
+        H2, a2 = sharded_blocked_qr(A, mesh, block_size=4, policy=spec)
+        np.testing.assert_array_equal(np.asarray(H1), np.asarray(H2))
+        np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+        assert np.all(np.isfinite(np.asarray(H1)))
+    with pytest.raises(ValueError, match="not both"):
+        sharded_blocked_qr(A, mesh, block_size=4, policy="accurate",
+                           comms="bf16")
+
+
+# ------------------------------------------------------- budget enforcement
+
+
+def test_compressed_volume_ratios_traced():
+    """bf16 halves the panel-broadcast volume EXACTLY (every psum
+    payload is bf16); int8 cuts > 3x at these shapes (scales ride f32
+    sidecars)."""
+    from dhqr_tpu.analysis.comms_pass import collect_comms
+
+    mesh = _mesh2()
+    A = jnp.zeros((32, 16), jnp.float32)
+
+    def vol(comms):
+        closed = jax.make_jaxpr(lambda A_: sharded_blocked_qr(
+            A_, mesh, block_size=4, comms=comms))(A)
+        return collect_comms(closed).total_volume_bytes()
+
+    v32, vb, vi = vol(None), vol("bf16"), vol("int8")
+    assert v32 == 2 * vb                      # exactly 2x
+    assert v32 / vi > 3.0
+
+
+def test_dhqr302_compressed_budget_bites():
+    """Enforcement, not assertion: the UNCOMPRESSED program checked
+    against the bf16 contract must fail DHQR302 — which is exactly what
+    pins the >= 1.8x reduction (budget x slack = words x 2.2 < the f32
+    program's words x 4)."""
+    import json
+
+    from dhqr_tpu.analysis.comms_pass import (
+        CONTRACTS_PATH,
+        EngineParams,
+        check_comms,
+    )
+
+    with open(CONTRACTS_PATH) as fh:
+        contracts = json.load(fh)["engines"]
+    mesh = _mesh2()
+    A = jnp.zeros((32, 16), jnp.float32)
+    params = EngineParams(32, 16, 4, 2)
+    contract = contracts["blocked_qr_wire_bf16"]
+
+    plain = jax.make_jaxpr(lambda A_: sharded_blocked_qr(
+        A_, mesh, block_size=4))(A)
+    findings = check_comms(plain, "wire-test", contract, params)
+    assert any(f.rule == "DHQR302" and "compressed" in f.message
+               for f in findings), findings
+
+    compressed = jax.make_jaxpr(lambda A_: sharded_blocked_qr(
+        A_, mesh, block_size=4, comms="bf16"))(A)
+    assert check_comms(compressed, "wire-test", contract, params) == []
+
+
+def test_budget_bytes_compressed_pricing():
+    from dhqr_tpu.analysis.cost_model import budget_bytes
+
+    plain = budget_bytes("blocked_qr", 32, 16, 4, 2, 4)
+    assert budget_bytes("blocked_qr", 32, 16, 4, 2, 4,
+                        comms="bf16") * 2 == plain
+    assert budget_bytes("blocked_qr", 32, 16, 4, 2, 4,
+                        comms="int8") * 4 == plain
+    with pytest.raises(KeyError, match="wire format"):
+        budget_bytes("blocked_qr", 32, 16, 4, 2, 4, comms="fp8")
+
+
+# --------------------------------------------------------- plan / serve
+
+
+def test_tune_grid_offers_comms_plans_and_config_fold():
+    from dhqr_tpu.tune.plan import Plan
+    from dhqr_tpu.tune.search import apply_plan_to_config, candidate_plans
+    from dhqr_tpu.utils.config import DHQRConfig
+
+    plans = candidate_plans("lstsq", 512, 16, nproc=4, policy=None,
+                            platform="cpu", budget=64)
+    descs = [p.describe() for p in plans]
+    assert "householder+wbf16" in descs
+    assert "householder+agg2+wbf16" in descs
+    assert "householder+wint8" in descs
+    assert "cholqr2+wbf16" in descs and "tsqr+wbf16" in descs
+    # never under a policy, never on one device, never for qr kinds
+    pol = resolve_policy("fast")
+    assert not any(p.comms for p in candidate_plans(
+        "lstsq", 512, 16, nproc=4, policy=pol, platform="cpu", budget=64))
+    assert not any(p.comms for p in candidate_plans(
+        "lstsq", 512, 16, nproc=1, policy=None, platform="cpu", budget=64))
+    assert not any(p.comms for p in candidate_plans(
+        "qr", 512, 16, nproc=4, policy=None, platform="cpu", budget=64))
+    # fold: plan.comms lands on the config; an explicit cfg comms wins
+    plan = Plan(block_size=32, comms="bf16")
+    assert plan == Plan.from_dict(plan.to_dict())
+    assert "comms" not in Plan(block_size=32).to_dict()  # schema stable
+    cfg = apply_plan_to_config(DHQRConfig(), plan)
+    assert cfg.comms == "bf16" and cfg.block_size == 32
+    cfg = apply_plan_to_config(DHQRConfig(comms="int8"), plan)
+    assert cfg.comms == "int8"
+
+
+def test_serve_rejects_comms_plans_and_keeps_key_stable():
+    from dhqr_tpu.serve.engine import _plan_key, _resolve_bucket_plan
+    from dhqr_tpu.serve.buckets import plan_bucket
+    from dhqr_tpu.tune.plan import Plan
+    from dhqr_tpu.utils.config import DHQRConfig, ServeConfig
+
+    scfg = ServeConfig()
+    cfg = DHQRConfig(plan=Plan(block_size=32, comms="bf16"))
+    bucket = plan_bucket(32, 16, "float32", scfg)
+    with pytest.raises(ValueError, match="no collectives"):
+        _resolve_bucket_plan("lstsq", cfg, bucket, None)
+    # a policy naming a wire format shares the uncompressed executable
+    from dhqr_tpu.models.qr_model import _resolve_policy_cfg
+
+    plain, _ = _resolve_policy_cfg(DHQRConfig(policy="accurate"))
+    wired, _ = _resolve_policy_cfg(DHQRConfig(policy="highest/bf16"))
+    k0, _ = _plan_key("lstsq", 4, 32, 16, "float32", plain, scfg)
+    k1, _ = _plan_key("lstsq", 4, 32, 16, "float32", wired, scfg)
+    assert k0 == k1
+
+
+# --------------------------------------------------------------- netmodel
+
+
+def test_pulse_dhqr306_green_under_compressed_wire_model():
+    """An armed compressed dispatch yields a PulseReport whose analytic
+    census carries the COMPRESSED avals (half the f32 twin's psum
+    volume), whose DHQR306 verdict is green (skip-with-reason on CPU's
+    unpublished interconnect counts, per the repo convention), and
+    whose label/report carry the wire tag — one capture per mode."""
+    from dhqr_tpu.obs import pulse as pulse_mod
+
+    mesh = _mesh2()
+    rng = np.random.default_rng(9)
+    A = jnp.asarray(rng.random((32, 16)), jnp.float32)
+    with pulse_mod.pulsed() as store:
+        jax.block_until_ready(
+            sharded_blocked_qr(A, mesh, block_size=4))
+        jax.block_until_ready(
+            sharded_blocked_qr(A, mesh, block_size=4, comms="bf16"))
+    reports = {r.label: r for r in store.reports()}
+    assert len(reports) == 2                       # one per mode
+    wired = [r for r in reports.values() if r.wire_format == "bf16"]
+    plain = [r for r in reports.values() if r.wire_format is None]
+    assert len(wired) == 1 and len(plain) == 1
+    assert ",wbf16]" in wired[0].label
+    for rep in (wired[0], plain[0]):
+        assert rep.dhqr306_pass, rep.dhqr306
+    assert wired[0].dhqr306.get("wire_format") == "bf16"
+    # the census volumes ARE the wire volumes: bf16 = half the f32 twin
+    v_plain = plain[0].analytic["psum"]["volume_bytes"]
+    v_wired = wired[0].analytic["psum"]["volume_bytes"]
+    assert v_plain == 2 * v_wired
+    assert wired[0].to_json()["wire_format"] == "bf16"
+
+
+def test_netmodel_explain_measured_wire_format():
+    from dhqr_tpu.obs import netmodel
+
+    out = netmodel.explain_measured("psum", 1e-3, 1024, 4, 100.0, 8.0,
+                                    wire_format="bf16")
+    assert out["wire_format"] == "bf16"
+    assert out["f32_equivalent_bytes"] == 2048
+    # without the tag the schema is unchanged
+    out = netmodel.explain_measured("psum", 1e-3, 1024, 4, 100.0, 8.0)
+    assert "wire_format" not in out and "f32_equivalent_bytes" not in out
+
+
+def test_policy_ladder_1024_comms_rung():
+    """The flagship-width comms rung (the 1024^2 policy-ladder cell,
+    dhqr-wire round 18): on the full 8-device mesh at the realistic
+    panel width, (a) the ``accurate`` preset stays BITWISE equal to
+    the plain spelling, and (b) the bf16 wire's factor error — via the
+    Gram proxy ``||R^H R - A^H A|| / ||A^H A||``, the tune gate's own
+    backward-error stand-in — is pinned to the wire-eps decade, well
+    separated from both the plain factor's f32 level and the O(1)
+    level of a broken factorization. One cell (~10 s with the
+    persistent compile cache); the mode x topology matrix runs under
+    ``-m slow`` below."""
+    from dhqr_tpu.ops.solve import r_matrix
+
+    mesh = column_mesh(8)
+    rng = np.random.default_rng(91)
+    A = jnp.asarray(rng.random((1024, 1024)), jnp.float32)
+
+    def gram_err(H, alpha):
+        R = r_matrix(H, alpha)
+        gram_a = jnp.matmul(jnp.conj(A.T), A, precision="highest")
+        gram_r = jnp.matmul(jnp.conj(R.T), R, precision="highest")
+        return float(jnp.linalg.norm(gram_a - gram_r)
+                     / jnp.linalg.norm(gram_a))
+
+    H0, a0 = sharded_blocked_qr(A, mesh, block_size=128)
+    Ha, aa = sharded_blocked_qr(A, mesh, block_size=128, policy="accurate")
+    np.testing.assert_array_equal(np.asarray(H0), np.asarray(Ha))
+    np.testing.assert_array_equal(np.asarray(a0), np.asarray(aa))
+    Hb, ab = sharded_blocked_qr(A, mesh, block_size=128, comms="bf16")
+    plain, wired = gram_err(H0, a0), gram_err(Hb, ab)
+    assert plain < 1e-5
+    assert plain < wired < 0.05, (plain, wired)
+
+
+# ------------------------------------------------------------ slow sweep
+
+
+@pytest.mark.slow  # the full mode x topology matrix at P=8 — the
+# tier-1 cells above cover P=2; this is the audit-scale replay.
+def test_wire_matrix_full_sweep_slow():
+    from dhqr_tpu.analysis.comms_pass import collect_comms
+    from dhqr_tpu.models.qr_model import lstsq as model_lstsq
+
+    rng = np.random.default_rng(7)
+    for Pn in (4, 8):
+        mesh = column_mesh(Pn)
+        n = 8 * Pn
+        A = jnp.asarray(rng.random((2 * n, n)), jnp.float32)
+        b = jnp.asarray(rng.random(2 * n), jnp.float32)
+        ref = oracle_residual(np.asarray(A), np.asarray(b))
+
+        def vol(comms):
+            closed = jax.make_jaxpr(lambda A_: sharded_blocked_qr(
+                A_, mesh, block_size=4, comms=comms))(A)
+            return collect_comms(closed).total_volume_bytes()
+
+        assert vol(None) == 2 * vol("bf16")
+        for comms in ("bf16", "int8"):
+            x = model_lstsq(A, b, mesh=mesh, block_size=4, comms=comms)
+            assert normal_equations_residual(A, np.asarray(x), b) < \
+                TOLERANCE_FACTOR * ref, (Pn, comms)

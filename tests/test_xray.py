@@ -87,10 +87,12 @@ def test_sketched_lstsq_flops_golden(m, n, s):
 
 @pytest.mark.parametrize("m,n", [(512, 16), (4096, 64), (256, 8)])
 def test_qr_update_flops_golden(m, n):
-    # Round 17: rank-1 update of a live factorization — Gram matvec +
-    # data update + dot + three rank-1 Gram updates + n^3/3 Cholesky.
+    # Round 18: rank-1 update of a live factorization — Gram matvec +
+    # data update + dot + three rank-1 Gram updates + the O(n^2)
+    # Givens/hyperbolic sweep pair (12n^2) that replaced the round-17
+    # n^3/3 re-Cholesky.
     assert oflops.qr_update_flops(m, n) == pytest.approx(
-        4 * m * n + 2 * m + 6 * n**2 + n**3 / 3)
+        4 * m * n + 2 * m + 18 * n**2)
     # CSNE solve: A^H b + two triangular solves, plus corrected sweeps.
     base = 2 * m * n + 2 * n**2
     sweep = 4 * m * n + 2 * n**2
