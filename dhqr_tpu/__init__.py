@@ -47,6 +47,11 @@ from dhqr_tpu.numeric import (
     guarded_lstsq,
     guarded_qr,
 )
+# Fault tolerance for the sharded tier (round 19): the typed transport
+# taxonomy rides the facade; the arming/verification API stays
+# namespaced at dhqr_tpu.armor (arm, armored, checked_dispatch, ...) so
+# the module attribute is not shadowed.
+from dhqr_tpu.armor import CorruptionDetected, ShardFailure
 from dhqr_tpu.precision import (
     PRECISION_POLICIES,
     POLICY_LADDER,
@@ -79,6 +84,7 @@ from dhqr_tpu.tune import Plan, PlanDB, resolve_plan
 # the module attribute is not shadowed.
 from dhqr_tpu.obs import MetricsRegistry, PulseReport, XrayReport
 from dhqr_tpu.utils.config import (
+    ArmorConfig,
     DHQRConfig,
     FaultConfig,
     ObsConfig,
@@ -88,7 +94,7 @@ from dhqr_tpu.utils.config import (
     TuneConfig,
 )
 
-__version__ = "0.5.0"
+__version__ = "0.6.0"
 
 __all__ = [
     "QRFactorization",
@@ -127,6 +133,9 @@ __all__ = [
     "ResidualGateFailed",
     "guarded_lstsq",
     "guarded_qr",
+    "CorruptionDetected",
+    "ShardFailure",
+    "ArmorConfig",
     "DHQRConfig",
     "FaultConfig",
     "ObsConfig",

@@ -544,6 +544,71 @@ def run(n_devices: int) -> None:
               "XLA_FLAGS=--xla_force_host_platform_device_count=8)",
               flush=True)
 
+    # ABFT armor / dhqr-armor (round 19): on a real multi-device mesh,
+    # (a) ONE injected collective corruption must be DETECTED by the
+    # weighted-checksum invariant and recovered by a single re-dispatch
+    # with the recovered result inside the 8x LAPACK bar, (b) a
+    # PERSISTENT drop schedule must exhaust the recovery ladder and
+    # resolve TYPED (an ArmorError carrying the collective label and
+    # the recovery path), (c) the armor.* registry names must exist,
+    # and (d) a warm armed repeat after the chaos must rebuild NOTHING
+    # (the post-chaos seam token collapses back to the cached key).
+    if n_devices >= 2:
+        from dhqr_tpu import armor as _armor_mod
+        from dhqr_tpu.faults import injected as _finjected
+        from dhqr_tpu.parallel.sharded_qr import (
+            _build_blocked as _armor_builds,
+        )
+        from dhqr_tpu.utils.config import ArmorConfig, FaultConfig
+
+        ref_a = oracle_residual(np.asarray(A), np.asarray(b))
+        ast_ = _armor_mod.arm(ArmorConfig(enabled=True))
+        try:
+            with _finjected(FaultConfig(sites=(
+                    ("parallel.collective.corrupt", 1.0, 1, 3),))):
+                xa = sharded_lstsq(A, b, cmesh, block_size=block_size)
+            snap = ast_.metrics_snapshot()
+            assert snap["detections"] >= 1, (
+                "injected corruption went UNDETECTED", snap)
+            assert snap["recovered_redispatch"] >= 1, (
+                "detection did not recover via re-dispatch", snap)
+            res = normal_equations_residual(A, np.asarray(xa), b)
+            assert res < TOLERANCE_FACTOR * ref_a, (
+                "recovered armor solve out of bar", res, ref_a)
+            try:
+                with _finjected(FaultConfig(sites=(
+                        ("parallel.collective.drop", 1.0, None),))):
+                    sharded_lstsq(A, b, cmesh, block_size=block_size)
+                raise AssertionError(
+                    "persistent drop schedule returned UNTYPED")
+            except _armor_mod.ArmorError as e:
+                assert e.label and e.recovery, (e.label, e.recovery)
+                typed_name = type(e).__name__
+            asnap = _obs_mod.registry().snapshot()
+            for dotted in ("armor.verifications", "armor.detections",
+                           "armor.typed_failures"):
+                assert dotted in asnap, (dotted, sorted(asnap))
+            n_built = _armor_builds.cache_info().currsize
+            xw = sharded_lstsq(A, b, cmesh, block_size=block_size)
+            jax.block_until_ready(xw)
+            assert _armor_builds.cache_info().currsize == n_built, (
+                "warm armed repeat rebuilt its program",
+                _armor_builds.cache_info())
+            snap = ast_.metrics_snapshot()
+        finally:
+            _armor_mod.disarm()
+            _armor_mod.reset_wire_trips()
+        print(f"dryrun: armor ok (1 injected corruption detected and "
+              f"re-dispatch-recovered within 8x, persistent drop typed "
+              f"{typed_name} with label+recovery, {snap['verifications']}"
+              " verifications, warm armed repeat 0 rebuilds)", flush=True)
+    else:
+        print("dryrun: armor SKIPPED (needs >= 2 devices: a 1-device "
+              "mesh launches no collectives, so there is nothing to "
+              "corrupt or verify — rerun with "
+              "XLA_FLAGS=--xla_force_host_platform_device_count=8)",
+              flush=True)
+
     # Plan autotuner (round 9): a tiny-grid on-device search must run end
     # to end on CPU — tune, persist, resolve through the PUBLIC lstsq
     # plan="auto" path — with the tuned answer held to the same 8x LAPACK

@@ -4,7 +4,7 @@ Two passes over two program representations (docs/DESIGN.md "Static
 invariants"):
 
 * **Pass 1 (AST)** — :mod:`dhqr_tpu.analysis.ast_rules` walks the source
-  tree with rule classes DHQR001-DHQR009: private-jax import hygiene, MXU
+  tree with rule classes DHQR001-DHQR010: private-jax import hygiene, MXU
   precision annotations on every contraction, config/env mutation
   containment, host syncs inside traced bodies, collective axis-name
   discipline inside ``shard_map`` bodies, swallowed-exception bans, and

@@ -1,4 +1,4 @@
-"""Pass 1 — AST lint rules DHQR001-DHQR009.
+"""Pass 1 — AST lint rules DHQR001-DHQR010.
 
 Each rule is a small class with an id, a scope predicate over the
 (posix) file path, and a ``check(module)`` hook receiving a
@@ -761,6 +761,65 @@ class RawCollectiveOutsideSeam(Rule):
         return out
 
 
+class ShardedDispatchOutsideArmor(Rule):
+    """DHQR010 — a sharded-tier entry point dispatches collective
+    results without the armor verification seam (round 19). The
+    ``sharded_*`` entry points in ``dhqr_tpu/parallel/`` are the ONE
+    place factor-carrying collective results surface to callers; each
+    one that builds a compiled sharded program (a ``_build_*`` call)
+    must route its dispatch through ``dhqr_tpu.armor.checked_dispatch``
+    (behind the ``armor.active()`` None check) so that, when armed,
+    every factor/solve crossing the mesh is checksum-verified and the
+    recovery ladder — re-dispatch, wire degrade, typed
+    ``CorruptionDetected``/``ShardFailure`` — applies. An entry point
+    that dispatches bare reintroduces exactly the silent-garbage
+    window the armor tier closes: a corrupted collective returns a
+    plausible wrong factor with no detection, no recovery, and no
+    typed refusal. Internal chaining helpers (no ``_build_*`` call of
+    their own) are exempt — they verify at the top level."""
+
+    id = "DHQR010"
+    title = ("sharded entry point dispatches collective results "
+             "outside the armor verification seam")
+
+    def applies(self, path: str) -> bool:
+        return ("parallel/" in path
+                and _in_package(path)
+                and not path.endswith("parallel/wire.py"))
+
+    def check(self, ctx):
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.FunctionDef) \
+                    or not node.name.startswith("sharded_"):
+                continue
+            builds = False
+            armored = False
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    name = _call_name(sub.func)
+                    if name.startswith("_build_"):
+                        builds = True
+                    if name == "checked_dispatch":
+                        armored = True
+                elif isinstance(sub, ast.Attribute) \
+                        and sub.attr == "checked_dispatch":
+                    armored = True
+            if builds and not armored:
+                out.append(self._finding(
+                    ctx, node,
+                    f"sharded entry point {node.name}() compiles a "
+                    "sharded program (_build_* call) but never routes "
+                    "its dispatch through armor.checked_dispatch: when "
+                    "the armor tier is armed this dispatch returns "
+                    "unverified collective results — wrap the launch "
+                    "in the checked_dispatch seam behind the "
+                    "armor.active() None check, or suppress with the "
+                    "reason no factor-carrying result crosses here",
+                ))
+        return out
+
+
 AST_RULES = (
     PrivateJaxImports(),
     UnannotatedContractions(),
@@ -771,6 +830,7 @@ AST_RULES = (
     UnguardedCholesky(),
     RawWallClock(),
     RawCollectiveOutsideSeam(),
+    ShardedDispatchOutsideArmor(),
 )
 
 

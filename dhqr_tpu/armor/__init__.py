@@ -1,0 +1,387 @@
+"""dhqr-armor — ABFT detection and typed self-healing for the sharded
+tier (round 19).
+
+The sharded engines (``dhqr_tpu/parallel``) assume every collective is
+perfect: a bit-flipped panel broadcast or a dropped shard contribution
+produces a *plausible, finite, wrong* factor — silent garbage at exactly
+the tier ROADMAP items 1-3 send to real hardware, where preemption and
+silent data corruption are operational facts (arXiv 2112.09017 scale),
+and PR-13's compressed wire widens the surface (quantized payloads and
+scale sidecars are the bytes a flaky link corrupts undetectably). This
+package closes the loop, end to end:
+
+* **Detection** — checksum-augmented verification
+  (:mod:`dhqr_tpu.armor.checks`): every armored sharded dispatch is
+  followed by an O(mn) weighted-checksum invariant over the factors it
+  already produced (``u^H A`` vs ``(Q^H u)^H R``; normal-equations
+  identity for solves) — no re-factorization — plus per-payload
+  integrity tags on COMPRESSED collectives at the ``parallel/wire.py``
+  seam (a mismatch at decompression poisons the payload NaN-loud, so
+  the post-hoc check cannot miss it).
+* **Injection** — deterministic ``parallel.collective.{corrupt,nan,
+  drop}`` fault sites fire inside the wire seam per seeded per-site
+  streams (``dhqr_tpu.faults``; the ``:k`` schedule segment picks
+  *which* traced collective), so every detection and recovery path
+  replays on CPU topologies.
+* **Recovery** — a typed ladder: verify -> single re-dispatch ->
+  degrade ``comms`` to the f32 passthrough for the offending label ->
+  typed :class:`CorruptionDetected` / :class:`ShardFailure` (NumericalError
+  siblings carrying engine, collective label, shard index, trace id),
+  which the PR-8 guarded ladder escalates past and the async scheduler
+  routes (ShardFailure -> retry/bisect like infrastructure;
+  CorruptionDetected -> bisect isolation). Repeated verification trips
+  on a compressed dispatch demote the key's compressed plans out of
+  ``tune``'s ``plan="auto"`` resolution.
+
+The PR-7 arming discipline throughout: ``DHQR_ARMOR*`` env vars
+CONFIGURE (:class:`~dhqr_tpu.utils.config.ArmorConfig`), only
+:func:`arm` / the :func:`armored` scope ARMS; disarmed, every sharded
+dispatch pays one module-global ``None`` check and compiles the
+pre-round-19 programs byte-for-byte, and warm armed loops are
+zero-recompile (every check a shape-cached jitted reduction).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Callable, Iterator, Optional
+
+from dhqr_tpu.armor.errors import (
+    ArmorError,
+    CorruptionDetected,
+    ShardFailure,
+)
+from dhqr_tpu.armor import checks
+from dhqr_tpu.faults import harness as _faults
+from dhqr_tpu.obs import trace as _obs
+from dhqr_tpu.utils.config import ArmorConfig
+from dhqr_tpu.utils.profiling import Counters
+
+__all__ = [
+    "ArmorConfig",
+    "ArmorError",
+    "ArmorState",
+    "CorruptionDetected",
+    "ShardFailure",
+    "active",
+    "arm",
+    "armored",
+    "checked_dispatch",
+    "checks",
+    "degraded_labels",
+    "disarm",
+    "effective_comms",
+    "reset_wire_trips",
+    "seam_token",
+    "wire_demoted",
+    "wire_tags_armed",
+    "wire_trips",
+]
+
+
+#: Checksum tolerance for COMPRESSED dispatches: wire rounding puts an
+#: honest compressed invariant at ~1e-3..1e-2 (measured on the
+#: committed grid — blocked-qr factor gaps are the worst at ~9e-3),
+#: while corruption lands at O(1)+. One decade of headroom each way.
+WIRE_RTOL = 0.1
+
+
+class ArmorState:
+    """One armed verification seam (config + accounting). Managed via
+    :func:`arm` / :func:`disarm` / :func:`armored`; the counters are
+    exported process-wide as ``armor.*`` by ``dhqr_tpu.obs.metrics``."""
+
+    _GEN = [0]
+
+    def __init__(self, config: ArmorConfig) -> None:
+        self.config = config
+        self.counters = Counters()
+        ArmorState._GEN[0] += 1
+        #: arm generation — seam-token material (a re-arm must re-key
+        #: the engine build caches so tag programs re-trace).
+        self.epoch = ArmorState._GEN[0]
+
+    def metrics_snapshot(self) -> dict:
+        out = {name: 0 for name in (
+            "verifications", "detections", "recovered_redispatch",
+            "recovered_degrade", "typed_failures")}
+        out.update(self.counters.snapshot())
+        out["degraded_labels"] = len(_DEGRADED)
+        out["wire_trips"] = sum(_WIRE_TRIPS.values())
+        return out
+
+
+_ACTIVE: "ArmorState | None" = None
+_ARM_LOCK = threading.Lock()
+
+# Persistent (module-lifetime, like tune's gate failures) transport
+# health memory: labels degraded to the f32 wire, and per-plan-key
+# verification-trip counts feeding tune's compressed-plan demotion.
+_DEGRADED: "set[str]" = set()
+_WIRE_TRIPS: "dict[tuple, int]" = {}
+_TRIP_LOCK = threading.Lock()
+
+# Bumped before every recovery re-dispatch WHILE wire fault sites are
+# armed: the trace-time fault schedules bake into the lru-cached engine
+# builds, so the re-dispatch must re-key them to re-draw (a harness
+# whose site is exhausted then traces a CLEAN program — that is what
+# makes single re-dispatch recovery replayable on CPU).
+_NONCE = [0]
+
+
+def arm(config: "ArmorConfig | None" = None) -> "ArmorState | None":
+    """Arm the process-wide verification seam from ``config`` (default:
+    the environment's ``DHQR_ARMOR*``). Returns the state, or None when
+    the config says disabled (mirrors ``obs.arm``)."""
+    global _ACTIVE
+    cfg = config if config is not None else ArmorConfig.from_env()
+    with _ARM_LOCK:
+        _ACTIVE = ArmorState(cfg) if cfg.enabled else None
+    return _ACTIVE
+
+
+def disarm() -> None:
+    """Back to the zero-overhead path (the degrade/trip memory is kept —
+    transport health outlives one armed scope; ``reset_wire_trips``
+    clears it)."""
+    global _ACTIVE
+    with _ARM_LOCK:
+        _ACTIVE = None
+
+
+def active() -> Optional[ArmorState]:
+    """The armed state, or None — THE one read every disarmed sharded
+    dispatch pays."""
+    return _ACTIVE
+
+
+@contextlib.contextmanager
+def armored(config: "ArmorConfig | None" = None) -> Iterator[ArmorState]:
+    """Scope the verification seam: arm on entry, restore on exit."""
+    global _ACTIVE
+    with _ARM_LOCK:
+        previous = _ACTIVE
+    state = arm(config if config is not None
+                else ArmorConfig(enabled=True))
+    try:
+        yield state
+    finally:
+        with _ARM_LOCK:
+            _ACTIVE = previous
+
+
+def wire_tags_armed() -> bool:
+    """Whether the wire seam should add integrity tags to compressed
+    payloads (armed AND ``ArmorConfig.wire_tags``) — read at TRACE time
+    by ``parallel/wire.py``."""
+    state = _ACTIVE
+    return state is not None and state.config.wire_tags
+
+
+def seam_token(comms: "str | None" = None):
+    """Cache-key material the engine ``_build_*`` lru caches append.
+
+    None — the common case: no wire fault sites armed and no armor tag
+    programs in play — keeps every existing cache key byte-identical
+    (disarmed runs compile the pre-round-19 programs). Non-None when
+    the traced program can differ from the plain one: wire fault sites
+    armed (trace-time injection; the nonce re-keys per recovery
+    re-dispatch so schedules re-draw), or armor tags armed on a
+    compressed wire.
+    """
+    f_ep = _faults.epoch() if _faults.wire_sites_armed() else 0
+    state = _ACTIVE
+    a_ep = state.epoch if (state is not None and comms is not None
+                           and state.config.wire_tags) else 0
+    if not f_ep and not a_ep:
+        return None
+    # The recovery nonce rides ONLY while wire fault sites are armed
+    # (its whole job is re-drawing baked trace-time schedules). With
+    # faults disarmed — production armor — a re-dispatch deliberately
+    # reuses the compiled program (a real transient SDC wants the same
+    # program run again), and one label's recovery must not invalidate
+    # every other armed label's build cache.
+    return (f_ep, _NONCE[0] if f_ep else 0, a_ep)
+
+
+def effective_comms(label: str, comms: "str | None") -> "str | None":
+    """The wire format ``label`` should actually dispatch with: the
+    caller's ``comms`` unless the recovery ladder degraded this label
+    to the f32 passthrough (then None, until the process restarts or
+    :func:`reset_wire_trips` clears the memory). Disarmed, the caller's
+    value passes through untouched."""
+    if comms is None or _ACTIVE is None:
+        return comms
+    with _TRIP_LOCK:
+        return None if label in _DEGRADED else comms
+
+
+def degraded_labels() -> "tuple[str, ...]":
+    with _TRIP_LOCK:
+        return tuple(sorted(_DEGRADED))
+
+
+def note_wire_trip(kind: str, m: int, n: int, dtype, nproc: int) -> int:
+    """Record one verification trip of a COMPRESSED dispatch against
+    the (kind, shape, dtype, nproc) key; returns the running count.
+    ``dhqr_tpu.tune.resolve_plan`` consults :func:`wire_demoted` and
+    strips ``comms`` from stored plans once the count reaches the
+    demotion threshold — compressed plans whose labels keep tripping
+    verification stop being offered."""
+    key = (str(kind), int(m), int(n), str(dtype), int(nproc))
+    with _TRIP_LOCK:
+        _WIRE_TRIPS[key] = _WIRE_TRIPS.get(key, 0) + 1
+        return _WIRE_TRIPS[key]
+
+
+def wire_trips(kind: str, m: int, n: int, dtype, nproc: int) -> int:
+    with _TRIP_LOCK:
+        return _WIRE_TRIPS.get(
+            (str(kind), int(m), int(n), str(dtype), int(nproc)), 0)
+
+
+def wire_demoted(kind: str, m: int, n: int, dtype, nproc: int) -> bool:
+    """Whether the key's compressed plans are demoted (trips >= tune's
+    ``PLAN_DEMOTE_AFTER`` — one threshold for both demotion flavors)."""
+    from dhqr_tpu.tune.search import PLAN_DEMOTE_AFTER
+
+    return wire_trips(kind, m, n, dtype, nproc) >= PLAN_DEMOTE_AFTER
+
+
+def reset_wire_trips() -> None:
+    """Clear the degrade/trip memory (tests; or after a link repair)."""
+    with _TRIP_LOCK:
+        _WIRE_TRIPS.clear()
+        _DEGRADED.clear()
+
+
+def _bump_nonce() -> None:
+    _NONCE[0] += 1
+
+
+def _classify(gap: float):
+    """NaN-loud detections (inf gap — wire-tag poisoning, an injected
+    NaN) are payload corruption; a finite over-threshold gap is a
+    shard's contribution arriving wrong/missing as a unit."""
+    return CorruptionDetected if gap == float("inf") else ShardFailure
+
+
+def checked_dispatch(
+    label: str,
+    dispatch: Callable[[], object],
+    verify: Callable[[object], "tuple[float, int | None]"],
+    *,
+    engine: str,
+    comms: "str | None" = None,
+    degrade: "Callable[[], object] | None" = None,
+    shard_of: "Callable[[int], int | None] | None" = None,
+    plan_shape: "tuple | None" = None,
+) -> object:
+    """The armored dispatch seam: run ``dispatch``, verify its result
+    against the checksum invariant, and on detection walk the recovery
+    ladder — re-dispatch (``ArmorConfig.redispatch`` times, re-keying
+    the build caches so injected trace-time faults re-draw), degrade
+    the label's wire to the f32 passthrough (compressed dispatches
+    only; the degrade sticks for the label and feeds tune's
+    compressed-plan demotion), then raise typed.
+
+    ``verify(result) -> (gap, worst_col)`` returns the relative
+    checksum gap (inf = NaN-loud) and the localizing column (None when
+    the invariant does not localize); ``shard_of(worst_col)`` maps it
+    to the mesh position. ``plan_shape = (kind, m, n, dtype, nproc)``
+    keys the wire-trip accounting. Callers guard with
+    :func:`active` — this function assumes an armed state.
+    """
+    state = _ACTIVE
+    if state is None:       # disarmed between the caller's check and now
+        return dispatch()
+    cfg = state.config
+    rec = _obs.active()
+    tid = rec.mint() if rec is not None else None
+    if rec is not None:
+        rec.event(tid, "submit", kind="armor", label=label, engine=engine,
+                  comms=comms or "f32")
+
+    last_tol = [cfg.rtol]
+
+    def _verify(out, stage: str, wire: "str | None"):
+        # Per-STAGE tolerance: compressed dispatches carry honest
+        # wire-rounding in their invariants (~1e-3..1e-2 measured),
+        # so they verify against WIRE_RTOL; the degrade stage runs the
+        # f32 passthrough and drops back to the tight cfg.rtol.
+        tol = cfg.rtol if wire is None else max(cfg.rtol, WIRE_RTOL)
+        last_tol[0] = tol
+        state.counters.bump("verifications")
+        gap, worst = verify(out)
+        ok = gap <= tol
+        if rec is not None:
+            rec.event(tid, "verify", stage=stage, ok=bool(ok),
+                      rtol=tol,
+                      gap=(round(gap, 8) if gap != float("inf")
+                           else "inf"))
+        return ok, gap, worst
+
+    out = dispatch()
+    ok, gap, worst = _verify(out, "dispatch", comms)
+    if ok:
+        if rec is not None:
+            rec.event(tid, "resolve", outcome="ok")
+        return out
+
+    state.counters.bump("detections")
+    first_cls = _classify(gap)
+    shard = shard_of(worst) if (shard_of is not None
+                                and worst is not None
+                                and gap != float("inf")) else None
+    if comms is not None and plan_shape is not None:
+        note_wire_trip(*plan_shape)
+    recovery: "list[str]" = []
+
+    for attempt in range(cfg.redispatch):
+        recovery.append("redispatch")
+        _bump_nonce()       # re-key the builds: injected schedules re-draw
+        if rec is not None:
+            rec.event(tid, "redispatch", attempt=attempt + 1)
+        out = dispatch()
+        ok, gap, worst = _verify(out, f"redispatch{attempt + 1}", comms)
+        if ok:
+            state.counters.bump("recovered_redispatch")
+            if rec is not None:
+                rec.event(tid, "resolve", outcome="ok",
+                          recovery="redispatch")
+            return out
+
+    if comms is not None and degrade is not None:
+        recovery.append("degrade")
+        with _TRIP_LOCK:
+            _DEGRADED.add(label)
+        _bump_nonce()
+        if rec is not None:
+            rec.event(tid, "degrade", label=label, from_comms=comms)
+        out = degrade()
+        ok, gap, worst = _verify(out, "degrade", None)
+        if ok:
+            state.counters.bump("recovered_degrade")
+            if rec is not None:
+                rec.event(tid, "resolve", outcome="ok",
+                          recovery="degrade")
+            return out
+
+    state.counters.bump("typed_failures")
+    cls = _classify(gap) if gap == float("inf") else first_cls
+    noun = ("corrupted collective payload"
+            if cls is CorruptionDetected else "shard contribution lost")
+    err = cls(
+        f"{noun} at {label!r}: checksum invariant failed "
+        f"(gap {gap:.3e} > rtol {last_tol[0]:.0e}) and recovery "
+        f"({' -> '.join(recovery) or 'none configured'}) did not "
+        "produce a verifiable result",
+        engine=engine, label=label, shard_index=shard, trace_id=tid,
+        recovery=tuple(recovery))
+    if rec is not None:
+        rec.attach(err, tid)
+        rec.event(tid, "resolve", outcome=type(err).__name__,
+                  error=str(err)[:200])
+        rec.on_error(err, tid)
+    raise err

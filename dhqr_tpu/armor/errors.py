@@ -1,0 +1,85 @@
+"""Typed fault-tolerance taxonomy for the sharded tier (round 19).
+
+The PR-12 fault model types every INFRASTRUCTURE failure
+(:class:`~dhqr_tpu.serve.errors.ServeError`) and the PR-13 guardrails
+every DATA failure (:class:`~dhqr_tpu.numeric.errors.NumericalError`).
+This module types the third population — failures of the *transport*
+between shards: a corrupted collective payload, a dropped shard
+contribution, a bit-flip landing in a compressed panel broadcast. Both
+types are NumericalError SIBLINGS inside the taxonomy (they arrive as
+wrong numbers, and the PR-8 guarded ladder can escalate past them), but
+they carry transport provenance the data types cannot: WHICH collective
+label, WHICH shard, and the obs trace id of the armored dispatch that
+caught them.
+
+The scheduler distinguishes them (``serve/scheduler.py``):
+:class:`ShardFailure` is presumed TRANSIENT — a flaky link, a wedged
+device, a preempted worker — so it takes the retry/bisect machinery
+like a ``DispatchFailed`` (re-dispatching genuinely can fix it), while
+:class:`CorruptionDetected` keeps the NumericalError bisect-isolation
+route (by the time the armor recovery ladder has re-dispatched and
+degraded the wire without success, retrying the same program is not the
+fix).
+"""
+
+from __future__ import annotations
+
+from dhqr_tpu.numeric.errors import NumericalError
+
+
+class ArmorError(NumericalError):
+    """Base of the armor taxonomy: a sharded-tier result whose ABFT
+    invariants failed verification (or whose wire integrity tags
+    poisoned it), after the recovery ladder ran dry.
+
+    Attributes (beyond :class:`NumericalError`'s ``engine`` /
+    ``cond_estimate`` / ``attempts``):
+      label: the collective dispatch label of the armored entry point
+        (the same spelling dhqr-pulse uses, e.g.
+        ``"blocked_qr[P=4,64x32,nb=8,block]"``) — the unit the
+        recovery ladder degrades.
+      shard_index: the shard (mesh position) the checksum discrepancy
+        localizes to, when the invariant localizes (column-sharded
+        factor checks do; row-sharded solve residuals do not — None
+        then).
+      trace_id: the obs trace id of the armored dispatch (None when
+        tracing was disarmed) — ``python -m dhqr_tpu.obs dump``
+        replays the verify -> re-dispatch -> degrade path.
+      recovery: the recovery rungs tried before the refusal, in order
+        (e.g. ``("redispatch", "degrade")``).
+    """
+
+    def __init__(self, message: str, engine: "str | None" = None,
+                 label: "str | None" = None,
+                 shard_index: "int | None" = None,
+                 trace_id: "int | None" = None,
+                 recovery: tuple = ()) -> None:
+        super().__init__(message, engine=engine)
+        self.label = label
+        self.shard_index = (None if shard_index is None
+                            else int(shard_index))
+        self.trace_id = trace_id
+        self.recovery = tuple(recovery)
+
+
+class CorruptionDetected(ArmorError):
+    """A collective payload arrived CORRUPTED: a wire integrity tag
+    mismatched at decompression (the payload was poisoned NaN-loud at
+    the seam), or the post-hoc weighted-checksum invariant found a
+    non-finite or checksum-breaking factor, and neither a re-dispatch
+    nor degrading the wire to the f32 passthrough produced a verifiable
+    result. The failure tracks the DATA PATH, not the request's data —
+    the matrix itself screened clean — so the scheduler
+    bisect-isolates rather than blind-retrying (the armor ladder
+    already spent the re-dispatches that could have helped)."""
+
+
+class ShardFailure(ArmorError):
+    """A shard's contribution is MISSING or wrong as a unit: the
+    invariant discrepancy localizes to one shard's columns (or the
+    result is exactly the all-but-one-shard value — the dropped-psum
+    signature), the collective completed, and recovery could not buy
+    the words back. Presumed transient infrastructure (preemption, a
+    flaky ICI link): the async scheduler routes this through the
+    SAME retry/backoff/bisect machinery as a
+    :class:`~dhqr_tpu.serve.errors.DispatchFailed`."""

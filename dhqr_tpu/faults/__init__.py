@@ -24,6 +24,14 @@ guarded entry points' input screen, as if the scan found a NaN) and
 rung's factors came back non-finite) — so every escalation path of
 ``dhqr_tpu.numeric`` is deterministically replayable without crafting
 an ill-conditioned matrix for it.
+
+Round 19 adds the COLLECTIVE sites — ``parallel.collective.corrupt``
+/ ``.nan`` / ``.drop``, "wire"-kind entries consulted at TRACE time
+inside the dhqr-wire seam (one visit per traced collective) — and the
+optional ``:k`` schedule segment (``site:prob[:count[:k]]``: silent
+for the first k-1 visits), so "corrupt exactly the 3rd panel
+broadcast" is a replayable experiment the armor chaos grid sweeps
+(``dhqr_tpu.armor``, benchmarks/serving_armor.py).
 """
 
 from dhqr_tpu.faults.harness import (
@@ -31,11 +39,14 @@ from dhqr_tpu.faults.harness import (
     FaultHarness,
     FaultInjected,
     active,
+    epoch,
     fire,
     injected,
     install,
     latency,
+    suspended,
     uninstall,
+    wire_sites_armed,
 )
 
 __all__ = [
@@ -43,9 +54,12 @@ __all__ = [
     "FaultHarness",
     "FaultInjected",
     "active",
+    "epoch",
     "fire",
     "injected",
     "install",
     "latency",
+    "suspended",
     "uninstall",
+    "wire_sites_armed",
 ]

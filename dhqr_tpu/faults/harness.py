@@ -57,6 +57,18 @@ site                  action  where it is threaded
                               ladder rung — treated exactly as that rung's
                               factors coming back non-finite, so the
                               fallback ladder escalates deterministically
+``parallel.collective.corrupt``
+                      wire    ``parallel/wire.py`` — consulted at TRACE
+                              time, once per traced collective; a trigger
+                              bakes a large additive corruption into the
+                              payload crossing that collective (round 19;
+                              the ``:k`` segment picks WHICH collective)
+``parallel.collective.nan``   wire — as above, poisoning one payload
+                              element NaN (a bit-flip landing in the
+                              exponent field)
+``parallel.collective.drop``  wire — as above, zeroing the payload (a
+                              dropped shard contribution: the psum/gather
+                              completes, the owner's words never arrive)
 ====================  ======  ==============================================
 """
 
@@ -73,7 +85,12 @@ from dhqr_tpu.utils.config import FaultConfig
 from dhqr_tpu.utils.profiling import Counters
 
 # site name -> action kind. "raise" sites throw FaultInjected when they
-# trigger; "sleep" sites block for FaultConfig.latency_ms.
+# trigger; "sleep" sites block for FaultConfig.latency_ms; "wire" sites
+# (round 19) are payload mutators consulted by the dhqr-wire seam at
+# TRACE time (parallel/wire.py) — a trigger bakes the corruption into
+# the traced collective, so one "visit" is one traced collective, not
+# one dispatch (the armor seam busts the engine build caches per fault
+# epoch so schedules re-draw per re-trace).
 SITES = {
     "serve.compile": "raise",
     "serve.dispatch": "raise",
@@ -81,6 +98,9 @@ SITES = {
     "serve.latency": "sleep",
     "numeric.nan": "raise",
     "numeric.breakdown": "raise",
+    "parallel.collective.corrupt": "wire",
+    "parallel.collective.nan": "wire",
+    "parallel.collective.drop": "wire",
 }
 
 
@@ -95,13 +115,19 @@ class FaultInjected(RuntimeError):
 
 
 class _SiteState:
-    __slots__ = ("prob", "remaining", "rng")
+    __slots__ = ("prob", "remaining", "rng", "from_visit", "visits")
 
     def __init__(self, prob: float, max_triggers: "int | None",
-                 rng: random.Random) -> None:
+                 rng: random.Random,
+                 from_visit: "int | None" = None) -> None:
         self.prob = prob
         self.remaining = max_triggers  # None = unbounded
         self.rng = rng
+        # Fire-on-kth-visit schedules (round 19, the :k config segment):
+        # the first from_visit - 1 visits never trigger; prob/count
+        # apply from visit from_visit onward. None = from the first.
+        self.from_visit = from_visit
+        self.visits = 0
 
 
 class FaultHarness:
@@ -120,7 +146,9 @@ class FaultHarness:
         self._sleep = sleeper
         self._lock = threading.Lock()
         self._sites: "dict[str, _SiteState]" = {}
-        for site, prob, count in config.sites:
+        for entry in config.sites:
+            site, prob, count = entry[0], entry[1], entry[2]
+            from_visit = entry[3] if len(entry) == 4 else None
             if site not in SITES:
                 raise ValueError(
                     f"unknown fault site {site!r}; registered sites: "
@@ -130,7 +158,7 @@ class FaultHarness:
             # survives PYTHONHASHSEED randomization.
             rng = random.Random(
                 (config.seed << 32) ^ zlib.crc32(site.encode("utf-8")))
-            self._sites[site] = _SiteState(prob, count, rng)
+            self._sites[site] = _SiteState(prob, count, rng, from_visit)
 
     def should_fire(self, site: str) -> bool:
         """Draw the site's next decision (and account the visit)."""
@@ -139,6 +167,10 @@ class FaultHarness:
             return False
         with self._lock:
             self.counters.bump(f"visits_{site}")
+            state.visits += 1
+            if state.from_visit is not None \
+                    and state.visits < state.from_visit:
+                return False    # the :k segment: silent before visit k
             if state.remaining is not None and state.remaining <= 0:
                 return False
             if state.prob < 1.0 and state.rng.random() >= state.prob:
@@ -178,6 +210,27 @@ class FaultHarness:
 # under the GIL; injection points read it exactly once per visit.
 _ACTIVE: "FaultHarness | None" = None
 _INSTALL_LOCK = threading.Lock()
+# Monotone arm/disarm generation (round 19). The "wire"-kind sites fire
+# at TRACE time inside lru-cached engine builds (parallel/wire.py), so
+# re-arming a schedule must re-key those caches or a stale baked fault
+# would replay forever; dhqr_tpu.armor folds this into its seam token.
+_EPOCH = 0
+
+
+def epoch() -> int:
+    """The harness arm/disarm generation — bumped by every
+    :func:`install` / :func:`uninstall` (and :func:`injected` scope
+    exit), never reset. Cache-key material for trace-time seams."""
+    return _EPOCH
+
+
+def wire_sites_armed() -> bool:
+    """Whether the armed harness (if any) configures a trace-time
+    ``parallel.collective.*`` site — the wire seam's one-read guard."""
+    harness = _ACTIVE
+    return harness is not None and any(
+        site.startswith("parallel.collective.")
+        for site in harness._sites)
 
 
 def install(config: "FaultConfig | None" = None,
@@ -185,23 +238,51 @@ def install(config: "FaultConfig | None" = None,
     """Arm the process-wide harness from ``config`` (default: the
     environment's ``DHQR_FAULTS*``). Replaces any previously armed
     harness. Returns the harness so callers can read its stats."""
-    global _ACTIVE
+    global _ACTIVE, _EPOCH
     cfg = config if config is not None else FaultConfig.from_env()
     harness = FaultHarness(cfg, sleeper=sleeper)
     with _INSTALL_LOCK:
         _ACTIVE = harness if cfg.enabled else None
+        _EPOCH += 1
     return harness
 
 
 def uninstall() -> None:
     """Disarm: every injection point reverts to the zero-overhead path."""
-    global _ACTIVE
+    global _ACTIVE, _EPOCH
     with _INSTALL_LOCK:
         _ACTIVE = None
+        _EPOCH += 1
+
+
+# Suspension depth (round 19): while the CALLING thread's depth > 0,
+# active() reads None so no injection point fires OR accounts a visit.
+# The pulse census retrace (obs/pulse.measure's abstract() ->
+# jax.make_jaxpr) re-traces shard bodies whose wire seams would
+# otherwise consume trace-time schedule visits against a DISCARDED
+# jaxpr — breaking the "one visit = one traced collective of a real
+# program" replay contract. THREAD-local, not process-global: another
+# thread concurrently tracing a REAL armed program (an AsyncScheduler
+# worker) must keep its schedule firing and its visit indices intact.
+_SUSPEND = threading.local()
+
+
+@contextlib.contextmanager
+def suspended() -> Iterator[None]:
+    """Scope during which every injection point on THIS thread is inert
+    and unvisited (nests; other threads' schedules are untouched)."""
+    _SUSPEND.depth = getattr(_SUSPEND, "depth", 0) + 1
+    try:
+        yield
+    finally:
+        _SUSPEND.depth -= 1
 
 
 def active() -> Optional[FaultHarness]:
-    """The currently armed harness, or None."""
+    """The currently armed harness, or None (also None inside the
+    calling thread's :func:`suspended` scope)."""
+    if getattr(_SUSPEND, "depth", 0):
+        return None
     return _ACTIVE
 
 
@@ -209,7 +290,7 @@ def active() -> Optional[FaultHarness]:
 def injected(config: FaultConfig, sleeper=time.sleep) -> Iterator[FaultHarness]:
     """Scope a fault schedule: arm on entry, disarm on exit (restoring
     whatever was armed before — scopes nest)."""
-    global _ACTIVE
+    global _ACTIVE, _EPOCH
     with _INSTALL_LOCK:
         previous = _ACTIVE
     harness = install(config, sleeper=sleeper)
@@ -218,20 +299,24 @@ def injected(config: FaultConfig, sleeper=time.sleep) -> Iterator[FaultHarness]:
     finally:
         with _INSTALL_LOCK:
             _ACTIVE = previous
+            _EPOCH += 1
 
 
 def fire(site: str) -> None:
     """Injection point for ``raise``-kind sites: no-op unless a harness
     is armed AND the site triggers, in which case :class:`FaultInjected`
-    propagates. THE hot-path entry — one global read when disarmed."""
-    harness = _ACTIVE
+    propagates. THE hot-path entry — one :func:`active` read when
+    disarmed (which honors :func:`suspended`: a suspended scope must
+    silence raise/sleep sites too, not just the wire kind)."""
+    harness = active()
     if harness is not None:
         harness.fire(site)
 
 
 def latency(site: str = "serve.latency") -> None:
     """Injection point for ``sleep``-kind sites: no-op unless armed and
-    triggered, in which case the configured latency is slept."""
-    harness = _ACTIVE
+    triggered (inert inside a :func:`suspended` scope), in which case
+    the configured latency is slept."""
+    harness = active()
     if harness is not None:
         harness.latency(site)

@@ -53,6 +53,7 @@ from __future__ import annotations
 
 import dataclasses
 
+from dhqr_tpu.armor.errors import ArmorError
 from dhqr_tpu.faults import harness as _faults
 from dhqr_tpu.numeric import guards as _guards
 from dhqr_tpu.numeric.errors import (
@@ -106,6 +107,10 @@ class Attempt:
     rejected the problem shape/knobs — e.g. tsqr needs genuinely tall
     row blocks, the m < n path takes no refinement), "residual_gate"
     (finite but over the 8x criterion; ratio in ``residual_ratio``),
+    "corruption" (round 19: the armor seam's typed
+    ``CorruptionDetected``/``ShardFailure`` after its own recovery
+    ladder ran dry — the rung's TRANSPORT failed, so the guarded
+    ladder escalates to the next engine exactly as for a breakdown),
     or "zero_pivot" (``guarded_qr``: finite factors with an
     exactly-zero R diagonal entry). Anything else a rung raises
     propagates immediately — the ladder absorbs numerical failures,
@@ -204,7 +209,8 @@ def _attempt_recorder(attempts: list, rec, tid):
     can never desynchronize."""
     def _att(att: Attempt) -> None:
         attempts.append(att)
-        if att.outcome in ("breakdown", "inapplicable", "residual_gate"):
+        if att.outcome in ("breakdown", "inapplicable", "residual_gate",
+                           "corruption"):
             COUNTERS.bump("fallbacks")
         _trace_rung(rec, tid, att)
     return _att
@@ -455,6 +461,7 @@ def guarded_lstsq(
             rungs.append(("householder", ecfg, desc))
 
     attempts: "list[Attempt]" = []
+    last_armor: "ArmorError | None" = None
     _att = _attempt_recorder(attempts, rec, tid)
     for i, (eng, rcfg, desc) in enumerate(rungs):
         try:
@@ -471,6 +478,16 @@ def guarded_lstsq(
             if i == 0:
                 raise  # the caller's own config error — never masked
             _att(Attempt(eng, desc, "inapplicable", detail=str(e)))
+            continue
+        except ArmorError as e:
+            # Round 19: the armor seam refused the rung's TRANSPORT
+            # (corrupted collective / lost shard, its own
+            # re-dispatch/degrade ladder dry). The next rung dispatches
+            # a DIFFERENT program — exactly what escalation is for.
+            last_armor = e
+            _att(Attempt(eng, desc, "corruption", detail=str(e)[:200]))
+            if i == 0 and plan_active:
+                _note_plan_failure(A, mesh, pol)
             continue
         if _guards.any_nonfinite(x):
             _att(Attempt(eng, desc, "breakdown"))
@@ -496,6 +513,13 @@ def guarded_lstsq(
                       escalations=len(attempts) - 1)
         return GuardedResult(x, eng, desc, tuple(attempts),
                              residual_ratio=ratio, trace_id=tid)
+    if last_armor is not None and not any(
+            a.outcome in ("breakdown", "residual_gate") for a in attempts):
+        # Every failure was transport: the armor error IS the right
+        # typed refusal (it carries the collective label / shard index
+        # / trace id the runbook triages by); attempts ride along.
+        last_armor.attempts = tuple(attempts)
+        raise _refuse(rec, tid, last_armor)
     raise _refuse(rec, tid, _classify_exhausted(A, tuple(attempts), probe))
 
 
@@ -576,6 +600,7 @@ def guarded_qr(
         rungs.append((acc, "accurate"))
 
     attempts: "list[Attempt]" = []
+    last_armor: "ArmorError | None" = None
     _att = _attempt_recorder(attempts, rec, tid)
     for i, (rcfg, desc) in enumerate(rungs):
         try:
@@ -584,7 +609,15 @@ def guarded_qr(
             _att(Attempt("householder", desc, "breakdown",
                          detail="injected numeric.breakdown"))
             continue
-        fact = _qr(A, config=rcfg, mesh=mesh)  # config errors propagate
+        try:
+            fact = _qr(A, config=rcfg, mesh=mesh)  # config errors propagate
+        except ArmorError as e:
+            # Round 19: transport refusal from the armor seam — the
+            # policy-escalation rung re-dispatches a fresh program.
+            last_armor = e
+            _att(Attempt("householder", desc, "corruption",
+                         detail=str(e)[:200]))
+            continue
         if _guards.any_nonfinite(fact.H, fact.alpha):
             _att(Attempt("householder", desc, "breakdown"))
             continue
@@ -608,6 +641,13 @@ def guarded_qr(
                 if mode == "full" else None)
         return GuardedResult(fact, "householder", desc, tuple(attempts),
                              cond_estimate=cond, trace_id=tid)
+    if last_armor is not None and not any(
+            a.outcome == "breakdown" for a in attempts):
+        # Every failure was transport (same rule as guarded_lstsq):
+        # the armor error carries the label/shard/trace-id provenance
+        # the runbook triages by, and its type routes the scheduler.
+        last_armor.attempts = tuple(attempts)
+        raise _refuse(rec, tid, last_armor)
     raise _refuse(rec, tid, Breakdown(
         f"householder factorization broke down on every rung "
         f"({len(attempts)} tried) — a finite input should never do "

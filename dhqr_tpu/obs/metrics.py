@@ -255,6 +255,7 @@ def _tune_provider() -> dict:
         "demoted_keys": sum(1 for v in failures.values()
                             if v >= PLAN_DEMOTE_AFTER),
         "demoted_lookups": stats.get("demoted_lookups", 0),
+        "wire_demoted_lookups": stats.get("wire_demoted_lookups", 0),
         "demote_after": stats.get("demote_after", PLAN_DEMOTE_AFTER),
     }
 
@@ -310,6 +311,21 @@ def _pulse_provider() -> dict:
     return store.stats()
 
 
+def _armor_provider() -> dict:
+    """The armed armor state's ABFT accounting (``armor.verifications``
+    / ``armor.detections`` / ``armor.recovered_redispatch`` /
+    ``armor.recovered_degrade`` / ``armor.typed_failures`` /
+    ``armor.degraded_labels`` / ``armor.wire_trips``), empty when the
+    verification seam is disarmed — the armed-harness pattern of
+    ``faults.*``/``obs.*`` (round 19)."""
+    from dhqr_tpu import armor as _armor
+
+    state = _armor.active()
+    if state is None:
+        return {}
+    return state.metrics_snapshot()
+
+
 def _solvers_provider() -> dict:
     """The round-17 solver families' module counters
     (``solvers.sketch_calls`` / ``solvers.update_refactors`` / ... —
@@ -341,6 +357,7 @@ def _new_default_registry() -> MetricsRegistry:
     reg.register("xray", _xray_provider)
     reg.register("comms", _pulse_provider)
     reg.register("solvers", _solvers_provider)
+    reg.register("armor", _armor_provider)
     # serve.cache.* / serve.sched.* have no lazy provider: every
     # ExecutableCache and AsyncScheduler instance self-registers at
     # construction (weakly — test instances evaporate with GC).

@@ -240,8 +240,17 @@ def _analytic_census(abstract: "Callable[[], object] | None",
         return None, (), "no abstract trace provided for this dispatch"
     try:
         from dhqr_tpu.analysis.comms_pass import collect_comms
+        from dhqr_tpu.faults import harness as _faults
 
-        stats = collect_comms(abstract())
+        # abstract() re-traces the shard body into a DISCARDED jaxpr;
+        # with trace-time fault schedules armed (the round-19
+        # parallel.collective.* wire sites) that retrace would consume
+        # schedule visits against a program that never runs, shifting
+        # which real collective a :k schedule hits. Suspend the
+        # harness for the census — one visit = one traced collective
+        # of a real program.
+        with _faults.suspended():
+            stats = collect_comms(abstract())
     # dhqr: ignore[DHQR006] the census rides a dispatch path: a trace failure costs the analytic side of the report, never the dispatch
     except Exception as e:
         return None, (), f"abstract trace failed: {type(e).__name__}: {e}"

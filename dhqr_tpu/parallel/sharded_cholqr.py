@@ -32,6 +32,9 @@ from dhqr_tpu.obs import pulse as _pulse
 # (per-device scales cannot ride an additive reduction).
 from dhqr_tpu.parallel import wire as _wire
 
+# dhqr-armor (round 19) ABFT verification seam (DHQR010).
+from dhqr_tpu import armor as _armor
+
 from dhqr_tpu.ops.cholqr import _cholqr_passes
 from dhqr_tpu.ops.solve import as_matrix_rhs
 from dhqr_tpu.ops.householder import DEFAULT_PRECISION
@@ -85,7 +88,8 @@ def _cholqr_shard_body(Al, bl, *, axis: str, precision: str, shift: bool,
 
 @lru_cache(maxsize=None)
 def _build_cholqr(mesh: Mesh, axis_name: str, precision: str, shift: bool,
-                  comms: "str | None" = None):
+                  comms: "str | None" = None, seam=None):
+    # ``seam``: round-19 cache-key material only (wire.seam_token).
     body = partial(
         _cholqr_shard_body, axis=axis_name, precision=precision, shift=shift,
         comms=comms,
@@ -126,14 +130,31 @@ def sharded_cholqr_lstsq(
         raise ValueError(f"m={m} must be divisible by mesh size {nproc}")
     A = jax.device_put(A, NamedSharding(mesh, P(axis_name, None)))
     b = jax.device_put(b, NamedSharding(mesh, P(axis_name)))
-    fn = _build_cholqr(mesh, axis_name, precision, bool(shift), comms)
-    if _pulse.active() is None:
-        return fn(A, b)
-    return _pulse.observed_dispatch(
-        f"cholqr_lstsq[P={nproc},{m}x{n}" + (",shift" if shift else "")
-        + (f",w{comms}" if comms else "") + "]",
-        lambda: fn(A, b), abstract=lambda: jax.make_jaxpr(fn)(A, b),
-        n_devices=nproc, wire_format=comms)
+    base_label = (f"cholqr_lstsq[P={nproc},{m}x{n}"
+                  + (",shift" if shift else "") + "]")
+    comms = _armor.effective_comms(base_label, comms)
+
+    def _dispatch(wire_comms):
+        fn = _build_cholqr(mesh, axis_name, precision, bool(shift),
+                           wire_comms, _wire.seam_token(wire_comms))
+        if _pulse.active() is None:
+            return fn(A, b)
+        return _pulse.observed_dispatch(
+            f"cholqr_lstsq[P={nproc},{m}x{n}" + (",shift" if shift else "")
+            + (f",w{wire_comms}" if wire_comms else "") + "]",
+            lambda: fn(A, b), abstract=lambda: jax.make_jaxpr(fn)(A, b),
+            n_devices=nproc, wire_format=wire_comms)
+
+    if _armor.active() is None:
+        return _dispatch(comms)
+    # ABFT verification (round 19): O(mn) normal-equations checksum ->
+    # re-dispatch -> degrade wire -> typed (dhqr_tpu.armor).
+    return _armor.checked_dispatch(
+        base_label, lambda: _dispatch(comms),
+        lambda x: (_armor.checks.lstsq_gap(A, b, x), None),
+        engine="cholqr3" if shift else "cholqr2", comms=comms,
+        degrade=(lambda: _dispatch(None)) if comms else None,
+        plan_shape=("lstsq", m, n, str(A.dtype), nproc))
 
 
 # Comms contract (dhqr-audit): psum only, 2*n^2 + n*nrhs words per

@@ -49,6 +49,13 @@ from dhqr_tpu.obs import pulse as _pulse
 # rejects raw lax collectives in this package.
 from dhqr_tpu.parallel import wire as _wire
 
+# dhqr-armor (round 19): the ABFT verification seam (DHQR010) — the
+# public entry points below wrap their dispatch in
+# armor.checked_dispatch when armed (weighted-checksum invariant,
+# recovery ladder, typed refusal); disarmed cost is one module-global
+# None check and the build-cache keys stay byte-identical.
+from dhqr_tpu import armor as _armor
+
 from dhqr_tpu.ops.blocked import (
     MAX_UNROLLED_PANELS,
     _factor_group,
@@ -93,6 +100,16 @@ def _panel_owner(k: int, n: int, nloc: int, nb: int, layout: str):
         return owner, k - owner * nloc
     kb = k // nb
     return kb % P, (kb // P) * nb
+
+
+def _col_owner(col: int, n: int, nproc: int, nb: int, layout: str) -> int:
+    """Owner device of global column ``col`` — the armor seam's
+    checksum-gap localization (worst discrepant column -> implicated
+    shard; :class:`dhqr_tpu.armor.ShardFailure` carries it)."""
+    nloc = n // nproc
+    if layout == "cyclic":
+        return (int(col) // max(nb, 1)) % nproc
+    return int(col) // nloc
 
 
 def _panel_owner_traced(kb, P: int, nloc: int, nb: int, layout: str):
@@ -656,7 +673,11 @@ def _blocked_shard_agg(
 def _build_unblocked(
     mesh: Mesh, axis_name: str, n: int, precision: str, layout: str,
     store_nb: int, norm: str = "accurate", comms: "str | None" = None,
+    seam=None,
 ):
+    # ``seam``: round-19 cache-key material only (wire.seam_token) —
+    # None in the common case, a fresh tuple per fault epoch / armor
+    # re-arm so trace-time injection and tag programs re-trace.
     body = partial(
         _unblocked_shard_body,
         n=n, axis=axis_name, precision=precision, layout=layout,
@@ -680,7 +701,9 @@ def _build_blocked(
     panel_impl: str = "loop", pallas_flat: "int | None" = None,
     trailing_precision: "str | None" = None, lookahead: bool = False,
     agg_panels: "int | None" = None, comms: "str | None" = None,
+    seam=None,
 ):
+    # ``seam``: round-19 cache-key material only (see _build_unblocked).
     body = partial(
         _blocked_shard_body,
         n=n, nb=nb, axis=axis_name, precision=precision, layout=layout,
@@ -814,19 +837,47 @@ def sharded_householder_qr(
     # (store_nb | n // nproc holds by construction here: the padding
     # dispatch above guarantees n % (store_nb * nproc) == 0.)
     _check_divisibility(m, n, nproc, None, layout)
+    A_in = A
+    base_label = f"unblocked_qr[P={nproc},{m}x{n},{layout}]"
+    comms = _armor.effective_comms(base_label, comms)
     A = _to_store_layout(A, n, nproc, store_nb, layout)
     A = jax.device_put(A, column_sharding(mesh, axis_name))
-    fn = _build_unblocked(
-        mesh, axis_name, n, precision, layout, store_nb, norm, comms
-    )
-    if _pulse.active() is None:
-        H, alpha = fn(A)
-    else:
-        H, alpha = _pulse.observed_dispatch(
+
+    def _dispatch(wire_comms):
+        fn = _build_unblocked(
+            mesh, axis_name, n, precision, layout, store_nb, norm,
+            wire_comms, _wire.seam_token(wire_comms)
+        )
+        if _pulse.active() is None:
+            return fn(A)
+        return _pulse.observed_dispatch(
             f"unblocked_qr[P={nproc},{m}x{n},{layout}"
-            + (f",w{comms}" if comms else "") + "]",
+            + (f",w{wire_comms}" if wire_comms else "") + "]",
             lambda: fn(A), abstract=lambda: jax.make_jaxpr(fn)(A),
-            n_devices=nproc, wire_format=comms)
+            n_devices=nproc, wire_format=wire_comms)
+
+    if _armor.active() is None or _store_layout_output:
+        # Internal store-layout chaining (sharded_lstsq) verifies once,
+        # at the top level, over the whole factor+solve pipeline.
+        H, alpha = _dispatch(comms)
+    else:
+        # Armed branch = natural-layout output only: one relayout per
+        # attempt, shared by verify and the caller (see blocked twin).
+        def _dispatch_nat(wire_comms):
+            Hs, a = _dispatch(wire_comms)
+            return _to_natural_layout(Hs, n, nproc, store_nb, layout), a
+
+        def _verify(out):
+            return _armor.checks.qr_gap(out[0], out[1], A_in,
+                                        min(32, n), precision="highest")
+
+        return _armor.checked_dispatch(
+            base_label, lambda: _dispatch_nat(comms), _verify,
+            engine="householder", comms=comms,
+            degrade=(lambda: _dispatch_nat(None)) if comms else None,
+            shard_of=lambda col: _col_owner(col, n, nproc, store_nb,
+                                            layout),
+            plan_shape=("qr", m, n, str(A_in.dtype), nproc))
     if not _store_layout_output:
         H = _to_natural_layout(H, n, nproc, store_nb, layout)
     return H, alpha
@@ -945,27 +996,57 @@ def sharded_blocked_qr(
     # vehicle — the returned interpret flag encodes exactly that).
     pallas, interp = _resolve_pallas(use_pallas, m, nb, A.dtype,
                                      device=mesh.devices.flat[0])
-    A = _to_store_layout(A, n, nproc, nb, layout)
-    A = jax.device_put(A, column_sharding(mesh, axis_name))
     from dhqr_tpu.ops.blocked import _pallas_cache_guard
 
-    with _pallas_cache_guard(interp):
-        fn = _build_blocked(
-            mesh, axis_name, n, nb, precision, layout, norm, pallas, interp,
-            panel_impl, PALLAS_FLAT_WIDTH, trailing_precision, lookahead,
-            agg_panels, comms,
-        )
-        if _pulse.active() is None:
-            H, alpha = fn(A)
-        else:
-            sched = ("la" if lookahead else "") + (
-                f"agg{agg_panels}" if agg_panels else "")
+    sched = ("la" if lookahead else "") + (
+        f"agg{agg_panels}" if agg_panels else "")
+    base_label = (f"blocked_qr[P={nproc},{m}x{n},nb={nb},{layout}"
+                  + (f",{sched}" if sched else "") + "]")
+    comms = _armor.effective_comms(base_label, comms)
+
+    def _dispatch(wire_comms):
+        with _pallas_cache_guard(interp):
+            fn = _build_blocked(
+                mesh, axis_name, n, nb, precision, layout, norm, pallas,
+                interp, panel_impl, PALLAS_FLAT_WIDTH, trailing_precision,
+                lookahead, agg_panels, wire_comms,
+                _wire.seam_token(wire_comms),
+            )
+            if _pulse.active() is None:
+                return fn(A)
             tags = (f",{sched}" if sched else "") + (
-                f",w{comms}" if comms else "")
-            H, alpha = _pulse.observed_dispatch(
+                f",w{wire_comms}" if wire_comms else "")
+            return _pulse.observed_dispatch(
                 f"blocked_qr[P={nproc},{m}x{n},nb={nb},{layout}{tags}]",
                 lambda: fn(A), abstract=lambda: jax.make_jaxpr(fn)(A),
-                n_devices=nproc, wire_format=comms)
+                n_devices=nproc, wire_format=wire_comms)
+
+    A_in = A
+    A = _to_store_layout(A, n, nproc, nb, layout)
+    A = jax.device_put(A, column_sharding(mesh, axis_name))
+    if _armor.active() is None or _store_layout_output:
+        # Internal chaining (sharded_lstsq) verifies once, at the top.
+        H, alpha = _dispatch(comms)
+    else:
+        # ABFT weighted-checksum verification (round 19): u^H A vs
+        # (Q^H u)^H R over the factors the dispatch already produced —
+        # O(mn), localizing to the worst column's owner shard. The
+        # armed branch is only reached for natural-layout output, so
+        # each attempt relayouts ONCE, shared by verify and the caller.
+        def _dispatch_nat(wire_comms):
+            Hs, a = _dispatch(wire_comms)
+            return _to_natural_layout(Hs, n, nproc, nb, layout), a
+
+        def _verify(out):
+            return _armor.checks.qr_gap(out[0], out[1], A_in, nb,
+                                        precision="highest")
+
+        return _armor.checked_dispatch(
+            base_label, lambda: _dispatch_nat(comms), _verify,
+            engine="householder", comms=comms,
+            degrade=(lambda: _dispatch_nat(None)) if comms else None,
+            shard_of=lambda col: _col_owner(col, n, nproc, nb, layout),
+            plan_shape=("qr", m, n, str(A_in.dtype), nproc))
     if not _store_layout_output:
         H = _to_natural_layout(H, n, nproc, nb, layout)
     return H, alpha

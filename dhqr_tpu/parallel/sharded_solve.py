@@ -38,6 +38,9 @@ from dhqr_tpu.obs import pulse as _pulse
 # routes through it (DHQR009); comms=None is a verbatim passthrough.
 from dhqr_tpu.parallel import wire as _wire
 
+# dhqr-armor (round 19) ABFT verification seam (DHQR010).
+from dhqr_tpu import armor as _armor
+
 from dhqr_tpu.ops.blocked import (
     MAX_UNROLLED_PANELS,
     _panels_schedule,
@@ -216,8 +219,9 @@ def _backsub_shard_body(
 @lru_cache(maxsize=None)
 def _build_solve(
     mesh: Mesh, axis_name: str, n: int, nb: int, precision: str, layout: str,
-    comms: "str | None" = None,
+    comms: "str | None" = None, seam=None,
 ):
+    # ``seam``: round-19 cache-key material only (wire.seam_token).
     def full(Hl, alpha, b):
         cb = _apply_qt_shard_body(
             Hl, b, n=n, nb=nb, axis=axis_name, precision=precision,
@@ -296,20 +300,40 @@ def sharded_solve(
         )
         return x[:n]
     _check_divisibility(m, n, nproc, nb, layout)
+    base_label = f"sharded_solve[P={nproc},{m}x{n},nb={nb},{layout}]"
+    comms = _armor.effective_comms(base_label, comms)
     if not _H_in_store_layout:
         H = _to_store_layout(H, n, nproc, nb, layout)
     H = jax.device_put(H, column_sharding(mesh, axis_name))
     alpha = jax.device_put(alpha, replicated_sharding(mesh))
     b = jax.device_put(b, replicated_sharding(mesh))
-    fn = _build_solve(mesh, axis_name, n, nb, precision, layout, comms)
-    if _pulse.active() is None:
-        return fn(H, alpha, b)
-    return _pulse.observed_dispatch(
-        f"sharded_solve[P={nproc},{m}x{n},nb={nb},{layout}"
-        + (f",w{comms}" if comms else "") + "]",
-        lambda: fn(H, alpha, b),
-        abstract=lambda: jax.make_jaxpr(fn)(H, alpha, b),
-        n_devices=nproc, wire_format=comms)
+
+    def _dispatch(wire_comms):
+        fn = _build_solve(mesh, axis_name, n, nb, precision, layout,
+                          wire_comms, _wire.seam_token(wire_comms))
+        if _pulse.active() is None:
+            return fn(H, alpha, b)
+        return _pulse.observed_dispatch(
+            f"sharded_solve[P={nproc},{m}x{n},nb={nb},{layout}"
+            + (f",w{wire_comms}" if wire_comms else "") + "]",
+            lambda: fn(H, alpha, b),
+            abstract=lambda: jax.make_jaxpr(fn)(H, alpha, b),
+            n_devices=nproc, wire_format=wire_comms)
+
+    if _armor.active() is None or _H_in_store_layout:
+        # Internal chaining (sharded_lstsq) verifies the whole
+        # factor+solve pipeline once, at the top level, against A.
+        return _dispatch(comms)
+    # Standalone solve: handed factors, not A, so the checkable
+    # invariant is finiteness only (NaN-loud wire-tag poisoning and
+    # injected NaN are caught; docs/DESIGN.md "Fault tolerance for the
+    # sharded tier" documents the coverage split).
+    return _armor.checked_dispatch(
+        base_label, lambda: _dispatch(comms),
+        lambda x: (_armor.checks.finite_gap(x), None),
+        engine="householder", comms=comms,
+        degrade=(lambda: _dispatch(None)) if comms else None,
+        plan_shape=("lstsq", m, n, str(H.dtype), nproc))
 
 
 def sharded_lstsq(
@@ -380,24 +404,48 @@ def sharded_lstsq(
     if apply_precision is None:
         apply_precision = precision
     m, n = A.shape
+    m0, n0 = m, n   # the CALLER's shape — the tune/demotion plan key
     nproc = mesh.shape[axis_name]
     nb, n_pad = plan_padding(n, nproc, block_size)
     if n_pad != n:
         A = _pad_cols_orthogonal(A, n_pad)
         pad_b = [(0, n_pad - n)] + [(0, 0)] * (b.ndim - 1)
         b = jnp.pad(b, pad_b)  # zero rows for the appended identity rows
-    H, alpha = sharded_blocked_qr(
-        A, mesh, block_size=nb, axis_name=axis_name, precision=precision,
-        layout=layout, _store_layout_output=True, norm=norm,
-        use_pallas=use_pallas, panel_impl=panel_impl,
-        trailing_precision=trailing_precision, lookahead=lookahead,
-        agg_panels=agg_panels, comms=comms,
-    )
-    x = sharded_solve(
-        H, alpha, b, mesh,
-        block_size=nb, axis_name=axis_name, precision=apply_precision,
-        layout=layout, _H_in_store_layout=True, comms=comms,
-    )
+
+    def _dispatch(wire_comms):
+        H, alpha = sharded_blocked_qr(
+            A, mesh, block_size=nb, axis_name=axis_name,
+            precision=precision, layout=layout,
+            _store_layout_output=True, norm=norm, use_pallas=use_pallas,
+            panel_impl=panel_impl,
+            trailing_precision=trailing_precision, lookahead=lookahead,
+            agg_panels=agg_panels, comms=wire_comms,
+        )
+        return sharded_solve(
+            H, alpha, b, mesh,
+            block_size=nb, axis_name=axis_name, precision=apply_precision,
+            layout=layout, _H_in_store_layout=True, comms=wire_comms,
+        )
+
+    if _armor.active() is None:
+        return _dispatch(comms)[:n]
+    # ABFT verification at the top of the pipeline (round 19): the
+    # chained factor/solve stages skip their own armor wrap
+    # (_store_layout_output/_H_in_store_layout), so one O(mn)
+    # normal-equations checksum covers the whole factor+solve and a
+    # recovery re-dispatch re-runs BOTH stages.
+    base_label = (f"sharded_lstsq[P={nproc},{m}x{A.shape[1]},nb={nb},"
+                  f"{layout}]")
+    comms_eff = _armor.effective_comms(base_label, comms)
+    # plan_shape carries the CALLER's (m, n): tune.resolve_plan keys
+    # demotion on the shape the caller asked for, and the padded twin
+    # would never match it.
+    x = _armor.checked_dispatch(
+        base_label, lambda: _dispatch(comms_eff),
+        lambda xx: (_armor.checks.lstsq_gap(A, b, xx), None),
+        engine="householder", comms=comms_eff,
+        degrade=(lambda: _dispatch(None)) if comms_eff else None,
+        plan_shape=("lstsq", m0, n0, str(A.dtype), nproc))
     return x[:n]
 
 

@@ -32,6 +32,10 @@ from dhqr_tpu.obs import pulse as _pulse
 # gather may cross the wire as bf16/int8; comms=None is a passthrough.
 from dhqr_tpu.parallel import wire as _wire
 
+# dhqr-armor (round 19) ABFT verification seam (DHQR010) — one
+# module-global None check disarmed, same discipline as pulse above.
+from dhqr_tpu import armor as _armor
+
 from dhqr_tpu.ops.householder import DEFAULT_PRECISION
 from dhqr_tpu.ops.solve import as_matrix_rhs
 from dhqr_tpu.ops.tsqr import _combine_solve, _leaf_factor
@@ -116,7 +120,12 @@ def _tsqr_shard_body(Al, bl, *, n: int, nb: int, axis: str, precision: str,
 def _build_tsqr(mesh: Mesh, axis_name: str, n: int, nb: int, precision: str,
                 pallas: bool = False, interpret: bool = False,
                 pallas_flat: "int | None" = None,
-                comms: "str | None" = None):
+                comms: "str | None" = None, seam=None):
+    # ``seam`` (round 19) is cache-key material only — wire.seam_token:
+    # None in the common case (key byte-identical to pre-armor), a
+    # fresh tuple per fault epoch / armor re-arm / recovery re-dispatch
+    # so trace-time injection and tag programs re-trace instead of
+    # replaying a stale baked fault.
     body = partial(
         _tsqr_shard_body, n=n, nb=nb, axis=axis_name, precision=precision,
         pallas=pallas, interpret=interpret, pallas_flat=pallas_flat,
@@ -172,17 +181,35 @@ def sharded_tsqr_lstsq(
     b = jax.device_put(b, NamedSharding(mesh, P(axis_name)))
     from dhqr_tpu.ops.blocked import _pallas_cache_guard
 
-    with _pallas_cache_guard(interpret):
-        fn = _build_tsqr(mesh, axis_name, n, nb, precision, pallas,
-                         interpret, PALLAS_FLAT_WIDTH, comms)
-        if _pulse.active() is None:
-            return fn(A, b)
-        return _pulse.observed_dispatch(
-            f"tsqr_lstsq[P={nproc},{m}x{n},nb={nb}"
-            + (f",w{comms}" if comms else "") + "]",
-            lambda: fn(A, b),
-            abstract=lambda: jax.make_jaxpr(fn)(A, b), n_devices=nproc,
-            wire_format=comms)
+    base_label = f"tsqr_lstsq[P={nproc},{m}x{n},nb={nb}]"
+    comms = _armor.effective_comms(base_label, comms)
+
+    def _dispatch(wire_comms):
+        with _pallas_cache_guard(interpret):
+            fn = _build_tsqr(mesh, axis_name, n, nb, precision, pallas,
+                             interpret, PALLAS_FLAT_WIDTH, wire_comms,
+                             _wire.seam_token(wire_comms))
+            if _pulse.active() is None:
+                return fn(A, b)
+            return _pulse.observed_dispatch(
+                f"tsqr_lstsq[P={nproc},{m}x{n},nb={nb}"
+                + (f",w{wire_comms}" if wire_comms else "") + "]",
+                lambda: fn(A, b),
+                abstract=lambda: jax.make_jaxpr(fn)(A, b), n_devices=nproc,
+                wire_format=wire_comms)
+
+    if _armor.active() is None:
+        return _dispatch(comms)
+    # ABFT verification (round 19): the normal-equations checksum over
+    # the solve the dispatch already produced — O(mn), no
+    # re-factorization; recovery re-dispatches, then degrades the
+    # label's wire to the f32 passthrough, then refuses typed.
+    return _armor.checked_dispatch(
+        base_label, lambda: _dispatch(comms),
+        lambda x: (_armor.checks.lstsq_gap(A, b, x), None),
+        engine="tsqr", comms=comms,
+        degrade=(lambda: _dispatch(None)) if comms else None,
+        plan_shape=("lstsq", m, n, str(A.dtype), nproc))
 
 
 # Comms contract (dhqr-audit): exactly one all_gather pair per solve —

@@ -48,12 +48,34 @@ DHQR009 keeps every sharded collective in ``dhqr_tpu/parallel/``
 routed through this seam, and dhqr-pulse's DHQR306 runtime contract
 reads the compressed avals straight from the traced census (the wire
 volume IS the compressed volume — obs/netmodel).
+
+Round 19 (dhqr-armor) makes the seam the transport-integrity boundary
+too: with the armor tier armed, every COMPRESSED payload ships one
+packed f32 ``(sum, abs-sum, count)`` sidecar and a mismatch at
+decompression poisons the payload
+NaN-loud (:func:`_check_tag` — a corrupted compressed collective can
+never be consumed as a plausible value), and the deterministic
+``parallel.collective.{corrupt,nan,drop}`` fault sites mutate the
+payload between tag and transmit at TRACE time
+(:func:`_inject_collective`; the engine build caches are re-keyed per
+fault epoch via :func:`seam_token`, so schedules re-draw per
+re-trace). Everything in this module runs at trace time only — the
+disarmed runtime cost is zero and the disarmed traced programs are
+byte-identical to the pre-armor tier.
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
 from jax import lax
+
+# Round 19 (dhqr-armor): deterministic collective-level fault injection
+# (the parallel.collective.* "wire"-kind sites) and per-payload
+# integrity tags. Both are TRACE-time concerns — every function in this
+# module only ever runs while a shard body is being traced — so the
+# disarmed cost is one module-global read per traced collective and
+# the compiled programs are byte-identical to the pre-seam tier.
+from dhqr_tpu.faults import harness as _faults
 
 # The mode vocabulary lives in the jax-free precision module (shared
 # with the stdlib-only analysis tier); re-exported here so the seam is
@@ -65,9 +87,22 @@ __all__ = [
     "CSNE_SWEEPS",
     "WIRE_ITEMSIZE",
     "resolve_comms",
+    "seam_token",
     "wire_all_gather",
     "wire_psum",
 ]
+
+
+def seam_token(comms: "str | None" = None):
+    """Cache-key material for the engine ``_build_*`` lru caches (the
+    armor module owns the definition — re-exported here so the engines
+    import one seam). None in the common case, keeping existing cache
+    keys byte-identical; non-None whenever trace-time state (armed
+    wire fault sites, armor integrity tags on a compressed wire) can
+    change the traced program."""
+    from dhqr_tpu import armor as _armor
+
+    return _armor.seam_token(comms)
 
 #: Corrected-semi-normal-equation sweeps the row-sharded engines run
 #: when (and only when) their combine exchange is compressed: the
@@ -129,16 +164,30 @@ def _quant_int8(x):
         xb = jnp.pad(x, ((0, pad), (0, 0))).reshape(blocks, block, c)
         absmax = jnp.max(jnp.abs(xb), axis=1)          # (blocks, c)
         scale = absmax / 127.0
-        safe = jnp.where(scale > 0, scale, jnp.ones_like(scale))
+        safe = _safe_scale(scale)
         q = jnp.clip(jnp.round(xb / safe[:, None, :]), -127, 127)
         q = q.reshape(blocks * block, c)[:r].astype(jnp.int8)
         return q, scale
     absmax = jnp.max(jnp.abs(x)) if x.ndim == 1 else jnp.max(
         jnp.abs(x), axis=tuple(range(x.ndim - 1)))
     scale = absmax / 127.0
-    safe = jnp.where(scale > 0, scale, jnp.ones_like(scale))
+    safe = _safe_scale(scale)
     q = jnp.clip(jnp.round(x / safe), -127, 127).astype(jnp.int8)
     return q, scale
+
+
+def _safe_scale(scale):
+    """Divide-safe quantization scale: zero blocks (an all-zero column
+    block — scale 0) divide by 1 and round-trip exactly; a NaN scale
+    (the block carried NaN — ``max`` propagates it) is KEPT, so the
+    int8 payload dequantizes back to NaN instead of a finite garbage
+    value. NaN-loudness is the armor tier's detection contract: a
+    poisoned payload must never quantize itself respectable. (Inf
+    blocks keep their inf scale the same way: q = x/inf = 0,
+    dequant = 0 * inf = NaN — loud.)"""
+    return jnp.where(scale > 0, scale,
+                     jnp.where(jnp.isnan(scale), scale,
+                               jnp.ones_like(scale)))
 
 
 def _dequant_int8(q, scale, dtype):
@@ -154,6 +203,88 @@ def _dequant_int8(q, scale, dtype):
     return q.astype(dtype) * scale.astype(dtype)
 
 
+def _inject_collective(x):
+    """Trace-time collective-level fault injection (round 19, the
+    ``parallel.collective.*`` "wire"-kind sites): consulted once per
+    traced collective, in site order corrupt -> nan -> drop, each per
+    its own seeded stream. A trigger bakes the mutation into the traced
+    payload AFTER the sender's integrity tag was computed — the tag
+    models the SENDER's truth, the mutation models the wire, so a
+    tagged compressed payload detects its own corruption at
+    decompression. The armor seam token re-keys the engine build caches
+    per fault epoch / recovery re-dispatch so schedules re-draw per
+    re-trace (one "visit" = one traced collective)."""
+    harness = _faults.active()
+    if harness is None:
+        return x
+    if harness.should_fire("parallel.collective.corrupt"):
+        # A large additive hit on one element — a bit flip landing in
+        # a high exponent bit: plausible dtype, wildly wrong value
+        # (four decades over the payload's own scale, the way exponent
+        # flips land — NOT a near-threshold nudge).
+        hit = jnp.zeros(x.shape, x.dtype).at[(0,) * x.ndim].set(1)
+        x = x + hit * (1e4 * (1.0 + jnp.max(jnp.abs(x)))).astype(x.dtype)
+    if harness.should_fire("parallel.collective.nan"):
+        x = x.at[(0,) * x.ndim].set(jnp.nan)
+    if harness.should_fire("parallel.collective.drop"):
+        # The collective completes, the words never arrive: the
+        # dropped-shard signature (a one-hot psum of zeros is the
+        # owner's panel simply missing from every replica).
+        x = jnp.zeros_like(x)
+    return x
+
+
+#: Relative sum-tag slack for the bf16 wire: payload rounding is
+#: <= 2^-8 per element (x4 margin). Dense bf16 reductions additionally
+#: accumulate up to P-1 partial-sum roundings of ~2^-9 relative each,
+#: so their bound carries a ``2^-9 * P`` term — P read from the tag
+#: sidecar's own count lane, never assumed (at the pod scales ROADMAP
+#: items 1-3 target, a P-free bound crosses the honest population).
+_TAG_EPS_BF16 = 4.0 * 2.0 ** -8
+_TAG_EPS_BF16_PER_RANK = 2.0 ** -9
+
+
+def _tags_armed() -> bool:
+    from dhqr_tpu import armor as _armor
+
+    return _armor.wire_tags_armed()
+
+
+def _pack_tags(x):
+    """The integrity-tag sidecar: ONE f32 triple ``(sum, sum|.|,
+    count)`` per payload, riding a single collective alongside it. The
+    count lane reduces to the participating-device count P, which the
+    dense bf16 bound needs; the abs lane anchors the relative slack."""
+    return jnp.stack([jnp.sum(x), jnp.sum(jnp.abs(x)),
+                      jnp.asarray(1.0, x.dtype)]).astype(jnp.float32)
+
+
+def _int8_sum_bound(scale, elems_per_scale: int):
+    """Exact worst-case |sum error| of an int8 block-scaled payload:
+    per-element quantization error <= scale/2, ``elems_per_scale``
+    elements covered by each scale entry — the row-block height for
+    2-D payloads, the FULL element count for 1-D payloads (their one
+    scalar scale covers everything; clamping at the block height there
+    understates the bound and poisons honest long vectors)."""
+    return (0.5 * max(int(elems_per_scale), 1)
+            * jnp.sum(scale).astype(jnp.float32) + 1e-30)
+
+
+def _check_tag(rx, tag_rx, bound):
+    """Compare the received payload's checksum against the sender-side
+    tag; on mismatch poison the WHOLE payload NaN — the armor post-hoc
+    verification (and the PR-8 guards) are NaN-loud, so a corrupted
+    compressed collective caught here can never be consumed as a
+    plausible value downstream."""
+    ok = jnp.abs(jnp.sum(rx).astype(jnp.float32) - tag_rx) <= bound
+    return jnp.where(ok, rx, jnp.full_like(rx, jnp.nan))
+
+
+def _int8_elems_per_scale(x) -> int:
+    return (min(INT8_BLOCK_ROWS, max(x.shape[0], 1)) if x.ndim == 2
+            else int(x.size))
+
+
 def wire_psum(x, axis_name, comms=None, *, onehot: bool = True):
     """``lax.psum`` with the payload compressed to the ``comms`` wire
     format (decompressed to ``x.dtype`` on return).
@@ -165,16 +296,46 @@ def wire_psum(x, axis_name, comms=None, *, onehot: bool = True):
     one-hot psum. ``onehot=False`` (dense reductions — the CholeskyQR
     Gram) reduces in the wire dtype; the int8 rung is refused there
     (per-device scales cannot be summed) and degrades to bf16.
+
+    Round 19: with armor's wire tags armed, compressed payloads ship a
+    f32 sum sidecar (one scalar per collective — one-hot psums keep it
+    exact, dense psums sum the per-device truths, which is the right
+    reference for the summed payload) and a mismatch at decompression
+    poisons the payload NaN-loud. The ``parallel.collective.*`` fault
+    sites mutate the payload between tag and transmit, on every rung
+    including the f32 passthrough.
     """
     if comms is None or not _compressible(x):
+        if _faults.active() is not None:
+            x = _inject_collective(x)
         return lax.psum(x, axis_name)
+    tagged = _tags_armed()
+    if tagged:
+        tags = _pack_tags(x)
+    if _faults.active() is not None:
+        x = _inject_collective(x)
     if comms == "int8" and onehot:
         q, scale = _quant_int8(x)
         q = lax.psum(q, axis_name)
         scale = lax.psum(scale, axis_name)
-        return _dequant_int8(q, scale, x.dtype)
+        rx = _dequant_int8(q, scale, x.dtype)
+        if tagged:
+            tags_rx = lax.psum(tags, axis_name)
+            rx = _check_tag(rx, tags_rx[0],
+                            _int8_sum_bound(scale,
+                                            _int8_elems_per_scale(x)))
+        return rx
     # bf16 — and int8's dense-reduction fallback.
-    return lax.psum(x.astype(jnp.bfloat16), axis_name).astype(x.dtype)
+    rx = lax.psum(x.astype(jnp.bfloat16), axis_name).astype(x.dtype)
+    if tagged:
+        tags_rx = lax.psum(tags, axis_name)
+        # One-hot psums accumulate exactly (zeros); dense reductions
+        # ring-add in bf16, so the bound grows with the participating
+        # device count (the tag triple's own count lane).
+        eps = _TAG_EPS_BF16 if onehot else (
+            _TAG_EPS_BF16 + _TAG_EPS_BF16_PER_RANK * tags_rx[2])
+        rx = _check_tag(rx, tags_rx[0], eps * tags_rx[1] + 1e-30)
+    return rx
 
 
 def wire_all_gather(x, axis_name, comms=None):
@@ -182,9 +343,18 @@ def wire_all_gather(x, axis_name, comms=None):
     wire format. A gather is pure concatenation — no accumulation at
     any rung — so int8 per-column scales apply cleanly: each device
     quantizes its own share, the (tiny) scales gather alongside, and
-    decompression is local."""
+    decompression is local. Armor wire tags and the collective fault
+    sites apply exactly as on :func:`wire_psum` (the tag compares the
+    gathered whole against the gathered per-device truths)."""
     if comms is None or not _compressible(x):
+        if _faults.active() is not None:
+            x = _inject_collective(x)
         return lax.all_gather(x, axis_name)
+    tagged = _tags_armed()
+    if tagged:
+        tags = _pack_tags(x)
+    if _faults.active() is not None:
+        x = _inject_collective(x)
     if comms == "int8":
         import jax
 
@@ -193,6 +363,20 @@ def wire_all_gather(x, axis_name, comms=None):
         sg = lax.all_gather(scale, axis_name)
         # qg: (P, *x.shape); sg: (P, *scale.shape) — each device's
         # share decompresses against its own (block, column) scales.
-        return jax.vmap(lambda qq, ss: _dequant_int8(qq, ss, x.dtype))(
+        rx = jax.vmap(lambda qq, ss: _dequant_int8(qq, ss, x.dtype))(
             qg, sg)
-    return lax.all_gather(x.astype(jnp.bfloat16), axis_name).astype(x.dtype)
+        if tagged:
+            tags_g = lax.all_gather(tags, axis_name)    # (P, 3)
+            rx = _check_tag(
+                rx, jnp.sum(tags_g[:, 0]),
+                _int8_sum_bound(sg, _int8_elems_per_scale(x)))
+        return rx
+    rx = lax.all_gather(x.astype(jnp.bfloat16), axis_name).astype(x.dtype)
+    if tagged:
+        # A gather concatenates — no accumulation — so the bound is
+        # the payload-rounding term alone, anchored on the gathered
+        # abs lanes.
+        tags_g = lax.all_gather(tags, axis_name)        # (P, 3)
+        rx = _check_tag(rx, jnp.sum(tags_g[:, 0]),
+                        _TAG_EPS_BF16 * jnp.sum(tags_g[:, 1]) + 1e-30)
+    return rx

@@ -75,6 +75,7 @@ import traceback
 from concurrent.futures import Future
 from typing import Optional
 
+from dhqr_tpu.armor.errors import ShardFailure
 from dhqr_tpu.faults import harness as _faults
 from dhqr_tpu.numeric.errors import NumericalError
 from dhqr_tpu.obs import metrics as _obs_metrics
@@ -738,7 +739,9 @@ class AsyncScheduler:
         Failure routing: a :class:`NumericalError` is a property of
         the op's DATA (a poisoned vector, a refactor the PR-8 ladder
         refused) — it resolves THAT op typed and the stream continues
-        (the session rolled the op back, so neighbors are safe). Any
+        (the session rolled the op back, so neighbors are safe); the
+        round-19 ``ShardFailure`` is carved out as presumed-transient
+        infrastructure, exactly as in ``_handle_failure``. Any
         other failure raises out of the flush with the already-resolved
         ops excluded, so ``_handle_failure`` retries only the remainder
         — requeued at the front, order preserved — and a transient
@@ -758,6 +761,13 @@ class AsyncScheduler:
                     val = session.update(p.b[1], p.b[2])
                 else:
                     val = session.downdate(p.b[1], p.b[2])
+            except ShardFailure:
+                # Round 19: a lost shard contribution is presumed
+                # TRANSIENT infrastructure, not poisoned data — raise
+                # out of the flush (already-resolved ops excluded) so
+                # _handle_failure routes it through retry/backoff like
+                # a DispatchFailed, same as on the batched kinds.
+                raise
             except NumericalError as e:
                 self.counters.bump("numeric_failures")
                 self.counters.bump("poisoned")
@@ -906,7 +916,8 @@ class AsyncScheduler:
                                  cooldown_s=round(wait, 6))
                 self._requeue(group, can_wait, now + wait)
             return
-        if isinstance(err, NumericalError):
+        if isinstance(err, NumericalError) and \
+                not isinstance(err, ShardFailure):
             # Round 13: a numerical failure is a property of the
             # request's DATA — no backoff or retry can fix it, so no
             # retry budget is spent. A LONE request fails typed NOW
@@ -916,6 +927,15 @@ class AsyncScheduler:
             # bisection, which re-dispatches the halves (completing
             # the innocent batchmates) until the poison request fails
             # alone with the typed NumericalError.
+            #
+            # Round 19 carve-out: armor's ShardFailure is EXCLUDED —
+            # a lost shard contribution is presumed transient
+            # infrastructure (preemption, a flaky link), so it falls
+            # through to the retry/backoff/bisect machinery below
+            # exactly like a DispatchFailed; its sibling
+            # CorruptionDetected stays on this bisect-isolation path
+            # (the armor seam already spent the re-dispatches that
+            # could have helped).
             self.counters.bump("numeric_failures")
             self._span_batch(alive, "numeric_isolate", t=now,
                              cause=type(err).__name__, batch=len(alive))

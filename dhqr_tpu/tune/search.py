@@ -94,6 +94,9 @@ PLAN_DEMOTE_AFTER = 3
 _GATE_FAILURES: "dict[str, int]" = {}
 _GATE_LOCK = threading.Lock()
 _DEMOTED_LOOKUPS = [0]
+# resolve_plan lookups answered with a comms-stripped plan because the
+# armor seam demoted the key's compressed wire (round 19).
+_WIRE_DEMOTED_LOOKUPS = [0]
 
 
 def note_gate_failure(kind: str, m: int, n: int, dtype="float32", *,
@@ -122,6 +125,7 @@ def plan_gate_stats() -> dict:
             "failures": dict(_GATE_FAILURES),
             "demote_after": PLAN_DEMOTE_AFTER,
             "demoted_lookups": _DEMOTED_LOOKUPS[0],
+            "wire_demoted_lookups": _WIRE_DEMOTED_LOOKUPS[0],
         }
 
 
@@ -130,6 +134,7 @@ def reset_gate_failures() -> None:
     with _GATE_LOCK:
         _GATE_FAILURES.clear()
         _DEMOTED_LOOKUPS[0] = 0
+        _WIRE_DEMOTED_LOOKUPS[0] = 0
 
 
 def _demoted(key: str) -> bool:
@@ -655,6 +660,19 @@ def resolve_plan(kind: str, m: int, n: int, dtype="float32", *,
         return None
     hit = db.get(key)
     if hit is not None:
+        if hit.comms:
+            # Round 19 (dhqr-armor): a COMPRESSED plan whose key keeps
+            # tripping the armor verification seam is demoted to its
+            # uncompressed twin — the stored winner was measured on a
+            # healthy wire, and the live transport keeps refusing it.
+            # Same in-memory-evidence philosophy as _demoted above;
+            # armor.reset_wire_trips() (or a restart) re-admits it.
+            from dhqr_tpu import armor as _armor
+
+            if _armor.wire_demoted(kind, m, n, dtype, nproc):
+                with _GATE_LOCK:
+                    _WIRE_DEMOTED_LOOKUPS[0] += 1
+                return dataclasses.replace(hit, comms=None)
         return hit
     if on_miss is None:
         on_miss = TuneConfig.from_env().on_miss

@@ -720,25 +720,34 @@ class SketchConfig:
         return SketchConfig(**env)
 
 
-def _parse_fault_sites(raw: str) -> "tuple[tuple[str, float, int | None], ...]":
-    """Parse ``DHQR_FAULTS``: comma-separated ``site:prob[:count]``
+def _parse_fault_sites(raw: str):
+    """Parse ``DHQR_FAULTS``: comma-separated ``site:prob[:count[:k]]``
     entries, e.g. ``"serve.compile:0.5,serve.dispatch:0.1:3"`` — fire
     at ``site`` with probability ``prob`` per visit, at most ``count``
-    times total (unbounded when omitted)."""
+    times total (unbounded when omitted). The optional fourth ``k``
+    segment (round 19) makes the schedule fire-on-kth-visit: the
+    site's first ``k - 1`` visits never trigger, and ``prob``/``count``
+    apply from visit ``k`` onward — ``"parallel.collective.corrupt:
+    1.0:1:3"`` corrupts exactly the 3rd traced collective, the
+    replayable "corrupt exactly the 3rd panel broadcast" schedule the
+    armor chaos grid sweeps."""
     out = []
     for part in raw.split(","):
         part = part.strip()
         if not part:
             continue
         fields = part.split(":")
-        if len(fields) not in (2, 3) or not fields[0].strip():
+        if len(fields) not in (2, 3, 4) or not fields[0].strip():
             raise ValueError(
-                f"fault entry must be 'site:prob[:count]', got {part!r}"
+                f"fault entry must be 'site:prob[:count[:k]]', got {part!r}"
             )
         site = fields[0].strip()
         prob = float(fields[1])
-        count = int(fields[2]) if len(fields) == 3 else None
-        out.append((site, prob, count))
+        count = int(fields[2]) if len(fields) >= 3 else None
+        if len(fields) == 4:
+            out.append((site, prob, count, int(fields[3])))
+        else:
+            out.append((site, prob, count))
     return tuple(out)
 
 
@@ -752,13 +761,20 @@ class FaultConfig:
 
     Attributes:
       sites: ``(site, probability, max_triggers)`` triples
-        (``DHQR_FAULTS`` as ``"site:prob[:count]"`` comma-separated).
+        (``DHQR_FAULTS`` as ``"site:prob[:count[:k]]"`` comma-separated),
+        optionally extended to ``(site, probability, max_triggers,
+        from_visit)`` quadruples (round 19).
         ``site`` names an injection point registered in
         ``faults.SITES`` (unknown names are rejected at install time,
         not silently ignored); ``probability`` in [0, 1] is the per-visit
         trigger chance; ``max_triggers`` (None = unbounded) caps total
         firings — ``prob=1.0`` with a count gives an exactly-N
         deterministic schedule, the shape tests and the dry run use.
+        ``from_visit`` (the ``:k`` segment; None = from the first)
+        holds the site silent for its first ``k - 1`` visits, so
+        ``prob=1.0, count=1, k`` is the deterministic
+        fire-exactly-on-the-kth-visit schedule the armor chaos grid
+        replays ("corrupt exactly the 3rd panel broadcast").
       seed: base seed (``DHQR_FAULTS_SEED``). Each site derives its own
         independent deterministic stream from (seed, site name), so one
         site's visit count never perturbs another's schedule.
@@ -774,10 +790,16 @@ class FaultConfig:
         if isinstance(self.sites, dict):
             object.__setattr__(
                 self, "sites",
-                tuple((k, float(v[0]), v[1]) if isinstance(v, tuple)
+                tuple((k,) + tuple([float(v[0])] + list(v[1:]))
+                      if isinstance(v, tuple)
                       else (k, float(v), None)
                       for k, v in sorted(self.sites.items())))
-        for site, prob, count in self.sites:
+        for entry in self.sites:
+            if len(entry) not in (3, 4):
+                raise ValueError(
+                    "fault site entry must be (site, prob, count) or "
+                    f"(site, prob, count, from_visit), got {entry!r}")
+            site, prob, count = entry[0], entry[1], entry[2]
             if not 0.0 <= prob <= 1.0:
                 raise ValueError(
                     f"fault probability must be in [0, 1], got "
@@ -786,6 +808,10 @@ class FaultConfig:
                 raise ValueError(
                     f"fault max_triggers must be >= 1 or None, got "
                     f"{site!r}: {count}")
+            if len(entry) == 4 and entry[3] is not None and entry[3] < 1:
+                raise ValueError(
+                    f"fault from_visit (the :k segment) must be >= 1 or "
+                    f"None, got {site!r}: {entry[3]}")
         if not self.latency_ms >= 0:
             raise ValueError(
                 f"latency_ms must be >= 0, got {self.latency_ms}")
@@ -807,3 +833,75 @@ class FaultConfig:
             env["latency_ms"] = float(os.environ["DHQR_FAULTS_LATENCY_MS"])
         env.update(overrides)
         return FaultConfig(**env)
+
+
+@dataclasses.dataclass(frozen=True)
+class ArmorConfig:
+    """Knobs for the ABFT/self-healing layer of the sharded tier
+    (``dhqr_tpu.armor``, round 19). All overridable from
+    ``DHQR_ARMOR*`` environment variables; like the fault harness and
+    the obs layer, the env vars CONFIGURE and only
+    :func:`dhqr_tpu.armor.arm` (or the :func:`~dhqr_tpu.armor.armored`
+    scope) ARMS — disarmed, every sharded dispatch pays one
+    module-global ``None`` check and compiles the pre-round-19
+    programs byte-for-byte.
+
+    Attributes:
+      enabled: whether :func:`dhqr_tpu.armor.arm` with this config
+        actually installs the verification seam (``DHQR_ARMOR`` —
+        truthy values arm, ``0``/``off``/unset keep the zero-overhead
+        path).
+      rtol: relative tolerance of the post-hoc checksum invariants on
+        the UNCOMPRESSED (f32) wire (``DHQR_ARMOR_RTOL``). The
+        weighted-checksum discrepancy of a healthy f32 factorization
+        sits at the backward-error level (<= ~1e-6 relative on the
+        committed grid) and corruption lands at O(1)+ — the default
+        1e-4 sits two decades above one population and four below the
+        other. Compressed dispatches verify against
+        ``max(rtol, armor.WIRE_RTOL)`` instead (wire rounding puts
+        honest compressed gaps at ~1e-3..1e-2; WIRE_RTOL = 0.1 keeps
+        the same >=2-decade separation on that wire).
+      redispatch: how many single re-dispatches the recovery ladder
+        tries after a detection before degrading the wire / refusing
+        typed (``DHQR_ARMOR_REDISPATCH``; the ladder is verify ->
+        re-dispatch -> comms degrade -> typed, docs/DESIGN.md "Fault
+        tolerance for the sharded tier").
+      wire_tags: arm the per-payload integrity tags on COMPRESSED
+        collectives at the ``parallel/wire.py`` seam
+        (``DHQR_ARMOR_TAGS``, default on when armed): each compressed
+        payload ships one packed f32 ``(sum, abs-sum, count)``
+        checksum sidecar and a mismatch at decompression poisons the
+        payload NaN-loud, so a corrupted compressed collective is
+        caught at the seam instead of surfacing as a
+        plausible-but-wrong factor.
+    """
+
+    enabled: bool = False
+    rtol: float = 1e-4
+    redispatch: int = 1
+    wire_tags: bool = True
+
+    def __post_init__(self):
+        if not self.rtol > 0:
+            raise ValueError(f"rtol must be > 0, got {self.rtol}")
+        if self.redispatch < 0:
+            raise ValueError(
+                f"redispatch must be >= 0, got {self.redispatch}")
+
+    @staticmethod
+    def from_env(**overrides) -> "ArmorConfig":
+        """Build an armor config from ``DHQR_ARMOR*`` variables +
+        overrides."""
+        env = {}
+        if "DHQR_ARMOR" in os.environ:
+            env["enabled"] = os.environ["DHQR_ARMOR"].strip().lower() \
+                not in ("0", "false", "no", "off", "n", "")
+        if "DHQR_ARMOR_RTOL" in os.environ:
+            env["rtol"] = float(os.environ["DHQR_ARMOR_RTOL"])
+        if "DHQR_ARMOR_REDISPATCH" in os.environ:
+            env["redispatch"] = int(os.environ["DHQR_ARMOR_REDISPATCH"])
+        if "DHQR_ARMOR_TAGS" in os.environ:
+            env["wire_tags"] = os.environ["DHQR_ARMOR_TAGS"].strip() \
+                .lower() not in ("0", "false", "no", "off", "n", "")
+        env.update(overrides)
+        return ArmorConfig(**env)

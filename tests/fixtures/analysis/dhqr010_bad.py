@@ -1,0 +1,20 @@
+"""DHQR010 fixture: a sharded entry point dispatching bare."""
+
+import jax
+
+from dhqr_tpu.utils.compat import shard_map
+
+
+def _build_bare(mesh, axis_name, n):
+    return jax.jit(shard_map(lambda A: A, mesh=mesh, in_specs=None,
+                             out_specs=None))
+
+
+def sharded_bare_qr(A, mesh, axis_name="cols"):  # line 13: finding
+    fn = _build_bare(mesh, axis_name, A.shape[1])
+    return fn(A)  # collective results surface unverified
+
+
+def sharded_bare_lstsq(A, b, mesh, axis_name="cols"):  # line 18: finding
+    fn = _build_bare(mesh, axis_name, A.shape[1])
+    return fn(A)[:, 0]

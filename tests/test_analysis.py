@@ -200,6 +200,37 @@ def test_dhqr009_scope_is_the_sharded_tier():
                      "DHQR009") == []
 
 
+def test_dhqr010_sharded_dispatch_outside_armor_seam():
+    # A sharded_* entry point compiling a _build_* program without
+    # routing its dispatch through armor.checked_dispatch is flagged;
+    # the armored twin, a chaining helper with no build of its own,
+    # and a non-entry builder function are all clean.
+    findings = _scan_fixture("dhqr010_bad.py",
+                             virtual_path="dhqr_tpu/parallel/_fixture.py")
+    assert _hits(findings, "DHQR010") == [13, 18]
+    good = _scan_fixture("dhqr010_good.py",
+                         virtual_path="dhqr_tpu/parallel/_fixture.py")
+    assert _hits(good, "DHQR010") == []
+
+
+def test_dhqr010_scope_and_live_engines_clean():
+    with open(os.path.join(FIXTURES, "dhqr010_bad.py")) as fh:
+        text = fh.read()
+    # Scope: the sharded tier only — ops-tier and test code are out.
+    assert _hits(scan_source(text, "dhqr_tpu/ops/blocked.py"),
+                 "DHQR010") == []
+    assert _hits(scan_source(text, "tests/test_x.py"), "DHQR010") == []
+    # Every live sharded engine module must be clean: each entry point
+    # that builds a sharded program routes through the armor seam.
+    for mod in ("sharded_qr", "sharded_tsqr", "sharded_cholqr",
+                "sharded_solve"):
+        src = os.path.join(REPO, "dhqr_tpu", "parallel", f"{mod}.py")
+        with open(src) as fh:
+            assert _hits(scan_source(fh.read(),
+                                     f"dhqr_tpu/parallel/{mod}.py"),
+                         "DHQR010") == [], mod
+
+
 def test_dhqr008_out_of_package_paths_exempt():
     with open(os.path.join(FIXTURES, "dhqr008_bad.py")) as fh:
         text = fh.read()

@@ -1,0 +1,426 @@
+"""dhqr-armor (round 19): ABFT checksums, collective fault injection,
+typed self-healing.
+
+Everything here runs on the conftest's virtual 8-device CPU platform;
+shapes are small (the armor seam's behavior is shape-independent) and
+the P in {4, 8} grid rides ``-m slow`` — tier-1 keeps the P=2/4 core
+at the ~10 s budget (ROADMAP wall-clock warning).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dhqr_tpu import armor
+from dhqr_tpu.armor import CorruptionDetected, ShardFailure, checks
+from dhqr_tpu.faults import injected
+from dhqr_tpu.numeric.errors import NumericalError
+from dhqr_tpu.parallel.mesh import column_mesh
+from dhqr_tpu.parallel.sharded_qr import (
+    _build_blocked,
+    sharded_blocked_qr,
+)
+from dhqr_tpu.parallel.sharded_solve import sharded_lstsq
+from dhqr_tpu.parallel.sharded_tsqr import row_mesh, sharded_tsqr_lstsq
+from dhqr_tpu.utils.config import ArmorConfig, FaultConfig
+from dhqr_tpu.utils.testing import (
+    TOLERANCE_FACTOR,
+    normal_equations_residual,
+    oracle_residual,
+)
+
+
+@pytest.fixture
+def armed():
+    state = armor.arm(ArmorConfig(enabled=True))
+    try:
+        yield state
+    finally:
+        armor.disarm()
+        armor.reset_wire_trips()
+
+
+def _problem(m=64, n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    return (jnp.asarray(rng.random((m, n)), jnp.float32),
+            jnp.asarray(rng.random(m), jnp.float32))
+
+
+# --------------------------------------------------------------- invariants
+
+
+def test_checksum_gap_separates_honest_from_corrupt():
+    A, b = _problem()
+    mesh = column_mesh(2)
+    H, alpha = sharded_blocked_qr(A, mesh, block_size=8)
+    gap, _ = checks.qr_gap(H, alpha, A, 8)
+    assert gap < 1e-5, gap
+    # A single corrupted factor entry (the bit-flip magnitude the
+    # injector models) must blow the invariant by decades.
+    Hbad = H.at[4, 20].add(100.0)
+    bad_gap, worst = checks.qr_gap(Hbad, alpha, A, 8)
+    assert bad_gap > 1e-1, bad_gap
+    assert worst >= 16, worst   # localizes into the corrupted half
+    # NaN factors read as an infinite gap (NaN-loud contract).
+    inf_gap, _ = checks.qr_gap(H.at[0, 0].set(jnp.nan), alpha, A, 8)
+    assert inf_gap == float("inf")
+
+
+def test_lstsq_gap_and_finite_gap():
+    A, b = _problem()
+    x = jnp.linalg.lstsq(A, b)[0]
+    assert checks.lstsq_gap(A, b, x) < 1e-5
+    assert checks.lstsq_gap(A, b, x + 10.0) > 1e-2
+    assert checks.finite_gap(x) == 0.0
+    assert checks.finite_gap(x.at[0].set(jnp.inf)) == float("inf")
+
+
+# ------------------------------------------------------- disarmed contract
+
+
+def test_disarmed_seam_token_is_none_and_no_rebuild():
+    A, b = _problem()
+    mesh = column_mesh(2)
+    assert armor.seam_token(None) is None
+    assert armor.seam_token("bf16") is None
+    x0 = sharded_lstsq(A, b, mesh, block_size=8)
+    n0 = _build_blocked.cache_info().currsize
+    x1 = sharded_lstsq(A, b, mesh, block_size=8)
+    assert _build_blocked.cache_info().currsize == n0
+    assert bool(jnp.all(x0 == x1))
+
+
+def test_armed_clean_bit_identical_and_zero_rebuild(armed):
+    A, b = _problem()
+    mesh = column_mesh(2)
+    armor.disarm()
+    x0 = sharded_lstsq(A, b, mesh, block_size=8)
+    armor.arm(ArmorConfig(enabled=True))
+    x1 = sharded_lstsq(A, b, mesh, block_size=8)
+    # comms=None armed adds no tag ops: the SAME compiled program runs
+    # (token None), so the armed result is bitwise the disarmed one.
+    assert bool(jnp.all(x0 == x1))
+    n0 = _build_blocked.cache_info().currsize
+    x2 = sharded_lstsq(A, b, mesh, block_size=8)
+    assert _build_blocked.cache_info().currsize == n0, \
+        "warm armed repeat rebuilt its program"
+    assert bool(jnp.all(x2 == x1))
+    assert armor.active().metrics_snapshot()["detections"] == 0
+
+
+# ------------------------------------------------------ detection/recovery
+
+
+def test_injected_corruption_detected_and_redispatch_recovers(armed):
+    A, b = _problem()
+    mesh = column_mesh(2)
+    ref = oracle_residual(np.asarray(A), np.asarray(b))
+    with injected(FaultConfig(sites=(
+            ("parallel.collective.corrupt", 1.0, 1, 3),))) as h:
+        x = sharded_lstsq(A, b, mesh, block_size=8)
+        assert h.stats()["parallel.collective.corrupt"]["fired"] == 1
+    snap = armed.metrics_snapshot()
+    assert snap["detections"] == 1 and snap["recovered_redispatch"] == 1
+    res = normal_equations_residual(A, np.asarray(x), b)
+    assert res < TOLERANCE_FACTOR * ref, (res, ref)
+
+
+def test_kth_visit_schedule_is_deterministic():
+    # The :k segment: silent for k-1 visits, then prob/count apply —
+    # the exactly-the-3rd-collective replayability the chaos grid uses.
+    from dhqr_tpu.faults.harness import FaultHarness
+
+    h = FaultHarness(FaultConfig(sites=(
+        ("parallel.collective.corrupt", 1.0, 1, 3),)))
+    fires = [h.should_fire("parallel.collective.corrupt")
+             for _ in range(6)]
+    assert fires == [False, False, True, False, False, False]
+
+
+def test_persistent_drop_resolves_typed_with_provenance(armed):
+    A, b = _problem()
+    mesh = column_mesh(2)
+    with injected(FaultConfig(sites=(
+            ("parallel.collective.drop", 1.0, None),))):
+        with pytest.raises(armor.ArmorError) as ei:
+            sharded_lstsq(A, b, mesh, block_size=8)
+    err = ei.value
+    assert isinstance(err, NumericalError)   # taxonomy sibling
+    assert err.label.startswith("sharded_lstsq[P=2,")
+    assert err.recovery == ("redispatch",)   # no comms -> no degrade rung
+    assert armed.metrics_snapshot()["typed_failures"] == 1
+
+
+def test_nan_payload_poisons_compressed_wire_loud(armed):
+    # One NaN injected into a bf16 combine: the integrity tag poisons
+    # at decompression, the invariant reads inf, and the single
+    # re-dispatch (schedule exhausted) recovers a clean result.
+    rng = np.random.default_rng(1)
+    At = jnp.asarray(rng.random((64, 8)), jnp.float32)
+    bt = jnp.asarray(rng.random(64), jnp.float32)
+    with injected(FaultConfig(sites=(
+            ("parallel.collective.nan", 1.0, 1),))):
+        x = sharded_tsqr_lstsq(At, bt, row_mesh(2), block_size=8,
+                               comms="bf16")
+    snap = armed.metrics_snapshot()
+    assert snap["detections"] >= 1
+    assert bool(jnp.all(jnp.isfinite(x)))
+    res = normal_equations_residual(At, np.asarray(x), bt)
+    assert res < TOLERANCE_FACTOR * oracle_residual(
+        np.asarray(At), np.asarray(bt))
+
+
+def test_error_carries_trace_id_and_flight_path(armed):
+    from dhqr_tpu import obs as obs_mod
+    from dhqr_tpu.utils.config import ObsConfig
+
+    A, b = _problem(seed=3)
+    mesh = column_mesh(2)
+    with obs_mod.observed(ObsConfig(enabled=True)):
+        with injected(FaultConfig(sites=(
+                ("parallel.collective.drop", 1.0, None),))):
+            with pytest.raises(armor.ArmorError) as ei:
+                sharded_lstsq(A, b, mesh, block_size=8)
+        err = ei.value
+        assert err.trace_id is not None
+        names = [s["name"] for s in
+                 obs_mod.flight_dump(err.trace_id)["spans"]]
+    assert names[0] == "submit"
+    assert "verify" in names and "redispatch" in names
+    assert names[-1] == "resolve"
+
+
+# ------------------------------------------------- degrade + tune demotion
+
+
+def test_compressed_wire_degrades_label_and_notes_trips(armed):
+    A, b = _problem(seed=5)
+    mesh = column_mesh(2)
+    # Persistent corruption: redispatch cannot help; the degrade rung
+    # drops the label to the f32 passthrough — where the fault STILL
+    # fires (it corrupts every rung including passthrough), so the
+    # ladder refuses typed; the label stays degraded and the trip is
+    # recorded against the plan key.
+    with injected(FaultConfig(sites=(
+            ("parallel.collective.corrupt", 1.0, None),))):
+        with pytest.raises(armor.ArmorError) as ei:
+            sharded_lstsq(A, b, mesh, block_size=8, comms="bf16")
+    assert "degrade" in ei.value.recovery
+    assert armor.degraded_labels()
+    assert armor.wire_trips("lstsq", 64, 32, "float32", 2) >= 1
+    # A degraded label dispatches uncompressed from now on: clean call,
+    # verified, no new detection.
+    before = armed.metrics_snapshot()["detections"]
+    x = sharded_lstsq(A, b, mesh, block_size=8, comms="bf16")
+    assert armed.metrics_snapshot()["detections"] == before
+    assert bool(jnp.all(jnp.isfinite(x)))
+
+
+def test_resolve_plan_strips_comms_after_repeated_trips(armed, tmp_path):
+    from dhqr_tpu.tune import Plan, PlanDB, resolve_plan
+    from dhqr_tpu.tune.db import plan_key, policy_tag
+    from dhqr_tpu.tune.search import PLAN_DEMOTE_AFTER
+
+    db = PlanDB(str(tmp_path / "plans.json"))
+    plan = Plan(engine="cholqr2", comms="bf16")
+    db.record(plan_key("lstsq", 512, 16, "float32", nproc=2,
+                       policy_tag=policy_tag(None)), plan)
+    hit = resolve_plan("lstsq", 512, 16, nproc=2, db=db,
+                       on_miss="default")
+    assert hit is not None and hit.comms == "bf16"
+    for _ in range(PLAN_DEMOTE_AFTER):
+        armor.note_wire_trip("lstsq", 512, 16, "float32", 2)
+    demoted = resolve_plan("lstsq", 512, 16, nproc=2, db=db,
+                           on_miss="default")
+    assert demoted is not None and demoted.comms is None
+    assert demoted.engine == "cholqr2"   # only the wire is demoted
+    from dhqr_tpu.tune.search import plan_gate_stats
+
+    assert plan_gate_stats()["wire_demoted_lookups"] >= 1
+
+
+# ------------------------------------------------------- scheduler routing
+
+
+def test_update_stream_retries_shard_failure(monkeypatch):
+    """The update kind's per-op dispatch carves ShardFailure out of
+    its typed-NumericalError path exactly like _handle_failure does:
+    presumed-transient infrastructure raises out of the flush and the
+    remainder retries in order, instead of poisoning the op typed."""
+    from dhqr_tpu.serve.scheduler import AsyncScheduler
+    from dhqr_tpu.solvers.update import UpdatableQR
+
+    rng = np.random.default_rng(5)
+    A = rng.random((64, 8)).astype(np.float32)
+    b = rng.random(64).astype(np.float32)
+    fact = UpdatableQR(jnp.asarray(A))
+
+    calls = {"n": 0}
+    real = UpdatableQR.solve
+
+    def flaky(self, rhs):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ShardFailure("injected shard loss", label="upd",
+                               shard_index=0)
+        return real(self, rhs)
+
+    monkeypatch.setattr(UpdatableQR, "solve", flaky)
+    clock = [0.0]
+    sched = AsyncScheduler(start=False, clock=lambda: clock[0])
+    try:
+        fut = sched.submit("update", fact, ("solve", jnp.asarray(b)),
+                           deadline=30.0)
+        clock[0] += 1.0
+        sched.poll()                      # fails -> retry (transient)
+        assert calls["n"] == 1 and not fut.done()
+        clock[0] += 1.0                   # past the retry backoff
+        sched.poll()                      # retry succeeds
+        x = fut.result(timeout=60)
+        stats = sched.stats()
+        assert stats["retries"] == 1 and stats["poisoned"] == 0
+        assert bool(jnp.all(jnp.isfinite(x)))
+    finally:
+        sched.shutdown(drain=False)
+
+
+def test_scheduler_retries_shard_failure_but_isolates_corruption():
+    from dhqr_tpu.serve import engine as serve_engine
+    from dhqr_tpu.serve.scheduler import AsyncScheduler
+
+    rng = np.random.default_rng(7)
+    A = jnp.asarray(rng.random((32, 8)), jnp.float32)
+    b = jnp.asarray(rng.random(32), jnp.float32)
+
+    calls = {"n": 0}
+    real = serve_engine._dispatch_groups
+
+    def flaky(kind, As, bs, cfg, scfg, cache, consume, pol=None,
+              trace_id=None):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ShardFailure("injected shard loss", label="test",
+                               shard_index=1)
+        return real(kind, As, bs, cfg, scfg, cache, consume, pol=pol,
+                    trace_id=trace_id)
+
+    clock = [0.0]
+    sched = AsyncScheduler(start=False, clock=lambda: clock[0])
+    try:
+        serve_engine._dispatch_groups = flaky
+        fut = sched.submit("lstsq", A, b, deadline=30.0)
+        clock[0] += 1.0                   # past the flush interval
+        sched.poll()                      # fails -> retry (transient)
+        assert calls["n"] == 1 and not fut.done()
+        clock[0] += 1.0                   # past the retry backoff
+        sched.poll()                      # retry succeeds
+        x = fut.result(timeout=60)        # cold AOT compile inside
+        stats = sched.stats()
+        assert stats["retries"] == 1 and stats["poisoned"] == 0
+        assert bool(jnp.all(jnp.isfinite(x)))
+
+        # CorruptionDetected: NumericalError route — a lone request
+        # fails typed immediately, no retry budget spent.
+        calls["n"] = -10**6
+        def corrupt(kind, As, bs, cfg, scfg, cache, consume, pol=None,
+                    trace_id=None):
+            raise CorruptionDetected("corrupted", label="test")
+        serve_engine._dispatch_groups = corrupt
+        fut2 = sched.submit("lstsq", A, b, deadline=30.0)
+        clock[0] += 1.0
+        sched.poll()
+        with pytest.raises(CorruptionDetected):
+            fut2.result(timeout=5)
+        stats = sched.stats()
+        assert stats["poisoned"] == 1
+        assert stats["retries"] == 1     # unchanged: no retry was spent
+    finally:
+        serve_engine._dispatch_groups = real
+        sched.shutdown(drain=False)
+
+
+# ------------------------------------------------------- guarded ladder
+
+
+def test_guarded_ladder_escalates_past_transport_corruption(armed):
+    from dhqr_tpu.numeric import guarded_lstsq
+
+    rng = np.random.default_rng(11)
+    A = jnp.asarray(rng.random((32, 8)), jnp.float32)
+    b = jnp.asarray(rng.random(32), jnp.float32)
+    mesh = row_mesh(2)
+    # redispatch=0: a detection refuses typed immediately, so the
+    # PR-8 ladder is what recovers — rung 0 (cholqr2) eats the one
+    # scheduled corruption, rung 1 re-traces clean. The :3 segment
+    # targets the Q^H b psum: corrupting the FIRST Gram pass is
+    # mathematically self-corrected by CholeskyQR2's second pass (the
+    # first pass is a preconditioner), so the honest verify passes it
+    # — the right behavior, and a fact worth this comment.
+    armor.arm(ArmorConfig(enabled=True, redispatch=0))
+    with injected(FaultConfig(sites=(
+            ("parallel.collective.corrupt", 1.0, 1, 3),))):
+        res = guarded_lstsq(A, b, engine="cholqr2", mesh=mesh)
+    assert res.attempts[0].outcome == "corruption"
+    assert res.engine != "cholqr2" or len(res.attempts) > 1
+    assert bool(jnp.all(jnp.isfinite(res.x)))
+
+
+def test_guarded_qr_all_transport_exhaustion_reraises_armor_error(armed):
+    # Every guarded_qr rung refused by the armor seam (a persistent
+    # drop): the typed ArmorError — with its label/shard/trace-id
+    # provenance and ShardFailure retry routing — must surface, not a
+    # generic Breakdown; attempts ride along (same rule as
+    # guarded_lstsq's all-transport exhaustion).
+    from dhqr_tpu.numeric import guarded_qr
+
+    A, _ = _problem(seed=17)
+    mesh = column_mesh(2)
+    armor.arm(ArmorConfig(enabled=True, redispatch=0))
+    with injected(FaultConfig(sites=(
+            ("parallel.collective.drop", 1.0, None),))):
+        with pytest.raises(armor.ArmorError) as ei:
+            guarded_qr(A, mesh=mesh)
+    err = ei.value
+    assert err.label and err.attempts
+    assert all(a.outcome == "corruption" for a in err.attempts)
+
+
+# ------------------------------------------------------------ registry
+
+
+def test_registry_exports_armor_names(armed):
+    from dhqr_tpu.obs import metrics as obs_metrics
+
+    A, b = _problem(seed=13)
+    sharded_lstsq(A, b, column_mesh(2), block_size=8)
+    snap = obs_metrics.registry().snapshot()
+    for dotted in ("armor.verifications", "armor.detections",
+                   "armor.typed_failures", "armor.degraded_labels",
+                   "armor.wire_trips"):
+        assert dotted in snap, (dotted, sorted(snap))
+    assert snap["armor.verifications"] >= 1
+    armor.disarm()
+    assert not any(k.startswith("armor.")
+                   for k in obs_metrics.registry().snapshot())
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("nproc", [4, 8])
+@pytest.mark.parametrize("site", ["parallel.collective.corrupt",
+                                  "parallel.collective.nan",
+                                  "parallel.collective.drop"])
+def test_armor_matrix_detects_or_types_every_fault(nproc, site, armed):
+    A, b = _problem(m=32 * nproc, n=8 * nproc, seed=nproc)
+    mesh = column_mesh(nproc)
+    ref = oracle_residual(np.asarray(A), np.asarray(b))
+    try:
+        with injected(FaultConfig(sites=((site, 1.0, 1, 2),))):
+            x = sharded_lstsq(A, b, mesh, block_size=8)
+        res = normal_equations_residual(A, np.asarray(x), b)
+        assert res < TOLERANCE_FACTOR * ref, (res, ref)
+    except armor.ArmorError as e:
+        assert e.label and e.recovery   # typed, never silent
+    snap = armed.metrics_snapshot()
+    assert snap["detections"] >= 1, snap

@@ -88,6 +88,58 @@ def test_fault_config_parsing_and_validation(monkeypatch):
         faults.FaultHarness(FaultConfig(sites=(("serve.nope", 1.0, None),)))
 
 
+def test_fault_config_kth_visit_segment(monkeypatch):
+    """Round 19: the optional :k segment parses to a 4-tuple, validates,
+    and makes the schedule fire-on-kth-visit (silent before visit k)."""
+    monkeypatch.setenv(
+        "DHQR_FAULTS",
+        "parallel.collective.corrupt:1.0:1:3, serve.dispatch:0.25:3")
+    cfg = FaultConfig.from_env()
+    assert cfg.sites == (("parallel.collective.corrupt", 1.0, 1, 3),
+                         ("serve.dispatch", 0.25, 3))
+    with pytest.raises(ValueError, match="from_visit"):
+        FaultConfig(sites=(("serve.dispatch", 1.0, 1, 0),))
+    with pytest.raises(ValueError, match="site:prob"):
+        from dhqr_tpu.utils.config import _parse_fault_sites
+
+        _parse_fault_sites("serve.dispatch:1.0:1:3:9")
+    # fire EXACTLY on the kth visit: prob 1, count 1, k = 4.
+    h = faults.FaultHarness(FaultConfig(
+        sites=(("serve.dispatch", 1.0, 1, 4),)))
+    fires = [h.should_fire("serve.dispatch") for _ in range(6)]
+    assert fires == [False, False, False, True, False, False]
+    assert h.stats()["serve.dispatch"] == {"visits": 6, "fired": 1}
+    # from-visit composes with an UNBOUNDED count: silent for k-1
+    # visits, then every visit fires (prob 1, no cap).
+    h2 = faults.FaultHarness(FaultConfig(
+        sites=(("serve.dispatch", 1.0, None, 3),)))
+    assert [h2.should_fire("serve.dispatch") for _ in range(5)] \
+        == [False, False, True, True, True]
+
+
+def test_suspended_is_thread_local_and_silences_raise_sites():
+    """Round 19: a suspended() scope silences EVERY injection kind on
+    the calling thread — raise/sleep sites through fire()/latency(),
+    not just the wire seam's active() read — without accounting
+    visits, while OTHER threads' schedules keep firing (an
+    AsyncScheduler worker tracing a real armed program during a pulse
+    census must keep its visit indices intact)."""
+    with faults.injected(FaultConfig(
+            sites=(("serve.dispatch", 1.0, None),))) as h:
+        with faults.suspended():
+            faults.fire("serve.dispatch")   # inert: no raise, no visit
+            faults.latency("serve.latency")
+            seen = []
+            t = threading.Thread(
+                target=lambda: seen.append(faults.active()))
+            t.start()
+            t.join()
+            assert seen == [h]    # suspension is THIS thread's only
+        with pytest.raises(FaultInjected):
+            faults.fire("serve.dispatch")
+    assert h.stats()["serve.dispatch"] == {"visits": 1, "fired": 1}
+
+
 def test_harness_deterministic_streams_and_trigger_counts():
     cfg = FaultConfig(sites=(("serve.dispatch", 0.4, None),
                              ("serve.compile", 1.0, 2)), seed=42)

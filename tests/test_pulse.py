@@ -111,6 +111,32 @@ def test_collective_census_falls_back_without_hlo_annotations():
     assert census["families"]["psum"]["events"] == 1
 
 
+def test_analytic_census_suspends_fault_harness():
+    # Round 19: abstract() re-traces the shard body into a DISCARDED
+    # jaxpr; with trace-time wire fault schedules armed, the census
+    # retrace must not consume schedule visits (it would shift which
+    # real collective a :k schedule hits). _analytic_census runs
+    # abstract() under faults.suspended(), where active() reads None.
+    import jax
+
+    from dhqr_tpu import faults
+    from dhqr_tpu.utils.config import FaultConfig
+
+    seen = []
+
+    def abstract():
+        seen.append(faults.active())
+        return jax.make_jaxpr(lambda x: x + 1.0)(1.0)
+
+    with faults.injected(FaultConfig(
+            sites=(("parallel.collective.corrupt", 1.0, 1, 3),))) as h:
+        families, opaque, reason = pulse._analytic_census(abstract, 2)
+        assert faults.active() is h  # suspension scoped to the census
+    assert seen == [None]
+    assert reason is None
+    assert h.stats()["parallel.collective.corrupt"]["visits"] == 0
+
+
 # --------------------------------------------------------------- DHQR306
 
 def test_dhqr306_fail_on_unexplainable_family():
@@ -302,6 +328,10 @@ def test_xray_cli_json_is_machine_readable(tmp_path, capsys):
 
 # --------------------------------------------- live profiler integration
 
+@pytest.mark.slow  # 20 s (round-19 tier-1 triage, --durations=25): the
+# live jax.profiler capture over a multi-device dispatch; the 1-device
+# seam checks and test_pulse_smoke_is_green stay tier-1 as the cheap
+# cover (docs/OPERATIONS.md "Tier-1 wall clock triage").
 def test_measure_sharded_dispatch_end_to_end():
     """One armed P=2 sharded dispatch on the real CPU backend: the
     measured census must agree with the traced analytic census on

@@ -619,6 +619,11 @@ def test_sharded_agg_lookahead_matches_default(mesh, layout):
                                    rtol=1e-10, atol=1e-10)
 
 
+@pytest.mark.slow  # 16 s (round-19 tier-1 triage, --durations=25): the
+# ragged-remainder super-block composition compiles three big scanned
+# programs; the agg/lookahead parity matrix at P in {2, 8} stays
+# tier-1 as the cover, and the dryrun's cyclic+agg2+lookahead stage
+# runs the composition end to end on every PR.
 def test_sharded_agg_lookahead_remainder_and_public_api():
     """The composition through the public surface with a ragged tail:
     40 panels, k=3 -> super-blocks of 6 (two groups, lookahead engages)

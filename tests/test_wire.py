@@ -124,6 +124,58 @@ def test_int8_quantization_roundtrip_and_zero_columns():
     assert np.all(small <= np.asarray(s2)[1] * 0.5 + 1e-12)
 
 
+def test_int8_degenerate_blocks_roundtrip_finite():
+    """Round-19 edge cases: all-zero column BLOCKS (scale 0) and
+    single-row tail blocks must round-trip finite and exact — the
+    degenerate scales must never manufacture NaN/Inf."""
+    rng = np.random.default_rng(1)
+    # A 33-row payload: one full 32-row block + a 1-row tail block.
+    x = jnp.asarray(rng.standard_normal((33, 4)).astype(np.float32))
+    x = x.at[:32, 1].set(0.0)     # zero block atop a non-zero tail
+    x = x.at[32, 2].set(0.0)      # zero 1-row tail under a live block
+    q, scale = wire._quant_int8(x)
+    assert scale.shape == (2, 4)
+    back = np.asarray(wire._dequant_int8(q, scale, x.dtype))
+    assert np.all(np.isfinite(back))
+    assert np.all(back[:32, 1] == 0.0)
+    assert back[32, 2] == 0.0
+    # single-ROW payload: the clamp makes one 1-row block, exact zeros
+    # where the input is zero, finite everywhere.
+    z = jnp.asarray(np.array([[0.0, 3.0, -2.0]], np.float32))
+    qz, sz = wire._quant_int8(z)
+    backz = np.asarray(wire._dequant_int8(qz, sz, z.dtype))
+    assert np.all(np.isfinite(backz)) and backz[0, 0] == 0.0
+    # all-zero payload round-trips to exact zeros (scale 0 -> divide
+    # by 1, dequant 0 * 0 = 0).
+    zero = jnp.zeros((40, 3), jnp.float32)
+    qq, ss = wire._quant_int8(zero)
+    assert np.all(np.asarray(wire._dequant_int8(qq, ss, zero.dtype)) == 0.0)
+
+
+def test_int8_nonfinite_payloads_stay_loud():
+    """A NaN-bearing payload must dequantize back to NaN — NaN-loud,
+    never a finite garbage value (the armor tier's detection contract
+    rides on this; pre-round-19 the where(scale > 0) clamp silently
+    quantized NaN blocks against a scale of 1). Inf blocks go loud the
+    same way (q = x/inf = 0, dequant 0 * inf = NaN)."""
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((64, 3)).astype(np.float32))
+    x = x.at[5, 0].set(jnp.nan)
+    back = np.asarray(wire._dequant_int8(*wire._quant_int8(x), x.dtype))
+    # The poisoned (block, column) is loud; untouched columns exact.
+    assert np.any(np.isnan(back[:32, 0]))
+    assert np.all(np.isfinite(back[:, 1:]))
+    y = x.at[5, 0].set(jnp.inf)
+    backy = np.asarray(wire._dequant_int8(*wire._quant_int8(y), y.dtype))
+    assert not np.all(np.isfinite(backy[:32, 0]))
+    assert np.all(np.isfinite(backy[:, 1:]))
+    # 1-D payloads (scalar scale): a NaN anywhere poisons the payload
+    # loudly rather than quantizing respectable.
+    v = jnp.asarray(np.array([1.0, np.nan, -2.0], np.float32))
+    backv = np.asarray(wire._dequant_int8(*wire._quant_int8(v), v.dtype))
+    assert np.any(np.isnan(backv))
+
+
 def test_policy_comms_field_and_fourth_spec_segment():
     pol = resolve_policy("highest/default/r1/bf16")
     assert (pol.panel, pol.trailing, pol.refine, pol.comms) == (
@@ -349,6 +401,11 @@ def test_serve_rejects_comms_plans_and_keeps_key_stable():
 # --------------------------------------------------------------- netmodel
 
 
+@pytest.mark.slow  # 17 s (round-19 tier-1 triage, --durations=25): a
+# live profiler measurement under the compressed wire; the jax-free
+# test_netmodel_explain_measured_wire_format pins the same DHQR306
+# compressed-bound logic in tier-1, and tools/lint.sh's DHQR402 smoke
+# measures for real on every PR.
 def test_pulse_dhqr306_green_under_compressed_wire_model():
     """An armed compressed dispatch yields a PulseReport whose analytic
     census carries the COMPRESSED avals (half the f32 twin's psum
