@@ -74,6 +74,13 @@ from dhqr_tpu.serve import (
 # engine and the updatable factorization ride the facade; the operator/
 # program helpers stay namespaced at dhqr_tpu.solvers.
 from dhqr_tpu.solvers import UpdatableQR, sketched_lstsq
+# Two-tier pod topology (round 20): the descriptor and the two mesh
+# constructors ride the facade; the per-axis helpers (resolve_axis,
+# spec_axes, ...) stay namespaced at dhqr_tpu.parallel.topology — they
+# are engine plumbing, not user surface.
+from dhqr_tpu.parallel.mesh import pod_mesh
+from dhqr_tpu.parallel.multihost import global_pod_mesh
+from dhqr_tpu.parallel.topology import TierAxes
 # NOTE: the tune() search function itself stays at dhqr_tpu.tune.tune —
 # re-exporting it here would shadow the `dhqr_tpu.tune` submodule
 # attribute with a function (breaking `import dhqr_tpu.tune as t`).
@@ -119,6 +126,9 @@ __all__ = [
     "batched_sketched_lstsq",
     "sketched_lstsq",
     "UpdatableQR",
+    "TierAxes",
+    "pod_mesh",
+    "global_pod_mesh",
     "AsyncScheduler",
     "BackpressureError",
     "ServeError",

@@ -711,6 +711,107 @@ def run(n_devices: int) -> None:
           f"worst {worst_sk:.2f}x of oracle; update/downdate round trip "
           "within 8x, warm repeat 0 recompiles)", flush=True)
 
+    # Two-tier pod topology / dhqr-pod (round 20): on a simulated 2x2
+    # (DCN x ICI) factorization of 4 devices, (a) the hierarchical
+    # schedule's launch counts must match the analytic census — one ICI
+    # psum + one DCN chunk psum + one ICI broadcast-back all_gather per
+    # scheduled collective (cost_model.payload_schedule), with the
+    # cross-DCN byte share exactly 1/ici_size of the flat twin's, (b)
+    # the committed *_pod comms contracts must hold the traced matrix
+    # (the same check_comms tools/lint.sh replays at P in {4, 8}), (c)
+    # the dcn:bf16 tiered rung must keep a pod lstsq inside the 8x
+    # LAPACK criterion, and (d) a warm hierarchical repeat rebuilds
+    # nothing — TierAxes is a cache key exactly like the axis-name
+    # string it replaces.
+    if n_devices >= 4:
+        import dataclasses as _dc
+
+        from dhqr_tpu.analysis.comms_pass import (
+            EngineParams,
+            check_comms,
+            collect_comms as _collect,
+            load_contracts,
+        )
+        from dhqr_tpu.analysis.cost_model import payload_schedule
+        from dhqr_tpu.parallel.mesh import pod_mesh
+        from dhqr_tpu.parallel.sharded_qr import (
+            _build_blocked as _pod_builds,
+            sharded_blocked_qr as _pod_qr,
+        )
+
+        m_pod, n_pod, nb_pod = 64, 32, 4
+        Ap = jnp.asarray(rng.random((m_pod, n_pod)), jnp.float32)
+        bp = jnp.asarray(rng.random(m_pod), jnp.float32)
+        pmesh, taxes = pod_mesh(4, topo="2x2")
+        flat_axes = _dc.replace(taxes, hierarchical=False)
+
+        def _pod_trace(axis, comms=None):
+            return jax.make_jaxpr(
+                lambda A_: _pod_qr(A_, pmesh, block_size=nb_pod,
+                                   axis_name=axis, comms=comms))(Ap)
+
+        hier = _collect(_pod_trace(taxes))
+        flat = _collect(_pod_trace(flat_axes))
+        sched_psums = len([s for s in payload_schedule(
+            "blocked_qr", m_pod, n_pod, nb_pod, 4) if s[0] == "psum"])
+        launches = hier.launches()
+        assert launches.get("psum") == 2 * sched_psums, (
+            "hierarchical psum launches diverged from the analytic "
+            "census (one ICI + one DCN leg per scheduled collective)",
+            launches, sched_psums)
+        assert launches.get("all_gather") == sched_psums, (
+            "hierarchical broadcast-back gathers diverged from the "
+            "analytic census", launches, sched_psums)
+        assert flat.launches().get("psum") == sched_psums, (
+            "flat twin launch count diverged", flat.launches())
+        assert hier.dcn_volume_bytes() * taxes.ici_size \
+            == flat.dcn_volume_bytes(), (
+            "cross-DCN byte share is not 1/ici_size of the flat twin",
+            hier.dcn_volume_bytes(), flat.dcn_volume_bytes())
+        # The committed two-tier contract, replayed exactly as the lint
+        # gate replays it (check_comms arms the per-tier DHQR302 budget
+        # through EngineParams.topology).
+        pod_contract = load_contracts().get("blocked_qr_pod")
+        assert pod_contract is not None, (
+            "blocked_qr_pod contract missing from comms_contracts.json")
+        pod_findings = check_comms(
+            _pod_trace(taxes), "dryrun::blocked_qr_pod", pod_contract,
+            EngineParams(m=m_pod, n=n_pod, nb=nb_pod, P=4,
+                         topology=(2, 2)))
+        assert not pod_findings, "pod contract findings:\n" + "\n".join(
+            f.render() for f in pod_findings)
+        # Tiered compression: dcn:bf16 keeps f32 inside the ICI domain
+        # and compresses only the DCN crossing; through the model tier
+        # (CSNE floor) the rung must hold the same 8x bar as any other.
+        xp = _model_lstsq(Ap, bp, mesh=pmesh, block_size=nb_pod,
+                          comms="dcn:bf16")
+        res_p = normal_equations_residual(Ap, np.asarray(xp), bp)
+        ref_p = oracle_residual(np.asarray(Ap), np.asarray(bp))
+        assert res_p < TOLERANCE_FACTOR * ref_p, (
+            "pod dcn:bf16 lstsq", res_p, ref_p)
+        Hp, _ = _pod_qr(Ap, pmesh, block_size=nb_pod, axis_name=taxes)
+        jax.block_until_ready(Hp)
+        n_pod_built = _pod_builds.cache_info().currsize
+        Hp2, _ = _pod_qr(Ap, pmesh, block_size=nb_pod, axis_name=taxes)
+        jax.block_until_ready(Hp2)
+        assert _pod_builds.cache_info().currsize == n_pod_built, (
+            "warm pod repeat rebuilt its program",
+            _pod_builds.cache_info())
+        print(f"dryrun: pod ok (2x2 hierarchical census "
+              f"{launches.get('psum')} psums + "
+              f"{launches.get('all_gather')} broadcast-backs for "
+              f"{sched_psums} scheduled collectives, cross-DCN bytes "
+              f"{hier.dcn_volume_bytes()} B = flat/"
+              f"{taxes.ici_size}, blocked_qr_pod contract green, "
+              "dcn:bf16 lstsq within 8x, warm repeat 0 rebuilds)",
+              flush=True)
+    else:
+        print("dryrun: pod SKIPPED (needs >= 4 devices for a 2x2 "
+              "DCN x ICI factorization — a smaller mesh has no two-"
+              "tier topology to schedule; rerun with "
+              "XLA_FLAGS=--xla_force_host_platform_device_count=8)",
+              flush=True)
+
     # Comms-contract audit (dhqr-audit, analysis/comms_pass): the same
     # multi-device virtual CPU topology the dry run already runs under is
     # exactly what the audit needs, so a collective-shaped regression

@@ -65,7 +65,8 @@ def rule_catalogue() -> "list[tuple[str, str, str]]":
         ("DHQR301", "collective family outside the engine's comms "
          "contract", "comms"),
         ("DHQR302", "traced collective volume exceeds the analytic "
-         "budget", "comms"),
+         "budget (per-tier cross-DCN column on *_pod contracts)",
+         "comms"),
         ("DHQR303", "shard_map intermediate exceeds the per-shard "
          "working set", "comms"),
         ("DHQR304", "donated entry point compiled without input-output "
@@ -73,7 +74,8 @@ def rule_catalogue() -> "list[tuple[str, str, str]]":
         ("DHQR305", "jaxpr differs across two traces of one cache key",
          "comms"),
         ("DHQR306", "measured collective time unexplainable by volume "
-         "/ interconnect bandwidth x slack", "pulse"),
+         "/ interconnect bandwidth x slack (priced per ICI/DCN tier "
+         "on two-tier meshes)", "pulse"),
         ("DHQR401", "compiled-program xray introspection smoke failed",
          "xray"),
         ("DHQR402", "pulse runtime-comms profiling smoke failed",

@@ -47,7 +47,13 @@ import re
 
 from dhqr_tpu.analysis.findings import Finding
 from dhqr_tpu.analysis.jaxpr_pass import _ensure_cpu_backend, sub_jaxprs
-from dhqr_tpu.analysis.cost_model import budget_bytes
+from dhqr_tpu.analysis.cost_model import budget_bytes, tiered_budget_bytes
+
+#: The mesh axis name that marks the slow tier of a two-tier pod mesh
+#: (parallel/topology.DCN_AXIS — literal copy, stdlib-only tier; pinned
+#: by tests/test_topology.py). A collective whose axes include it
+#: crosses the data-center network; everything else is ICI-local.
+DCN_AXIS = "dcn"
 
 DEFAULT_DEVICE_COUNTS = (2, 4, 8)
 
@@ -83,10 +89,20 @@ class CollectiveUse:
     launches: int
     payload_bytes: int
     bounded: bool = True
+    #: Mesh axis names the collective runs over, as traced from the eqn
+    #: params (``axes`` for the reductions, ``axis_name`` for gathers;
+    #: empty when the primitive carries neither). Round 20: the tier
+    #: split reads this — ``DCN_AXIS in axes`` means the payload
+    #: crosses the slow tier.
+    axes: "tuple[str, ...]" = ()
 
     @property
     def volume_bytes(self) -> int:
         return self.launches * self.payload_bytes
+
+    @property
+    def crosses_dcn(self) -> bool:
+        return DCN_AXIS in self.axes
 
 
 @dataclasses.dataclass
@@ -125,8 +141,32 @@ class CommsStats:
     def total_volume_bytes(self) -> int:
         return sum(u.volume_bytes for u in self.uses if u.bounded)
 
+    def dcn_volume_bytes(self) -> int:
+        """Traced bytes that cross the DCN tier (round 20): the volume
+        of every bounded collective whose axes include
+        :data:`DCN_AXIS`. Zero on any 1-D mesh — the split degrades to
+        'everything is ICI', which keeps the pre-pod contracts
+        byte-identical."""
+        return sum(u.volume_bytes for u in self.uses
+                   if u.bounded and u.crosses_dcn)
+
     def families(self) -> "set[str]":
         return {u.prim for u in self.uses}
+
+
+def _eqn_axes(eqn) -> "tuple[str, ...]":
+    """Mesh axis names of one collective eqn: the reductions carry
+    ``axes``, the gathers ``axis_name``; either may be a single name or
+    a tuple (the flat-on-2-D schedule reduces over both tiers in one
+    collective)."""
+    val = eqn.params.get("axes")
+    if val is None:
+        val = eqn.params.get("axis_name")
+    if val is None:
+        return ()
+    if isinstance(val, (tuple, list)):
+        return tuple(str(a) for a in val)
+    return (str(val),)
 
 
 def _aval_bytes(aval) -> int:
@@ -162,7 +202,8 @@ def collect_comms(closed_jaxpr) -> CommsStats:
                 if in_while:
                     stats.opaque_loop_collectives.append(prim)
                 stats.uses.append(CollectiveUse(prim, mult, out_bytes,
-                                                bounded=not in_while))
+                                                bounded=not in_while,
+                                                axes=_eqn_axes(eqn)))
             sub_mult = mult
             if prim == "scan":
                 sub_mult = mult * int(eqn.params.get("length", 1))
@@ -207,6 +248,12 @@ class EngineParams:
     P: int
     itemsize: int = 4
     nrhs: int = 1
+    #: Round 20: ``(dcn_size, ici_size)`` of the two-tier pod mesh the
+    #: engine was traced on, or None for a 1-D mesh. Non-None switches
+    #: DHQR302 to the per-tier budgets
+    #: (:func:`dhqr_tpu.analysis.cost_model.tiered_budget_bytes`) and
+    #: arms the cross-DCN volume column.
+    topology: "tuple[int, int] | None" = None
 
 
 def check_comms(closed_jaxpr, label: str, contract: dict,
@@ -225,10 +272,19 @@ def check_comms(closed_jaxpr, label: str, contract: dict,
             snippet=prim,
         ))
     comms = contract.get("comms")
-    budget = budget_bytes(contract["model"], params.m, params.n, params.nb,
-                          params.P, params.itemsize, nrhs=params.nrhs,
-                          comms=comms)
     slack = float(contract.get("slack", 1.5))
+    if params.topology is not None:
+        tiered = tiered_budget_bytes(
+            contract["model"], params.m, params.n, params.nb, params.P,
+            params.itemsize, nrhs=params.nrhs, comms=comms,
+            topology=params.topology,
+            hierarchical=bool(contract.get("hierarchical", True)))
+        budget = tiered["total"]
+    else:
+        tiered = None
+        budget = budget_bytes(contract["model"], params.m, params.n,
+                              params.nb, params.P, params.itemsize,
+                              nrhs=params.nrhs, comms=comms)
     traced = stats.total_volume_bytes()
     if traced > budget * slack:
         wire = f", wire={comms}" if comms else ""
@@ -244,6 +300,27 @@ def check_comms(closed_jaxpr, label: str, contract: dict,
                "words than its communication pattern is contracted to"),
             snippet="volume",
         ))
+    if tiered is not None:
+        # Round 20: the cross-DCN column is its own contract — the
+        # hierarchical schedule exists to shrink THIS number ici_size-
+        # fold, so a total-volume check alone would let a schedule
+        # regression hide inside the (much larger) ICI share.
+        dcn_slack = float(contract.get("dcn_slack", slack))
+        dcn_traced = stats.dcn_volume_bytes()
+        if dcn_traced > tiered["dcn"] * dcn_slack:
+            findings.append(Finding(
+                "DHQR302", label, 0,
+                f"traced cross-DCN volume {dcn_traced} B exceeds the "
+                f"tier budget {tiered['dcn']} B (model "
+                f"'{contract['model']}', topology "
+                f"{params.topology[0]}x{params.topology[1]}"
+                + (f", wire={comms}" if comms else "")
+                + f") x slack {dcn_slack}: the hierarchical schedule "
+                "stopped isolating the slow tier — the ici_size-fold "
+                "cross-DCN reduction this engine is contracted to "
+                "deliver regressed",
+                snippet="dcn-volume",
+            ))
     for prim in sorted(set(stats.opaque_loop_collectives)):
         findings.append(Finding(
             "DHQR302", label, 0,
@@ -488,6 +565,51 @@ def _engine_specs(P: int, preset: str, pol, sweep_presets: bool):
     )
     for engine, mode, mk, params in wire_specs:
         yield (engine, f"comms::{engine}{tag}", mk(mode), params)
+    # dhqr-pod (round 20): the hierarchical two-tier engine matrix,
+    # traced on a (2, P/2) pod mesh wherever the sweep's P factors into
+    # one (P >= 4 — a 2x1 topology has no ICI domain to reduce inside).
+    # Contracts for these entries allow BOTH psum and all_gather (the
+    # hierarchical psum's ICI broadcast-back is a traced all_gather) and
+    # carry a ``dcn_slack`` column bounding the cross-DCN share — the
+    # ici_size-fold reduction this round exists for, machine-checked.
+    if P >= 4:
+        from dhqr_tpu.parallel.mesh import pod_mesh
+
+        pmesh, taxes = pod_mesh(P, topo=f"2x{P // 2}")
+        topo = (2, P // 2)
+        colp = EngineParams(m, n, nb, P, topology=topo)
+        rowp = EngineParams(_ROW_M, _ROW_N, _ROW_NB, P, topology=topo)
+        pod_specs = (
+            ("unblocked_qr_pod",
+             jx(lambda A: sharded_householder_qr(
+                 A, pmesh, axis_name=taxes), A), colp),
+            ("blocked_qr_pod",
+             jx(lambda A: sharded_blocked_qr(
+                 A, pmesh, block_size=nb, axis_name=taxes), A), colp),
+            ("sharded_solve_pod",
+             jx(lambda H, a, b: sharded_solve(
+                 H, a, b, pmesh, block_size=nb, axis_name=taxes),
+                H, alpha, b), colp),
+            ("tsqr_lstsq_pod",
+             jx(lambda A, b: sharded_tsqr_lstsq(
+                 A, b, pmesh, block_size=_ROW_NB, axis_name=taxes),
+                At, bt), rowp),
+            ("cholqr_lstsq_pod",
+             jx(lambda A, b: sharded_cholqr_lstsq(
+                 A, b, pmesh, axis_name=taxes), At, bt), rowp),
+            # The topology-tiered rungs: f32 inside ICI, compressed only
+            # at the DCN crossing — one column engine, one row engine.
+            ("sharded_solve_pod_dcn_bf16",
+             jx(lambda H, a, b: sharded_solve(
+                 H, a, b, pmesh, block_size=nb, axis_name=taxes,
+                 comms="dcn:bf16"), H, alpha, b), colp),
+            ("tsqr_lstsq_pod_dcn_bf16",
+             jx(lambda A, b: sharded_tsqr_lstsq(
+                 A, b, pmesh, block_size=_ROW_NB, axis_name=taxes,
+                 comms="dcn:bf16"), At, bt), rowp),
+        )
+        for engine, thunk, params in pod_specs:
+            yield (engine, f"comms::{engine}{tag}", thunk, params)
     from dhqr_tpu.precision import PrecisionPolicy
 
     As = jnp.zeros((_BATCH_B, _BATCH_M, _BATCH_N), jnp.float32)

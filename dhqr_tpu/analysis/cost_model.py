@@ -114,7 +114,11 @@ MODELS = {
 #: the stdlib-only regress tier that imports this module. The copies
 #: are pinned against each other by
 #: tests/test_wire.py::test_wire_modes_validation_and_vocab_parity.
-WIRE_ITEMSIZE = {"bf16": 2, "int8": 1}
+#: The dcn:* rungs (round 20) price the DCN LEG of the hierarchical
+#: schedule; their ICI legs are f32 (see tiered_budget_bytes below —
+#: flat budget_bytes with a dcn:* mode prices the whole volume at the
+#: DCN itemsize, which is only meaningful per-tier).
+WIRE_ITEMSIZE = {"bf16": 2, "int8": 1, "dcn:bf16": 2, "dcn:int8": 1}
 
 
 def budget_bytes(model: str, m: int, n: int, nb: int, P: int,
@@ -148,3 +152,138 @@ def budget_bytes(model: str, m: int, n: int, nb: int, P: int,
                 "wire format this version does not ship"
             ) from None
     return fn(m, n, nb, P, nrhs=nrhs) * itemsize
+
+
+# ---------------------------------------------------------------------------
+# Two-tier (DCN x ICI) budgets — dhqr-pod, round 20.
+#
+# The word models above are schedule-invariant totals; a two-tier
+# contract needs the per-TIER split, which depends on the collective
+# SCHEDULE (hierarchical vs flat) and on the per-leg wire format (the
+# dcn:* rungs compress only the DCN crossing). The split is derived
+# from the same per-collective payload sequence the engines trace, so
+# at the pass's own shapes the per-tier traced volume matches the
+# budget to the byte for the exact engines.
+
+#: Literal copy of parallel/wire._DCN_TIERED (same stdlib-only-tier
+#: reasoning as WIRE_ITEMSIZE above; pinned by the vocab-parity test).
+DCN_TIERED = {"dcn:bf16": "bf16", "dcn:int8": "int8"}
+
+
+def payload_schedule(model: str, m: int, n: int, nb: int, P: int,
+                     nrhs: int = 1):
+    """The engine's per-collective payload sequence:
+    ``(kind, rows, cols, f32_wire, onehot)`` tuples where ``kind`` is
+    ``"psum"`` or ``"gather"``, ``(rows, cols)`` the payload shape on
+    one device, ``f32_wire`` marks the CSNE correction reductions that
+    stay on the exact f32 wire at every rung, and ``onehot`` the
+    one-hot-broadcast invariant (dense reductions refuse int8 at the
+    seam — the tiered pricing mirrors that refusal). Summing
+    ``rows * cols`` over the sequence reproduces the word models above
+    exactly."""
+    if model == "unblocked_qr":
+        return [("psum", m, 1, False, True)] * n
+    if model == "blocked_qr":
+        out = []
+        for k in range(0, n, nb):
+            out.append(("psum", m - k, nb, False, True))
+            out.append(("psum", nb, 1, False, True))
+        return out
+    if model == "sharded_solve":
+        out = [("psum", m - k, nb, False, True) for k in range(0, n, nb)]
+        out += [("psum", n, nrhs, False, True)] * (n // nb)
+        return out
+    if model in ("tsqr_lstsq", "tsqr_lstsq_wire"):
+        out = [("gather", n, n, False, True),
+               ("gather", n, nrhs, False, True)]
+        if model == "tsqr_lstsq_wire":
+            out += [("psum", n, nrhs, True, False)] * CSNE_SWEEPS
+        return out
+    if model in ("cholqr_lstsq", "cholqr_lstsq_wire"):
+        out = [("psum", n, n, False, False)] * 2
+        out.append(("psum", n, nrhs, False, False))
+        if model == "cholqr_lstsq_wire":
+            out += [("psum", n, nrhs, True, False)] * CSNE_SWEEPS
+        return out
+    if model == "none":
+        return []
+    raise KeyError(
+        f"unknown comms cost model {model!r} (have {sorted(MODELS)}); "
+        "comms_contracts.json names a model this version does not ship")
+
+
+def _leg_itemsize(mode: "str | None", itemsize: int, onehot: bool) -> int:
+    """Wire bytes/word for one leg: f32 passthrough at ``itemsize``,
+    int8 dense reductions degrade to bf16 exactly as at the seam."""
+    if mode is None:
+        return itemsize
+    if mode == "int8" and not onehot:
+        return WIRE_ITEMSIZE["bf16"]
+    return WIRE_ITEMSIZE[mode]
+
+
+def tiered_budget_bytes(model: str, m: int, n: int, nb: int, P: int,
+                        itemsize: int, nrhs: int = 1,
+                        comms: "str | None" = None,
+                        topology: "tuple[int, int] | None" = None,
+                        hierarchical: bool = True) -> "dict[str, int]":
+    """Per-tier analytic collective budget ``{"ici": B, "dcn": B,
+    "total": B}`` for ``model`` on a ``topology = (dcn_size,
+    ici_size)`` mesh (dhqr-pod, round 20).
+
+    Pricing mirrors the traced census byte-for-byte (output-aval
+    convention, module docstring): a hierarchical ``psum`` is an ICI
+    reduce (wire itemsize), a DCN chunk exchange of ``ceil(rows /
+    ici_size)`` rows (DCN-leg itemsize — the ici_size-fold cross-DCN
+    cut this round exists for), and an f32 ICI broadcast-back gather of
+    the row-padded payload; a hierarchical ``gather`` exchanges only
+    the local share across DCN then gathers the stacks over ICI in
+    f32. The flat baseline (``hierarchical=False``) runs ONE joint-axis
+    collective whose full payload crosses DCN — counted entirely on
+    the DCN tier, which is exactly the comparison the serving_pod
+    benchmark publishes. ``topology=None`` (a 1-D mesh) has no DCN
+    tier at all; the ``dcn:*`` rungs degrade to f32 wherever no
+    isolated DCN leg exists, mirroring the seam."""
+    sched = payload_schedule(model, m, n, nb, P, nrhs=nrhs)
+    if topology is None:
+        total = budget_bytes(
+            model, m, n, nb, P, itemsize, nrhs=nrhs,
+            comms=None if comms in DCN_TIERED else comms)
+        return {"ici": total, "dcn": 0, "total": total}
+    dcn_size, ici_size = topology
+    if dcn_size * ici_size != P:
+        raise ValueError(
+            f"topology {topology} does not factor P={P}")
+    if comms in DCN_TIERED:
+        ici_mode, dcn_mode = None, DCN_TIERED[comms]
+    else:
+        ici_mode = dcn_mode = comms
+    ici = dcn = 0
+    for kind, rows, cols, f32_wire, onehot in sched:
+        im = None if f32_wire else ici_mode
+        dm = None if f32_wire else dcn_mode
+        if not hierarchical:
+            # One joint-axis collective: the full payload crosses DCN.
+            # dcn:* has no isolated DCN leg on the flat schedule -> f32.
+            fm = None if comms in DCN_TIERED or f32_wire else comms
+            isz = _leg_itemsize(fm, itemsize, onehot)
+            words = P * rows * cols if kind == "gather" else rows * cols
+            dcn += words * isz
+            continue
+        if kind == "psum":
+            ici += rows * cols * _leg_itemsize(im, itemsize, onehot)
+            if dcn_size > 1:
+                rp = -(-rows // ici_size) * ici_size
+                dcn += (rp // ici_size) * cols * _leg_itemsize(
+                    dm, itemsize, onehot)
+                ici += rp * cols * itemsize     # f32 broadcast-back
+        else:  # gather
+            if dcn_size > 1:
+                dcn += (dcn_size * rows * cols
+                        * _leg_itemsize(dm, itemsize, onehot))
+                if ici_size > 1:
+                    ici += P * rows * cols * itemsize
+            else:
+                ici += P * rows * cols * _leg_itemsize(
+                    im, itemsize, onehot)
+    return {"ici": ici, "dcn": dcn, "total": ici + dcn}

@@ -311,6 +311,28 @@ def _entry_points(preset: str, pol):
                                                 precision=pol.panel),
               A, b),
            ("rows",))
+    # Two-tier pod routes (round 20, dhqr-pod): the hierarchical
+    # schedules trace over BOTH axes of a ("dcn", "ici") mesh, and the
+    # dcn:* rungs add compressed DCN legs — sanitize each once (the
+    # schedule is preset-independent; the rungs enumerate here so a
+    # mode that stops tracing fails DHQR104 and a collective escaping
+    # the declared axes fails DHQR103). Needs a 2x2 factorization —
+    # skipped quietly on narrower backends (the comms audit's pod
+    # matrix covers those via its own subprocess vehicle).
+    if preset == "accurate" and len(jax.devices()) >= 4:
+        from dhqr_tpu.parallel.mesh import pod_mesh
+
+        pmesh, _taxes = pod_mesh(4, topo="2x2")
+        yield ("sharded_blocked_qr_pod",
+               jx(lambda A: sharded_blocked_qr(A, pmesh, block_size=_NB),
+                  A),
+               ("dcn", "ici"))
+        for _mode in ("dcn:bf16", "dcn:int8"):
+            yield (f"lstsq_pod[{_mode}]",
+                   jx(lambda A, b, _m=_mode: dhqr_tpu.lstsq(
+                       A, b, mesh=pmesh, block_size=_NB, comms=_m),
+                      A, b),
+                   ("dcn", "ici"))
 
 
 def run_jaxpr_pass(presets=None) -> "list[Finding]":

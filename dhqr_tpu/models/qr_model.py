@@ -502,11 +502,18 @@ def qr(
         from dhqr_tpu.parallel.layout import plan_padding
         from dhqr_tpu.parallel.mesh import DEFAULT_AXIS
 
+        from dhqr_tpu.parallel import topology as _topo
+
         col_axis = cfg.mesh_axis or DEFAULT_AXIS
         # Same planning the engines do internally (arbitrary n is padded and
         # sliced back there) — recomputed here so the factorization object
-        # records the panel width the solve stage will reuse.
-        nb, _ = plan_padding(A.shape[1], mesh.shape[col_axis], cfg.block_size)
+        # records the panel width the solve stage will reuse. axis_size (not
+        # mesh.shape[...]) so a two-tier ("dcn", "ici") pod mesh plans over
+        # the full device count (dhqr-pod, round 20).
+        nb, _ = plan_padding(
+            A.shape[1],
+            _topo.axis_size(mesh, _topo.resolve_axis(mesh, col_axis)),
+            cfg.block_size)
         if cfg.blocked:
             H, alpha = _sharded.sharded_blocked_qr(
                 A, mesh, block_size=nb, axis_name=col_axis,
@@ -789,6 +796,11 @@ def _lstsq_alt_engine(A, b, cfg: DHQRConfig, mesh):
         elif len(mesh.shape) == 1:
             axis = next(iter(mesh.shape))
         elif ROW_AXIS in mesh.shape:
+            axis = ROW_AXIS
+        elif tuple(mesh.axis_names) == ("dcn", "ici"):
+            # A two-tier pod mesh is unambiguous: the engines resolve the
+            # default row axis to both tiers jointly (parallel/topology
+            # .resolve_axis), running the hierarchical schedule.
             axis = ROW_AXIS
         else:
             # Never guess among multiple axes — sharding rows over a
@@ -1122,8 +1134,13 @@ def lstsq(
         if not cfg.blocked:
             _reject_nonblocked_knobs(cfg.use_pallas, cfg.trailing_precision,
                                      cfg.lookahead, cfg.agg_panels)
+            from dhqr_tpu.parallel import topology as _topo
+
             m, n = A.shape
-            nb, n_pad = plan_padding(n, mesh.shape[col_axis], cfg.block_size)
+            nb, n_pad = plan_padding(
+                n,
+                _topo.axis_size(mesh, _topo.resolve_axis(mesh, col_axis)),
+                cfg.block_size)
             if n_pad != n:
                 # Pad once so the factor->solve store-layout chaining holds
                 # (see sharded_lstsq for the blocked twin of this dance).

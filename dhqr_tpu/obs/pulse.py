@@ -183,6 +183,12 @@ class PulseReport:
     skew: "dict | None" = None
     skew_unavailable: "str | None" = None
     ici_gbps: "float | None" = None
+    #: Round 20 (dhqr-pod): published DCN (cross-slice) bandwidth for
+    #: the device kind, or None — absent by design on CPU and on any
+    #: kind utils/platform.device_dcn_gbps does not know. The DHQR306
+    #: two-tier bound reads it; a None with a non-zero cross-DCN traced
+    #: share skips with the reason, never crashes.
+    dcn_gbps: "float | None" = None
     dhqr306: "dict | None" = None
     comms: "dict | None" = None
 
@@ -223,6 +229,8 @@ class PulseReport:
                 self.skew_unavailable or "no per-shard lanes captured")
         if self.ici_gbps is not None:
             out["ici_gbps"] = self.ici_gbps
+        if self.dcn_gbps is not None:
+            out["dcn_gbps"] = self.dcn_gbps
         out["dhqr306"] = self.dhqr306
         out["dhqr306_pass"] = self.dhqr306_pass
         if self.comms is not None:
@@ -256,12 +264,23 @@ def _analytic_census(abstract: "Callable[[], object] | None",
         return None, (), f"abstract trace failed: {type(e).__name__}: {e}"
     families: "dict[str, dict]" = {}
     launches, volumes = stats.launches(), stats.volume()
+    # Round 20 (dhqr-pod): the cross-DCN share of each primitive's
+    # volume, read off the collective's own axis names — zero on any
+    # 1-D mesh, so pre-pod census rows are unchanged except for the
+    # constant extra key.
+    dcn_volumes: "dict[str, int]" = {}
+    for u in stats.uses:
+        if u.bounded and u.crosses_dcn:
+            dcn_volumes[u.prim] = (dcn_volumes.get(u.prim, 0)
+                                   + u.volume_bytes)
     for prim in set(launches) | set(volumes):
         family = _net.PRIMITIVE_FAMILY.get(prim, prim)
         row = families.setdefault(
-            family, {"launches": 0, "volume_bytes": 0})
+            family, {"launches": 0, "volume_bytes": 0,
+                     "dcn_volume_bytes": 0})
         row["launches"] += launches.get(prim, 0)
         row["volume_bytes"] += volumes.get(prim, 0)
+        row["dcn_volume_bytes"] += dcn_volumes.get(prim, 0)
     opaque = tuple(sorted(
         {_net.PRIMITIVE_FAMILY.get(p, p)
          for p in stats.opaque_loop_collectives}))
@@ -282,7 +301,8 @@ def _check_dhqr306(measured: "dict | None", analytic: "dict | None",
                    opaque: "tuple[str, ...]", n_devices: int,
                    ici_gbps: "float | None", slack: float,
                    contract_families: "tuple | None" = None,
-                   wire_format: "str | None" = None) -> dict:
+                   wire_format: "str | None" = None,
+                   dcn_gbps: "float | None" = None) -> dict:
     """The runtime contract verdict. Per measured family: the
     :func:`~dhqr_tpu.obs.netmodel.explain_measured` wire check against
     the analytic volume (skip with reason when no wire speed is
@@ -344,7 +364,9 @@ def _check_dhqr306(measured: "dict | None", analytic: "dict | None",
             continue
         check = _net.explain_measured(
             family, meas["time_s"], row["volume_bytes"], n_devices,
-            ici_gbps or 0.0, slack, wire_format=wire_format)
+            ici_gbps or 0.0, slack, wire_format=wire_format,
+            dcn_volume_bytes=row.get("dcn_volume_bytes", 0) or 0,
+            dcn_gbps=dcn_gbps)
         if note:
             check["note"] = note
         verdict["checks"].append(check)
@@ -393,9 +415,12 @@ def measure(label: str, thunk: Callable[[], object], *,
         from dhqr_tpu.obs.xray import _default_device_kind
 
         device_kind, _platform = _default_device_kind()
-    from dhqr_tpu.utils.platform import device_ici_gbps
+    from dhqr_tpu.utils.platform import device_dcn_gbps, device_ici_gbps
 
     ici = device_ici_gbps(device_kind) if device_kind else None
+    # Round 20: the DCN tier's own bandwidth — None (with the skip
+    # reason downstream) on CPU and unknown kinds, by design.
+    dcn = device_dcn_gbps(device_kind) if device_kind else None
 
     tmpdir = keep_trace_dir or tempfile.mkdtemp(prefix="dhqr_pulse_")
     events: "list[dict]" = []
@@ -498,7 +523,7 @@ def measure(label: str, thunk: Callable[[], object], *,
     dhqr306 = _check_dhqr306(measured, analytic, opaque, n_devices,
                              ici, slack,
                              contract_families=contract_families,
-                             wire_format=wire_format)
+                             wire_format=wire_format, dcn_gbps=dcn)
 
     comms: "dict | None" = None
     if measured is not None and skew is not None:
@@ -527,7 +552,7 @@ def measure(label: str, thunk: Callable[[], object], *,
         measured=measured, measured_unavailable=reason,
         analytic=analytic, analytic_unavailable=analytic_reason,
         opaque_families=opaque, skew=skew, skew_unavailable=skew_reason,
-        ici_gbps=ici, dhqr306=dhqr306, comms=comms,
+        ici_gbps=ici, dcn_gbps=dcn, dhqr306=dhqr306, comms=comms,
     )
     return out, report
 

@@ -16,6 +16,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dhqr_tpu.parallel import topology as _topo
+
 DEFAULT_AXIS = "cols"
 
 
@@ -40,13 +42,56 @@ def column_mesh(
     return Mesh(np.asarray(devices), (axis_name,))
 
 
-def column_sharding(mesh: Mesh, axis_name: str = DEFAULT_AXIS) -> NamedSharding:
+def pod_mesh(
+    n_devices: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    topo: "tuple[int, int] | str | None" = None,
+) -> "tuple[Mesh, _topo.TierAxes]":
+    """Two-tier ``("dcn", "ici")`` device mesh + its :class:`TierAxes`
+    descriptor (dhqr-pod, round 20).
+
+    ``topo`` is ``(dcn_size, ici_size)`` or a ``"2x4"`` spec string;
+    None asks :func:`dhqr_tpu.parallel.topology.detect_topology`
+    (``DHQR_TOPO`` env override first, then TPU slice structure). A
+    flat device set (no detectable tier, or ``1xP``) still returns a
+    valid 1xP pod mesh — the hierarchical schedule degenerates to the
+    flat one there, so callers need no special case. Device order is
+    preserved: device ``(d, i)`` is flat device ``d * ici_size + i``,
+    the same assignment ``column_mesh`` would make.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices but only {len(devices)} visible"
+            )
+        devices = devices[:n_devices]
+    if isinstance(topo, str):
+        topo = _topo.parse_topo(topo)
+    if topo is None:
+        topo = _topo.detect_topology(devices) or (1, len(devices))
+    dcn, ici = int(topo[0]), int(topo[1])
+    if dcn * ici != len(devices):
+        raise ValueError(
+            f"topology {dcn}x{ici} does not factor the device count "
+            f"{len(devices)}"
+        )
+    mesh = Mesh(np.asarray(devices).reshape(dcn, ici),
+                (_topo.DCN_AXIS, _topo.ICI_AXIS))
+    return mesh, _topo.TierAxes(dcn_size=dcn, ici_size=ici)
+
+
+def column_sharding(mesh: Mesh, axis_name=DEFAULT_AXIS) -> NamedSharding:
     """Sharding for an (m, n) matrix: columns split over the mesh, rows whole.
 
     The reference's ``DArray(..., (1, nworkers()))`` layout (runtests.jl:71)
     with the rows-unpartitioned invariant (src:33) encoded in the spec.
+    ``axis_name`` may be a :class:`dhqr_tpu.parallel.topology.TierAxes`
+    — columns then shard over both tiers dcn-major (same block order as
+    the 1-D mesh over the same device list).
     """
-    return NamedSharding(mesh, P(None, axis_name))
+    return NamedSharding(mesh, P(None, _topo.spec_axes(axis_name)))
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:

@@ -59,8 +59,18 @@ def initialize(
             **kwargs,
         )
     except RuntimeError as e:
-        if "already initialized" not in str(e).lower():
-            raise
+        msg = str(e).lower()
+        if "already initialized" in msg:
+            return
+        if ("before any jax computations" in msg
+                and coordinator_address is None and num_processes is None
+                and process_id is None and not kwargs):
+            # Backend already live in single-process mode and nothing
+            # multi-process was requested: the documented no-op (an
+            # explicit coordinator request after backend init still
+            # surfaces — that one IS a real ordering bug).
+            return
+        raise
     except ValueError:
         if (coordinator_address is not None or num_processes is not None
                 or process_id is not None or kwargs):
@@ -71,6 +81,23 @@ def initialize(
 def global_column_mesh(axis_name: str = DEFAULT_AXIS):
     """Column mesh over every device of every host (ICI+DCN collectives)."""
     return column_mesh(axis_name=axis_name, devices=jax.devices())
+
+
+def global_pod_mesh(topo=None):
+    """Two-tier ``("dcn", "ici")`` mesh over every device of every host
+    + its ``TierAxes`` descriptor (dhqr-pod, round 20).
+
+    The pod-scale replacement for :func:`global_column_mesh`: the DCN
+    tier is discovered from the multi-slice runtime (``slice_index``,
+    falling back to per-process grouping) or forced with
+    ``DHQR_TOPO=PdcnxPici`` / the ``topo`` argument, and the sharded
+    engines run the hierarchical reduce-inside-ICI-first schedule on
+    it (parallel/wire.py). On a single slice this degenerates to a
+    1xP mesh — same collectives as the flat tier.
+    """
+    from dhqr_tpu.parallel.mesh import pod_mesh
+
+    return pod_mesh(devices=jax.devices(), topo=topo)
 
 
 def global_row_mesh(axis_name: str = ROW_AXIS):

@@ -35,6 +35,9 @@ from dhqr_tpu.parallel import wire as _wire
 # dhqr-armor (round 19) ABFT verification seam (DHQR010).
 from dhqr_tpu import armor as _armor
 
+# dhqr-pod (round 20): two-tier topology descriptor + axis helpers.
+from dhqr_tpu.parallel import topology as _topo
+
 from dhqr_tpu.ops.cholqr import _cholqr_passes
 from dhqr_tpu.ops.solve import as_matrix_rhs
 from dhqr_tpu.ops.householder import DEFAULT_PRECISION
@@ -94,11 +97,12 @@ def _build_cholqr(mesh: Mesh, axis_name: str, precision: str, shift: bool,
         _cholqr_shard_body, axis=axis_name, precision=precision, shift=shift,
         comms=comms,
     )
+    spec = _topo.spec_axes(axis_name)
     return jax.jit(
         shard_map(
             body,
             mesh=mesh,
-            in_specs=(P(axis_name, None), P(axis_name)),
+            in_specs=(P(spec, None), P(spec)),
             out_specs=P(),
             check_vma=False,  # x is replicated by construction (psum inputs)
         )
@@ -125,12 +129,15 @@ def sharded_cholqr_lstsq(
     m, n = A.shape
     if m < n:
         raise ValueError(f"lstsq requires m >= n, got {A.shape}")
-    nproc = mesh.shape[axis_name]
+    axis_name = _topo.resolve_axis(mesh, axis_name)
+    nproc = _topo.axis_size(mesh, axis_name)
+    ptag = _topo.axis_label(axis_name, nproc)
     if m % nproc != 0:
         raise ValueError(f"m={m} must be divisible by mesh size {nproc}")
-    A = jax.device_put(A, NamedSharding(mesh, P(axis_name, None)))
-    b = jax.device_put(b, NamedSharding(mesh, P(axis_name)))
-    base_label = (f"cholqr_lstsq[P={nproc},{m}x{n}"
+    spec = _topo.spec_axes(axis_name)
+    A = jax.device_put(A, NamedSharding(mesh, P(spec, None)))
+    b = jax.device_put(b, NamedSharding(mesh, P(spec)))
+    base_label = (f"cholqr_lstsq[P={ptag},{m}x{n}"
                   + (",shift" if shift else "") + "]")
     comms = _armor.effective_comms(base_label, comms)
 
@@ -140,7 +147,7 @@ def sharded_cholqr_lstsq(
         if _pulse.active() is None:
             return fn(A, b)
         return _pulse.observed_dispatch(
-            f"cholqr_lstsq[P={nproc},{m}x{n}" + (",shift" if shift else "")
+            f"cholqr_lstsq[P={ptag},{m}x{n}" + (",shift" if shift else "")
             + (f",w{wire_comms}" if wire_comms else "") + "]",
             lambda: fn(A, b), abstract=lambda: jax.make_jaxpr(fn)(A, b),
             n_devices=nproc, wire_format=wire_comms)

@@ -71,6 +71,11 @@ from dhqr_tpu.ops.householder import (
 )
 from dhqr_tpu.parallel.mesh import DEFAULT_AXIS, column_sharding
 
+# dhqr-pod (round 20): the two-tier topology descriptor + the four
+# axis helpers that keep this engine tier-agnostic (a plain string
+# axis takes the exact pre-pod paths — same labels, same cache keys).
+from dhqr_tpu.parallel import topology as _topo
+
 
 def _local_gidx(p, n: int, nloc: int, nb: int, layout: str):
     """Global (natural) column index of each local column — the traced
@@ -139,7 +144,7 @@ def _unblocked_shard_body(
     ``sharded_solve`` so both stages share one storage order.
     """
     m, nloc = Al.shape
-    p = lax.axis_index(axis)
+    p = _topo.axis_index(axis)
     P = n // nloc
     delta_j = p * nloc  # global column offset — LocalColumnBlock.Δj (src:34)
     rows = lax.iota(jnp.int32, m)
@@ -195,7 +200,7 @@ def _blocked_shard_body(
     src:141-143, batched nb columns at a time).
     """
     m, nloc = Al.shape
-    p = lax.axis_index(axis)
+    p = _topo.axis_index(axis)
     nproc = n // nloc
     gidx_base = _local_gidx(p, n, nloc, nb, layout)
     alpha = jnp.zeros((n,), dtype=Al.dtype)
@@ -683,12 +688,13 @@ def _build_unblocked(
         n=n, axis=axis_name, precision=precision, layout=layout,
         store_nb=store_nb, norm=norm, comms=comms,
     )
+    spec = _topo.spec_axes(axis_name)
     return jax.jit(
         shard_map(
             body,
             mesh=mesh,
-            in_specs=P(None, axis_name),
-            out_specs=(P(None, axis_name), P()),
+            in_specs=P(None, spec),
+            out_specs=(P(None, spec), P()),
             check_vma=False,  # alpha is replicated by construction (psum inputs)
         )
     )
@@ -712,12 +718,13 @@ def _build_blocked(
         trailing_precision=trailing_precision, lookahead=lookahead,
         agg_panels=agg_panels, comms=comms,
     )
+    spec = _topo.spec_axes(axis_name)
     return jax.jit(
         shard_map(
             body,
             mesh=mesh,
-            in_specs=P(None, axis_name),
-            out_specs=(P(None, axis_name), P()),
+            in_specs=P(None, spec),
+            out_specs=(P(None, spec), P()),
             check_vma=False,
         )
     )
@@ -803,7 +810,9 @@ def sharded_householder_qr(
     """
     comms = _wire.resolve_comms(comms)
     m, n = A.shape
-    nproc = mesh.shape[axis_name]
+    axis_name = _topo.resolve_axis(mesh, axis_name)
+    nproc = _topo.axis_size(mesh, axis_name)
+    ptag = _topo.axis_label(axis_name, nproc)
     if layout == "block":
         store_nb = 1  # unused by the block layout; normalize the cache key
     # Arbitrary n: pad to the layout's divisibility (multiple of store_nb *
@@ -838,7 +847,7 @@ def sharded_householder_qr(
     # dispatch above guarantees n % (store_nb * nproc) == 0.)
     _check_divisibility(m, n, nproc, None, layout)
     A_in = A
-    base_label = f"unblocked_qr[P={nproc},{m}x{n},{layout}]"
+    base_label = f"unblocked_qr[P={ptag},{m}x{n},{layout}]"
     comms = _armor.effective_comms(base_label, comms)
     A = _to_store_layout(A, n, nproc, store_nb, layout)
     A = jax.device_put(A, column_sharding(mesh, axis_name))
@@ -851,7 +860,7 @@ def sharded_householder_qr(
         if _pulse.active() is None:
             return fn(A)
         return _pulse.observed_dispatch(
-            f"unblocked_qr[P={nproc},{m}x{n},{layout}"
+            f"unblocked_qr[P={ptag},{m}x{n},{layout}"
             + (f",w{wire_comms}" if wire_comms else "") + "]",
             lambda: fn(A), abstract=lambda: jax.make_jaxpr(fn)(A),
             n_devices=nproc, wire_format=wire_comms)
@@ -942,7 +951,9 @@ def sharded_blocked_qr(
         policy, precision, trailing_precision,
         default_precision=DEFAULT_PRECISION)
     m, n = A.shape
-    nproc = mesh.shape[axis_name]
+    axis_name = _topo.resolve_axis(mesh, axis_name)
+    nproc = _topo.axis_size(mesh, axis_name)
+    ptag = _topo.axis_label(axis_name, nproc)
     if agg_panels is not None and agg_panels < 2:
         raise ValueError(f"agg_panels must be >= 2 (got {agg_panels}); "
                          "use None to disable aggregation")
@@ -1000,7 +1011,7 @@ def sharded_blocked_qr(
 
     sched = ("la" if lookahead else "") + (
         f"agg{agg_panels}" if agg_panels else "")
-    base_label = (f"blocked_qr[P={nproc},{m}x{n},nb={nb},{layout}"
+    base_label = (f"blocked_qr[P={ptag},{m}x{n},nb={nb},{layout}"
                   + (f",{sched}" if sched else "") + "]")
     comms = _armor.effective_comms(base_label, comms)
 
@@ -1017,7 +1028,7 @@ def sharded_blocked_qr(
             tags = (f",{sched}" if sched else "") + (
                 f",w{wire_comms}" if wire_comms else "")
             return _pulse.observed_dispatch(
-                f"blocked_qr[P={nproc},{m}x{n},nb={nb},{layout}{tags}]",
+                f"blocked_qr[P={ptag},{m}x{n},nb={nb},{layout}{tags}]",
                 lambda: fn(A), abstract=lambda: jax.make_jaxpr(fn)(A),
                 n_devices=nproc, wire_format=wire_comms)
 

@@ -50,6 +50,10 @@ from dhqr_tpu.ops.blocked import (
 from dhqr_tpu.ops.householder import DEFAULT_PRECISION
 from dhqr_tpu.parallel.mesh import DEFAULT_AXIS, column_sharding, replicated_sharding
 
+# dhqr-pod (round 20): two-tier topology descriptor + axis helpers
+# (plain string axes take the exact pre-pod paths).
+from dhqr_tpu.parallel import topology as _topo
+
 
 def _apply_qt_shard_body(
     Hl, b, *, n: int, nb: int, axis: str,
@@ -69,7 +73,7 @@ def _apply_qt_shard_body(
 
     m, nloc = Hl.shape
     nproc = n // nloc
-    p = lax.axis_index(axis)
+    p = _topo.axis_index(axis)
     vec = b.ndim == 1
     B = b[:, None] if vec else b
     num_panels = n // nb  # nb | nloc | n in the sharded path (checked)
@@ -132,7 +136,7 @@ def _backsub_shard_body(
 
     m, nloc = Hl.shape
     nproc = n // nloc
-    p = lax.axis_index(axis)
+    p = _topo.axis_index(axis)
     rows_n = lax.iota(jnp.int32, n)[:, None]
     vec = c.ndim == 1
     C = (c[:, None] if vec else c)[:n]
@@ -237,7 +241,7 @@ def _build_solve(
         shard_map(
             full,
             mesh=mesh,
-            in_specs=(P(None, axis_name), P(), P()),
+            in_specs=(P(None, _topo.spec_axes(axis_name)), P(), P()),
             out_specs=P(),
             check_vma=False,
         )
@@ -272,7 +276,9 @@ def sharded_solve(
 
     comms = _wire.resolve_comms(comms)
     m, n = H.shape
-    nproc = mesh.shape[axis_name]
+    axis_name = _topo.resolve_axis(mesh, axis_name)
+    nproc = _topo.axis_size(mesh, axis_name)
+    ptag = _topo.axis_label(axis_name, nproc)
     nb, n_pad = plan_padding(n, nproc, block_size)
     if n_pad != n:
         # Arbitrary n: pad H with zero columns (v = 0 is the identity
@@ -300,7 +306,7 @@ def sharded_solve(
         )
         return x[:n]
     _check_divisibility(m, n, nproc, nb, layout)
-    base_label = f"sharded_solve[P={nproc},{m}x{n},nb={nb},{layout}]"
+    base_label = f"sharded_solve[P={ptag},{m}x{n},nb={nb},{layout}]"
     comms = _armor.effective_comms(base_label, comms)
     if not _H_in_store_layout:
         H = _to_store_layout(H, n, nproc, nb, layout)
@@ -314,7 +320,7 @@ def sharded_solve(
         if _pulse.active() is None:
             return fn(H, alpha, b)
         return _pulse.observed_dispatch(
-            f"sharded_solve[P={nproc},{m}x{n},nb={nb},{layout}"
+            f"sharded_solve[P={ptag},{m}x{n},nb={nb},{layout}"
             + (f",w{wire_comms}" if wire_comms else "") + "]",
             lambda: fn(H, alpha, b),
             abstract=lambda: jax.make_jaxpr(fn)(H, alpha, b),
@@ -405,7 +411,9 @@ def sharded_lstsq(
         apply_precision = precision
     m, n = A.shape
     m0, n0 = m, n   # the CALLER's shape — the tune/demotion plan key
-    nproc = mesh.shape[axis_name]
+    axis_name = _topo.resolve_axis(mesh, axis_name)
+    nproc = _topo.axis_size(mesh, axis_name)
+    ptag = _topo.axis_label(axis_name, nproc)
     nb, n_pad = plan_padding(n, nproc, block_size)
     if n_pad != n:
         A = _pad_cols_orthogonal(A, n_pad)
@@ -434,7 +442,7 @@ def sharded_lstsq(
     # (_store_layout_output/_H_in_store_layout), so one O(mn)
     # normal-equations checksum covers the whole factor+solve and a
     # recovery re-dispatch re-runs BOTH stages.
-    base_label = (f"sharded_lstsq[P={nproc},{m}x{A.shape[1]},nb={nb},"
+    base_label = (f"sharded_lstsq[P={ptag},{m}x{A.shape[1]},nb={nb},"
                   f"{layout}]")
     comms_eff = _armor.effective_comms(base_label, comms)
     # plan_shape carries the CALLER's (m, n): tune.resolve_plan keys

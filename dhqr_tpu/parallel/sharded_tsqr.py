@@ -36,6 +36,9 @@ from dhqr_tpu.parallel import wire as _wire
 # module-global None check disarmed, same discipline as pulse above.
 from dhqr_tpu import armor as _armor
 
+# dhqr-pod (round 20): two-tier topology descriptor + axis helpers.
+from dhqr_tpu.parallel import topology as _topo
+
 from dhqr_tpu.ops.householder import DEFAULT_PRECISION
 from dhqr_tpu.ops.solve import as_matrix_rhs
 from dhqr_tpu.ops.tsqr import _combine_solve, _leaf_factor
@@ -131,11 +134,12 @@ def _build_tsqr(mesh: Mesh, axis_name: str, n: int, nb: int, precision: str,
         pallas=pallas, interpret=interpret, pallas_flat=pallas_flat,
         comms=comms,
     )
+    spec = _topo.spec_axes(axis_name)
     return jax.jit(
         shard_map(
             body,
             mesh=mesh,
-            in_specs=(P(axis_name, None), P(axis_name)),
+            in_specs=(P(spec, None), P(spec)),
             out_specs=P(),
             check_vma=False,  # x is replicated by construction (all_gather)
         )
@@ -165,7 +169,9 @@ def sharded_tsqr_lstsq(
     ensure_complex_supported(A.dtype)
     comms = _wire.resolve_comms(comms)
     m, n = A.shape
-    nproc = mesh.shape[axis_name]
+    axis_name = _topo.resolve_axis(mesh, axis_name)
+    nproc = _topo.axis_size(mesh, axis_name)
+    ptag = _topo.axis_label(axis_name, nproc)
     if m % nproc != 0:
         raise ValueError(f"m={m} must be divisible by mesh size {nproc}")
     if m // nproc < n:
@@ -177,11 +183,12 @@ def sharded_tsqr_lstsq(
                                              A.dtype)
     from dhqr_tpu.ops.blocked import PALLAS_FLAT_WIDTH
 
-    A = jax.device_put(A, NamedSharding(mesh, P(axis_name, None)))
-    b = jax.device_put(b, NamedSharding(mesh, P(axis_name)))
+    spec = _topo.spec_axes(axis_name)
+    A = jax.device_put(A, NamedSharding(mesh, P(spec, None)))
+    b = jax.device_put(b, NamedSharding(mesh, P(spec)))
     from dhqr_tpu.ops.blocked import _pallas_cache_guard
 
-    base_label = f"tsqr_lstsq[P={nproc},{m}x{n},nb={nb}]"
+    base_label = f"tsqr_lstsq[P={ptag},{m}x{n},nb={nb}]"
     comms = _armor.effective_comms(base_label, comms)
 
     def _dispatch(wire_comms):
@@ -192,7 +199,7 @@ def sharded_tsqr_lstsq(
             if _pulse.active() is None:
                 return fn(A, b)
             return _pulse.observed_dispatch(
-                f"tsqr_lstsq[P={nproc},{m}x{n},nb={nb}"
+                f"tsqr_lstsq[P={ptag},{m}x{n},nb={nb}"
                 + (f",w{wire_comms}" if wire_comms else "") + "]",
                 lambda: fn(A, b),
                 abstract=lambda: jax.make_jaxpr(fn)(A, b), n_devices=nproc,

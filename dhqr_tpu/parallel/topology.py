@@ -1,0 +1,231 @@
+"""dhqr-pod — the two-tier ICI/DCN topology descriptor (round 20).
+
+A TPU pod is not a flat ring: chips within a slice talk over ICI
+(200-600 GB/s per chip, ``utils/platform._DEVICE_PEAKS``) while slices
+talk over the data-center network at 25-50 GB/s — a 10-20x cliff that
+"Large Scale Distributed Linear Algebra With TPUs" (arXiv 2112.09017)
+shows decides whether dense factorizations scale at all. Until this
+round every sharded engine ran flat collectives over a 1-D mesh, paying
+the DCN price ~P times per collective; this module names the two tiers
+so the wire seam (parallel/wire.py) can reduce inside ICI first, cross
+DCN exactly once, and broadcast back.
+
+The descriptor is :class:`TierAxes` — a frozen, hashable value the
+engines accept anywhere they accept an ``axis_name`` string. It rides
+the ``lru_cache`` build keys unchanged and carries the schedule choice
+(``hierarchical=False`` spells the flat joint-axis baseline the pod
+benchmark A/Bs against). Engines themselves stay tier-agnostic: the
+four helpers at the bottom (:func:`axis_size`, :func:`spec_axes`,
+:func:`axis_index`, :func:`axis_label`) are the complete surface an
+engine needs, and each degrades to the 1-D spelling on a plain string
+axis so the single-tier programs stay byte-identical.
+
+Topology discovery:
+
+* On TPU, multi-slice runtimes expose ``device.slice_index``; devices
+  grouped by it give the real (DCN crossings x ICI domain) split.
+  Single-slice device sets have one group — no DCN tier, flat mesh.
+* On CPU (and for forcing a shape on TPU), ``DHQR_TOPO=PdcnxPici``
+  (e.g. ``DHQR_TOPO=2x4``) simulates a factorization, so the same P=8
+  host can run as 1x8 / 2x4 / 4x2 and the schedules, contracts, and
+  benchmarks exercise the two-tier paths without a pod.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Sequence
+
+DCN_AXIS = "dcn"
+ICI_AXIS = "ici"
+
+__all__ = [
+    "DCN_AXIS",
+    "ICI_AXIS",
+    "TierAxes",
+    "axis_index",
+    "axis_label",
+    "axis_size",
+    "detect_topology",
+    "parse_topo",
+    "resolve_axis",
+    "spec_axes",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class TierAxes:
+    """The two-tier mesh axis descriptor the engines thread in place of
+    a 1-D ``axis_name`` string.
+
+    ``dcn``/``ici`` name the mesh axes (outer = DCN crossings, inner =
+    ICI domain); ``dcn_size``/``ici_size`` are their extents (device
+    ``(d, i)`` of the 2-D mesh holds flat block ``d * ici_size + i``,
+    the same device order as the 1-D mesh over the same device list).
+    ``hierarchical=True`` selects the reduce-inside-ICI-first /
+    cross-DCN-once wire schedule; ``False`` keeps the flat joint-axis
+    collective over ``(dcn, ici)`` — the measured baseline. Frozen and
+    hashable by construction: it is ``lru_cache`` key material in every
+    engine ``_build_*``.
+    """
+
+    dcn: str = DCN_AXIS
+    ici: str = ICI_AXIS
+    dcn_size: int = 1
+    ici_size: int = 1
+    hierarchical: bool = True
+
+    def __post_init__(self):
+        if self.dcn_size < 1 or self.ici_size < 1:
+            raise ValueError(
+                f"tier sizes must be >= 1, got "
+                f"{self.dcn_size}x{self.ici_size}"
+            )
+        if self.dcn == self.ici:
+            raise ValueError(
+                f"the two tier axes must be distinct, got {self.dcn!r} "
+                "for both"
+            )
+
+    @property
+    def size(self) -> int:
+        """Total device count P = dcn_size * ici_size."""
+        return self.dcn_size * self.ici_size
+
+    def label(self) -> str:
+        """Topology tag for engine labels: ``"2x4"`` (hierarchical) /
+        ``"2x4f"`` (flat joint-axis schedule). The two schedules MUST
+        label differently: pulse captures once per label and armor
+        keys wire demotion on it."""
+        return (f"{self.dcn_size}x{self.ici_size}"
+                + ("" if self.hierarchical else "f"))
+
+
+def parse_topo(spec: "str | None") -> "tuple[int, int] | None":
+    """Parse a ``DHQR_TOPO``-style ``"PdcnxPici"`` spec (``"2x4"``) into
+    ``(dcn_size, ici_size)``; None/empty passes through as None. A
+    malformed spec refuses loudly — a typo silently running flat would
+    invalidate every pod measurement made under it."""
+    if spec is None or not str(spec).strip():
+        return None
+    parts = str(spec).strip().lower().split("x")
+    if len(parts) != 2 or not all(p.isdigit() and int(p) >= 1
+                                  for p in parts):
+        raise ValueError(
+            f"DHQR_TOPO must look like '2x4' (DCNxICI, both >= 1), "
+            f"got {spec!r}"
+        )
+    return int(parts[0]), int(parts[1])
+
+
+def detect_topology(devices: Sequence,
+                    n_devices: "int | None" = None
+                    ) -> "tuple[int, int] | None":
+    """``(dcn_size, ici_size)`` for a device list, or None when there is
+    no two-tier structure (single slice, or nothing detectable).
+
+    Priority: the ``DHQR_TOPO`` env override (validated against the
+    device count) wins — it is the CPU simulation knob and the TPU
+    force-a-shape knob. Otherwise multi-slice TPU runtimes are detected
+    through the per-device ``slice_index`` attribute (falling back to
+    ``process_index`` grouping, the multi-host single-slice-per-host
+    shape); uniform group sizes are required — a ragged pod is not a
+    mesh and refuses loudly.
+    """
+    count = int(n_devices if n_devices is not None else len(devices))
+    spec = parse_topo(os.environ.get("DHQR_TOPO"))
+    if spec is not None:
+        dcn, ici = spec
+        if dcn * ici != count:
+            raise ValueError(
+                f"DHQR_TOPO={dcn}x{ici} does not factor the device "
+                f"count {count} (needs dcn*ici == P)"
+            )
+        return (dcn, ici) if dcn > 1 else None
+    groups: "dict[object, int]" = {}
+    for d in devices[:count]:
+        key = getattr(d, "slice_index", None)
+        if key is None:
+            key = getattr(d, "process_index", 0)
+        groups[key] = groups.get(key, 0) + 1
+    sizes = set(groups.values())
+    if len(groups) <= 1 or len(sizes) != 1:
+        return None  # one slice (flat), or ragged — no tier structure
+    return len(groups), sizes.pop()
+
+
+def resolve_axis(mesh, axis_name):
+    """The engine entry-point resolution: map the caller's ``axis_name``
+    onto what the mesh actually is.
+
+    * a :class:`TierAxes` passes through (validated against the mesh);
+    * a string naming a mesh axis passes through (the 1-D tier);
+    * a string against a 2-D ``(dcn, ici)`` mesh resolves to the
+      hierarchical :class:`TierAxes` — so ``sharded_lstsq(A, b,
+      mesh=pod_mesh())`` just works with the default ``axis_name``.
+    """
+    names = tuple(mesh.axis_names)
+    if isinstance(axis_name, TierAxes):
+        for ax in (axis_name.dcn, axis_name.ici):
+            if ax not in names:
+                raise ValueError(
+                    f"mesh axes {names} do not carry tier axis {ax!r}"
+                )
+        if (mesh.shape[axis_name.dcn] != axis_name.dcn_size
+                or mesh.shape[axis_name.ici] != axis_name.ici_size):
+            raise ValueError(
+                f"TierAxes {axis_name.label()} does not match mesh "
+                f"shape {dict(mesh.shape)}"
+            )
+        return axis_name
+    if axis_name in names:
+        return axis_name
+    if DCN_AXIS in names and ICI_AXIS in names:
+        return TierAxes(dcn_size=int(mesh.shape[DCN_AXIS]),
+                        ici_size=int(mesh.shape[ICI_AXIS]))
+    raise KeyError(
+        f"axis {axis_name!r} not in mesh axes {names} and the mesh is "
+        f"not a ({DCN_AXIS!r}, {ICI_AXIS!r}) pod mesh"
+    )
+
+
+def axis_size(mesh, axis) -> int:
+    """Total device count of ``axis`` on ``mesh`` — the product of both
+    tiers for a :class:`TierAxes`, ``mesh.shape[axis]`` for a string."""
+    if isinstance(axis, TierAxes):
+        return int(mesh.shape[axis.dcn]) * int(mesh.shape[axis.ici])
+    return int(mesh.shape[axis])
+
+
+def spec_axes(axis):
+    """What a ``PartitionSpec`` dimension entry should carry for
+    ``axis``: the ``(dcn, ici)`` tuple for a :class:`TierAxes` (sharding
+    a dim over both axes, dcn-major — block ``d * ici_size + i`` on
+    device ``(d, i)``, the 1-D device order), the string itself
+    otherwise."""
+    if isinstance(axis, TierAxes):
+        return (axis.dcn, axis.ici)
+    return axis
+
+
+def axis_index(axis):
+    """The shard body's own linear position along ``axis`` — the
+    drop-in for ``lax.axis_index`` that flattens the two tiers
+    dcn-major (matching :func:`spec_axes` block order)."""
+    from jax import lax
+
+    if isinstance(axis, TierAxes):
+        return (lax.axis_index(axis.dcn) * axis.ici_size
+                + lax.axis_index(axis.ici))
+    return lax.axis_index(axis)
+
+
+def axis_label(axis, nproc: int) -> str:
+    """The ``P=`` token of an engine label: the topology tag
+    (``"2x4"``/``"2x4f"``) for a :class:`TierAxes`, the plain device
+    count for a 1-D axis — so every single-tier label stays
+    byte-identical to previous rounds."""
+    if isinstance(axis, TierAxes):
+        return axis.label()
+    return str(int(nproc))

@@ -62,6 +62,19 @@ fault epoch via :func:`seam_token`, so schedules re-draw per
 re-trace). Everything in this module runs at trace time only — the
 disarmed runtime cost is zero and the disarmed traced programs are
 byte-identical to the pre-armor tier.
+
+Round 20 (dhqr-pod) teaches the seam that not all hops cost the same:
+on a two-tier ``(dcn, ici)`` mesh (parallel/topology.py) the
+collectives run HIERARCHICAL schedules — reduce inside the fast ICI
+domain first, cross the 10-20x-slower DCN exactly once per collective
+(in 1/ici_size-row chunks), broadcast back over ICI — so the per-
+collective cross-DCN volume shrinks ici_size-fold versus the flat ring
+(arXiv 2112.09017's decisive cost). The ``dcn:bf16``/``dcn:int8``
+rungs compose EQuARX on top: f32 inside ICI, compressed + tagged only
+at that one DCN crossing. The flat/1-D paths and every existing rung
+are untouched — the schedules share one set of leg bodies
+(:func:`_psum_leg` / :func:`_gather_leg`), so tags, fault sites, and
+quantization are written exactly once.
 """
 
 from __future__ import annotations
@@ -76,6 +89,11 @@ from jax import lax
 # disarmed cost is one module-global read per traced collective and
 # the compiled programs are byte-identical to the pre-seam tier.
 from dhqr_tpu.faults import harness as _faults
+
+# Round 20 (dhqr-pod): the two-tier topology descriptor. The seam
+# branches on it ONCE per collective — a plain string axis takes the
+# exact pre-pod code path.
+from dhqr_tpu.parallel.topology import TierAxes
 
 # The mode vocabulary lives in the jax-free precision module (shared
 # with the stdlib-only analysis tier); re-exported here so the seam is
@@ -127,7 +145,27 @@ CSNE_SWEEPS = 2
 #: 4). The row engines keep the flat in-body :data:`CSNE_SWEEPS` —
 #: their combine exchange quantizes once (no per-panel accumulation of
 #: wire error), and both rungs measured within the bar at 2.
-CSNE_MODEL_SWEEPS = {"bf16": 2, "int8": 4}
+CSNE_MODEL_SWEEPS = {"bf16": 2, "int8": 4, "dcn:bf16": 2, "dcn:int8": 2}
+
+#: The topology-tiered rungs (round 20, dhqr-pod; EQuARX-style
+#: "compress where the wire is slow"): the payload crosses the ICI legs
+#: of a hierarchical two-tier schedule in exact f32 and is compressed
+#: (+armor-tagged) ONLY at the isolated DCN crossing. On a flat
+#: schedule, a 1-D mesh, or a 1-slice topology there is no isolated DCN
+#: leg, so these rungs degrade to the exact f32 passthrough — which is
+#: why dcn:int8 needs only the bf16-level CSNE_MODEL_SWEEPS above: the
+#: payload is quantized exactly once per collective (the block-scale
+#: step ~1/254 is bf16-eps-level), never accumulated through a ring.
+_DCN_TIERED = {"dcn:bf16": "bf16", "dcn:int8": "int8"}
+
+
+def _leg_comms(comms):
+    """Per-leg wire formats ``(ici_leg, dcn_leg)`` for one collective
+    under ``comms``: the flat rungs compress both legs, the ``dcn:*``
+    rungs only the DCN crossing."""
+    if comms in _DCN_TIERED:
+        return None, _DCN_TIERED[comms]
+    return comms, comms
 
 
 def _compressible(x) -> bool:
@@ -285,6 +323,143 @@ def _int8_elems_per_scale(x) -> int:
             else int(x.size))
 
 
+def _psum_leg(x, axes, comms, onehot: bool):
+    """One traced ``psum`` over ``axes`` (a mesh axis name or a tuple of
+    them — one collective either way) at the ``comms`` wire format.
+    This is the complete pre-pod ``wire_psum`` body: tags, fault sites,
+    and the quantization rungs are written exactly once and reused by
+    both the flat and the hierarchical schedules."""
+    if comms is None or not _compressible(x):
+        if _faults.active() is not None:
+            x = _inject_collective(x)
+        return lax.psum(x, axes)
+    tagged = _tags_armed()
+    if tagged:
+        tags = _pack_tags(x)
+    if _faults.active() is not None:
+        x = _inject_collective(x)
+    if comms == "int8" and onehot:
+        q, scale = _quant_int8(x)
+        q = lax.psum(q, axes)
+        scale = lax.psum(scale, axes)
+        rx = _dequant_int8(q, scale, x.dtype)
+        if tagged:
+            tags_rx = lax.psum(tags, axes)
+            rx = _check_tag(rx, tags_rx[0],
+                            _int8_sum_bound(scale,
+                                            _int8_elems_per_scale(x)))
+        return rx
+    # bf16 — and int8's dense-reduction fallback.
+    rx = lax.psum(x.astype(jnp.bfloat16), axes).astype(x.dtype)
+    if tagged:
+        tags_rx = lax.psum(tags, axes)
+        # One-hot psums accumulate exactly (zeros); dense reductions
+        # ring-add in bf16, so the bound grows with the participating
+        # device count (the tag triple's own count lane).
+        eps = _TAG_EPS_BF16 if onehot else (
+            _TAG_EPS_BF16 + _TAG_EPS_BF16_PER_RANK * tags_rx[2])
+        rx = _check_tag(rx, tags_rx[0], eps * tags_rx[1] + 1e-30)
+    return rx
+
+
+def _gather_leg(x, axes, comms):
+    """One traced ``all_gather`` over ``axes`` at the ``comms`` wire
+    format — the complete pre-pod ``wire_all_gather`` body, reused by
+    both schedules (see :func:`_psum_leg`)."""
+    if comms is None or not _compressible(x):
+        if _faults.active() is not None:
+            x = _inject_collective(x)
+        return lax.all_gather(x, axes)
+    tagged = _tags_armed()
+    if tagged:
+        tags = _pack_tags(x)
+    if _faults.active() is not None:
+        x = _inject_collective(x)
+    if comms == "int8":
+        import jax
+
+        q, scale = _quant_int8(x)
+        qg = lax.all_gather(q, axes)
+        sg = lax.all_gather(scale, axes)
+        # qg: (P, *x.shape); sg: (P, *scale.shape) — each device's
+        # share decompresses against its own (block, column) scales.
+        rx = jax.vmap(lambda qq, ss: _dequant_int8(qq, ss, x.dtype))(
+            qg, sg)
+        if tagged:
+            tags_g = lax.all_gather(tags, axes)         # (P, 3)
+            rx = _check_tag(
+                rx, jnp.sum(tags_g[:, 0]),
+                _int8_sum_bound(sg, _int8_elems_per_scale(x)))
+        return rx
+    rx = lax.all_gather(x.astype(jnp.bfloat16), axes).astype(x.dtype)
+    if tagged:
+        # A gather concatenates — no accumulation — so the bound is
+        # the payload-rounding term alone, anchored on the gathered
+        # abs lanes.
+        tags_g = lax.all_gather(tags, axes)             # (P, 3)
+        rx = _check_tag(rx, jnp.sum(tags_g[:, 0]),
+                        _TAG_EPS_BF16 * jnp.sum(tags_g[:, 1]) + 1e-30)
+    return rx
+
+
+def _tier_psum(x, t: TierAxes, comms, onehot: bool):
+    """The hierarchical two-tier reduction (dhqr-pod, round 20):
+    reduce inside the ICI domain first, exchange across DCN exactly
+    ONCE per collective (each ICI member carries a 1/ici_size row chunk
+    of the partial, so the cross-DCN payload shrinks ici_size-fold vs
+    the flat schedule), then broadcast the chunks back over ICI in f32.
+
+    The DCN leg stays one-hot whenever the full-mesh collective was:
+    the ICI reduction collapses the owner's domain to one non-zero
+    contributor per DCN group, so int8's exactness argument survives
+    tier by tier. Dense reductions (``onehot=False``) ring-add across
+    ``dcn_size`` participants on the DCN leg — int8 is refused there by
+    :func:`_psum_leg` exactly as on the flat wire.
+    """
+    ici_comms, dcn_comms = _leg_comms(comms)
+    if not t.hierarchical:
+        # Flat baseline on the 2-D mesh: ONE joint-axis collective —
+        # the same schedule a 1-D mesh runs, spelled over both tiers.
+        # The dcn:* rungs have no isolated DCN leg here: exact f32.
+        return _psum_leg(x, (t.dcn, t.ici), ici_comms, onehot)
+    r = _psum_leg(x, t.ici, ici_comms, onehot)
+    if t.dcn_size == 1:
+        return r
+    if r.ndim == 0:
+        return _psum_leg(r, t.dcn, dcn_comms, onehot)
+    rows = r.shape[0]
+    rp = -(-rows // t.ici_size) * t.ici_size
+    if rp != rows:
+        r = jnp.pad(r, [(0, rp - rows)] + [(0, 0)] * (r.ndim - 1))
+    crows = rp // t.ici_size
+    idx = lax.axis_index(t.ici)
+    chunk = lax.dynamic_slice_in_dim(r, idx * crows, crows, axis=0)
+    chunk = _psum_leg(chunk, t.dcn, dcn_comms, onehot)
+    # Broadcast-back: tiled ICI gather reassembles the row chunks in
+    # ici-index order — the original row order — on the fast tier, in
+    # f32 (the DCN check/decompression already ran on the chunk).
+    out = lax.all_gather(chunk, t.ici, axis=0, tiled=True)
+    return out[:rows] if rp != rows else out
+
+
+def _tier_all_gather(x, t: TierAxes, comms):
+    """The hierarchical two-tier gather: exchange each device's local
+    share across DCN first (the ONLY compressed/slow leg — dcn_size
+    shares instead of the flat schedule's full P), then gather the
+    stacks over ICI in f32 and restore the flat dcn-major device order
+    (block ``d * ici_size + i`` — matching ``topology.spec_axes``)."""
+    ici_comms, dcn_comms = _leg_comms(comms)
+    if not t.hierarchical:
+        return _gather_leg(x, (t.dcn, t.ici), ici_comms)
+    if t.dcn_size == 1:
+        return _gather_leg(x, t.ici, ici_comms)
+    g = _gather_leg(x, t.dcn, dcn_comms)                # (dcn, *x)
+    if t.ici_size == 1:
+        return g
+    gg = _gather_leg(g, t.ici, None)                    # (ici, dcn, *x)
+    return jnp.moveaxis(gg, 0, 1).reshape((t.size,) + x.shape)
+
+
 def wire_psum(x, axis_name, comms=None, *, onehot: bool = True):
     """``lax.psum`` with the payload compressed to the ``comms`` wire
     format (decompressed to ``x.dtype`` on return).
@@ -304,38 +479,20 @@ def wire_psum(x, axis_name, comms=None, *, onehot: bool = True):
     poisons the payload NaN-loud. The ``parallel.collective.*`` fault
     sites mutate the payload between tag and transmit, on every rung
     including the f32 passthrough.
+
+    Round 20 (dhqr-pod): ``axis_name`` may be a
+    :class:`~dhqr_tpu.parallel.topology.TierAxes` — the collective then
+    runs the hierarchical two-tier schedule (:func:`_tier_psum`;
+    ``hierarchical=False`` spells the flat joint-axis baseline). The
+    ``dcn:*`` rungs compress ONLY the isolated DCN crossing of that
+    schedule; on a plain 1-D axis they degrade to the exact f32
+    passthrough (there is no DCN leg to compress).
     """
-    if comms is None or not _compressible(x):
-        if _faults.active() is not None:
-            x = _inject_collective(x)
-        return lax.psum(x, axis_name)
-    tagged = _tags_armed()
-    if tagged:
-        tags = _pack_tags(x)
-    if _faults.active() is not None:
-        x = _inject_collective(x)
-    if comms == "int8" and onehot:
-        q, scale = _quant_int8(x)
-        q = lax.psum(q, axis_name)
-        scale = lax.psum(scale, axis_name)
-        rx = _dequant_int8(q, scale, x.dtype)
-        if tagged:
-            tags_rx = lax.psum(tags, axis_name)
-            rx = _check_tag(rx, tags_rx[0],
-                            _int8_sum_bound(scale,
-                                            _int8_elems_per_scale(x)))
-        return rx
-    # bf16 — and int8's dense-reduction fallback.
-    rx = lax.psum(x.astype(jnp.bfloat16), axis_name).astype(x.dtype)
-    if tagged:
-        tags_rx = lax.psum(tags, axis_name)
-        # One-hot psums accumulate exactly (zeros); dense reductions
-        # ring-add in bf16, so the bound grows with the participating
-        # device count (the tag triple's own count lane).
-        eps = _TAG_EPS_BF16 if onehot else (
-            _TAG_EPS_BF16 + _TAG_EPS_BF16_PER_RANK * tags_rx[2])
-        rx = _check_tag(rx, tags_rx[0], eps * tags_rx[1] + 1e-30)
-    return rx
+    if isinstance(axis_name, TierAxes):
+        return _tier_psum(x, axis_name, comms, onehot)
+    if comms in _DCN_TIERED:
+        comms = None  # no isolated DCN crossing on a 1-D axis
+    return _psum_leg(x, axis_name, comms, onehot)
 
 
 def wire_all_gather(x, axis_name, comms=None):
@@ -345,38 +502,13 @@ def wire_all_gather(x, axis_name, comms=None):
     quantizes its own share, the (tiny) scales gather alongside, and
     decompression is local. Armor wire tags and the collective fault
     sites apply exactly as on :func:`wire_psum` (the tag compares the
-    gathered whole against the gathered per-device truths)."""
-    if comms is None or not _compressible(x):
-        if _faults.active() is not None:
-            x = _inject_collective(x)
-        return lax.all_gather(x, axis_name)
-    tagged = _tags_armed()
-    if tagged:
-        tags = _pack_tags(x)
-    if _faults.active() is not None:
-        x = _inject_collective(x)
-    if comms == "int8":
-        import jax
-
-        q, scale = _quant_int8(x)
-        qg = lax.all_gather(q, axis_name)
-        sg = lax.all_gather(scale, axis_name)
-        # qg: (P, *x.shape); sg: (P, *scale.shape) — each device's
-        # share decompresses against its own (block, column) scales.
-        rx = jax.vmap(lambda qq, ss: _dequant_int8(qq, ss, x.dtype))(
-            qg, sg)
-        if tagged:
-            tags_g = lax.all_gather(tags, axis_name)    # (P, 3)
-            rx = _check_tag(
-                rx, jnp.sum(tags_g[:, 0]),
-                _int8_sum_bound(sg, _int8_elems_per_scale(x)))
-        return rx
-    rx = lax.all_gather(x.astype(jnp.bfloat16), axis_name).astype(x.dtype)
-    if tagged:
-        # A gather concatenates — no accumulation — so the bound is
-        # the payload-rounding term alone, anchored on the gathered
-        # abs lanes.
-        tags_g = lax.all_gather(tags, axis_name)        # (P, 3)
-        rx = _check_tag(rx, jnp.sum(tags_g[:, 0]),
-                        _TAG_EPS_BF16 * jnp.sum(tags_g[:, 1]) + 1e-30)
-    return rx
+    gathered whole against the gathered per-device truths). A
+    :class:`~dhqr_tpu.parallel.topology.TierAxes` axis runs the
+    hierarchical DCN-first schedule (:func:`_tier_all_gather`); the
+    ``dcn:*`` rungs compress only that DCN leg and degrade to the f32
+    passthrough on a plain 1-D axis."""
+    if isinstance(axis_name, TierAxes):
+        return _tier_all_gather(x, axis_name, comms)
+    if comms in _DCN_TIERED:
+        comms = None  # no isolated DCN crossing on a 1-D axis
+    return _gather_leg(x, axis_name, comms)

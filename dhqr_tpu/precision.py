@@ -56,8 +56,19 @@ MXU_PASSES = {"highest": 6, "high": 3, "default": 1, "float32": 6}
 # engines. WIRE_ITEMSIZE is the bytes-per-f32-word factor the
 # compressed DHQR302 budgets are priced with (int8's per-block scale
 # sidecars are absorbed by the contract slack).
-COMMS_MODES = ("bf16", "int8")
-WIRE_ITEMSIZE = {None: None, "bf16": 2, "int8": 1}
+#
+# Round 20 (dhqr-pod) adds the topology-tiered rungs "dcn:bf16" /
+# "dcn:int8" (EQuARX, arXiv 2506.17615: compress only where the wire is
+# slow): on a two-tier hierarchical schedule the ICI legs stay f32 and
+# ONLY the isolated DCN crossing is compressed (+armor-tagged); on a
+# flat schedule / 1-D mesh / 1-slice topology there is no isolated DCN
+# leg, so the dcn:* rungs degrade to the exact f32 passthrough by
+# construction. Their WIRE_ITEMSIZE prices the DCN leg (the tier the
+# tiered DHQR302 budgets compress); the f32 ICI legs are priced at 4
+# bytes by the tiered cost model, not by this factor.
+COMMS_MODES = ("bf16", "int8", "dcn:bf16", "dcn:int8")
+WIRE_ITEMSIZE = {None: None, "bf16": 2, "int8": 1,
+                 "dcn:bf16": 2, "dcn:int8": 1}
 
 
 def resolve_comms(comms) -> "str | None":
@@ -98,11 +109,16 @@ class PrecisionPolicy:
         halves the traced collective volume with f32 accumulation
         everywhere outside the wire, ``"int8"`` quarters it with
         per-(32-row-block, column) scales on the one-hot
-        broadcast/gather paths (see ``dhqr_tpu.parallel.wire``). Programs with no collectives
-        (single-device engines, the batched serving dispatch) are
-        unaffected by contract. The presets all keep ``comms=None``;
-        compressed comms is selected explicitly, or per-platform by a
-        tuned :class:`dhqr_tpu.tune.Plan` under the 8x-LAPACK gate.
+        broadcast/gather paths (see ``dhqr_tpu.parallel.wire``). Round
+        20 (dhqr-pod) adds the topology-tiered rungs ``"dcn:bf16"`` /
+        ``"dcn:int8"``: f32 inside the ICI domain, compressed only at
+        the isolated DCN crossing of a two-tier hierarchical schedule
+        (exact f32 everywhere on flat/1-tier topologies). Programs with
+        no collectives (single-device engines, the batched serving
+        dispatch) are unaffected by contract. The presets all keep
+        ``comms=None``; compressed comms is selected explicitly, or
+        per-platform by a tuned :class:`dhqr_tpu.tune.Plan` under the
+        8x-LAPACK gate.
     """
 
     panel: str = "highest"
@@ -167,8 +183,10 @@ def resolve_policy(policy) -> PrecisionPolicy:
     round 18 — a fourth comms-wire segment ``"panel/trailing/rN/bf16"``
     (a :data:`COMMS_MODES` member; e.g. ``"highest/default/r1/bf16"``
     is the bf16-trailing + one-refine + bf16-wire point, and
-    ``"highest/bf16"`` compresses the wire only). This is the
-    ``DHQR_POLICY`` environment spelling (utils/config.py).
+    ``"highest/bf16"`` compresses the wire only; the round-20 tiered
+    rungs spell the same way — ``"highest/dcn:bf16"`` — the ``:`` is
+    not a separator). This is the ``DHQR_POLICY`` environment spelling
+    (utils/config.py).
     """
     if isinstance(policy, PrecisionPolicy):
         return policy

@@ -189,13 +189,31 @@ def _is_real(dtype) -> bool:
     return not np.issubdtype(np.dtype(dtype), np.complexfloating)
 
 
+def _mesh_topology(mesh) -> "tuple[int, int] | None":
+    """``(dcn_size, ici_size)`` when ``mesh`` is a two-tier pod mesh
+    (axis names exactly ``("dcn", "ici")`` — the only spelling
+    ``parallel/mesh.pod_mesh`` constructs), else None. Pure attribute
+    reads — no device access, so :func:`candidate_plans` stays pure."""
+    names = tuple(getattr(mesh, "axis_names", ()) or ())
+    if names == ("dcn", "ici"):
+        return (int(mesh.shape["dcn"]), int(mesh.shape["ici"]))
+    return None
+
+
 def candidate_plans(kind: str, m: int, n: int, dtype="float32",
                     nproc: int = 1, policy=None,
                     platform: "str | None" = None,
-                    budget: "int | None" = None) -> List[Plan]:
+                    budget: "int | None" = None,
+                    topology: "tuple[int, int] | None" = None) -> List[Plan]:
     """The pruned, deterministically-ordered candidate grid (module
     docstring rules 1-7). Pure — no timing, no device access (pass
-    ``platform`` explicitly to keep it that way; None asks jax)."""
+    ``platform`` explicitly to keep it that way; None asks jax).
+    ``topology`` (round 20, dhqr-pod) is the mesh's ``(dcn_size,
+    ici_size)`` factorization when it is a two-tier pod mesh — it arms
+    the rule-6b ``dcn:*`` tiered-compression rungs, which are pointless
+    on a 1-D mesh (the seam degrades them to the exact f32
+    passthrough there, so a candidate would time a duplicate of the
+    uncompressed plan)."""
     if kind not in TUNE_KINDS:
         raise ValueError(f"kind must be one of {TUNE_KINDS}, got {kind!r}")
     if n < 1 or m < n:
@@ -282,6 +300,21 @@ def candidate_plans(kind: str, m: int, n: int, dtype="float32",
                 out.append(Plan(engine="cholqr2", comms="bf16"))
             if aspect >= TSQR_MIN_ASPECT:
                 out.append(Plan(engine="tsqr", comms="bf16"))
+            # Rule 6c (round 20, dhqr-pod) — topology-tiered rungs,
+            # offered only on a genuinely two-tier mesh (dcn_size > 1):
+            # f32 inside the ICI domain, compressed + armor-tagged only
+            # at the one DCN crossing of the hierarchical schedule. The
+            # same 8x-LAPACK accuracy gate decides admissibility; the
+            # dcn:int8 rung is viable where flat int8 is not because
+            # the payload quantizes exactly once (no per-panel ring
+            # accumulation — parallel/wire.CSNE_MODEL_SWEEPS note).
+            if topology is not None and topology[0] > 1:
+                out.extend([
+                    Plan(block_size=base_nb, comms="dcn:bf16"),
+                    Plan(block_size=base_nb, comms="dcn:int8"),
+                ])
+                if aspect >= TSQR_MIN_ASPECT:
+                    out.append(Plan(engine="tsqr", comms="dcn:bf16"))
     # Dedupe preserving order (Plan() and the ladder can collide at tiny
     # n), then rule 7 — budget truncation from the end.
     seen = set()
@@ -554,11 +587,13 @@ def tune(kind: str, m: int, n: int, dtype="float32", *,
     repeats = tcfg.repeats if repeats is None else repeats
     pol = resolve_policy(policy) if policy is not None else None
     nproc = 1
+    topology = None
     if mesh is not None:
         nproc = int(np.prod(list(mesh.shape.values())))
+        topology = _mesh_topology(mesh)
     key = plan_key(kind, m, n, dtype, nproc=nproc, policy_tag=policy_tag(pol))
     candidates = candidate_plans(kind, m, n, dtype, nproc=nproc, policy=pol,
-                                 budget=budget)
+                                 budget=budget, topology=topology)
     stubbed = measure is not None
     timer = measure or _measure_wall
     args = None if stubbed else _problem(kind, m, n, dtype, seed)

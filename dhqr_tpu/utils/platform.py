@@ -78,9 +78,10 @@ def enable_compile_cache(cache_dir: str | None = None,
 #   regression (obs/netmodel.py carries the per-family algorithm
 #   factors).
 # * ``dcn_gbps`` is the per-host data-center-network bandwidth the
-#   multi-slice tier would cross (v4/v5e/v5p hosts ship 200 Gbit/s
-#   NICs; v6e 400 Gbit/s) — unused until a DCN mesh exists, recorded
-#   now so the comms roofline has both denominators in ONE table.
+#   multi-slice tier crosses (v4/v5e/v5p hosts ship 200 Gbit/s NICs;
+#   v6e 400 Gbit/s) — since round 20 (dhqr-pod) the slow denominator
+#   of the two-tier DHQR306 bound, kept beside ICI so the comms
+#   roofline has both denominators in ONE table.
 _DEVICE_PEAKS = {
     "TPU v4": {"peak_tflops": 275.0, "hbm_gbps": 1228.0,
                "ici_gbps": 300.0, "dcn_gbps": 25.0},
@@ -129,9 +130,21 @@ def device_ici_gbps(device_kind: str):
 
 
 def device_dcn_gbps(device_kind: str):
-    """Per-host DCN bandwidth in GB/s, or None when unknown — recorded
-    alongside ICI so the comms roofline's two denominators live in one
-    table (unused until a multi-slice mesh exists)."""
+    """Per-host DCN bandwidth in GB/s, or None when unknown — the slow
+    denominator of the round-20 two-tier DHQR306 bound
+    (obs/netmodel.explain_measured): collectives whose axes cross the
+    ``dcn`` tier of a pod mesh (parallel/topology.py) are bounded
+    against THIS number, everything else against
+    :func:`device_ici_gbps`.
+
+    Degradation contract (pinned by tests/test_topology.py): an
+    unknown ``device_kind`` — and every CPU host, DELIBERATELY — maps
+    to None, which the pulse/netmodel tier turns into a DHQR306
+    ``skip`` carrying the reason, never a crash and never a silently
+    single-tier bound. CPU is absent by design: a simulated
+    ``DHQR_TOPO`` factorization on host devices moves its "DCN" words
+    through memcpy, and a made-up wire number would manufacture a fake
+    bandwidth percentage exactly as for ICI above."""
     entry = _DEVICE_PEAKS.get(str(device_kind))
     return entry.get("dcn_gbps") if entry else None
 

@@ -355,12 +355,22 @@ def test_lstsq_trailing_precision_surface(mesh):
         _qr(Aj, blocked=False, trailing_precision="high")
 
 
+# The P=8 copies of the lookahead/agg parity sweeps are the module's
+# wall-clock tail (~20 s each against the tier-1 cap); the property is
+# P-independent, so tier-1 keeps the P=2 twins and the P=8 copies ride
+# -m slow — the same split test_wire/test_armor use for their big-P
+# matrices.
+_PARITY_NPROC = [2, pytest.param(8, marks=pytest.mark.slow)]
+
+
+@pytest.mark.parametrize("nproc", _PARITY_NPROC)
 @pytest.mark.parametrize("layout", ["block", "cyclic"])
-def test_sharded_lookahead_matches_default(mesh, layout):
+def test_sharded_lookahead_matches_default(nproc, layout):
     """The lookahead schedule issues each panel's psum before the previous
     panel's wide trailing GEMM — per-column arithmetic is unchanged, so
     the sharded result must match the default schedule to roundoff on
     both program paths (unrolled and super-block scan)."""
+    mesh = column_mesh(nproc)
     for (m, n, nb) in [(96, 64, 8),    # 8 panels: unrolled
                        (160, 96, 4)]:  # 24 panels: scan path
         A, _ = random_problem(m, n, np.float64, seed=54)
@@ -459,14 +469,16 @@ def test_lookahead_trailing_gemm_independent_of_panel_psum():
                 "iteration's psum — lookahead overlap broken")
 
 
+@pytest.mark.parametrize("nproc", _PARITY_NPROC)
 @pytest.mark.parametrize("layout", ["block", "cyclic"])
 @pytest.mark.parametrize("k", [2, 3])
-def test_sharded_agg_matches_default(mesh, layout, k):
+def test_sharded_agg_matches_default(nproc, layout, k):
     """Aggregated groups apply the same product of panel transforms as the
     per-panel schedule (one gathered psum + one aggregated wide GEMM per
     group instead of k of each), so the sharded result must match the
     default schedule to roundoff on both program paths — including ragged
     final groups (k=3 never divides the panel counts below)."""
+    mesh = column_mesh(nproc)
     for (m, n, nb) in [(96, 64, 8),    # 8 panels: unrolled
                        (160, 96, 4)]:  # 24 panels: scan path
         A, _ = random_problem(m, n, np.float64, seed=57)
@@ -548,6 +560,7 @@ def test_sharded_agg_one_psum_per_group():
     assert count_psums(agg_panels=4) == 2   # 2 groups x 1 gather
 
 
+@pytest.mark.slow
 def test_sharded_agg_scan_remainder_branch():
     """The scan path's sub-k remainder branch (code-review r5: it shipped
     unexercised — 24 panels divide evenly for both k in the parity sweep
@@ -555,7 +568,9 @@ def test_sharded_agg_scan_remainder_branch():
     ppo=6, so the last super-block holds pcount=4 panels = one full
     group + ONE remainder panel, which runs as a ragged single-panel
     aggregated group (one gather psum) and must still match the default
-    schedule end to end."""
+    schedule end to end. (-m slow: ~15 s of P=8 compile at the largest
+    shape in the module — the branch is P-independent but only engages
+    past 24 panels, so there is no cheap tier-1 twin.)"""
     mesh8 = column_mesh(8)
     A, _ = random_problem(192, 160, np.float64, seed=60)
     H0, a0 = sharded_blocked_qr(jnp.asarray(A), mesh8, block_size=4,
@@ -596,8 +611,9 @@ def test_sharded_agg_composes_with_panel_engines():
                                atol=5e-5)
 
 
+@pytest.mark.parametrize("nproc", _PARITY_NPROC)
 @pytest.mark.parametrize("layout", ["block", "cyclic"])
-def test_sharded_agg_lookahead_matches_default(mesh, layout):
+def test_sharded_agg_lookahead_matches_default(nproc, layout):
     """Grouped lookahead (agg_panels + lookahead, mesh-only): each group's
     single gather psum is issued and its replicated factorization done
     BEFORE the previous group's wide trailing GEMM — per-column
@@ -606,6 +622,7 @@ def test_sharded_agg_lookahead_matches_default(mesh, layout):
     with k=2 puts >= 2 groups in each super-block, so the pending-group
     scan genuinely engages; (96, 64, 8) exercises the ppo bump that
     gives small matrices a 2-group super-block."""
+    mesh = column_mesh(nproc)
     for (m, n, nb) in [(96, 64, 8), (160, 96, 4)]:
         A, _ = random_problem(m, n, np.float64, seed=63)
         H0, a0 = sharded_blocked_qr(jnp.asarray(A), mesh, block_size=nb,
