@@ -163,25 +163,36 @@ def main(argv=None) -> None:
         print(json.dumps(rec))
 
     chosen = {int(tok) for tok in args.configs.split(",")}
+    # The stage catalogue — config numbers, metric stems, nominal
+    # (pod-scale) shapes, default engines/layouts — is the route
+    # registry's (tune/registry.BENCH_STAGES, round 21): each stage
+    # names the registered route it exercises and the atlas pass
+    # (DHQR505) fails lint if a stage drifts off the registry. The
+    # imperative bodies below stay here — they ARE the benchmark.
+    from dhqr_tpu.tune.registry import bench_stages
+
+    stages = {s.config: s for s in bench_stages()}
 
     if 1 in chosen:
+        s = stages[1]
         # f64 runs where f64 is native; on TPU it is emulated, so report f32
         dt = jnp.float64 if platform == "cpu" else jnp.float32
-        m = n = 1024 // (scale if platform == "cpu" else 1)
+        m = n = s.m // (scale if platform == "cpu" else 1)
         A = jnp.asarray(rng.random((m, n)), dtype=dt)
         t, (H, alpha) = _bench(
             lambda: dhqr_tpu.blocked_householder_qr(A, nb), sync, args.repeats
         )
-        report(1, f"dense_qr_{jnp.dtype(dt).name}", m, n, t, _flops_qr(m, n),
-               qr_accuracy(A, H, alpha))
+        report(s.config, f"{s.metric}_{jnp.dtype(dt).name}", m, n, t,
+               _flops_qr(m, n), qr_accuracy(A, H, alpha))
 
     if 2 in chosen:
+        s = stages[2]
         # tall-skinny: TSQR (row-parallel, one all-gather) — the regime where
         # the column layout cannot scale (see dhqr_tpu/parallel/sharded_tsqr.py)
-        m, n = 65536 // scale, 256 // scale
+        m, n = s.m // scale, s.n // scale
         A = jnp.asarray(rng.random((m, n)), dtype=jnp.float32)
         b = jnp.asarray(rng.random(m), dtype=jnp.float32)
-        eng2 = args.engine or "tsqr"
+        eng2 = args.engine or s.engine
         if ndev > 1 and m % ndev == 0 and (eng2 != "tsqr" or m // ndev >= n):
             from dhqr_tpu.parallel.sharded_tsqr import row_mesh
             rmesh = row_mesh(ndev)
@@ -192,11 +203,14 @@ def main(argv=None) -> None:
             fn = lambda: dhqr_tpu.lstsq(A, b, engine=eng2, block_size=nb)
             meshsz = 1
         t, x2 = _bench(fn, sync, args.repeats)
-        report(2, f"tall_skinny_{eng2}_lstsq_f32", m, n, t, _flops_lstsq(m, n),
+        report(s.config,
+               s.metric.replace("_lstsq", f"_{eng2}_lstsq") + "_f32",
+               m, n, t, _flops_lstsq(m, n),
                {"mesh": meshsz, **lstsq_accuracy(A, b, x2)})
 
     if 3 in chosen:
-        m = n = 16384 // scale
+        s = stages[3]
+        m = n = s.m // scale
         mesh = mesh_or_none()
         # the cyclic layout needs n % (nb * P) == 0; fall back to a single
         # device rather than dying on an awkward device count (ADVICE r1)
@@ -213,23 +227,26 @@ def main(argv=None) -> None:
         else:
             from dhqr_tpu.parallel.sharded_qr import sharded_blocked_qr
             # pass the clamped width so the guard above and the engine agree
-            fn = lambda: sharded_blocked_qr(A, mesh, block_size=nb3, layout="cyclic")
-            layout = "cyclic"
+            fn = lambda: sharded_blocked_qr(A, mesh, block_size=nb3,
+                                            layout=s.layout)
+            layout = s.layout
         t, (H3, a3) = _bench(fn, sync, args.repeats)
-        report(3, "square_qr_f32", m, n, t, _flops_qr(m, n),
+        report(s.config, s.metric, m, n, t, _flops_qr(m, n),
                {"layout": layout, **qr_accuracy(A, H3, a3)})
 
     if 4 in chosen:
-        m, n = 32768 // scale, 4096 // scale
+        s = stages[4]
+        m, n = s.m // scale, s.n // scale
         A = jnp.asarray(rng.random((m, n)), dtype=jnp.float32)
         t, (H4, a4) = _bench(
             lambda: dhqr_tpu.blocked_householder_qr(A, nb), sync, args.repeats
         )
-        report(4, "blocked_wy_qr_f32", m, n, t, _flops_qr(m, n),
+        report(s.config, s.metric, m, n, t, _flops_qr(m, n),
                {"block_size": nb, **qr_accuracy(A, H4, a4)})
 
     if 5 in chosen:
-        m, n = 131072 // scale, 512 // scale
+        s = stages[5]
+        m, n = s.m // scale, s.n // scale
         mesh = mesh_or_none()
         if mesh is not None and n % mesh.shape["cols"]:
             n += mesh.shape["cols"] - n % mesh.shape["cols"]
@@ -245,8 +262,8 @@ def main(argv=None) -> None:
             fn = lambda: dhqr_tpu.lstsq(A, b, mesh=mesh, block_size=nb)
         t, x = _bench(fn, sync, args.repeats)
         eff_mesh = rmesh5 if args.engine else mesh
-        report(5, "overdetermined_lstsq_f32", m, n, t, _flops_lstsq(m, n),
-               {"engine": args.engine or "householder",
+        report(s.config, s.metric, m, n, t, _flops_lstsq(m, n),
+               {"engine": args.engine or s.engine,
                 "mesh": 1 if eff_mesh is None else eff_mesh.shape["cols"],
                 **lstsq_accuracy(A, b, x)})
 

@@ -835,6 +835,35 @@ def run(n_devices: int) -> None:
         print("dryrun: comms audit SKIPPED (needs >= 2 devices; "
               "run tools/lint.sh for the audited gate)", flush=True)
 
+    # Route-registry atlas (dhqr-atlas, round 21): the registry's own
+    # structural self-check runs unconditionally (it is jax-free), and
+    # with >= 2 devices the full DHQR5xx drift audit runs — route
+    # coverage, contract bijection, cache-key collision tracing, grid
+    # drift — so a consumer that drifted off the registry fails the dry
+    # run before lint ever sees it.
+    from dhqr_tpu.tune.registry import self_check
+
+    problems = self_check()
+    assert not problems, "route registry self-check:\n" + "\n".join(
+        problems)
+    if n_devices >= 2:
+        from dhqr_tpu.analysis.atlas import run_atlas_pass
+
+        atlas_findings = run_atlas_pass()
+        assert not atlas_findings, "atlas findings:\n" + "\n".join(
+            f.render() for f in atlas_findings)
+        print("dryrun: atlas ok (route registry structurally sound, "
+              "DHQR501-505 green: contracts bijective, serve keys "
+              "collision-free, grid inside the registry)", flush=True)
+    else:
+        # The audit can technically run at P=1 (its meshes are lazy),
+        # but a 1-device dryrun is a degraded environment the other
+        # sharded stages already skipped in — be loud, not silently
+        # green, and point at the gate that really decides.
+        print("dryrun: atlas DHQR5xx audit SKIPPED (needs >= 2 devices "
+              "like the sharded stages; registry self-check ran — run "
+              "tools/lint.sh for the full audited gate)", flush=True)
+
     # TSQR wants a genuinely tall problem: local row blocks must stay tall
     nt = 8
     mt = 2 * nt * n_devices

@@ -17,6 +17,13 @@ from dhqr_tpu.analysis.findings import Finding
 
 _INIT_PATH = "dhqr_tpu/__init__.py"
 
+#: This pass's rule-catalogue rows (assembled by analysis/cli.py —
+#: round 21 retired the CLI's hand-kept copy).
+RULES = (
+    ("DHQR201", "__all__ export does not import cleanly", "api"),
+    ("DHQR202", "public name undocumented in docs/DESIGN.md", "api"),
+)
+
 
 def check_api(design_md: "str | None" = None) -> "list[Finding]":
     """Validate ``dhqr_tpu.__all__`` against the import surface and the

@@ -20,6 +20,7 @@ import os
 from dhqr_tpu.analysis.findings import (
     Finding,
     apply_suppressions,
+    missing_reason_findings,
     parse_suppressions,
 )
 
@@ -851,7 +852,12 @@ def scan_source(text: str, path: str, rules=AST_RULES) -> "list[Finding]":
         if rule.applies(path):
             findings.extend(rule.check(ctx))
     findings.sort(key=lambda f: (f.line, f.rule))
-    return apply_suppressions(findings, parse_suppressions(lines))
+    out = apply_suppressions(findings, parse_suppressions(lines))
+    # After apply_suppressions, and never routed through it: a
+    # reason-less `# dhqr: ignore[DHQR000]` must not suppress its own
+    # missing-reason report (round 21 — warn-only, severity="warning").
+    out.extend(missing_reason_findings(lines, path))
+    return out
 
 
 def iter_python_files(paths):

@@ -1,0 +1,468 @@
+"""Pass 6 (dhqr-atlas, round 21) — DHQR5xx cross-subsystem drift audit.
+
+The route registry (``tune/registry.py``) is the ONE enumeration of the
+execution-route space; the jaxpr pass, the comms audit, the tune grid,
+the serve cache keys and the bench stages all iterate it. This pass
+proves the consumers have not drifted from the registry — the failure
+class PRs 12-16 kept re-opening by hand-widening four subsystems per
+route (the unaudited-route / unpriced-collective hazard of
+arXiv 2112.09017's per-route cost accounting, and the silent-recompile
+hazard XLA serving tiers pay per under-keyed cache entry):
+
+* DHQR501 — route coverage: every registered route reachable by the
+  audit ladder, every trace spec resolvable against a pass's builder
+  map, every traced label registered (two-way, via ``traced_labels``).
+* DHQR502 — contract pricing: registry ``contract`` fields and
+  ``comms_contracts.json`` rows are bijective; every row names a known
+  cost model, known collectives, and a wire rung some claiming route
+  actually runs. A dead row is a finding, not tidiness.
+* DHQR503 — under-keyed caches: mint the serve CacheKey for every
+  registered probe cell; any two cells colliding on one key must trace
+  to the IDENTICAL program (a collision with distinct jaxprs is a
+  recompile per dispatch in steady-state serving). The tune-side twin:
+  distinct grid candidates must not share a ``describe()`` tag (the
+  plan-DB key).
+* DHQR504 — donation audit: ``donated`` routes and the DHQR304
+  AOT-aliasing probes (``comms_pass._donation_entries``) are bijective.
+* DHQR505 — grid drift: every ``candidate_plans`` emission at a probe
+  grid maps onto a registered route (``registry.grid_route_for``), and
+  every bench stage names a registered route of the right kind.
+
+Every check takes its enumerations as injectable arguments (tests seed
+drifts without touching the committed registry) and returns plain
+:class:`Finding` records, so the baseline/suppression machinery and the
+CLI gate treat atlas findings exactly like AST ones. The committed tree
+holds ZERO findings — the gate ships with an empty baseline by policy.
+"""
+
+from __future__ import annotations
+
+from dhqr_tpu.analysis.findings import Finding
+from dhqr_tpu.tune import registry
+
+RULES = (
+    ("DHQR501",
+     "registered route invisible to an analysis pass, or a traced "
+     "label with no registered route", "atlas"),
+    ("DHQR502",
+     "comms contract row and registry route sets are not bijective, "
+     "or a contract row is unpriceable", "atlas"),
+    ("DHQR503",
+     "under-keyed cache: distinct route cells collide on one cache "
+     "key with different traced programs", "atlas"),
+    ("DHQR504",
+     "donated routes and DHQR304 donation probes have drifted apart",
+     "atlas"),
+    ("DHQR505",
+     "tune-grid candidate or bench stage escapes the route registry",
+     "atlas"),
+)
+
+#: (kind, m, n, nproc, topology, platform) probe grids DHQR503/505 run
+#: ``candidate_plans`` over — chosen to arm every emission rule: the nb
+#: ladder + panel variants (tall n>=64 single-host), the mesh levers +
+#: flat wire rungs (nproc=4), the dcn rungs (two-tier topology), the
+#: alt engines (aspect >= TSQR_MIN_ASPECT), and all three serve kinds.
+GRID_PROBES = (
+    ("lstsq", 4096, 64, 1, None, "tpu"),
+    ("lstsq", 2048, 64, 4, None, "cpu"),
+    ("lstsq", 8192, 64, 4, (2, 2), "tpu"),
+    ("qr", 256, 128, 1, None, "tpu"),
+    ("qr", 512, 128, 4, None, "cpu"),
+    ("serve_lstsq", 64, 16, 1, None, "cpu"),
+    ("serve_qr", 64, 16, 1, None, "cpu"),
+    ("serve_sketch", 512, 8, 1, None, "cpu"),
+)
+
+#: Request shapes DHQR503 mints serve keys at, per program kind. The
+#: lstsq/qr probe must be large enough that the loop and recursive
+#: panel interiors trace DIFFERENT programs at the bucketed shape (they
+#: are identical below nb=64 buckets — verified empirically), so a
+#: dropped ``panel_impl`` key field produces a collision this pass can
+#: actually convict.
+SERVE_PROBE_SHAPES = {"lstsq": (256, 128), "qr": (256, 128),
+                      "sketch": (512, 8)}
+
+
+def _f(rule, path, message, snippet):
+    return Finding(rule, path, 0, message, snippet=snippet)
+
+
+# ---------------------------------------------------------------------------
+# DHQR501 — registry structure + route coverage
+
+
+def registry_findings() -> "list[Finding]":
+    """The registry's own structural invariants, as gate findings."""
+    return [_f("DHQR501", "atlas::registry", problem, snippet=problem)
+            for problem in registry.self_check()]
+
+
+def expected_jaxpr_labels(routes=None,
+                          devices: int = 8) -> "set[str]":
+    """Every trace label the jaxpr pass owes the registry across the
+    full preset sweep, at the audit ladder's widest mesh."""
+    from dhqr_tpu.precision import PRECISION_POLICIES
+
+    out = set()
+    for r in (registry.routes() if routes is None else routes):
+        for spec in r.jaxpr:
+            for preset in PRECISION_POLICIES:
+                if r.presets == "accurate" and preset != "accurate":
+                    continue
+                if r.schedule == "pod" and devices < r.min_devices:
+                    continue
+                out.add(spec["label"].format(preset=preset))
+    return out
+
+
+def check_route_coverage(routes=None, jaxpr_builders=None,
+                         comms_builders=None,
+                         traced_labels=None) -> "list[Finding]":
+    """DHQR501. Static coverage: every jaxpr/comms trace spec must name
+    a builder its pass can resolve (an unknown name would trace as a
+    DHQR104/DHQR305 unexpressible-route finding at runtime — this
+    catches it without tracing), and every route must sit inside the
+    audit ladder's reach. ``traced_labels`` (when given — the CLI
+    passes the labels the jaxpr pass actually produced) is checked
+    two-way against :func:`expected_jaxpr_labels`: a label traced but
+    unregistered is exactly the hand-enumerated drift the registry
+    retired."""
+    findings = []
+    routes = registry.routes() if routes is None else routes
+    if jaxpr_builders is None or comms_builders is None:
+        from dhqr_tpu.analysis import comms_pass, jaxpr_pass
+        from dhqr_tpu.precision import PRECISION_POLICIES
+
+        jaxpr_pass._ensure_cpu_backend()
+        pol = PRECISION_POLICIES["accurate"]
+        if jaxpr_builders is None:
+            jaxpr_builders = set(jaxpr_pass._builders("accurate", pol))
+        if comms_builders is None:
+            comms_builders = set(
+                comms_pass._comms_builders(2, "accurate", pol)[0])
+    from dhqr_tpu.analysis.comms_pass import DEFAULT_DEVICE_COUNTS
+
+    ladder_max = max(DEFAULT_DEVICE_COUNTS)
+    for r in routes:
+        for spec in r.jaxpr:
+            if spec["builder"] not in jaxpr_builders:
+                findings.append(_f(
+                    "DHQR501", "atlas::coverage",
+                    f"route {r.name!r} jaxpr spec names builder "
+                    f"{spec['builder']!r} the jaxpr pass has no "
+                    "mechanism for — it would trace as an "
+                    "unexpressible-route DHQR104, so register the "
+                    "builder or drop the spec",
+                    snippet=f"{r.name}:jaxpr:{spec['builder']}"))
+        if r.comms_trace is not None \
+                and r.comms_trace["builder"] not in comms_builders:
+            findings.append(_f(
+                "DHQR501", "atlas::coverage",
+                f"route {r.name!r} comms_trace names builder "
+                f"{r.comms_trace['builder']!r} the comms audit has no "
+                "mechanism for",
+                snippet=f"{r.name}:comms:{r.comms_trace['builder']}"))
+        if r.min_devices > ladder_max:
+            findings.append(_f(
+                "DHQR501", "atlas::coverage",
+                f"route {r.name!r} needs {r.min_devices} devices but "
+                f"the audit ladder tops out at {ladder_max} "
+                f"(comms_pass.DEFAULT_DEVICE_COUNTS) — the route would "
+                "never be traced by any pass",
+                snippet=f"{r.name}:min_devices"))
+    if traced_labels is not None:
+        traced = set(traced_labels)
+        expected = expected_jaxpr_labels(routes)
+        for lab in sorted(traced - expected):
+            findings.append(_f(
+                "DHQR501", "atlas::coverage",
+                f"jaxpr pass traced label {lab!r} that no registered "
+                "route declares — a hand-enumerated route outside the "
+                "registry; register it (tune/registry.py) so the grid, "
+                "the serve keys and the contracts see it too",
+                snippet=f"unregistered:{lab}"))
+        for lab in sorted(expected - traced):
+            findings.append(_f(
+                "DHQR501", "atlas::coverage",
+                f"registered trace label {lab!r} was never produced by "
+                "the jaxpr pass — the route is registered but "
+                "unaudited",
+                snippet=f"untraced:{lab}"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# DHQR502 — contract pricing bijection
+
+
+def check_contract_pricing(routes=None,
+                           contracts=None) -> "list[Finding]":
+    """DHQR502. The comms audit prices what it traces against
+    ``comms_contracts.json`` — so a registry route naming a missing row
+    ships an unpriced collective, and a row no route claims is a dead
+    contract (its budget silently stopped binding anything). Rows must
+    also be self-consistent: a known cost model, known collective
+    primitives, and a wire rung matching some claiming route."""
+    from dhqr_tpu.analysis.comms_pass import (COMMS_COLLECTIVES,
+                                              load_contracts)
+    from dhqr_tpu.analysis.cost_model import MODELS
+    from dhqr_tpu.precision import COMMS_MODES
+
+    findings = []
+    routes = registry.routes() if routes is None else routes
+    contracts = load_contracts() if contracts is None else contracts
+    claims = {}
+    for r in routes:
+        if not r.contract:
+            continue
+        claims.setdefault(r.contract, []).append(r)
+        if r.contract not in contracts:
+            findings.append(_f(
+                "DHQR502", "atlas::contracts",
+                f"route {r.name!r} prices its census against contract "
+                f"{r.contract!r}, which is not a row of "
+                "comms_contracts.json — the route's collectives ship "
+                "unpriced",
+                snippet=f"missing-row:{r.contract}"))
+    for key, row in sorted(contracts.items()):
+        if key not in claims:
+            findings.append(_f(
+                "DHQR502", "atlas::contracts",
+                f"contract row {key!r} is claimed by no registered "
+                "route — a dead budget; delete the row or register the "
+                "route that should be held to it",
+                snippet=f"dead-row:{key}"))
+            continue
+        model = row.get("model")
+        if model not in MODELS:
+            findings.append(_f(
+                "DHQR502", "atlas::contracts",
+                f"contract row {key!r} names unknown cost model "
+                f"{model!r} (have {sorted(MODELS)})",
+                snippet=f"model:{key}"))
+        unknown = sorted(set(row.get("collectives", ()))
+                         - set(COMMS_COLLECTIVES))
+        if unknown:
+            findings.append(_f(
+                "DHQR502", "atlas::contracts",
+                f"contract row {key!r} allows unknown collective "
+                f"primitives {unknown} — the census would never match "
+                "them, so the allowance is dead",
+                snippet=f"collectives:{key}"))
+        rung = row.get("comms")
+        if rung is not None:
+            if rung not in COMMS_MODES:
+                findings.append(_f(
+                    "DHQR502", "atlas::contracts",
+                    f"contract row {key!r} names unknown wire rung "
+                    f"{rung!r} (have {COMMS_MODES})",
+                    snippet=f"rung:{key}"))
+            elif not any(r.comms == rung for r in claims[key]):
+                findings.append(_f(
+                    "DHQR502", "atlas::contracts",
+                    f"contract row {key!r} prices wire rung {rung!r} "
+                    "but no claiming route runs that rung — the "
+                    "compressed budget binds nothing",
+                    snippet=f"rung-unclaimed:{key}"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# DHQR503 — under-keyed caches / recompile hazard
+
+
+def check_cache_keys(routes=None, key_fn=None,
+                     trace: bool = True) -> "list[Finding]":
+    """DHQR503. Serve side: mint the CacheKey for every registered
+    probe cell through the ONE key mint (``serve.engine._plan_key``, or
+    the injected ``key_fn`` twin a test plants); cells that collide on
+    a key are then traced (``trace=True``) and convicted only if their
+    programs differ — colliding-by-design cells (the wire-policy twin)
+    stay green because their programs are identical. Tune side: grid
+    candidates keyed identically in the plan DB (``describe()``) must
+    BE identical."""
+    from dhqr_tpu.serve.engine import (_plan_key, _resolve_serve_cfg,
+                                       bucket_program)
+    from dhqr_tpu.utils.config import ServeConfig
+
+    findings = []
+    key_fn = _plan_key if key_fn is None else key_fn
+    scfg = ServeConfig()
+    cells = []
+    route_list = (registry.serve_routes() if routes is None
+                  else [r for r in routes if r.serve is not None])
+    for r in route_list:
+        kind = r.serve["kind"]
+        m, n = SERVE_PROBE_SHAPES.get(kind, (256, 128))
+        for overrides in r.serve["cells"]:
+            try:
+                cfg, _pol = _resolve_serve_cfg(None, dict(overrides))
+                key, _bucket = key_fn(kind, 2, m, n, "float32", cfg,
+                                      scfg)
+            except Exception as e:
+                findings.append(_f(
+                    "DHQR503", "atlas::serve-keys",
+                    f"route {r.name!r} serve cell {overrides!r} failed "
+                    f"to mint a cache key: {type(e).__name__}: {e}",
+                    snippet=f"mint:{r.name}"))
+                continue
+            cells.append((r.name, kind, overrides, key))
+    groups = {}
+    for name, kind, overrides, key in cells:
+        groups.setdefault(key, []).append((name, kind, overrides))
+    for key, members in sorted(groups.items(),
+                               key=lambda kv: repr(kv[0])):
+        if len(members) < 2 or not trace:
+            continue
+        import jax
+        import jax.numpy as jnp
+
+        programs = {}
+        for name, kind, overrides in members:
+            fn = bucket_program(kind, **dict(overrides))
+            A = jnp.zeros((key.batch, key.m, key.n), jnp.float32)
+            args = (A,) if kind == "qr" \
+                else (A, jnp.zeros((key.batch, key.m), jnp.float32))
+            programs[name] = str(jax.make_jaxpr(fn)(*args))
+        if len(set(programs.values())) > 1:
+            names = sorted(n for n, _, _ in members)
+            findings.append(_f(
+                "DHQR503", "atlas::serve-keys",
+                f"cache key collision with distinct programs: route "
+                f"cells {names} share one serve CacheKey but trace to "
+                f"{len(set(programs.values()))} different jaxprs at "
+                f"bucket ({key.batch}, {key.m}, {key.n}) — the serve "
+                "cache would recompile on every alternation; add the "
+                "distinguishing config field to CacheKey/_plan_key",
+                snippet="servekey:" + ",".join(names)))
+    # Tune side: the plan DB keys measurements on Plan.describe().
+    from dhqr_tpu.tune.search import candidate_plans
+
+    for kind, m, n, nproc, topology, platform in GRID_PROBES:
+        seen = {}
+        for plan in candidate_plans(kind, m, n, "float32", nproc=nproc,
+                                    platform=platform, budget=10_000,
+                                    topology=topology):
+            tag = plan.describe()
+            if tag in seen and seen[tag] != plan:
+                findings.append(_f(
+                    "DHQR503", "atlas::plan-keys",
+                    f"two distinct grid candidates share describe() "
+                    f"tag {tag!r} at kind={kind} ({m}x{n}, "
+                    f"nproc={nproc}) — the plan DB would conflate "
+                    "their measurements under one key",
+                    snippet=f"plan:{kind}:{tag}"))
+            seen.setdefault(tag, plan)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# DHQR504 — donation audit
+
+
+def check_donation_routes(routes=None,
+                          entries=None) -> "list[Finding]":
+    """DHQR504. Routes flagged ``donated`` carry the
+    ``comms_pass._donation_entries`` label their dispatch compiles
+    through; the two sets must be bijective, or a donated dispatch
+    ships with no AOT-aliasing probe (and DHQR304 audits a phantom)."""
+    findings = []
+    routes = registry.routes() if routes is None else routes
+    declared = {r.donation: r.name for r in routes if r.donation}
+    if entries is None:
+        from dhqr_tpu.analysis.comms_pass import (_donation_entries,
+                                                  _ensure_cpu_backend)
+
+        _ensure_cpu_backend()
+        probed = {label for label, _fn, _args in _donation_entries()}
+    else:
+        probed = set(entries)
+    for label in sorted(set(declared) - probed):
+        findings.append(_f(
+            "DHQR504", "atlas::donation",
+            f"route {declared[label]!r} declares donation entry "
+            f"{label!r} but comms_pass._donation_entries has no such "
+            "probe — the donated dispatch ships without its DHQR304 "
+            "aliasing audit",
+            snippet=f"unprobed:{label}"))
+    for label in sorted(probed - set(declared)):
+        findings.append(_f(
+            "DHQR504", "atlas::donation",
+            f"donation probe {label!r} matches no registered route's "
+            "donation field — DHQR304 audits an entry the registry "
+            "does not know exists",
+            snippet=f"unregistered:{label}"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# DHQR505 — grid / bench drift
+
+
+def check_grid_drift(routes=None, probes=None,
+                     stages=None) -> "list[Finding]":
+    """DHQR505. Run the real ``candidate_plans`` over the probe grids
+    and require every emission to map onto a registered route via
+    ``registry.grid_route_for`` — an unmappable candidate is a route
+    the tuner would measure and serve that no pass audits. Bench stages
+    must likewise name registered routes of the right kind."""
+    from dhqr_tpu.tune.search import candidate_plans
+
+    findings = []
+    routes = registry.routes() if routes is None else routes
+    known = {r.name: r for r in routes}
+    for kind, m, n, nproc, topology, platform in (
+            GRID_PROBES if probes is None else probes):
+        for plan in candidate_plans(kind, m, n, "float32", nproc=nproc,
+                                    platform=platform, budget=10_000,
+                                    topology=topology):
+            name = registry.grid_route_for(kind, plan, nproc=nproc)
+            if name is None or name not in known:
+                findings.append(_f(
+                    "DHQR505", "atlas::grid",
+                    f"grid candidate {plan.describe()!r} at kind="
+                    f"{kind} ({m}x{n}, nproc={nproc}) maps to "
+                    f"{'no route' if name is None else name!r} in the "
+                    "registry — the tuner would measure an unaudited "
+                    "route; register it or prune the emission",
+                    snippet=f"grid:{kind}:{plan.describe()}"))
+    for s in (registry.bench_stages() if stages is None else stages):
+        r = known.get(s.route)
+        if r is None:
+            findings.append(_f(
+                "DHQR505", "atlas::grid",
+                f"bench stage {s.config} ({s.metric}) names "
+                f"unregistered route {s.route!r}",
+                snippet=f"stage:{s.config}:{s.route}"))
+            continue
+        if r.kind != s.kind:
+            findings.append(_f(
+                "DHQR505", "atlas::grid",
+                f"bench stage {s.config} ({s.metric}) is a {s.kind} "
+                f"benchmark but route {s.route!r} is registered as "
+                f"kind {r.kind!r}",
+                snippet=f"stage-kind:{s.config}:{s.route}"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Orchestrator
+
+
+def run_atlas_pass(trace: bool = True) -> "list[Finding]":
+    """All DHQR5xx checks with the committed enumerations. Runs at any
+    device count (the coverage check is static; the serve-key tracing
+    is single-device); ``trace=False`` skips the jaxpr comparisons for
+    collided keys (AST-speed, used by ``--fast``'s dryrun twin — note
+    the CLI's ``--fast`` skips the pass entirely)."""
+    from dhqr_tpu.analysis.jaxpr_pass import _ensure_cpu_backend
+
+    _ensure_cpu_backend()
+    findings = registry_findings()
+    findings.extend(check_route_coverage())
+    findings.extend(check_contract_pricing())
+    findings.extend(check_cache_keys(trace=trace))
+    findings.extend(check_donation_routes())
+    findings.extend(check_grid_drift())
+    return findings

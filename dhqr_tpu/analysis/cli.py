@@ -5,14 +5,19 @@ runs on every named path; the jaxpr sanitizer, the API-consistency
 check, the multi-device comms-contract audit (dhqr-audit,
 ``analysis/comms_pass.py``), the xray introspection smoke
 (``analysis/xray_smoke.py``, DHQR401), and the pulse runtime-comms
-smoke (``analysis/pulse_smoke.py``, DHQR402) run whenever the
-dhqr_tpu package itself is among the scan targets (they validate the
-package, not arbitrary files), unless disabled with ``--no-jaxpr`` /
-``--no-api`` / ``--no-comms`` / ``--no-xray`` / ``--no-pulse``. ``comms`` is the audit alone (the subprocess vehicle
-``check`` uses when the backend initialized before the multi-device CPU
-topology could be forced). ``--list-rules`` prints the full DHQR rule
-catalogue so the docs table cannot drift from the code
-(tests/test_analysis.py asserts parity with docs/DESIGN.md).
+smoke (``analysis/pulse_smoke.py``, DHQR402), and the route-registry drift
+audit (dhqr-atlas, ``analysis/atlas.py``, DHQR501-DHQR505) run
+whenever the dhqr_tpu package itself is among the scan targets (they
+validate the package, not arbitrary files), unless disabled with
+``--no-jaxpr`` / ``--no-api`` / ``--no-comms`` / ``--no-xray`` /
+``--no-pulse`` / ``--no-atlas`` — or all at once with ``--fast``
+(AST-only, for edit loops). ``--format {text,json}`` selects the
+output shape (``--json`` is the legacy alias). ``comms`` is the audit
+alone (the subprocess vehicle ``check`` uses when the backend
+initialized before the multi-device CPU topology could be forced).
+``--list-rules`` prints the full DHQR rule catalogue so the docs table
+cannot drift from the code (tests/test_analysis.py asserts parity with
+docs/DESIGN.md).
 """
 
 from __future__ import annotations
@@ -42,46 +47,30 @@ def _scans_package(paths) -> bool:
 
 def rule_catalogue() -> "list[tuple[str, str, str]]":
     """(rule id, one-line summary, pass) for every DHQR rule — THE
-    registry ``--list-rules`` prints and the docs-parity test checks, so
-    a rule cannot ship without a catalogue row."""
+    list ``--list-rules`` prints and the docs-parity test checks, so a
+    rule cannot ship without a catalogue row. Round 21 (dhqr-atlas)
+    retired the hand-kept copy: each pass module owns its ``RULES``
+    tuple and this function only assembles them, so a new pass rule
+    registers once, next to its implementation."""
+    from dhqr_tpu.analysis import (
+        api_check,
+        atlas,
+        comms_pass,
+        jaxpr_pass,
+        pulse_smoke,
+        xray_smoke,
+    )
     from dhqr_tpu.analysis.ast_rules import AST_RULES
 
-    rows = [("DHQR000", "source file failed to parse (syntax error)",
-             "ast")]
+    rows = [("DHQR000", "source file failed to parse, or a suppression "
+             "directive carries no reason (warn-only)", "ast")]
     rows += [(r.id, r.title, "ast") for r in AST_RULES]
     # (DHQR009 — the dhqr-wire seam rule — rides in AST_RULES like the
-    # other pass-1 rows; listed here only as a cross-reference.)
-    rows += [
-        ("DHQR101", "f64/c128 intermediate traced from f32 inputs",
-         "jaxpr"),
-        ("DHQR102", "host callback primitive in a traced program",
-         "jaxpr"),
-        ("DHQR103", "collective axis name unresolvable against the mesh",
-         "jaxpr"),
-        ("DHQR104", "entry point failed to trace under a policy preset",
-         "jaxpr"),
-        ("DHQR201", "__all__ export does not import cleanly", "api"),
-        ("DHQR202", "public name undocumented in docs/DESIGN.md", "api"),
-        ("DHQR301", "collective family outside the engine's comms "
-         "contract", "comms"),
-        ("DHQR302", "traced collective volume exceeds the analytic "
-         "budget (per-tier cross-DCN column on *_pod contracts)",
-         "comms"),
-        ("DHQR303", "shard_map intermediate exceeds the per-shard "
-         "working set", "comms"),
-        ("DHQR304", "donated entry point compiled without input-output "
-         "aliasing", "comms"),
-        ("DHQR305", "jaxpr differs across two traces of one cache key",
-         "comms"),
-        ("DHQR306", "measured collective time unexplainable by volume "
-         "/ interconnect bandwidth x slack (priced per ICI/DCN tier "
-         "on two-tier meshes)", "pulse"),
-        ("DHQR401", "compiled-program xray introspection smoke failed",
-         "xray"),
-        ("DHQR402", "pulse runtime-comms profiling smoke failed",
-         "pulse"),
-    ]
-    return rows
+    # other pass-1 rows.)
+    for mod in (jaxpr_pass, api_check, comms_pass, pulse_smoke,
+                xray_smoke, atlas):
+        rows += list(mod.RULES)
+    return sorted(rows, key=lambda row: row[0])
 
 
 def _force_multidevice_env(count: int) -> None:
@@ -117,7 +106,19 @@ def main(argv=None) -> int:
         help="files/directories to scan (default: dhqr_tpu tests)",
     )
     check.add_argument("--json", action="store_true",
-                       help="emit findings as JSON")
+                       help="emit findings as JSON (alias for "
+                       "--format json)")
+    check.add_argument(
+        "--format", choices=("text", "json"), default=None,
+        help="output format (default text; json is the machine shape "
+        "tools/lint.sh --format json forwards)",
+    )
+    check.add_argument(
+        "--fast", action="store_true",
+        help="AST-only lint: skip every traced/compiled pass (jaxpr, "
+        "api, comms, xray, pulse, atlas) — seconds instead of minutes, "
+        "for edit loops; the full gate still runs in CI/tools/lint.sh",
+    )
     check.add_argument(
         "--baseline", default=None, metavar="FILE",
         help="accepted-findings file: matching fingerprints do not fail "
@@ -143,6 +144,9 @@ def main(argv=None) -> int:
                        help="skip the xray introspection smoke (DHQR401)")
     check.add_argument("--no-pulse", action="store_true",
                        help="skip the pulse runtime-comms smoke (DHQR402)")
+    check.add_argument("--no-atlas", action="store_true",
+                       help="skip the route-registry drift audit "
+                       "(DHQR501-DHQR505)")
     check.add_argument(
         "--preset", action="append", default=None,
         help="restrict the jaxpr/comms passes to these policy presets "
@@ -217,6 +221,9 @@ def main(argv=None) -> int:
     )
 
     paths = args.paths or ["dhqr_tpu", "tests"]
+    if args.fast:
+        args.no_jaxpr = args.no_api = args.no_comms = True
+        args.no_xray = args.no_pulse = args.no_atlas = True
     if _scans_package(paths) and not args.no_comms:
         # Before ANY jax device touch (the jaxpr pass initializes the
         # backend), so the comms audit can run in-process.
@@ -249,6 +256,10 @@ def main(argv=None) -> int:
         from dhqr_tpu.analysis.pulse_smoke import run_pulse_smoke
 
         findings.extend(run_pulse_smoke())
+    if _scans_package(paths) and not args.no_atlas:
+        from dhqr_tpu.analysis.atlas import run_atlas_pass
+
+        findings.extend(run_atlas_pass())
 
     if args.write_baseline:
         write_baseline(args.write_baseline, findings)
@@ -279,16 +290,26 @@ def main(argv=None) -> int:
         else:
             active.append(f)
 
-    if args.json:
+    # Severity split (round 21): warn-only findings (the missing-reason
+    # DHQR000) are reported — and baseline-able above — but never gate
+    # the exit code on their own.
+    errors = [f for f in active if f.severity != "warning"]
+    warnings = [f for f in active if f.severity == "warning"]
+
+    if args.json or args.format == "json":
         print(json.dumps({
-            "findings": [f.to_json() for f in active],
+            "findings": [f.to_json() for f in errors],
+            "warnings": [f.to_json() for f in warnings],
             "suppressed": [f.to_json() for f in suppressed],
             "baselined": [f.to_json() for f in baselined],
         }, indent=2))
     else:
-        for f in active:
+        for f in errors:
             print(f.render())
-        print(f"dhqr-lint: {len(active)} finding(s), "
+        for f in warnings:
+            print(f.render())
+        print(f"dhqr-lint: {len(errors)} finding(s), "
+              f"{len(warnings)} warning(s), "
               f"{len(suppressed)} suppressed, {len(baselined)} baselined",
               file=sys.stderr)
-    return 1 if active else 0
+    return 1 if errors else 0

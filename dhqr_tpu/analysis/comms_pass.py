@@ -68,6 +68,21 @@ COMMS_COLLECTIVES = (
 CONTRACTS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "comms_contracts.json")
 
+#: This pass's rule-catalogue rows (assembled by analysis/cli.py —
+#: round 21 retired the CLI's hand-kept copy).
+RULES = (
+    ("DHQR301", "collective family outside the engine's comms contract",
+     "comms"),
+    ("DHQR302", "traced collective volume exceeds the analytic budget "
+     "(per-tier cross-DCN column on *_pod contracts)", "comms"),
+    ("DHQR303", "shard_map intermediate exceeds the per-shard working "
+     "set", "comms"),
+    ("DHQR304", "donated entry point compiled without input-output "
+     "aliasing", "comms"),
+    ("DHQR305", "jaxpr differs across two traces of one cache key",
+     "comms"),
+)
+
 
 # ---------------------------------------------------------------------------
 # Collective census over a traced program
@@ -439,12 +454,21 @@ _ROW_M, _ROW_N, _ROW_NB = 256, 8, 8
 _BATCH_B, _BATCH_M, _BATCH_N, _BATCH_NB = 8, 16, 8, 4
 
 
-def _engine_specs(P: int, preset: str, pol, sweep_presets: bool):
-    """(engine, label, thunk, params) per traced entry point at mesh
-    size P. ``sweep_presets=False`` restricts to the preset-insensitive
-    census (presets change precision attributes, not comms structure —
-    see the module docstring); the policy-parameterized engines are
-    yielded only when sweeping."""
+def _comms_builders(P: int, preset: str, pol):
+    """The trace-construction mechanisms, keyed by the builder names the
+    route registry's ``comms_trace`` specs cite (tune/registry.py — THE
+    engine-matrix enumeration since round 21; this map owns only HOW to
+    build each thunk, never WHICH engines exist). Each builder returns a
+    zero-arg thunk producing a closed jaxpr.
+
+    Conventions the builders preserve from the hand matrix they retire:
+    the preset-swept engines fold ``policy=preset``; the classic sharded
+    engines take precision knobs (``pol.panel`` / ``pol.resolved_apply``)
+    instead; the wire rungs (dhqr-wire, round 18) trace with only the
+    ``comms`` seam armed — the tightened bf16 slack in the contract is
+    what machine-enforces the >= 1.8x traced-volume reduction; the pod
+    engines (dhqr-pod, round 20) trace on a (2, P/2) two-tier mesh with
+    ``axis_name`` spanning both tiers."""
     import jax
     import jax.numpy as jnp
 
@@ -458,168 +482,194 @@ def _engine_specs(P: int, preset: str, pol, sweep_presets: bool):
     from dhqr_tpu.parallel.sharded_tsqr import row_mesh, sharded_tsqr_lstsq
 
     m, n, nb = _column_shape(P)
-    cmesh = column_mesh(P)
-    rmesh = row_mesh(P)
+    mesh_box = {}
+
+    # Lazy meshes (like pod() below): constructing a 2+-device mesh on a
+    # 1-device host raises, and the atlas coverage pass (DHQR501) needs
+    # this map's KEYS anywhere the registry is enumerable — the meshes
+    # only have to exist once a thunk actually traces.
+    def cmesh():
+        if "c" not in mesh_box:
+            mesh_box["c"] = column_mesh(P)
+        return mesh_box["c"]
+
+    def rmesh():
+        if "r" not in mesh_box:
+            mesh_box["r"] = row_mesh(P)
+        return mesh_box["r"]
+
     A = jnp.zeros((m, n), jnp.float32)
     H = jnp.zeros((m, n), jnp.float32)
     alpha = jnp.zeros((n,), jnp.float32)
     b = jnp.zeros((m,), jnp.float32)
     At = jnp.zeros((_ROW_M, _ROW_N), jnp.float32)
     bt = jnp.zeros((_ROW_M,), jnp.float32)
-    col = EngineParams(m, n, nb, P)
-    row = EngineParams(_ROW_M, _ROW_N, _ROW_NB, P)
+    pod_box = {}
+
+    def pod():
+        # Lazy (2, P/2) pod mesh — only the min_devices>=4 routes reach
+        # it, and only when the registry offered them at this P.
+        if "mesh" not in pod_box:
+            from dhqr_tpu.parallel.mesh import pod_mesh
+
+            pod_box["mesh"], pod_box["axes"] = pod_mesh(
+                P, topo=f"2x{P // 2}")
+        return pod_box["mesh"], pod_box["axes"]
 
     def jx(fn, *args):
         return lambda: jax.make_jaxpr(fn)(*args)
 
-    tag = f"[P={P},{preset}]" if sweep_presets else f"[P={P}]"
+    def blocked(layout=None, lookahead=False, agg_panels=None,
+                comms=None, pod_mesh=False):
+        kw = {}
+        if layout:
+            kw["layout"] = layout
+        if lookahead:
+            kw["lookahead"] = True
+        if agg_panels:
+            kw["agg_panels"] = agg_panels
+        if pod_mesh:
+            pmesh, taxes = pod()
+            return jx(lambda A: sharded_blocked_qr(
+                A, pmesh, block_size=nb, axis_name=taxes, **kw), A)
+        if comms:
+            return jx(lambda A: sharded_blocked_qr(
+                A, cmesh(), block_size=nb, comms=comms, **kw), A)
+        return jx(lambda A: sharded_blocked_qr(
+            A, cmesh(), block_size=nb, policy=preset, **kw), A)
 
-    if sweep_presets:
-        blocked_variants = (
-            ("blocked_qr", {}),
-            ("blocked_qr_cyclic", {"layout": "cyclic"}),
-            ("blocked_qr_lookahead", {"lookahead": True}),
-            ("blocked_qr_agg", {"agg_panels": 2}),
-            ("blocked_qr_agg_lookahead", {"agg_panels": 2,
-                                          "lookahead": True}),
-        )
-        for engine, kw in blocked_variants:
-            yield (engine, f"comms::{engine}{tag}",
-                   jx(lambda A, kw=kw: sharded_blocked_qr(
-                       A, cmesh, block_size=nb, policy=preset, **kw), A),
-                   col)
+    def unblocked(comms=None, pod_mesh=False):
+        if pod_mesh:
+            pmesh, taxes = pod()
+            return jx(lambda A: sharded_householder_qr(
+                A, pmesh, axis_name=taxes), A)
+        if comms:
+            return jx(lambda A: sharded_householder_qr(
+                A, cmesh(), comms=comms), A)
+        return jx(lambda A: sharded_householder_qr(
+            A, cmesh(), precision=pol.panel), A)
+
+    def solve(comms=None, pod_mesh=False):
+        if pod_mesh:
+            pmesh, taxes = pod()
+            kw = {"comms": comms} if comms else {}
+            return jx(lambda H, a, b: sharded_solve(
+                H, a, b, pmesh, block_size=nb, axis_name=taxes, **kw),
+                H, alpha, b)
+        if comms:
+            return jx(lambda H, a, b: sharded_solve(
+                H, a, b, cmesh(), block_size=nb, comms=comms), H, alpha, b)
+        return jx(lambda H, a, b: sharded_solve(
+            H, a, b, cmesh(), block_size=nb,
+            precision=pol.resolved_apply()), H, alpha, b)
+
+    def tsqr(comms=None, pod_mesh=False):
+        if pod_mesh:
+            pmesh, taxes = pod()
+            kw = {"comms": comms} if comms else {}
+            return jx(lambda A, b: sharded_tsqr_lstsq(
+                A, b, pmesh, block_size=_ROW_NB, axis_name=taxes, **kw),
+                At, bt)
+        if comms:
+            return jx(lambda A, b: sharded_tsqr_lstsq(
+                A, b, rmesh(), block_size=_ROW_NB, comms=comms), At, bt)
+        return jx(lambda A, b: sharded_tsqr_lstsq(
+            A, b, rmesh(), block_size=_ROW_NB, precision=pol.panel), At, bt)
+
+    def cholqr(comms=None, pod_mesh=False):
+        if pod_mesh:
+            pmesh, taxes = pod()
+            return jx(lambda A, b: sharded_cholqr_lstsq(
+                A, b, pmesh, axis_name=taxes), At, bt)
+        if comms:
+            return jx(lambda A, b: sharded_cholqr_lstsq(
+                A, b, rmesh(), comms=comms), At, bt)
+        return jx(lambda A, b: sharded_cholqr_lstsq(
+            A, b, rmesh(), precision=pol.panel), At, bt)
+
+    def bucket_sharded(policy=None):
         # The serving dispatch, traced with its batch axis sharded over
-        # the mesh: the contract is ZERO collectives — any psum/gather in
-        # the bucket program means the vmapped engine stopped being
-        # embarrassingly parallel over requests.
+        # the mesh: the contract is ZERO collectives — any psum/gather
+        # means the vmapped engine stopped being embarrassingly parallel
+        # over requests (and under a wire policy: compression must never
+        # introduce one).
         from jax.sharding import NamedSharding, PartitionSpec
         from dhqr_tpu.parallel.mesh import DEFAULT_AXIS
         from dhqr_tpu.serve.engine import bucket_program
 
         As = jnp.zeros((_BATCH_B, _BATCH_M, _BATCH_N), jnp.float32)
         bs = jnp.zeros((_BATCH_B, _BATCH_M), jnp.float32)
-        sh = NamedSharding(cmesh, PartitionSpec(DEFAULT_AXIS))
-        fn = bucket_program("lstsq", block_size=_BATCH_NB, policy=preset)
-        yield ("batched_lstsq", f"comms::batched_lstsq{tag}",
-               jx(jax.jit(fn, in_shardings=(sh, sh)), As, bs),
-               EngineParams(_BATCH_M, _BATCH_N, _BATCH_NB, P))
-        return
+        sh = NamedSharding(cmesh(), PartitionSpec(DEFAULT_AXIS))
+        fn = bucket_program("lstsq", block_size=_BATCH_NB,
+                            policy=policy if policy is not None else preset)
+        return jx(jax.jit(fn, in_shardings=(sh, sh)), As, bs)
 
-    yield ("unblocked_qr", f"comms::unblocked_qr{tag}",
-           jx(lambda A: sharded_householder_qr(A, cmesh,
-                                               precision=pol.panel), A),
-           col)
-    yield ("sharded_solve", f"comms::sharded_solve{tag}",
-           jx(lambda H, a, b: sharded_solve(
-               H, a, b, cmesh, block_size=nb,
-               precision=pol.resolved_apply()), H, alpha, b),
-           col)
-    yield ("tsqr_lstsq", f"comms::tsqr_lstsq{tag}",
-           jx(lambda A, b: sharded_tsqr_lstsq(A, b, rmesh,
-                                              block_size=_ROW_NB,
-                                              precision=pol.panel), At, bt),
-           row)
-    yield ("cholqr_lstsq", f"comms::cholqr_lstsq{tag}",
-           jx(lambda A, b: sharded_cholqr_lstsq(A, b, rmesh,
-                                                precision=pol.panel),
-              At, bt),
-           row)
-    # dhqr-wire (round 18): the compressed engine matrix. Each entry
-    # re-traces an engine with the seam armed and checks it against a
-    # COMPRESSED-mode contract (analysis/comms_contracts.json entries
-    # carrying "comms"): the tightened bf16 slack (1.1 on the
-    # exact-to-the-word engines) is what machine-enforces the >= 1.8x
-    # traced-volume reduction — 4 bytes / (2 bytes x 1.1) = 1.82. The
-    # bucket program is traced under a bf16-wire policy against its
-    # ZERO-collective contract: compression must never introduce a
-    # collective into the embarrassingly-parallel serving dispatch.
-    from dhqr_tpu.serve.engine import bucket_program
-    from jax.sharding import NamedSharding, PartitionSpec
-    from dhqr_tpu.parallel.mesh import DEFAULT_AXIS
+    builders = {
+        "blocked": blocked,
+        "unblocked": unblocked,
+        "solve": solve,
+        "tsqr": tsqr,
+        "cholqr": cholqr,
+        "bucket_sharded": bucket_sharded,
+    }
 
-    wire_specs = (
-        ("blocked_qr_wire_bf16", "bf16",
-         lambda c: jx(lambda A: sharded_blocked_qr(
-             A, cmesh, block_size=nb, comms=c), A), col),
-        ("blocked_qr_wire_int8", "int8",
-         lambda c: jx(lambda A: sharded_blocked_qr(
-             A, cmesh, block_size=nb, comms=c), A), col),
-        ("blocked_qr_agg_wire_bf16", "bf16",
-         lambda c: jx(lambda A: sharded_blocked_qr(
-             A, cmesh, block_size=nb, agg_panels=2, comms=c), A), col),
-        ("unblocked_qr_wire_bf16", "bf16",
-         lambda c: jx(lambda A: sharded_householder_qr(
-             A, cmesh, comms=c), A), col),
-        ("sharded_solve_wire_bf16", "bf16",
-         lambda c: jx(lambda H, a, b: sharded_solve(
-             H, a, b, cmesh, block_size=nb, comms=c), H, alpha, b), col),
-        ("tsqr_lstsq_wire_bf16", "bf16",
-         lambda c: jx(lambda A, b: sharded_tsqr_lstsq(
-             A, b, rmesh, block_size=_ROW_NB, comms=c), At, bt), row),
-        ("tsqr_lstsq_wire_int8", "int8",
-         lambda c: jx(lambda A, b: sharded_tsqr_lstsq(
-             A, b, rmesh, block_size=_ROW_NB, comms=c), At, bt), row),
-        ("cholqr_lstsq_wire_bf16", "bf16",
-         lambda c: jx(lambda A, b: sharded_cholqr_lstsq(
-             A, b, rmesh, comms=c), At, bt), row),
-    )
-    for engine, mode, mk, params in wire_specs:
-        yield (engine, f"comms::{engine}{tag}", mk(mode), params)
-    # dhqr-pod (round 20): the hierarchical two-tier engine matrix,
-    # traced on a (2, P/2) pod mesh wherever the sweep's P factors into
-    # one (P >= 4 — a 2x1 topology has no ICI domain to reduce inside).
-    # Contracts for these entries allow BOTH psum and all_gather (the
-    # hierarchical psum's ICI broadcast-back is a traced all_gather) and
-    # carry a ``dcn_slack`` column bounding the cross-DCN share — the
-    # ici_size-fold reduction this round exists for, machine-checked.
-    if P >= 4:
-        from dhqr_tpu.parallel.mesh import pod_mesh
+    def params_for(shape: str, pod_topology: bool) -> EngineParams:
+        topo = (2, P // 2) if pod_topology else None
+        if shape == "row":
+            return EngineParams(_ROW_M, _ROW_N, _ROW_NB, P, topology=topo)
+        if shape == "batch":
+            return EngineParams(_BATCH_M, _BATCH_N, _BATCH_NB, P)
+        return EngineParams(m, n, nb, P, topology=topo)
 
-        pmesh, taxes = pod_mesh(P, topo=f"2x{P // 2}")
-        topo = (2, P // 2)
-        colp = EngineParams(m, n, nb, P, topology=topo)
-        rowp = EngineParams(_ROW_M, _ROW_N, _ROW_NB, P, topology=topo)
-        pod_specs = (
-            ("unblocked_qr_pod",
-             jx(lambda A: sharded_householder_qr(
-                 A, pmesh, axis_name=taxes), A), colp),
-            ("blocked_qr_pod",
-             jx(lambda A: sharded_blocked_qr(
-                 A, pmesh, block_size=nb, axis_name=taxes), A), colp),
-            ("sharded_solve_pod",
-             jx(lambda H, a, b: sharded_solve(
-                 H, a, b, pmesh, block_size=nb, axis_name=taxes),
-                H, alpha, b), colp),
-            ("tsqr_lstsq_pod",
-             jx(lambda A, b: sharded_tsqr_lstsq(
-                 A, b, pmesh, block_size=_ROW_NB, axis_name=taxes),
-                At, bt), rowp),
-            ("cholqr_lstsq_pod",
-             jx(lambda A, b: sharded_cholqr_lstsq(
-                 A, b, pmesh, axis_name=taxes), At, bt), rowp),
-            # The topology-tiered rungs: f32 inside ICI, compressed only
-            # at the DCN crossing — one column engine, one row engine.
-            ("sharded_solve_pod_dcn_bf16",
-             jx(lambda H, a, b: sharded_solve(
-                 H, a, b, pmesh, block_size=nb, axis_name=taxes,
-                 comms="dcn:bf16"), H, alpha, b), colp),
-            ("tsqr_lstsq_pod_dcn_bf16",
-             jx(lambda A, b: sharded_tsqr_lstsq(
-                 A, b, pmesh, block_size=_ROW_NB, axis_name=taxes,
-                 comms="dcn:bf16"), At, bt), rowp),
-        )
-        for engine, thunk, params in pod_specs:
-            yield (engine, f"comms::{engine}{tag}", thunk, params)
-    from dhqr_tpu.precision import PrecisionPolicy
+    return builders, params_for
 
-    As = jnp.zeros((_BATCH_B, _BATCH_M, _BATCH_N), jnp.float32)
-    bs = jnp.zeros((_BATCH_B, _BATCH_M), jnp.float32)
-    sh = NamedSharding(cmesh, PartitionSpec(DEFAULT_AXIS))
-    wfn = bucket_program("lstsq", block_size=_BATCH_NB,
-                         policy=PrecisionPolicy(comms="bf16"))
-    yield ("batched_lstsq", f"comms::batched_lstsq_wire_bf16{tag}",
-           jx(jax.jit(wfn, in_shardings=(sh, sh)), As, bs),
-           EngineParams(_BATCH_M, _BATCH_N, _BATCH_NB, P))
+
+def _unexpressible_comms(route_name: str, builder: str):
+    """Thunk for a registry comms spec citing a builder this pass has no
+    mechanism for: raising (-> DHQR104) makes the drift a finding, not a
+    silent drop."""
+    def thunk():
+        raise RuntimeError(
+            f"route {route_name!r} cites comms builder {builder!r} which "
+            "analysis/comms_pass implements no mechanism for: implement "
+            "the builder or fix the registry spec (tune/registry.py)")
+    return thunk
+
+
+def _engine_specs(P: int, preset: str, pol, sweep_presets: bool):
+    """(engine, label, thunk, params) per traced entry point at mesh
+    size P — ``engine`` is the comms-contract key the census is priced
+    against. ``sweep_presets=False`` restricts to the preset-insensitive
+    census (presets change precision attributes, not comms structure —
+    see the module docstring); the policy-parameterized engines are
+    yielded only when sweeping.
+
+    Round 21 (dhqr-atlas): the enumeration is the route registry
+    (tune/registry.comms_routes) — this function only resolves each
+    route's declarative ``comms_trace`` spec against the builder
+    mechanisms above, so a new sharded engine registers once and is
+    audited here automatically (DHQR501/502 fail lint if it is not)."""
+    from dhqr_tpu.tune.registry import comms_routes
+
+    builders, params_for = _comms_builders(P, preset, pol)
+    tag = f"[P={P},{preset}]" if sweep_presets else f"[P={P}]"
+    for route in comms_routes(P, sweep=sweep_presets):
+        spec = dict(route.comms_trace)
+        spec.pop("sweep", None)
+        label = f"comms::{spec.pop('label', route.name)}{tag}"
+        shape = spec.pop("shape", "col")
+        pod_topology = bool(spec.pop("pod", False))
+        name = spec.pop("builder")
+        build = builders.get(name)
+        if build is None:
+            yield (route.contract, label,
+                   _unexpressible_comms(route.name, name),
+                   params_for(shape, pod_topology))
+            continue
+        if pod_topology:
+            spec["pod_mesh"] = True
+        yield (route.contract, label, build(**spec),
+               params_for(shape, pod_topology))
 
 
 def trace_engine(engine: str, P: int, preset: str = "accurate"):

@@ -14,10 +14,12 @@ import json
 import re
 
 # ``# dhqr: ignore[DHQR002] reason`` — one or more rule IDs, comma
-# separated; the reason is free text (required by policy, see
-# docs/DESIGN.md "Static invariants", but the parser tolerates its
-# absence so a missing reason reads as an empty string rather than an
-# unsuppressed finding with a confusing cause).
+# separated; the reason is free text, required by policy (docs/DESIGN.md
+# "Static invariants"). The parser still tolerates its absence — the
+# suppression takes effect so the author's intent is honored — but a
+# reason-less directive is no longer silent: it reports as a warn-only
+# DHQR000 finding (:func:`missing_reason_findings`, round 21), so the
+# policy is machine-checked instead of review-checked.
 _SUPPRESS_RE = re.compile(
     r"#\s*dhqr:\s*ignore\[([A-Za-z0-9_,\s]+)\]\s*(.*?)\s*$"
 )
@@ -31,7 +33,9 @@ class Finding:
     ``line`` is 1-based (0 for whole-file / traced-program findings);
     ``snippet`` is the stripped source line, used for the baseline
     fingerprint; ``suppressed``/``reason`` record an inline
-    ``# dhqr: ignore[...]`` that matched this finding.
+    ``# dhqr: ignore[...]`` that matched this finding. ``severity`` is
+    ``"error"`` (gates the lint exit code) or ``"warning"`` (reported,
+    baseline-able, never red on its own — the missing-reason DHQR000).
     """
 
     rule: str
@@ -41,6 +45,7 @@ class Finding:
     snippet: str = ""
     suppressed: bool = False
     reason: str = ""
+    severity: str = "error"
 
     def fingerprint(self) -> str:
         key = f"{self.rule}|{self.path}|{self.snippet or self.message}"
@@ -49,7 +54,9 @@ class Finding:
     def render(self) -> str:
         sup = f"  [suppressed: {self.reason or 'no reason given'}]" \
             if self.suppressed else ""
-        return f"{self.path}:{self.line}: {self.rule} {self.message}{sup}"
+        sev = " (warning)" if self.severity == "warning" else ""
+        return (f"{self.path}:{self.line}: {self.rule}{sev} "
+                f"{self.message}{sup}")
 
     def to_json(self) -> dict:
         return {
@@ -60,6 +67,7 @@ class Finding:
             "snippet": self.snippet,
             "suppressed": self.suppressed,
             "reason": self.reason,
+            "severity": self.severity,
             "fingerprint": self.fingerprint(),
         }
 
@@ -74,6 +82,31 @@ def parse_suppressions(lines: "list[str]") -> "dict[int, tuple[set, str]]":
             rules = {r.strip().upper() for r in m.group(1).split(",")
                      if r.strip()}
             out[i] = (rules, m.group(2).strip())
+    return out
+
+
+def missing_reason_findings(lines: "list[str]",
+                            path: str) -> "list[Finding]":
+    """Warn-only DHQR000 for every ``# dhqr: ignore[...]`` directive
+    whose reason parsed to the empty string (round 21, satellite of
+    dhqr-atlas): the suppression still works, but the DESIGN.md
+    "reason required" policy is now machine-checked. Callers
+    (ast_rules.scan_source) run this AFTER :func:`apply_suppressions` —
+    a reason-less ``ignore[DHQR000]`` must not suppress its own
+    missing-reason report."""
+    out = []
+    for line, (rules, reason) in parse_suppressions(lines).items():
+        if reason:
+            continue
+        out.append(Finding(
+            "DHQR000", path, line,
+            f"suppression directive for {', '.join(sorted(rules))} "
+            "carries no reason: the suppression still applies, but "
+            "docs/DESIGN.md requires every inline ignore to say why — "
+            "append the justification after the bracket",
+            snippet=lines[line - 1].strip(),
+            severity="warning",
+        ))
     return out
 
 

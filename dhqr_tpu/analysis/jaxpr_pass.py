@@ -32,6 +32,17 @@ from __future__ import annotations
 
 from dhqr_tpu.analysis.findings import Finding
 
+#: This pass's rule-catalogue rows (assembled by analysis/cli.py —
+#: round 21 retired the CLI's hand-kept copy).
+RULES = (
+    ("DHQR101", "f64/c128 intermediate traced from f32 inputs", "jaxpr"),
+    ("DHQR102", "host callback primitive in a traced program", "jaxpr"),
+    ("DHQR103", "collective axis name unresolvable against the mesh",
+     "jaxpr"),
+    ("DHQR104", "entry point failed to trace under a policy preset",
+     "jaxpr"),
+)
+
 # Shapes small enough to trace in milliseconds but large enough to
 # exercise the blocked/panelled paths (two 4-wide panels per 8 columns).
 _M, _N, _NB = 16, 8, 4
@@ -160,12 +171,14 @@ def check_jaxpr(closed_jaxpr, label: str, mesh_axes=()) -> "list[Finding]":
     return findings
 
 
-def _entry_points(preset: str, pol):
-    """(label, thunk, mesh_axes) triples: thunk returns a closed jaxpr.
-
-    Inputs are f32 and tiny; every thunk traces abstractly (make_jaxpr) —
-    no compile, no execution, no device transfer of real data.
-    """
+def _builders(preset: str, pol):
+    """The trace-construction mechanisms, keyed by the builder names the
+    route registry's jaxpr specs cite (tune/registry.py — THE route
+    enumeration since round 21; this map owns only HOW to build each
+    thunk, never WHICH routes exist). Each builder returns a zero-arg
+    thunk producing a closed jaxpr. Inputs are f32 and tiny; every thunk
+    traces abstractly (make_jaxpr) — no compile, no execution, no device
+    transfer of real data."""
     import jax
     import jax.numpy as jnp
 
@@ -177,162 +190,210 @@ def _entry_points(preset: str, pol):
         sharded_householder_qr,
     )
     from dhqr_tpu.parallel.sharded_tsqr import row_mesh, sharded_tsqr_lstsq
+    from dhqr_tpu.serve.engine import bucket_program
+    from dhqr_tpu.solvers.sketch import sketched_lstsq as _sketched
+    from dhqr_tpu.solvers.update import solve_program, update_program
 
     A = jnp.zeros((_M, _N), jnp.float32)
     b = jnp.zeros((_M,), jnp.float32)
+    At = jnp.zeros((_M_TALL, _N_TALL), jnp.float32)
+    bt = jnp.zeros((_M_TALL,), jnp.float32)
+    As = jnp.zeros((2, _M, _N), jnp.float32)
+    bs = jnp.zeros((2, _M), jnp.float32)
+    Ask = jnp.zeros((2, _M_TALL, _N_TALL), jnp.float32)
+    bsk = jnp.zeros((2, _M_TALL), jnp.float32)
     cmesh = column_mesh(1)
     rmesh = row_mesh(1)
+    pod_box = {}
+
+    def pmesh():
+        # Lazy: only the pod routes (device-gated by the registry) need
+        # a 2x2 factorization.
+        if "mesh" not in pod_box:
+            from dhqr_tpu.parallel.mesh import pod_mesh
+
+            pod_box["mesh"], _ = pod_mesh(4, topo="2x2")
+        return pod_box["mesh"]
 
     def jx(fn, *args):
         return lambda: jax.make_jaxpr(fn)(*args)
 
-    yield (f"qr[{preset}]",
-           jx(lambda A: dhqr_tpu.qr(A, policy=preset), A), ())
-    yield (f"lstsq[{preset}]",
-           jx(lambda A, b: dhqr_tpu.lstsq(A, b, policy=preset), A, b), ())
-    # The tuned dispatch path (round 9): lstsq with an explicit Plan
-    # exercises plan resolution + apply_plan_to_config under every
-    # policy preset — the exact code the plan DB routes production calls
-    # through. An explicit Plan (not "auto") keeps the trace abstract:
-    # no DB read, no timing, deterministic across hosts. The recursive
-    # panel interior is the plan-only knob with the most distinct
-    # program structure, so regressions in the tuned route surface here.
-    from dhqr_tpu.tune import Plan
+    def api_qr():
+        return jx(lambda A: dhqr_tpu.qr(A, policy=preset), A)
 
-    yield (f"lstsq_plan[{preset}]",
-           jx(lambda A, b: dhqr_tpu.lstsq(
-               A, b, plan=Plan(block_size=_NB, panel_impl="recursive"),
-               policy=preset), A, b), ())
-    if preset == "accurate":
-        # Alt-engine plan routing is policy-free by pruning rule 5 —
-        # trace it once, on the tall-skinny problem whose aspect ratio
-        # the plan gates actually select (see _M_TALL above).
-        At = jnp.zeros((_M_TALL, _N_TALL), jnp.float32)
-        bt = jnp.zeros((_M_TALL,), jnp.float32)
-        yield ("lstsq_tall",
-               jx(lambda A, b: dhqr_tpu.lstsq(A, b), At, bt), ())
-        yield ("lstsq_plan_tsqr",
-               jx(lambda A, b: dhqr_tpu.lstsq(
-                   A, b, plan=Plan(engine="tsqr")), At, bt), ())
-        yield ("lstsq_plan_cholqr2",
-               jx(lambda A, b: dhqr_tpu.lstsq(
-                   A, b, plan=Plan(engine="cholqr2")), At, bt), ())
-    yield (f"tsqr_r[{preset}]",
-           jx(lambda A: dhqr_tpu.tsqr_r(A, n_blocks=2, policy=preset), A),
-           ())
-    yield (f"cholesky_qr2[{preset}]",
-           jx(lambda A: dhqr_tpu.cholesky_qr2(A, policy=preset), A), ())
-    # The serving tier's bucket dispatch unit (serve/engine.py): the same
-    # traced program batched_lstsq compiles per bucket, via the engine's
-    # own config/policy resolution — a policy preset that stops tracing
-    # through the vmapped path is a DHQR104 regression like any other.
-    from dhqr_tpu.serve.engine import bucket_program
+    def api_lstsq(tall=False):
+        if tall:
+            # Engine auto-selection on a genuinely tall problem —
+            # policy-free, like the plan gates it exercises.
+            return jx(lambda A, b: dhqr_tpu.lstsq(A, b), At, bt)
+        return jx(lambda A, b: dhqr_tpu.lstsq(A, b, policy=preset), A, b)
 
-    As = jnp.zeros((2, _M, _N), jnp.float32)
-    bs = jnp.zeros((2, _M), jnp.float32)
-    yield (f"batched_lstsq[{preset}]",
-           jx(bucket_program("lstsq", block_size=_NB, policy=preset),
-              As, bs), ())
-    # The async scheduler's dispatch path (round 11): must be the SAME
-    # bucket_program the comms pass contracts — the scheduler owns no
-    # second lowering/key scheme. The thunk asserts function-identity
-    # parity BEFORE tracing, so a drift (someone giving the scheduler
-    # its own _plan_key or dispatch loop) surfaces as a DHQR104 finding
-    # on this entry rather than as silent steady-state recompiles.
-    from dhqr_tpu.serve import engine as _serve_engine
-    from dhqr_tpu.serve import scheduler as _serve_sched
+    def api_lstsq_plan(plan, tall=False):
+        # The tuned dispatch path (round 9): lstsq with an explicit Plan
+        # exercises plan resolution + apply_plan_to_config — the exact
+        # code the plan DB routes production calls through. An explicit
+        # Plan (not "auto") keeps the trace abstract: no DB read, no
+        # timing, deterministic across hosts. Alt-engine plans are
+        # policy-free by pruning rule 5; householder plans sweep the
+        # preset like the rest of the tier.
+        kw = {"policy": preset} if plan.engine == "householder" else {}
+        Ax, bx = (At, bt) if tall else (A, b)
+        return jx(lambda A, b: dhqr_tpu.lstsq(A, b, plan=plan, **kw),
+                  Ax, bx)
 
-    def async_thunk():
-        # The drift this guards against is scheduler.py growing its OWN
-        # lowering helpers (a module-level _plan_key / _dispatch_groups /
-        # bucket_program shadowing the engine's), so check the
-        # scheduler's namespace — comparing engine attributes to
-        # themselves through the module alias would be a tautology.
-        shadowed = {"_plan_key", "_dispatch_groups", "bucket_program"} \
-            & set(vars(_serve_sched))
-        assert _serve_sched._engine is _serve_engine and not shadowed, (
-            "async scheduler dispatch path diverged from serve.engine "
-            f"(shadowed: {sorted(shadowed)}): cache-key parity (one "
-            "_plan_key, one _dispatch_groups) is the zero-recompile "
-            "contract")
-        return jax.make_jaxpr(_serve_sched.dispatch_program(
-            "lstsq", block_size=_NB, policy=preset))(As, bs)
+    def tsqr_r():
+        return jx(lambda A: dhqr_tpu.tsqr_r(A, n_blocks=2, policy=preset),
+                  A)
 
-    yield (f"async_lstsq[{preset}]", async_thunk, ())
-    # The round-17 solver families, BOTH traced under every preset
-    # (the ISSUE-13 acceptance bar): the sketched engine through its
-    # ops-level entry (operator drawn host-side at trace time — the
-    # trace stays abstract, nothing executes) and through the serve
-    # tier's "sketch" bucket program; the updatable-QR family through
-    # its exposed solve/update program builders (an UpdatableQR
-    # CONSTRUCTION would execute a guarded factorization — the program
-    # builders exist precisely so this pass never has to).
-    from dhqr_tpu.solvers.sketch import sketched_lstsq as _sketched
-    from dhqr_tpu.solvers.update import solve_program, update_program
+    def cholesky_qr2():
+        return jx(lambda A: dhqr_tpu.cholesky_qr2(A, policy=preset), A)
 
-    At_ = jnp.zeros((_M_TALL, _N_TALL), jnp.float32)
-    bt_ = jnp.zeros((_M_TALL,), jnp.float32)
-    yield (f"sketched_lstsq[{preset}]",
-           jx(lambda A, b: _sketched(A, b, policy=preset), At_, bt_), ())
-    Ask = jnp.zeros((2, _M_TALL, _N_TALL), jnp.float32)
-    bsk = jnp.zeros((2, _M_TALL), jnp.float32)
-    yield (f"batched_sketch[{preset}]",
-           jx(bucket_program("sketch", policy=preset), Ask, bsk), ())
-    Gu = jnp.zeros((_N_TALL, _N_TALL), jnp.float32)
-    uu_ = jnp.zeros((_M_TALL,), jnp.float32)
-    vv_ = jnp.zeros((_N_TALL,), jnp.float32)
-    sg_ = jnp.zeros((), jnp.float32)
-    yield (f"update_solve[{preset}]",
-           jx(solve_program(refine=max(1, pol.refine),
-                            precision=pol.panel), At_, Gu, bt_), ())
-    yield (f"update_rank1[{preset}]",
-           jx(update_program(), At_, Gu, Gu, uu_, vv_, sg_), ())
-    yield (f"sharded_blocked_qr[{preset}]",
-           jx(lambda A: sharded_blocked_qr(A, cmesh, block_size=_NB,
-                                           policy=preset), A),
-           ("cols",))
-    # The remaining sharded engines take the classic precision knobs, not
-    # a policy object — trace them at the preset's panel precision.
-    yield (f"sharded_householder_qr[{preset}]",
-           jx(lambda A: sharded_householder_qr(A, cmesh,
-                                               precision=pol.panel), A),
-           ("cols",))
-    yield (f"lstsq_mesh[{preset}]",
-           jx(lambda A, b: dhqr_tpu.lstsq(A, b, mesh=cmesh,
-                                          block_size=_NB, policy=preset),
-              A, b),
-           ("cols",))
-    yield (f"sharded_tsqr_lstsq[{preset}]",
-           jx(lambda A, b: sharded_tsqr_lstsq(A, b, rmesh, block_size=_NB,
-                                              precision=pol.panel), A, b),
-           ("rows",))
-    yield (f"sharded_cholqr_lstsq[{preset}]",
-           jx(lambda A, b: sharded_cholqr_lstsq(A, b, rmesh,
-                                                precision=pol.panel),
-              A, b),
-           ("rows",))
-    # Two-tier pod routes (round 20, dhqr-pod): the hierarchical
-    # schedules trace over BOTH axes of a ("dcn", "ici") mesh, and the
-    # dcn:* rungs add compressed DCN legs — sanitize each once (the
-    # schedule is preset-independent; the rungs enumerate here so a
-    # mode that stops tracing fails DHQR104 and a collective escaping
-    # the declared axes fails DHQR103). Needs a 2x2 factorization —
-    # skipped quietly on narrower backends (the comms audit's pod
-    # matrix covers those via its own subprocess vehicle).
-    if preset == "accurate" and len(jax.devices()) >= 4:
-        from dhqr_tpu.parallel.mesh import pod_mesh
+    def bucket(kind):
+        # The serving tier's bucket dispatch units (serve/engine.py):
+        # the same traced programs each bucket compiles, via the
+        # engine's own config/policy resolution — a preset that stops
+        # tracing through a vmapped path is a DHQR104 regression.
+        if kind == "sketch":
+            return jx(bucket_program("sketch", policy=preset), Ask, bsk)
+        if kind == "qr":
+            return jx(bucket_program("qr", block_size=_NB, policy=preset),
+                      As)
+        return jx(bucket_program("lstsq", block_size=_NB, policy=preset),
+                  As, bs)
 
-        pmesh, _taxes = pod_mesh(4, topo="2x2")
-        yield ("sharded_blocked_qr_pod",
-               jx(lambda A: sharded_blocked_qr(A, pmesh, block_size=_NB),
-                  A),
-               ("dcn", "ici"))
-        for _mode in ("dcn:bf16", "dcn:int8"):
-            yield (f"lstsq_pod[{_mode}]",
-                   jx(lambda A, b, _m=_mode: dhqr_tpu.lstsq(
-                       A, b, mesh=pmesh, block_size=_NB, comms=_m),
-                      A, b),
-                   ("dcn", "ici"))
+    def async_bucket():
+        # The async scheduler's dispatch path (round 11): must be the
+        # SAME bucket_program the comms pass contracts — the scheduler
+        # owns no second lowering/key scheme. Asserts function-identity
+        # parity BEFORE tracing, so a drift (someone giving the
+        # scheduler its own _plan_key or dispatch loop) surfaces as a
+        # DHQR104 finding rather than as steady-state recompiles.
+        from dhqr_tpu.serve import engine as _serve_engine
+        from dhqr_tpu.serve import scheduler as _serve_sched
+
+        def thunk():
+            # The drift this guards against is scheduler.py growing its
+            # OWN lowering helpers shadowing the engine's — check the
+            # scheduler's namespace (comparing engine attributes to
+            # themselves through the module alias would be a tautology).
+            shadowed = {"_plan_key", "_dispatch_groups", "bucket_program"} \
+                & set(vars(_serve_sched))
+            assert _serve_sched._engine is _serve_engine and not shadowed, (
+                "async scheduler dispatch path diverged from serve.engine "
+                f"(shadowed: {sorted(shadowed)}): cache-key parity (one "
+                "_plan_key, one _dispatch_groups) is the zero-recompile "
+                "contract")
+            return jax.make_jaxpr(_serve_sched.dispatch_program(
+                "lstsq", block_size=_NB, policy=preset))(As, bs)
+
+        return thunk
+
+    def sketched():
+        return jx(lambda A, b: _sketched(A, b, policy=preset), At, bt)
+
+    def upd_solve():
+        G = jnp.zeros((_N_TALL, _N_TALL), jnp.float32)
+        return jx(solve_program(refine=max(1, pol.refine),
+                                precision=pol.panel), At, G, bt)
+
+    def upd_rank1():
+        G = jnp.zeros((_N_TALL, _N_TALL), jnp.float32)
+        u = jnp.zeros((_M_TALL,), jnp.float32)
+        v = jnp.zeros((_N_TALL,), jnp.float32)
+        s = jnp.zeros((), jnp.float32)
+        return jx(update_program(), At, G, G, u, v, s)
+
+    def sharded_blocked(pod=False):
+        if pod:
+            # The hierarchical schedule is preset-independent — traced
+            # once (the registry gates the route to one preset).
+            return jx(lambda A: sharded_blocked_qr(
+                A, pmesh(), block_size=_NB), A)
+        return jx(lambda A: sharded_blocked_qr(
+            A, cmesh, block_size=_NB, policy=preset), A)
+
+    def sharded_unblocked():
+        # The classic sharded engines take precision knobs, not a policy
+        # object — trace at the preset's panel precision.
+        return jx(lambda A: sharded_householder_qr(
+            A, cmesh, precision=pol.panel), A)
+
+    def lstsq_mesh():
+        return jx(lambda A, b: dhqr_tpu.lstsq(
+            A, b, mesh=cmesh, block_size=_NB, policy=preset), A, b)
+
+    def lstsq_pod(mode):
+        # dcn:* rungs add compressed DCN legs: a mode that stops tracing
+        # fails DHQR104, a collective escaping the declared axes DHQR103.
+        return jx(lambda A, b: dhqr_tpu.lstsq(
+            A, b, mesh=pmesh(), block_size=_NB, comms=mode), A, b)
+
+    def sharded_tsqr():
+        return jx(lambda A, b: sharded_tsqr_lstsq(
+            A, b, rmesh, block_size=_NB, precision=pol.panel), A, b)
+
+    def sharded_cholqr():
+        return jx(lambda A, b: sharded_cholqr_lstsq(
+            A, b, rmesh, precision=pol.panel), A, b)
+
+    return {
+        "api_qr": api_qr,
+        "api_lstsq": api_lstsq,
+        "api_lstsq_plan": api_lstsq_plan,
+        "tsqr_r": tsqr_r,
+        "cholesky_qr2": cholesky_qr2,
+        "bucket": bucket,
+        "async_bucket": async_bucket,
+        "sketched": sketched,
+        "update_solve": upd_solve,
+        "update_rank1": upd_rank1,
+        "sharded_blocked": sharded_blocked,
+        "sharded_unblocked": sharded_unblocked,
+        "lstsq_mesh": lstsq_mesh,
+        "lstsq_pod": lstsq_pod,
+        "sharded_tsqr": sharded_tsqr,
+        "sharded_cholqr": sharded_cholqr,
+    }
+
+
+def _unexpressible(route_name: str, builder: str):
+    """Thunk for a registry jaxpr spec citing a builder this pass does
+    not implement: raising (-> DHQR104) makes the drift a finding, not a
+    silent drop — the round-21 contract for both directions of
+    registry/pass skew."""
+    def thunk():
+        raise RuntimeError(
+            f"route {route_name!r} cites jaxpr builder {builder!r} which "
+            "analysis/jaxpr_pass implements no mechanism for: implement "
+            "the builder or fix the registry spec (tune/registry.py)")
+    return thunk
+
+
+def _entry_points(preset: str, pol):
+    """(label, thunk, mesh_axes) triples: thunk returns a closed jaxpr.
+
+    Round 21 (dhqr-atlas): the enumeration is the route registry
+    (tune/registry.jaxpr_routes) — this function only resolves each
+    route's declarative spec against the builder mechanisms above, so a
+    new route registers once and is traced here automatically (DHQR501
+    fails lint if it is not).
+    """
+    import jax
+
+    from dhqr_tpu.tune.registry import jaxpr_routes
+
+    builders = _builders(preset, pol)
+    for route in jaxpr_routes(preset, devices=len(jax.devices())):
+        for spec in route.jaxpr:
+            spec = dict(spec)
+            label = spec.pop("label").format(preset=preset)
+            axes = spec.pop("axes", ())
+            name = spec.pop("builder")
+            build = builders.get(name)
+            if build is None:
+                yield (label, _unexpressible(route.name, name), axes)
+                continue
+            yield (label, build(**spec), axes)
 
 
 def run_jaxpr_pass(presets=None) -> "list[Finding]":

@@ -25,6 +25,17 @@ from dhqr_tpu.analysis.findings import Finding
 
 _PATH = "dhqr_tpu/obs/pulse.py"
 
+#: This pass's rule-catalogue rows (assembled by analysis/cli.py —
+#: round 21 retired the CLI's hand-kept copy). DHQR306 rides here: the
+#: measured-vs-priced gate is pulse-side even though its budget comes
+#: from the comms contracts.
+RULES = (
+    ("DHQR306", "measured collective time unexplainable by volume "
+     "/ interconnect bandwidth x slack (priced per ICI/DCN tier "
+     "on two-tier meshes)", "pulse"),
+    ("DHQR402", "pulse runtime-comms profiling smoke failed", "pulse"),
+)
+
 
 def run_pulse_smoke() -> "list[Finding]":
     """Dispatch one tiny sharded factorization with pulse armed; every

@@ -18,6 +18,13 @@ from dhqr_tpu.analysis.findings import Finding
 
 _PATH = "dhqr_tpu/obs/xray.py"
 
+#: This pass's rule-catalogue rows (assembled by analysis/cli.py —
+#: round 21 retired the CLI's hand-kept copy).
+RULES = (
+    ("DHQR401", "compiled-program xray introspection smoke failed",
+     "xray"),
+)
+
 
 def run_xray_smoke() -> "list[Finding]":
     """Compile one tiny serve bucket with xray capture armed; every

@@ -343,8 +343,13 @@ def bucket_program(kind: str, config: Optional[DHQRConfig] = None,
             return prog(A, b)
 
         return sketch_fn
+    # Unreachable for any registered kind: the route registry is the
+    # enumeration (tune/registry.SERVE_PROGRAM_KINDS) and the dispatch
+    # above covers it exactly — DHQR501/503 audit that coverage.
+    from dhqr_tpu.tune.registry import SERVE_PROGRAM_KINDS
+
     raise ValueError(
-        f"kind must be 'lstsq', 'qr' or 'sketch', got {kind!r}")
+        f"kind must be one of {SERVE_PROGRAM_KINDS}, got {kind!r}")
 
 
 def _resolve_dispatch_cfg(kind: str, config: Optional[DHQRConfig],
@@ -388,8 +393,10 @@ def _resolve_dispatch_cfg(kind: str, config: Optional[DHQRConfig],
             cfg, refine=SketchConfig.from_env().refine + extra)
         return cfg, pol, None
     if kind != "qr":
+        from dhqr_tpu.tune.registry import SERVE_PROGRAM_KINDS
+
         raise ValueError(
-            f"kind must be 'lstsq', 'qr' or 'sketch', got {kind!r}")
+            f"kind must be one of {SERVE_PROGRAM_KINDS}, got {kind!r}")
     if cfg.refine:
         raise ValueError(
             "refine applies to batched_lstsq only — batched_qr returns raw "

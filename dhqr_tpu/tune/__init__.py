@@ -12,7 +12,11 @@ TSQR/CholQR2). This package stops guessing:
   ``default_plans.json`` (tune/db.py);
 * :func:`tune` / :func:`resolve_plan` / :func:`candidate_plans` — the
   pruned on-device timing search and the ``plan="auto"`` lookup the
-  public API threads through (tune/search.py).
+  public API threads through (tune/search.py);
+* :mod:`dhqr_tpu.tune.registry` (round 21, dhqr-atlas) — THE
+  declarative route registry: one :class:`Route` record per execution
+  route, consumed by the grid, the serve cache keys, the lint passes
+  and the bench stages, and audited by the DHQR5xx atlas pass.
 
 Entry points: ``qr(A, plan="auto")``, ``lstsq(A, b, plan="auto")``,
 ``serve.prewarm(..., plan="auto")``, ``DHQR_TUNE_*`` env knobs
@@ -28,6 +32,17 @@ from dhqr_tpu.tune.db import (
     reset_default_db,
 )
 from dhqr_tpu.tune.plan import DEFAULT_PLAN, PLAN_ENGINES, Plan
+from dhqr_tpu.tune.registry import (
+    BenchStage,
+    Route,
+    SERVE_PROGRAM_KINDS,
+    TUNE_KINDS,
+    bench_stages,
+    grid_route_for,
+    route,
+    route_names,
+    routes,
+)
 from dhqr_tpu.tune.search import (
     Measurement,
     PLAN_DEMOTE_AFTER,
@@ -61,4 +76,13 @@ __all__ = [
     "note_gate_failure",
     "plan_gate_stats",
     "reset_gate_failures",
+    "Route",
+    "BenchStage",
+    "routes",
+    "route",
+    "route_names",
+    "bench_stages",
+    "grid_route_for",
+    "TUNE_KINDS",
+    "SERVE_PROGRAM_KINDS",
 ]

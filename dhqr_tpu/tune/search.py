@@ -77,8 +77,15 @@ from typing import Callable, List, Optional
 
 from dhqr_tpu.tune.db import PlanDB, default_db, plan_key, policy_tag
 from dhqr_tpu.tune.plan import DEFAULT_PLAN, Plan
-
-TUNE_KINDS = ("qr", "lstsq", "serve_qr", "serve_lstsq", "serve_sketch")
+from dhqr_tpu.tune.registry import (
+    GRID_ALT_DCN,
+    GRID_ALT_ENGINES,
+    GRID_ALT_WIRE,
+    GRID_DCN_PLANS,
+    GRID_MESH_LEVERS,
+    GRID_WIRE_PLANS,
+    TUNE_KINDS,
+)
 
 #: Gate failures on one plan key before ``resolve_plan`` demotes the
 #: stored plan (falls back to the static default instead of replaying
@@ -255,31 +262,28 @@ def candidate_plans(kind: str, m: int, n: int, dtype="float32",
         out.extend(Plan(block_size=v, trailing_precision="high")
                    for v in ladder if v >= 64)
     # Rule 5 — alt engines, lstsq-only, policy-free, aspect-gated.
+    # The engine axis and its offer order are the registry's
+    # (GRID_ALT_ENGINES — tune/registry.py); the aspect THRESHOLDS stay
+    # here with the rest of the grid's pruning policy. The sketched
+    # gate rides SketchConfig.min_aspect (default 64 — below it the
+    # O(mn) sketch pass + CGLS sweeps cannot amortize against the
+    # direct GEMMs); the accuracy gate still decides per-shape
+    # admissibility like for every other candidate.
     if kind == "lstsq" and policy is None:
         aspect = m / n
-        if aspect >= CHOLQR_MIN_ASPECT:
-            out.append(Plan(engine="cholqr2"))
-        if aspect >= TSQR_MIN_ASPECT:
-            out.append(Plan(engine="tsqr"))
-        # Round 17: the randomized sketched engine, gated at
-        # SketchConfig.min_aspect (default 64 — below it the O(mn)
-        # sketch pass + CGLS sweeps cannot amortize against the direct
-        # GEMMs, so the grid should not pay a timed candidate finding
-        # that out per key). The accuracy gate below decides per-shape
-        # admissibility like for every other candidate.
         from dhqr_tpu.utils.config import SketchConfig
 
-        if aspect >= SketchConfig.from_env().min_aspect:
-            out.append(Plan(engine="sketch"))
-    # Rule 6 — mesh schedule levers.
+        min_aspect = {"cholqr2": CHOLQR_MIN_ASPECT,
+                      "tsqr": TSQR_MIN_ASPECT,
+                      "sketch": SketchConfig.from_env().min_aspect}
+        for engine in GRID_ALT_ENGINES:
+            if aspect >= min_aspect[engine]:
+                out.append(Plan(engine=engine))
+    # Rule 6 — mesh schedule levers (axis order: GRID_MESH_LEVERS).
     if not serve and nproc > 1:
         base_nb = ladder[-1] if ladder else None
-        out.extend([
-            Plan(block_size=base_nb, lookahead=True),
-            Plan(block_size=base_nb, agg_panels=2),
-            Plan(block_size=base_nb, agg_panels=4),
-            Plan(block_size=base_nb, agg_panels=2, lookahead=True),
-        ])
+        out.extend(Plan(block_size=base_nb, **lever)
+                   for lever in GRID_MESH_LEVERS)
         # Rule 6b (round 18) — compressed collectives (dhqr-wire),
         # lstsq-only (the solve surfaces carry CSNE recovery by
         # contract, so a compressed candidate can actually hold the
@@ -290,16 +294,14 @@ def candidate_plans(kind: str, m: int, n: int, dtype="float32",
         # launches AND fewer bytes per launch is the schedule
         # EQuARX-style wire compression rewards most.
         if policy is None and kind == "lstsq":
-            out.extend([
-                Plan(block_size=base_nb, comms="bf16"),
-                Plan(block_size=base_nb, agg_panels=2, comms="bf16"),
-                Plan(block_size=base_nb, comms="int8"),
-            ])
+            out.extend(Plan(block_size=base_nb, **wire)
+                       for wire in GRID_WIRE_PLANS)
             aspect = m / n
-            if aspect >= CHOLQR_MIN_ASPECT:
-                out.append(Plan(engine="cholqr2", comms="bf16"))
-            if aspect >= TSQR_MIN_ASPECT:
-                out.append(Plan(engine="tsqr", comms="bf16"))
+            alt_gate = {"cholqr2": CHOLQR_MIN_ASPECT,
+                        "tsqr": TSQR_MIN_ASPECT}
+            out.extend(Plan(engine=engine, comms=comms)
+                       for engine, comms in GRID_ALT_WIRE
+                       if aspect >= alt_gate[engine])
             # Rule 6c (round 20, dhqr-pod) — topology-tiered rungs,
             # offered only on a genuinely two-tier mesh (dcn_size > 1):
             # f32 inside the ICI domain, compressed + armor-tagged only
@@ -309,12 +311,11 @@ def candidate_plans(kind: str, m: int, n: int, dtype="float32",
             # the payload quantizes exactly once (no per-panel ring
             # accumulation — parallel/wire.CSNE_MODEL_SWEEPS note).
             if topology is not None and topology[0] > 1:
-                out.extend([
-                    Plan(block_size=base_nb, comms="dcn:bf16"),
-                    Plan(block_size=base_nb, comms="dcn:int8"),
-                ])
-                if aspect >= TSQR_MIN_ASPECT:
-                    out.append(Plan(engine="tsqr", comms="dcn:bf16"))
+                out.extend(Plan(block_size=base_nb, **dcn)
+                           for dcn in GRID_DCN_PLANS)
+                out.extend(Plan(engine=engine, comms=comms)
+                           for engine, comms in GRID_ALT_DCN
+                           if aspect >= alt_gate[engine])
     # Dedupe preserving order (Plan() and the ladder can collide at tiny
     # n), then rule 7 — budget truncation from the end.
     seen = set()

@@ -1,0 +1,286 @@
+"""dhqr-atlas: the route registry and the DHQR5xx drift audit.
+
+Fast tier (runs in `pytest -m lint`, seconds): the committed registry
+is structurally sound and every atlas check is green on the committed
+tree — then each of the seeded drifts the round exists to catch turns
+its check red: an unregistered (hand-enumerated) route (DHQR501), a
+dead contract row and a missing one (DHQR502), a cache key minted
+without ``panel_impl`` — the classic recompile-hazard edit — whose
+collided cells trace to different programs (DHQR503), a donation-probe
+mismatch (DHQR504), and a grid/bench emission outside the registry
+(DHQR505). The warn-only missing-reason DHQR000 (satellite) is covered
+here too, including the exit-code split. The 8-device full-pass case
+rides the slow tier.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dhqr_tpu.analysis import atlas
+from dhqr_tpu.analysis.comms_pass import load_contracts
+from dhqr_tpu.tune import registry
+from dhqr_tpu.tune.plan import Plan
+from dhqr_tpu.tune.registry import BenchStage
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# -- the committed tree is green --------------------------------------------
+
+def test_registry_self_check_green():
+    assert registry.self_check() == []
+
+
+def test_registry_contract_bijection():
+    assert registry.contract_names() == set(load_contracts())
+
+
+def test_registry_route_lookup():
+    r = registry.route("blocked_qr_wire_bf16")
+    assert r.comms == "bf16" and r.contract == "blocked_qr_wire_bf16"
+    with pytest.raises(KeyError):
+        registry.route("no_such_route")
+
+
+def test_atlas_green_on_committed_tree():
+    # The full orchestrator — the exact pass tools/lint.sh gates on —
+    # must be finding-free with the committed enumerations (EMPTY
+    # baseline policy). Runs at any device count. This includes the
+    # collide-BY-DESIGN serve cell: the wire-policy twin shares
+    # batched_lstsq's key (cfg.comms is deliberately not a key field)
+    # and stays green because the traced programs are identical.
+    assert atlas.run_atlas_pass() == []
+
+
+def test_every_route_reaches_some_audit_surface():
+    for r in registry.routes():
+        assert r.jaxpr or r.comms_trace or r.serve or r.donation, r.name
+
+
+# -- seeded drift 1: a hand-enumerated route outside the registry -----------
+
+def test_dhqr501_unregistered_traced_label_is_red():
+    expected = atlas.expected_jaxpr_labels()
+    findings = atlas.check_route_coverage(
+        jaxpr_builders=None if False else set(
+            s["builder"] for r in registry.routes() for s in r.jaxpr),
+        comms_builders={r.comms_trace["builder"]
+                        for r in registry.routes() if r.comms_trace},
+        traced_labels=expected | {"rogue_engine[accurate]"})
+    assert _rules(findings) == ["DHQR501"]
+    assert any("rogue_engine[accurate]" in f.message for f in findings)
+    # Atlas findings gate the exit code (severity "error", not warn-only).
+    assert all(f.severity == "error" for f in findings)
+
+
+def test_dhqr501_untraced_registered_label_is_red():
+    expected = atlas.expected_jaxpr_labels()
+    dropped = expected - {"qr[accurate]"}
+    findings = atlas.check_route_coverage(
+        jaxpr_builders={s["builder"] for r in registry.routes()
+                        for s in r.jaxpr},
+        comms_builders={r.comms_trace["builder"]
+                        for r in registry.routes() if r.comms_trace},
+        traced_labels=dropped)
+    assert _rules(findings) == ["DHQR501"]
+    assert any("qr[accurate]" in f.message for f in findings)
+
+
+def test_dhqr501_unknown_builder_is_red():
+    findings = atlas.check_route_coverage(
+        jaxpr_builders=set(), comms_builders=set())
+    assert "DHQR501" in _rules(findings)
+    # every spec reports: nothing silently dropped
+    n_specs = sum(len(r.jaxpr) for r in registry.routes()) \
+        + sum(1 for r in registry.routes() if r.comms_trace)
+    assert len(findings) == n_specs
+
+
+# -- seeded drift 2: contract rows and routes disagree ----------------------
+
+def test_dhqr502_dead_contract_row_is_red():
+    contracts = dict(load_contracts())
+    contracts["ghost_engine"] = {"collectives": [], "model": "none",
+                                 "slack": 1.0, "replicated_factor": 2.0}
+    findings = atlas.check_contract_pricing(contracts=contracts)
+    assert _rules(findings) == ["DHQR502"]
+    assert any(f.snippet == "dead-row:ghost_engine" for f in findings)
+
+
+def test_dhqr502_missing_contract_row_is_red():
+    contracts = dict(load_contracts())
+    contracts.pop("blocked_qr")
+    findings = atlas.check_contract_pricing(contracts=contracts)
+    assert any(f.snippet == "missing-row:blocked_qr" for f in findings)
+    assert _rules(findings) == ["DHQR502"]
+
+
+def test_dhqr502_unpriceable_row_is_red():
+    contracts = dict(load_contracts())
+    row = dict(contracts["blocked_qr"])
+    row["model"] = "warp_drive"
+    row["collectives"] = list(row.get("collectives", ())) + ["pteleport"]
+    contracts["blocked_qr"] = row
+    findings = atlas.check_contract_pricing(contracts=contracts)
+    assert {f.snippet for f in findings} == {"model:blocked_qr",
+                                             "collectives:blocked_qr"}
+
+
+def test_dhqr502_committed_contracts_green():
+    assert atlas.check_contract_pricing() == []
+
+
+# -- seeded drift 3: a dropped cache-key field ------------------------------
+
+def test_dhqr503_dropping_panel_impl_from_key_is_red():
+    # The recompile-hazard edit: a key mint that stops distinguishing
+    # panel_impl. The registry's nb=64 twin cells (loop vs recursive)
+    # then collide — and at the (256, 128) probe bucket their programs
+    # genuinely differ, so the collision is convicted by tracing, not
+    # by key structure.
+    from dhqr_tpu.serve.engine import _plan_key
+
+    def dropped_key(kind, count, m, n, dtype, cfg, scfg):
+        key, bucket = _plan_key(kind, count, m, n, dtype, cfg, scfg)
+        return key._replace(panel_impl="loop"), bucket
+
+    findings = atlas.check_cache_keys(key_fn=dropped_key)
+    assert _rules(findings) == ["DHQR503"]
+    snippets = {f.snippet for f in findings}
+    assert "servekey:batched_lstsq,batched_lstsq_recursive" in snippets
+    assert "servekey:batched_qr,batched_qr_recursive" in snippets
+
+
+# -- seeded drift 4: donation probes ----------------------------------------
+
+def test_dhqr504_drift_both_directions_is_red():
+    findings = atlas.check_donation_routes(
+        entries=["ops/blocked._blocked_qr_impl_donate",
+                 "ops/rogue._mystery_donate"])
+    assert _rules(findings) == ["DHQR504"]
+    snippets = {f.snippet for f in findings}
+    assert "unprobed:ops/blocked._batched_qr_impl_donate" in snippets
+    assert "unregistered:ops/rogue._mystery_donate" in snippets
+
+
+def test_dhqr504_committed_donations_green():
+    assert atlas.check_donation_routes() == []
+
+
+# -- seeded drift 5: grid / bench escapes the registry ----------------------
+
+def test_dhqr505_unregistered_grid_candidate_is_red():
+    routes = tuple(r for r in registry.routes()
+                   if r.name != "sketched_lstsq")
+    findings = atlas.check_grid_drift(routes=routes)
+    assert _rules(findings) == ["DHQR505"]
+    assert any("sketch" in f.snippet for f in findings)
+
+
+def test_dhqr505_bad_bench_stage_is_red():
+    stages = (BenchStage(9, "warp_qr", "ghost_route", 64, 64, "qr"),
+              BenchStage(10, "kindless", "tsqr_lstsq", 64, 64, "qr"))
+    findings = atlas.check_grid_drift(probes=(), stages=stages)
+    assert _rules(findings) == ["DHQR505"]
+    snippets = {f.snippet for f in findings}
+    assert "stage:9:ghost_route" in snippets
+    assert "stage-kind:10:tsqr_lstsq" in snippets
+
+
+def test_grid_route_for_folds_ladder_knobs():
+    # block_size / trailing_precision are not route-distinguishing.
+    assert registry.grid_route_for("qr", Plan(block_size=64)) \
+        == registry.grid_route_for("qr", Plan(trailing_precision="high")) \
+        == "householder_single"
+    # unexpressible combination (no cholqr int8 wire route) -> None
+    assert registry.grid_route_for(
+        "lstsq", Plan(engine="cholqr2", comms="int8"), nproc=4) is None
+
+
+# -- satellite: warn-only missing-reason DHQR000 ----------------------------
+
+def test_missing_reason_suppression_warns():
+    from dhqr_tpu.analysis.ast_rules import scan_source
+
+    src = ("import time\n"
+           "t = time.perf_counter()  # dhqr: ignore[DHQR008]\n")
+    findings = scan_source(src, "dhqr_tpu/ops/_fixture.py")
+    warn = [f for f in findings if f.rule == "DHQR000"]
+    assert len(warn) == 1 and warn[0].severity == "warning"
+    assert "carries no reason" in warn[0].message
+    # the suppression itself still took effect
+    assert all(f.suppressed for f in findings if f.rule == "DHQR008")
+    # ...and a reason silences the warning
+    src_ok = src.replace("ignore[DHQR008]",
+                         "ignore[DHQR008] timing demo")
+    assert [f for f in scan_source(src_ok, "dhqr_tpu/ops/_fixture.py")
+            if f.rule == "DHQR000"] == []
+
+
+def test_warning_does_not_gate_exit_code(tmp_path, capsys):
+    from dhqr_tpu.analysis.cli import main
+
+    bad = tmp_path / "warn_only.py"
+    bad.write_text("import time\n"
+                   "t = time.perf_counter()  # dhqr: ignore[DHQR008]\n")
+    rc = main(["check", str(bad), "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0                       # warnings alone stay green
+    assert out["findings"] == []
+    assert [f["rule"] for f in out["warnings"]] == ["DHQR000"]
+    assert out["warnings"][0]["severity"] == "warning"
+
+
+# -- satellite: CLI --fast / --format ---------------------------------------
+
+def test_cli_fast_json_smoke(capsys):
+    from dhqr_tpu.analysis.cli import main
+
+    rc = main(["check", os.path.join(REPO, "dhqr_tpu", "analysis"),
+               "--fast", "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert set(out) == {"findings", "warnings", "suppressed", "baselined"}
+    assert out["findings"] == []
+
+
+def test_rule_catalogue_has_atlas_rows_and_is_sorted():
+    from dhqr_tpu.analysis.cli import rule_catalogue
+
+    rows = rule_catalogue()
+    ids = [r[0] for r in rows]
+    assert ids == sorted(ids) and len(ids) == len(set(ids))
+    for rid in ("DHQR501", "DHQR502", "DHQR503", "DHQR504", "DHQR505"):
+        assert rid in ids
+    assert dict((r[0], r[2]) for r in rows)["DHQR503"] == "atlas"
+
+
+# -- slow tier: the full pass under the 8-device audit topology -------------
+
+@pytest.mark.slow
+def test_atlas_pass_under_eight_device_topology():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8"
+                        ).strip()
+    code = ("from dhqr_tpu.analysis.atlas import run_atlas_pass\n"
+            "fs = run_atlas_pass()\n"
+            "assert not fs, [f.render() for f in fs]\n"
+            "import jax\n"
+            "assert len(jax.devices()) == 8\n"
+            "print('atlas-8dev-ok')\n")
+    proc = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr
+    assert "atlas-8dev-ok" in proc.stdout

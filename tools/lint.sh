@@ -3,8 +3,27 @@
 # [tool.ruff]) + dhqr-lint (the AST + jaxpr static-analysis subsystem,
 # docs/DESIGN.md "Static invariants"). Same checks as `pytest -m lint`;
 # exit nonzero on any unsuppressed finding.
+#
+# Usage: tools/lint.sh [--fast] [--format json]
+#   --fast         AST-only dhqr-lint (skips the traced/compiled passes:
+#                  jaxpr, api, comms, xray, pulse, atlas) and the
+#                  regress gate — seconds instead of minutes, for edit
+#                  loops; CI runs the full gate.
+#   --format json  forward machine-readable findings from dhqr-lint
+#                  (the {"findings", "warnings", "suppressed",
+#                  "baselined"} shape of `check --format json`).
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+DHQR_LINT_ARGS=()
+FAST=0
+while [ $# -gt 0 ]; do
+    case "$1" in
+        --fast) FAST=1; DHQR_LINT_ARGS+=(--fast); shift ;;
+        --format) DHQR_LINT_ARGS+=(--format "$2"); shift 2 ;;
+        *) echo "lint.sh: unknown argument $1" >&2; exit 2 ;;
+    esac
+done
 
 if command -v ruff >/dev/null 2>&1; then
     ruff check dhqr_tpu tests bench.py
@@ -27,11 +46,14 @@ fi
 # what the DHQR402 pulse smoke (runtime collective profiling, round
 # 16) dispatches under, so the measured-census assertion runs at full
 # strength here — `check` runs DHQR401 (xray) and DHQR402 (pulse)
-# whenever the package is a scan target.
+# whenever the package is a scan target — and since round 21 so does
+# the dhqr-atlas route-registry drift audit (DHQR501-505: route
+# coverage, contract bijection, serve cache-key collisions, grid/bench
+# drift against tune/registry.py).
 JAX_PLATFORMS=cpu \
 XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
 python -m dhqr_tpu.analysis check dhqr_tpu tests \
-    --baseline tools/lint_baseline.json
+    --baseline tools/lint_baseline.json "${DHQR_LINT_ARGS[@]}"
 
 # Perf-regression gate (dhqr-regress, round 15): the committed bench
 # trajectory (BENCH_r*.json + benchmarks/results/*.jsonl) against the
@@ -43,6 +65,10 @@ python -m dhqr_tpu.analysis check dhqr_tpu tests \
 # WAIVED with a reason in benchmarks/regress_waivers.json, never
 # absorbed silently; exit 1 on any unwaived regression
 # (docs/OPERATIONS.md "Triaging a red regress gate").
-python dhqr_tpu/obs/regress.py \
-    --rules benchmarks/regress_rules.json \
-    --waivers benchmarks/regress_waivers.json
+if [ "$FAST" -eq 0 ]; then
+    python dhqr_tpu/obs/regress.py \
+        --rules benchmarks/regress_rules.json \
+        --waivers benchmarks/regress_waivers.json
+else
+    echo "lint.sh: --fast — regress gate skipped (runs in CI)" >&2
+fi
