@@ -1,0 +1,285 @@
+"""dhqr-fleet acceptance: disk executable store + replica router.
+
+The round-22 decision artifact (benchmarks/README "Round-22 decision
+rules"):
+
+1. **cold-start ladder** — three CHILD interpreters serve the same
+   request mix: no store, store-cold (pays the compiles, publishes the
+   blobs), store-warm (a new replica on the populated store). The warm
+   child must report ZERO compiles — every executable arrives by
+   deserialization (``fleet.store`` disk_hits) — and its cold-start
+   wall (first-request latency, compile included for the others) must
+   beat the compiling children;
+2. **router capacity** — an open-loop request burst through a
+   ``Router`` over K=3 in-process replicas vs the single-scheduler
+   baseline, same shared cache (the router composes throughput, it
+   must not tax it);
+3. **replica-kill ladder** — K=3 replicas under a live stream, killed
+   one by one: every accepted future resolves (result or typed
+   ``ServeError``), survivors serve new work after each kill;
+4. **store overhead** — a warm serving loop with the store attached
+   holds >= 0.95x the store-less loop with zero recompiles (warm
+   dispatch never touches the disk tier).
+
+Ends with a ``serving_fleet_verdict`` row the regress gate's
+``fleet-*`` rules enforce from then on.
+
+Usage:  python benchmarks/serving_fleet.py
+Writes: benchmarks/results/serving_fleet_<platform>.jsonl (append)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from _axon_env import default_to_virtual_cpu, scrubbed_cpu_env  # noqa: E402
+
+SCHEMA_VERSION = 1
+
+#: The child request mix: same shapes every interpreter serves, so the
+#: store-warm child's key set is exactly the store-cold child's.
+_CHILD = """
+import json, sys, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import dhqr_tpu
+from dhqr_tpu.serve.cache import default_cache
+from dhqr_tpu.serve.store import default_store
+
+rng = np.random.default_rng(11)
+A = jnp.asarray(rng.standard_normal((64, 32)), jnp.float32)
+b = jnp.asarray(rng.standard_normal((64,)), jnp.float32)
+A2 = jnp.asarray(rng.standard_normal((128, 32)), jnp.float32)
+b2 = jnp.asarray(rng.standard_normal((128,)), jnp.float32)
+t0 = time.perf_counter()
+x = dhqr_tpu.batched_lstsq([A], [b])[0]
+np.asarray(x)
+first_request_s = time.perf_counter() - t0
+dhqr_tpu.batched_lstsq([A2], [b2])
+wall_s = time.perf_counter() - t0
+store = default_store()
+print(json.dumps({
+    "first_request_s": first_request_s,
+    "wall_s": wall_s,
+    "cache": default_cache().stats(),
+    "store": None if store is None else store.stats(),
+}))
+"""
+
+
+def _stage(name: str) -> None:
+    print(f"::stage {name} t={time.time():.1f}", file=sys.stderr, flush=True)
+
+
+def _run_child(env: dict, tag: str) -> dict:
+    with tempfile.NamedTemporaryFile("w", suffix=".py", dir=None,
+                                     delete=False) as fh:
+        fh.write(_CHILD)
+        script = fh.name
+    try:
+        proc = subprocess.run([sys.executable, script], env=env, cwd=_REPO,
+                              capture_output=True, text=True, timeout=300)
+    finally:
+        os.unlink(script)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"fleet child {tag} rc={proc.returncode}\n"
+            f"stderr:{proc.stderr[-2000:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def main() -> None:
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(3))
+    rnd = int(os.environ.get("DHQR_ROUND", "22"))
+    default_to_virtual_cpu(8)
+    _stage("import")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import dhqr_tpu
+    from dhqr_tpu.serve.cache import ExecutableCache
+    from dhqr_tpu.serve.errors import ReplicaLost, ServeError
+    from dhqr_tpu.serve.router import Router
+    from dhqr_tpu.serve.store import ExecutableStore
+    from dhqr_tpu.utils.config import FleetConfig
+
+    dev = jax.devices()[0]
+    platform = dev.platform
+    kind = getattr(dev, "device_kind", "?")
+    out_path = os.path.join(_REPO, "benchmarks", "results",
+                            f"serving_fleet_{platform}.jsonl")
+
+    def emit(rec):
+        rec.update(platform=platform, device_kind=kind, round=rnd,
+                   schema_version=SCHEMA_VERSION)
+        line = json.dumps(rec)
+        print(line, flush=True)
+        with open(out_path, "a") as f:
+            f.write(line + "\n")
+
+    # ------------------------------------------------ 1. cold-start ladder
+    _stage("warmstart")
+    with tempfile.TemporaryDirectory(prefix="dhqr-fleet-bench-") as root:
+        store_dir = os.path.join(root, "store")
+        children = {
+            "nostore": _run_child(
+                scrubbed_cpu_env(1, DHQR_FLEET_STORE=""), "nostore"),
+            "store_cold": _run_child(
+                scrubbed_cpu_env(1, DHQR_FLEET_STORE=store_dir),
+                "store_cold"),
+            "store_warm": _run_child(
+                scrubbed_cpu_env(1, DHQR_FLEET_STORE=store_dir),
+                "store_warm"),
+        }
+    warm = children["store_warm"]
+    cold = children["store_cold"]
+    warm_zero = (warm["cache"]["compile_seconds"] == 0
+                 and warm["store"]["puts"] == 0
+                 and warm["store"]["disk_hits"] >= 1
+                 and warm["store"]["deserialize_failures"] == 0)
+    # Wall ratio: the warm replica's first-request latency against the
+    # compiling replica's (compile included — that is the point).
+    wall_ratio = warm["first_request_s"] / max(cold["first_request_s"],
+                                               1e-9)
+    emit({"metric": "serving_fleet_warmstart",
+          "warm_zero_compiles": bool(warm_zero),
+          "warm_compile_seconds": warm["cache"]["compile_seconds"],
+          "warm_disk_hits": warm["store"]["disk_hits"],
+          "warm_first_request_s": round(warm["first_request_s"], 4),
+          "cold_first_request_s": round(cold["first_request_s"], 4),
+          "nostore_first_request_s": round(
+              children["nostore"]["first_request_s"], 4),
+          "warm_over_cold_wall": round(wall_ratio, 4)})
+
+    # ------------------------------------------- 2. router capacity burst
+    _stage("capacity")
+    rng = np.random.default_rng(0)
+    A = jnp.asarray(rng.standard_normal((64, 32)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((64,)), jnp.float32)
+    dhqr_tpu.batched_lstsq([A], [b])  # compile outside the timed burst
+    n_requests = 120
+
+    def burst(submit):
+        t0 = time.perf_counter()
+        futs = [submit() for _ in range(n_requests)]
+        for f in futs:
+            f.result(timeout=120)
+        return n_requests / (time.perf_counter() - t0)
+
+    from dhqr_tpu.serve.scheduler import AsyncScheduler
+    single = AsyncScheduler(workers=1)
+    single_rps = burst(lambda: single.submit("lstsq", A, b, deadline=60.0))
+    single.shutdown()
+    router = Router(replicas=3, fleet=FleetConfig(replicas=3, failovers=1),
+                    workers=1)
+    fleet_rps = burst(lambda: router.submit("lstsq", A, b, deadline=60.0))
+    emit({"metric": "serving_fleet_router", "phase": "capacity",
+          "replicas": 3, "requests": n_requests,
+          "single_requests_s": round(single_rps, 2),
+          "fleet_requests_s": round(fleet_rps, 2),
+          "fleet_over_single": round(fleet_rps / max(single_rps, 1e-9), 4)})
+
+    # --------------------------------------------- 3. replica-kill ladder
+    _stage("kill_ladder")
+    x_ref = np.asarray(dhqr_tpu.batched_lstsq([A], [b])[0])
+    outcomes = {"ok": 0, "lost": 0, "typed": 0, "untyped": 0}
+    futs = []
+    survivors_served = True
+    for kill in (None, 0, 1):
+        futs.extend(router.submit("lstsq", A, b, deadline=120.0)
+                    for _ in range(20))
+        if kill is not None:
+            router.kill(kill)
+            try:
+                x = router.submit("lstsq", A, b,
+                                  deadline=120.0).result(timeout=120)
+                survivors_served &= bool(
+                    np.allclose(np.asarray(x), x_ref, atol=1e-4))
+            except Exception:
+                survivors_served = False
+    for f in futs:
+        try:
+            x = f.result(timeout=120)
+            outcomes["ok" if np.allclose(np.asarray(x), x_ref, atol=1e-4)
+                     else "untyped"] += 1
+        except ReplicaLost:
+            outcomes["lost"] += 1
+        except ServeError:
+            outcomes["typed"] += 1
+        except BaseException:
+            outcomes["untyped"] += 1
+    snap = router.metrics_snapshot()
+    router.shutdown()
+    monotone = (outcomes["untyped"] == 0 and survivors_served
+                and sum(outcomes.values()) == 60
+                and snap["replicas_healthy"] == 1)
+    emit({"metric": "serving_fleet_chaos", "replicas": 3, "killed": 2,
+          "requests": 60, "monotone_typed": bool(monotone),
+          "survivors_served": bool(survivors_served),
+          "resolved_ok": outcomes["ok"], "resolved_lost": outcomes["lost"],
+          "resolved_typed": outcomes["typed"],
+          "resolved_untyped": outcomes["untyped"],
+          "router_failovers": snap["failovers"]})
+
+    # --------------------------------------------------- 4. store overhead
+    _stage("warm_overhead")
+    with tempfile.TemporaryDirectory(prefix="dhqr-fleet-ovh-") as root:
+        key_args = ("lstsq", A, b)
+
+        def warm_loop(cache):
+            # Pay the compile, then time the warm path only.
+            from dhqr_tpu.serve import engine as _engine
+
+            _engine.batched_lstsq([A], [b], cache=cache)
+            before = cache.stats()["compile_seconds"]
+            n = 150
+            t0 = time.perf_counter()
+            for _ in range(n):
+                _engine.batched_lstsq([A], [b], cache=cache)
+            rps = n / (time.perf_counter() - t0)
+            recompiled = cache.stats()["compile_seconds"] != before
+            return rps, recompiled
+
+        plain_rps, plain_rec = warm_loop(
+            ExecutableCache(max_size=64, store=None))
+        store_rps, store_rec = warm_loop(
+            ExecutableCache(max_size=64,
+                            store=ExecutableStore(os.path.join(root, "s"))))
+        del key_args
+    ratio = store_rps / max(plain_rps, 1e-9)
+    emit({"metric": "serving_fleet", "phase": "warm_store",
+          "nostore_requests_s": round(plain_rps, 2),
+          "store_requests_s": round(store_rps, 2),
+          "store_over_nostore": round(ratio, 4),
+          "warm_recompiles": int(plain_rec) + int(store_rec)})
+
+    # ------------------------------------------------------------ verdict
+    ok = bool(warm_zero and monotone and ratio >= 0.95
+              and not (plain_rec or store_rec))
+    emit({"metric": "serving_fleet_verdict", "kind": "verdict",
+          "value": round(ratio, 4),
+          "unit": "warm store/nostore throughput ratio",
+          "warm_zero_compiles": bool(warm_zero),
+          "monotone_typed": bool(monotone),
+          "store_overhead_in_bar": bool(ratio >= 0.95),
+          "warm_recompiles": int(plain_rec) + int(store_rec),
+          "ok": ok})
+    _stage("done")
+    if not ok:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -64,7 +64,10 @@ from dhqr_tpu.serve import (
     CompileFailed,
     DeadlineExceeded,
     DispatchFailed,
+    ExecutableStore,
     Quarantined,
+    ReplicaLost,
+    Router,
     ServeError,
     batched_lstsq,
     batched_qr,
@@ -94,6 +97,7 @@ from dhqr_tpu.utils.config import (
     ArmorConfig,
     DHQRConfig,
     FaultConfig,
+    FleetConfig,
     ObsConfig,
     SchedulerConfig,
     ServeConfig,
@@ -130,12 +134,15 @@ __all__ = [
     "pod_mesh",
     "global_pod_mesh",
     "AsyncScheduler",
+    "Router",
+    "ExecutableStore",
     "BackpressureError",
     "ServeError",
     "CompileFailed",
     "DispatchFailed",
     "DeadlineExceeded",
     "Quarantined",
+    "ReplicaLost",
     "NumericalError",
     "NonFiniteInput",
     "Breakdown",
@@ -148,6 +155,7 @@ __all__ = [
     "ArmorConfig",
     "DHQRConfig",
     "FaultConfig",
+    "FleetConfig",
     "ObsConfig",
     "MetricsRegistry",
     "PulseReport",

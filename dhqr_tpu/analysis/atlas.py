@@ -21,7 +21,10 @@ hazard XLA serving tiers pay per under-keyed cache entry):
   to the IDENTICAL program (a collision with distinct jaxprs is a
   recompile per dispatch in steady-state serving). The tune-side twin:
   distinct grid candidates must not share a ``describe()`` tag (the
-  plan-DB key).
+  plan-DB key). The fleet-side twin (round 22): the disk store's
+  canonical key spelling (``serve.store.canonical_key``) must stay
+  injective over distinct CacheKeys, or a warm start deserializes the
+  wrong executable.
 * DHQR504 — donation audit: ``donated`` routes and the DHQR304
   AOT-aliasing probes (``comms_pass._donation_entries``) are bijective.
 * DHQR505 — grid drift: every ``candidate_plans`` emission at a probe
@@ -336,6 +339,37 @@ def check_cache_keys(routes=None, key_fn=None,
                 "cache would recompile on every alternation; add the "
                 "distinguishing config field to CacheKey/_plan_key",
                 snippet="servekey:" + ",".join(names)))
+    # Fleet side (round 22): the disk executable store addresses blobs
+    # by the CANONICAL string spelling of the CacheKey
+    # (serve.store.canonical_key). The spelling must stay INJECTIVE
+    # over distinct keys: two different in-memory CacheKeys flattening
+    # to one canonical string would hand process B the wrong
+    # executable on a warm start — silently, since the header's
+    # key-match check would pass.
+    from dhqr_tpu.serve.store import canonical_key
+
+    canon: dict = {}
+    for name, kind, overrides, key in cells:
+        try:
+            spelled = canonical_key(key)
+        except Exception as e:
+            findings.append(_f(
+                "DHQR503", "atlas::fleet-keys",
+                f"route {r.name!r} serve key {key!r} failed the "
+                f"canonical spelling: {type(e).__name__}: {e} — the "
+                "disk store cannot address this cell's executable",
+                snippet=f"canon-mint:{name}"))
+            continue
+        prior = canon.setdefault(spelled, (name, key))
+        if prior[1] != key:
+            findings.append(_f(
+                "DHQR503", "atlas::fleet-keys",
+                f"canonical key collision: distinct CacheKeys for "
+                f"route cells {sorted([prior[0], name])} both spell "
+                f"{spelled!r} — a warm start would deserialize the "
+                "wrong executable; add the distinguishing field to "
+                "serve.store.canonical_key",
+                snippet=f"canon:{spelled}"))
     # Tune side: the plan DB keys measurements on Plan.describe().
     from dhqr_tpu.tune.search import candidate_plans
 

@@ -249,6 +249,39 @@ def wire_demoted(kind: str, m: int, n: int, dtype, nproc: int) -> bool:
     return wire_trips(kind, m, n, dtype, nproc) >= PLAN_DEMOTE_AFTER
 
 
+def export_wire_trips() -> "dict[str, int]":
+    """Wire-trip counts in the shared-fleet-state spelling (round 22):
+    ``"kind|m|n|dtype|nproc" -> count``. The flat string key crosses
+    process/JSON boundaries losslessly; :func:`adopt_wire_trips` parses
+    it back."""
+    with _TRIP_LOCK:
+        return {"|".join(str(part) for part in key): count
+                for key, count in _WIRE_TRIPS.items()}
+
+
+def adopt_wire_trips(trips: "dict[str, int]") -> None:
+    """Inherit another replica's wire-trip counts, merged by MAX per
+    key (monotone evidence, like tune's gate-failure adoption): a key
+    at/over the demotion threshold after adoption answers
+    :func:`wire_demoted` True immediately, so replica N+1 stops
+    offering the tripped compressed plans without re-tripping them
+    against live traffic. Malformed entries are skipped — the state
+    file is loaded tolerantly end to end."""
+    with _TRIP_LOCK:
+        for key_str, count in trips.items():
+            parts = str(key_str).split("|")
+            if len(parts) != 5:
+                continue
+            try:
+                key = (parts[0], int(parts[1]), int(parts[2]), parts[3],
+                       int(parts[4]))
+                count = int(count)
+            except (TypeError, ValueError):
+                continue
+            if count > _WIRE_TRIPS.get(key, 0):
+                _WIRE_TRIPS[key] = count
+
+
 def reset_wire_trips() -> None:
     """Clear the degrade/trip memory (tests; or after a link repair)."""
     with _TRIP_LOCK:

@@ -46,6 +46,13 @@ site                  action  where it is threaded
 ``serve.worker``      raise   ``serve.scheduler.AsyncScheduler._run``, top
                               of the dispatcher-worker loop — kills the
                               worker thread; crash detection respawns it
+``serve.store``       raise   ``serve.store.ExecutableStore.load``, inside
+                              the read/deserialize block — models a corrupt
+                              or version-skewed persisted executable; the
+                              store CATCHES it and degrades to a counted
+                              plain recompile (``deserialize_failures``),
+                              so firing this site must never surface as an
+                              error on a dispatch path (round 22)
 ``serve.latency``     sleep   ``serve.engine._dispatch_groups``, before the
                               dispatch — models a slow device/host without
                               failing anything
@@ -95,6 +102,7 @@ SITES = {
     "serve.compile": "raise",
     "serve.dispatch": "raise",
     "serve.worker": "raise",
+    "serve.store": "raise",
     "serve.latency": "sleep",
     "numeric.nan": "raise",
     "numeric.breakdown": "raise",

@@ -31,6 +31,19 @@ future resolves (``dhqr_tpu.faults`` injects the failures that prove
 it). See docs/DESIGN.md "Serving tier" / "Async serving" / "Fault
 model" for the rationale and docs/OPERATIONS.md for the cache, SLO
 and fault-triage runbooks.
+
+Round 22 adds the FLEET tier: a persistent executable store
+(``serve.store`` — serialized compiled programs on disk, keyed by a
+canonical cross-process key string, so a NEW process warm-starts at
+zero compiles), shared fleet state (quarantines / plan demotions /
+armor wire trips published and adopted via the PlanDB last-write-wins
+discipline) and a replica :class:`Router` over K in-process schedulers
+with tenant-aware smooth-WRR balancing, fleet-level backpressure
+composition and typed failover (``ReplicaLost``):
+
+    >>> from dhqr_tpu.serve import Router
+    >>> router = Router(replicas=3)
+    >>> x = router.submit("lstsq", A, b, tenant="acme").result()
 """
 
 from dhqr_tpu.serve.buckets import (
@@ -59,9 +72,18 @@ from dhqr_tpu.serve.errors import (
     DeadlineExceeded,
     DispatchFailed,
     Quarantined,
+    ReplicaLost,
     ServeError,
 )
+from dhqr_tpu.serve.router import Router
 from dhqr_tpu.serve.scheduler import AsyncScheduler
+from dhqr_tpu.serve.store import (
+    ExecutableStore,
+    canonical_key,
+    default_store,
+    load_fleet_state,
+    save_fleet_state,
+)
 
 __all__ = [
     "AsyncScheduler",
@@ -72,9 +94,16 @@ __all__ = [
     "DeadlineExceeded",
     "DispatchFailed",
     "ExecutableCache",
+    "ExecutableStore",
     "Quarantined",
+    "ReplicaLost",
+    "Router",
     "ServeError",
+    "canonical_key",
     "default_cache",
+    "default_store",
+    "load_fleet_state",
+    "save_fleet_state",
     "batched_lstsq",
     "batched_qr",
     "batched_sketched_lstsq",

@@ -21,6 +21,15 @@ executable handle; when a persistent jax compilation cache is enabled
 (utils/platform.enable_compile_cache) a re-miss recompiles cheaply from
 the serialized artifact instead of from scratch.
 
+Round 22 adds an optional FLEET disk tier underneath
+(``serve.store.ExecutableStore``, attached when ``DHQR_FLEET_STORE``
+names a directory): a miss first tries to DESERIALIZE a sibling
+replica's persisted executable (zero compiles on a warm fleet), a
+successful compile writes through, and quarantine verdicts adopted
+from the shared fleet state are honored next to the local ones. With
+no store configured every line of that is absent — the per-process
+behavior, keys and counters are unchanged.
+
 Failed compiles QUARANTINE their key (round 12): a program whose
 compile raised is not retried for ``ServeConfig.quarantine_s`` —
 requests that land on it inside the cooldown get a typed
@@ -97,12 +106,23 @@ class ExecutableCache:
 
     def __init__(self, max_size: "int | None" = None,
                  quarantine_s: "float | None" = None,
-                 clock=time.monotonic) -> None:
+                 clock=time.monotonic, store="auto") -> None:
         if max_size is None or quarantine_s is None:
             scfg = ServeConfig.from_env()
             max_size = scfg.cache_size if max_size is None else max_size
             quarantine_s = scfg.quarantine_s if quarantine_s is None \
                 else quarantine_s
+        if store == "auto":
+            # The fleet disk tier (round 22): attach the process-default
+            # ExecutableStore when DHQR_FLEET_STORE names a directory;
+            # unset, store is None and this cache is byte-for-byte the
+            # per-process pre-round-22 tier (same keys, same counters,
+            # same dispatch results). Tests pass store=None to force
+            # isolation or an ExecutableStore instance to share one.
+            from dhqr_tpu.serve import store as _store_mod
+
+            store = _store_mod.default_store()
+        self._store = store
         if max_size < 1:
             raise ValueError(f"max_size must be >= 1, got {max_size}")
         if not quarantine_s > 0:
@@ -114,6 +134,13 @@ class ExecutableCache:
         self._entries: "OrderedDict[object, object]" = OrderedDict()
         # key -> cooldown expiry (clock seconds) after a failed compile.
         self._quarantine: "dict[object, float]" = {}
+        # canonical key spelling -> cooldown expiry, INHERITED from
+        # another replica via the shared fleet state (round 22). Kept
+        # separate from the local dict: local keys are CacheKey objects,
+        # adopted verdicts arrive as cross-process strings, and the
+        # lookup below only pays the canonical rendering when this map
+        # is non-empty (zero cost for per-process serving).
+        self._quarantine_adopted: "dict[str, float]" = {}
         self.counters = Counters()
         self.timer = PhaseTimer()
         # One lock for lookup + insert + evict + counters: a serving tier
@@ -163,7 +190,35 @@ class ExecutableCache:
                     # underflows toward zero.
                     raise Quarantined(key, until - now)
                 del self._quarantine[key]  # cooldown over: one retry
+            if self._quarantine_adopted:
+                ks = self._canonical(key)
+                until = None if ks is None else \
+                    self._quarantine_adopted.get(ks)
+                if until is not None:
+                    now = self._clock()
+                    if now < until:
+                        self.counters.bump("quarantine_hits")
+                        raise Quarantined(key, until - now)
+                    del self._quarantine_adopted[ks]
             self.counters.bump("misses")
+            if self._store is not None:
+                # Fleet disk tier (round 22): a sibling replica already
+                # paid this compile — deserialize its blob instead. A
+                # miss/corrupt/skewed blob returns (None, reason) with
+                # the store counting it (disk_misses /
+                # deserialize_failures) and we fall through to the plain
+                # compile: the disk tier can make a miss cheaper, never
+                # make one fail.
+                exe, _reason = self._store.load(key)
+                if exe is not None:
+                    self._entries[key] = exe
+                    while len(self._entries) > self.max_size:
+                        # Memory eviction only — the disk blob stays (a
+                        # re-miss re-deserializes); store.evict() is the
+                        # explicit disk-side deletion.
+                        self._entries.popitem(last=False)
+                        self.counters.bump("evictions")
+                    return exe
             before = self.timer.total("aot_compile")
             try:
                 with self.timer.measure("aot_compile"):
@@ -187,6 +242,13 @@ class ExecutableCache:
             xray_store = _obs_xray.active()
             if xray_store is not None:
                 xray_store.capture(key, exe, compile_seconds=compile_s)
+            if self._store is not None:
+                # Write-through: the blob this process just paid for is
+                # every future replica's zero-compile warm start. Purely
+                # best-effort — an unserializable executable or a full
+                # disk costs a counted reason (fleet.store
+                # serialize_failures), never the dispatch.
+                self._store.save(key, exe)
             self._entries[key] = exe
             while len(self._entries) > self.max_size:
                 self._entries.popitem(last=False)
@@ -218,6 +280,9 @@ class ExecutableCache:
             now = self._clock()
             for k in [k for k, t in self._quarantine.items() if now >= t]:
                 del self._quarantine[k]  # expired: not "in quarantine"
+            for k in [k for k, t in self._quarantine_adopted.items()
+                      if now >= t]:
+                del self._quarantine_adopted[k]
             return {
                 "size": len(self._entries),
                 "max_size": self.max_size,
@@ -227,17 +292,83 @@ class ExecutableCache:
                 "compile_seconds": round(
                     float(snap.get("compile_seconds", 0)), 3),
                 "compile_failures": int(snap.get("compile_failures", 0)),
-                "quarantined": len(self._quarantine),
+                "quarantined": (len(self._quarantine)
+                                + len(self._quarantine_adopted)),
                 "quarantine_hits": int(snap.get("quarantine_hits", 0)),
             }
+
+    @staticmethod
+    def _canonical(key) -> "str | None":
+        """``key``'s canonical cross-process spelling, or None where it
+        has none (the store/state machinery then skips the key)."""
+        from dhqr_tpu.serve.store import canonical_key
+
+        try:
+            return canonical_key(key)
+        except ValueError:
+            return None
+
+    def export_quarantines(self, wall=time.time) -> "dict[str, float]":
+        """Active quarantines as {canonical key spelling: WALL-clock
+        expiry} — the shared-fleet-state spelling (round 22). Wall
+        clock, not this cache's (possibly fake/monotonic) clock: the
+        consumer is another process whose monotonic epoch is unrelated.
+        Adopted cooldowns re-export, so verdicts survive N hops of
+        replica succession, not just one."""
+        now = self._clock()
+        wall_now = wall()
+        out: "dict[str, float]" = {}
+        with self._lock:
+            local = list(self._quarantine.items())
+            adopted = list(self._quarantine_adopted.items())
+        for key, until in local:
+            remaining = until - now
+            if remaining <= 0:
+                continue
+            ks = self._canonical(key)
+            if ks is not None:
+                out[ks] = max(out.get(ks, 0.0), wall_now + remaining)
+        for ks, until in adopted:
+            remaining = until - now
+            if remaining > 0:
+                out[ks] = max(out.get(ks, 0.0), wall_now + remaining)
+        return out
+
+    def adopt_quarantines(self, mapping: "dict[str, float]",
+                          wall=time.time) -> int:
+        """Inherit another replica's quarantine verdicts ({canonical
+        spelling: wall-clock expiry}); returns how many are now active.
+        Later expiries win (a verdict can only be extended by newer
+        evidence, never silently shortened)."""
+        now = self._clock()
+        wall_now = wall()
+        adopted = 0
+        with self._lock:
+            for ks, expiry in mapping.items():
+                try:
+                    remaining = float(expiry) - wall_now
+                except (TypeError, ValueError):
+                    continue
+                if remaining <= 0:
+                    continue
+                until = now + remaining
+                prev = self._quarantine_adopted.get(str(ks))
+                if prev is None or until > prev:
+                    self._quarantine_adopted[str(ks)] = until
+                adopted += 1
+        return adopted
 
     def clear(self) -> None:
         """Drop every resident executable and every active quarantine
         (counters keep accumulating — they are lifetime telemetry, not
-        occupancy)."""
+        occupancy). The fleet disk tier is NOT touched: clearing memory
+        is an in-process operation, deleting shared blobs is
+        ``store.evict()``/``store.clear()`` — an explicit, separate
+        decision."""
         with self._lock:
             self._entries.clear()
             self._quarantine.clear()
+            self._quarantine_adopted.clear()
 
 
 # The process-default cache every public serve entry point dispatches

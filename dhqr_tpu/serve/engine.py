@@ -257,6 +257,29 @@ def _plan_key(kind: str, count: int, m: int, n: int, dtype,
     return key, bucket
 
 
+def cache_key_plan(key: CacheKey):
+    """The :class:`~dhqr_tpu.tune.Plan` a serve CacheKey carries — the
+    inverse of the plan-application step inside :func:`_plan_key`.
+
+    The fleet store's canonical cross-process key spelling
+    (``serve.store.canonical_key``, round 22) routes the plan segment
+    through ``Plan.describe()`` — ONE deterministic string owned by
+    tune, rather than a second ad-hoc rendering of the same knobs —
+    which is a concrete step toward ROADMAP item 6's "a Route instance
+    IS the cache key" fold: when that lands, this reconstruction
+    disappears and the key carries the route. Serve plans carry only
+    block_size / panel_impl / trailing_precision (``_resolve_plan``
+    rejects schedule levers and comms), so those three fields round-trip
+    exactly; the batched engine family is the blocked householder by
+    construction.
+    """
+    from dhqr_tpu.tune import Plan
+
+    return Plan(engine="householder", block_size=key.block_size,
+                panel_impl=key.panel_impl,
+                trailing_precision=key.trailing_precision or None)
+
+
 def _lower_for_key(key: CacheKey):
     """Build the Lowered program for a serve cache key (the cache owns
     the ``.compile()``)."""

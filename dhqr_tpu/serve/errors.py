@@ -87,8 +87,25 @@ class BackpressureError(ServeError):
     admission-priced deadline check says the queue's expected drain time
     already exceeds the request's budget. ``retry_after`` (seconds,
     always > 0) estimates when capacity frees up — the
-    429-with-Retry-After of this tier."""
+    429-with-Retry-After of this tier. The fleet router (round 22)
+    raises it only after EVERY healthy replica refused, carrying the
+    minimum of their priced hints — the soonest any capacity frees."""
 
     def __init__(self, message: str, retry_after: float) -> None:
         super().__init__(message)
         self.retry_after = max(float(retry_after), 1e-3)
+
+
+class ReplicaLost(ServeError):
+    """A fleet-router future's replica died under it (shut down
+    mid-queue, or crash-storming) and the failover budget
+    (``FleetConfig.failovers``) could not place the request on a
+    healthy sibling — none left, or the budget is exhausted. The
+    monotone-degradation contract one level up from the scheduler's:
+    even with whole replicas killed mid-stream, every accepted future
+    resolves typed, never hangs, never surfaces an anonymous
+    cancellation."""
+
+    def __init__(self, message: str, attempts: int = 0) -> None:
+        super().__init__(message)
+        self.attempts = int(attempts)

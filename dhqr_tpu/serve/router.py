@@ -1,0 +1,459 @@
+"""Fleet replica router: K in-process schedulers behind one submit().
+
+Round 22 (dhqr-fleet) closes the gap between "one AsyncScheduler per
+process" and "a serving fleet": :class:`Router` fronts K in-process
+:class:`~dhqr_tpu.serve.scheduler.AsyncScheduler` replicas — all
+sharing the process executable cache (and, when ``DHQR_FLEET_STORE``
+is set, the disk executable store underneath it) — and owns three
+fleet-level behaviours no single scheduler can provide:
+
+* **Tenant-aware weighted balancing.** Each tenant gets its own
+  smooth-WRR credit vector over the replicas (the same
+  credit-accumulate / debit-on-pick discipline the scheduler's flush
+  selector uses for tenant fairness, lifted one level): replica ``i``
+  earns ``weight[i]`` credit per pick round, the highest credit wins
+  the request and pays back the round's total weight. A tenant's
+  stream spreads ``weight``-proportionally across healthy replicas and
+  two tenants' streams interleave instead of convoying.
+
+* **Backpressure composition.** A replica refusing admission
+  (:class:`~dhqr_tpu.serve.errors.BackpressureError` — queue past the
+  high-water mark, or the PR 6/7 admission price says the deadline is
+  unmeetable there) is not a fleet refusal: the router retries the
+  remaining healthy replicas in credit order and raises
+  ``BackpressureError`` only when EVERY healthy replica refused,
+  carrying the **minimum** of their priced ``retry_after`` hints — the
+  soonest any capacity in the fleet frees up.
+
+* **Typed failover.** A replica that dies with requests queued
+  (``kill()``, an external ``shutdown(drain=False)``, a crash-storm)
+  cancels or fails those futures; the router's relay callback catches
+  exactly those terminal states (cancelled, or the scheduler's bare
+  ``ServeError`` shutdown sentinel), and — within the request's
+  remaining deadline and the :class:`~dhqr_tpu.utils.config.FleetConfig`
+  ``failovers`` budget — resubmits to a healthy sibling. The
+  monotone-degradation bar one level up from the scheduler's: every
+  future :meth:`submit` ever returned resolves — a result, or a typed
+  :class:`~dhqr_tpu.serve.errors.ServeError`
+  (:class:`~dhqr_tpu.serve.errors.ReplicaLost` when no sibling or no
+  budget remains) — never an anonymous cancellation, never a hang,
+  even with whole replicas killed mid-stream.
+
+``kind="update"`` sessions are STICKY: a live
+:class:`~dhqr_tpu.solvers.update.UpdatableQR`'s ops are serialized
+per-session inside one scheduler (``_Group.busy``), so the router pins
+each session to one replica and only re-pins on failover — two
+replicas never run the same session's ops concurrently.
+
+Everything here is in-process and host-side: the router holds no
+device state, so "replica" means an admission queue + dispatcher pool,
+and killing one loses only queue position, never data.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from dhqr_tpu.obs import metrics as _obs_metrics
+from dhqr_tpu.serve.cache import default_cache
+from dhqr_tpu.serve.errors import BackpressureError, ReplicaLost, ServeError
+from dhqr_tpu.serve.scheduler import AsyncScheduler
+from dhqr_tpu.utils.config import FleetConfig
+from dhqr_tpu.utils.profiling import Counters
+
+try:  # pragma: no cover - stdlib, but mirror scheduler's import shape
+    from concurrent.futures import Future
+except ImportError:  # pragma: no cover
+    Future = None  # type: ignore[assignment]
+
+
+class _Relay:
+    """One accepted request's routing state: the outer future the
+    client holds, the original submit arguments (for resubmission), the
+    absolute deadline, and the remaining failover budget. Mutated only
+    under the router lock."""
+
+    __slots__ = ("kind", "A", "b", "tenant", "policy", "plan",
+                 "deadline_at", "failovers_left", "attempts",
+                 "replica_idx", "outer")
+
+    def __init__(self, kind, A, b, tenant, policy, plan,
+                 deadline_at, failovers_left, replica_idx, outer):
+        self.kind = kind
+        self.A = A
+        self.b = b
+        self.tenant = tenant
+        self.policy = policy
+        self.plan = plan
+        self.deadline_at = deadline_at
+        self.failovers_left = failovers_left
+        self.attempts = 1          # submits that a replica accepted
+        self.replica_idx = replica_idx
+        self.outer = outer
+
+
+class Router:
+    """Tenant-aware smooth-WRR router over K in-process scheduler
+    replicas, with fleet-wide backpressure composition and typed
+    failover (module docstring has the full contract).
+
+    >>> router = Router(replicas=3)
+    >>> fut = router.submit("lstsq", A, b, tenant="acme")
+    >>> x = fut.result()         # same x a single scheduler returns
+    >>> router.kill(0)           # chaos: whole replica dies mid-stream
+    >>> router.shutdown()        # drains survivors, saves fleet state
+
+    ``replicas`` is an int (build that many schedulers via
+    ``scheduler_factory``, default ``AsyncScheduler(**sched_kwargs)``)
+    or a prebuilt list of schedulers (tests inject manual-mode ones).
+    ``weights`` skews the WRR credit rates (default: equal). When
+    ``fleet.state_path`` is set the constructor adopts the shared
+    fleet state (quarantines, gate demotions, wire trips — replica N+1
+    inherits replica N's verdicts) and :meth:`shutdown` publishes ours
+    back; both are best-effort null-WITH-reason paths that never gate
+    serving.
+    """
+
+    def __init__(
+        self,
+        replicas=None,
+        *,
+        fleet: "FleetConfig | None" = None,
+        weights=None,
+        scheduler_factory=None,
+        clock=time.monotonic,
+        **sched_kwargs,
+    ) -> None:
+        self._fleet = fleet or FleetConfig.from_env()
+        self._clock = clock
+        if replicas is None:
+            replicas = self._fleet.replicas
+        if isinstance(replicas, int):
+            if replicas < 1:
+                raise ValueError(f"replicas must be >= 1, got {replicas}")
+            factory = scheduler_factory or \
+                (lambda: AsyncScheduler(**sched_kwargs))
+            self._replicas = [factory() for _ in range(replicas)]
+        else:
+            self._replicas = list(replicas)
+            if not self._replicas:
+                raise ValueError("replicas list must be non-empty")
+        k = len(self._replicas)
+        if weights is None:
+            weights = [1.0] * k
+        weights = [float(w) for w in weights]
+        if len(weights) != k or any(w <= 0 for w in weights):
+            raise ValueError(
+                f"weights must be {k} positive numbers, got {weights!r}")
+        self._weights = weights
+        self._lock = threading.Lock()
+        self._credits: "dict[str, list[float]]" = {}  # tenant -> per-replica
+        self._dead = [False] * k   # router-side verdict (kill/shutdown seen)
+        self._sticky: "dict[int, int]" = {}  # id(session) -> replica idx
+        self._closed = False
+        self.counters = Counters()
+        _obs_metrics.registry().register("fleet.router", self)
+        # Inherit the fleet's shared verdicts (tentpole b): best-effort,
+        # a missing/corrupt state file degrades to a fresh start.
+        if self._fleet.state_path:
+            from dhqr_tpu.serve import store as _store_mod
+            _store_mod.load_fleet_state(self._fleet.state_path)
+
+    # ------------------------------------------------------------ balancing
+
+    def _healthy_indices(self) -> "list[int]":
+        return [i for i, r in enumerate(self._replicas)
+                if not self._dead[i] and r.healthy]
+
+    def _pick_order(self, tenant: str, healthy: "list[int]",
+                    exclude: "int | None" = None) -> "list[int]":
+        """Smooth-WRR pick under the router lock: every healthy replica
+        earns its weight, the richest wins and pays back the round's
+        total. Returns ALL healthy candidates, winner first then by
+        descending credit — the failover/backpressure try order."""
+        candidates = [i for i in healthy if i != exclude]
+        if not candidates:
+            return []
+        with self._lock:
+            credits = self._credits.get(tenant)
+            if credits is None:
+                credits = self._credits[tenant] = [0.0] * len(self._replicas)
+            total = 0.0
+            for i in candidates:
+                credits[i] += self._weights[i]
+                total += self._weights[i]
+            best = max(candidates, key=lambda i: (credits[i], -i))
+            credits[best] -= total
+            rest = sorted((i for i in candidates if i != best),
+                          key=lambda i: (-credits[i], i))
+        return [best] + rest
+
+    # ------------------------------------------------------------ admission
+
+    def submit(self, kind: str, A, b=None, *, deadline: "float | None" = None,
+               tenant: str = "default", policy=None, plan=None) -> Future:
+        """Route one request; returns a future resolving to exactly
+        what the chosen scheduler's own future resolves to — including
+        across failovers. Raises :class:`BackpressureError` (minimum
+        ``retry_after`` over the fleet) only when every healthy replica
+        refused, :class:`ReplicaLost` when none is healthy, and
+        ``RuntimeError`` after :meth:`shutdown`."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("router is shut down")
+        if deadline is None:
+            # Resolve ONCE here (the scheduler would too, but the
+            # failover budget needs the absolute deadline router-side).
+            deadline = self._replicas[0]._kcfg.slo_ms / 1e3
+        elif not deadline > 0:
+            raise ValueError(f"deadline must be > 0 seconds, got {deadline}")
+        healthy = self._healthy_indices()
+        if not healthy:
+            self.counters.bump("lost")
+            raise ReplicaLost(
+                f"no healthy replica among {len(self._replicas)} "
+                "(all shut down or crash-storming)")
+        if kind == "update":
+            order = self._sticky_order(A, healthy, tenant)
+        else:
+            order = self._pick_order(tenant, healthy)
+        self.counters.bump("submitted")
+        deadline_at = self._clock() + deadline
+        outer: Future = Future()
+        min_retry = None
+        for n, idx in enumerate(order):
+            try:
+                inner = self._replicas[idx].submit(
+                    kind, A, b, deadline=deadline, tenant=tenant,
+                    policy=policy, plan=plan)
+            except BackpressureError as err:
+                if min_retry is None or err.retry_after < min_retry:
+                    min_retry = err.retry_after
+                continue
+            except RuntimeError:
+                # Closed under us between the healthy check and the
+                # submit — record the verdict and try the next sibling.
+                self._mark_dead(idx)
+                continue
+            if n > 0:
+                self.counters.bump("backpressure_reroutes")
+            self.counters.bump("routed")
+            relay = _Relay(kind, A, b, tenant, policy, plan, deadline_at,
+                           self._fleet.failovers, idx, outer)
+            tid = getattr(inner, "trace_id", None)
+            if tid is not None:
+                outer.trace_id = tid
+            self._chain(relay, inner)
+            return outer
+        if min_retry is not None:
+            self.counters.bump("rejected")
+            raise BackpressureError(
+                f"all {len(order)} healthy replicas refused admission; "
+                f"retry in ~{min_retry:.3f}s", retry_after=min_retry)
+        self.counters.bump("lost")
+        raise ReplicaLost(
+            "every healthy replica shut down while routing the request")
+
+    def _sticky_order(self, session, healthy: "list[int]",
+                      tenant: str) -> "list[int]":
+        """Pin an update session to one replica (ops are serialized
+        per-session inside a scheduler; spreading them would race).
+        Re-pin via WRR when the pinned replica is gone."""
+        sid = id(session)
+        with self._lock:
+            idx = self._sticky.get(sid)
+        if idx is not None and idx in healthy:
+            return [idx]
+        order = self._pick_order(tenant, healthy)
+        with self._lock:
+            self._sticky[sid] = order[0]
+        return [order[0]]
+
+    # ------------------------------------------------------------- failover
+
+    def _chain(self, relay: _Relay, inner: Future) -> None:
+        def _on_done(f: Future, relay=relay) -> None:
+            try:
+                self._relay_done(relay, f)
+            except Exception as err:
+                # The callback runs inside a scheduler's resolution path
+                # (sometimes under its lock, during shutdown) — nothing
+                # it raises may escape, and the outer future must still
+                # resolve typed rather than hang.
+                if not relay.outer.done():
+                    if relay.outer.set_running_or_notify_cancel():
+                        relay.outer.set_exception(ServeError(
+                            f"router relay failed: "
+                            f"{type(err).__name__}: {err}"))
+        inner.add_done_callback(_on_done)
+
+    def _relay_done(self, relay: _Relay, inner: Future) -> None:
+        """Resolve the outer future from a finished inner one, or fail
+        the request over to a healthy sibling when the inner future
+        died of replica death (cancelled, or the scheduler's bare
+        ``ServeError`` drain=False sentinel)."""
+        outer = relay.outer
+        if outer.cancelled():
+            self.counters.bump("cancelled")
+            return
+        if inner.cancelled():
+            err = None
+            replica_died = True
+        else:
+            err = inner.exception()
+            # Exactly the bare base class: every deliberate serving
+            # failure is a SUBCLASS (CompileFailed, DeadlineExceeded,
+            # ...) and passes through untouched below.
+            replica_died = type(err) is ServeError
+        if not replica_died:
+            if not outer.set_running_or_notify_cancel():
+                self.counters.bump("cancelled")
+                return
+            if err is not None:
+                outer.set_exception(err)
+            else:
+                outer.set_result(inner.result())
+            return
+        self._mark_dead(relay.replica_idx)
+        remaining = relay.deadline_at - self._clock()
+        healthy = self._healthy_indices()
+        if relay.failovers_left > 0 and remaining > 0 and healthy:
+            order = self._pick_order(relay.tenant, healthy,
+                                     exclude=relay.replica_idx)
+            for idx in order:
+                try:
+                    nxt = self._replicas[idx].submit(
+                        relay.kind, relay.A, relay.b, deadline=remaining,
+                        tenant=relay.tenant, policy=relay.policy,
+                        plan=relay.plan)
+                except (BackpressureError, RuntimeError):
+                    continue
+                relay.failovers_left -= 1
+                relay.attempts += 1
+                relay.replica_idx = idx
+                if relay.kind == "update":
+                    with self._lock:
+                        self._sticky[id(relay.A)] = idx
+                self.counters.bump("failovers")
+                self._chain(relay, nxt)
+                return
+        self.counters.bump("lost")
+        if outer.set_running_or_notify_cancel():
+            cause = ("no healthy sibling accepted the retry"
+                     if relay.failovers_left > 0 and remaining > 0
+                     else "failover budget exhausted"
+                     if remaining > 0 else "deadline already passed")
+            lost = ReplicaLost(
+                f"replica {relay.replica_idx} died with the request "
+                f"queued and {cause} (attempts={relay.attempts})",
+                attempts=relay.attempts)
+            lost.__cause__ = err
+            outer.set_exception(lost)
+        else:
+            self.counters.bump("cancelled")
+
+    def _mark_dead(self, idx: int) -> None:
+        with self._lock:
+            if not self._dead[idx]:
+                self._dead[idx] = True
+                self.counters.bump("replicas_lost")
+
+    # ------------------------------------------------------ chaos/lifecycle
+
+    def kill(self, idx: int) -> None:
+        """Chaos hook: hard-kill replica ``idx`` mid-stream
+        (``shutdown(drain=False)`` — queued futures cancel and fail
+        over through the relay callbacks, synchronously, before this
+        returns). Idempotent."""
+        self._mark_dead(idx)
+        self.counters.bump("replica_kills")
+        self._replicas[idx].shutdown(drain=False)
+
+    def prewarm(self, shapes, kind: str = "lstsq", **kwargs):
+        """Compile (or, with a disk store attached, DESERIALIZE) the
+        executables a request mix needs, before routing traffic — the
+        fleet warm-start entry point. Delegates to
+        :func:`dhqr_tpu.serve.engine.prewarm` against the shared
+        process cache all replicas dispatch from."""
+        from dhqr_tpu.serve import engine as _engine
+        return _engine.prewarm(shapes, kind=kind, **kwargs)
+
+    def drain(self, timeout: "float | None" = None) -> None:
+        """Complete everything queued on every live replica. A second
+        pass covers requests that failed over DURING the first (a
+        failover lands synchronously, so two passes suffice for any
+        single kill wave)."""
+        for _ in range(2):
+            for i, rep in enumerate(self._replicas):
+                if not self._dead[i]:
+                    rep.drain(timeout=timeout)
+
+    def shutdown(self, drain: bool = True,
+                 timeout: "float | None" = None) -> None:
+        """Stop the fleet: close router admission, shut every replica
+        down (``drain=True`` completes accepted work first), and
+        publish our quarantine/demotion verdicts to the shared fleet
+        state file when one is configured."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        for i, rep in enumerate(self._replicas):
+            rep.shutdown(drain=drain and not self._dead[i], timeout=timeout)
+            self._mark_dead(i)
+        if self._fleet.state_path:
+            from dhqr_tpu.serve import store as _store_mod
+            _store_mod.save_fleet_state(self._fleet.state_path)
+
+    def __enter__(self) -> "Router":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown(drain=exc == (None, None, None))
+
+    # ---------------------------------------------------------------- stats
+
+    @property
+    def replicas(self) -> "list[AsyncScheduler]":
+        return list(self._replicas)
+
+    def queue_depth(self) -> int:
+        return sum(r.queue_depth() for i, r in enumerate(self._replicas)
+                   if not self._dead[i])
+
+    _METRIC_COUNTERS = (
+        "submitted", "routed", "backpressure_reroutes", "rejected",
+        "failovers", "lost", "cancelled", "replica_kills", "replicas_lost",
+    )
+
+    def metrics_snapshot(self) -> dict:
+        """Registry-facing flat snapshot (``fleet.router.*``): the
+        routing counters plus fleet occupancy and health."""
+        snap = self.counters.snapshot()
+        out: dict = {name: int(snap.get(name, 0))
+                     for name in self._METRIC_COUNTERS}
+        healthy = self._healthy_indices()
+        out["replicas"] = len(self._replicas)
+        out["replicas_healthy"] = len(healthy)
+        out["queue_depth"] = self.queue_depth()
+        return out
+
+    def stats(self) -> dict:
+        """JSON-ready operational snapshot: the router metrics plus
+        each replica's own ``metrics_snapshot()`` and health verdict."""
+        out = self.metrics_snapshot()
+        out["per_replica"] = [
+            {"healthy": (not self._dead[i]) and rep.healthy,
+             **rep.metrics_snapshot()}
+            for i, rep in enumerate(self._replicas)
+        ]
+        return out
+
+
+# The store/state half of the fleet tier lives in serve/store.py (disk
+# executable blobs + PlanDB-disciplined shared verdicts); the cache's
+# disk tier wiring is in serve/cache.py and the canonical cross-process
+# key spelling in serve/store.py:canonical_key (via
+# serve/engine.py:cache_key_plan). docs/DESIGN.md "Fleet serving" maps
+# the full layer.

@@ -1313,6 +1313,17 @@ class AsyncScheduler:
         with self._lock:
             return self._depth
 
+    @property
+    def healthy(self) -> bool:
+        """Whether a fleet router should keep routing NEW work here
+        (round 22): open for admission and not crash-storming. The
+        storm threshold is the same two-consecutive-crashes mark the
+        sweep in :meth:`_on_worker_crash` uses — one crash is a
+        respawnable blip, two in a row is a replica the router should
+        drain around until the streak clears."""
+        with self._lock:
+            return not self._closed and self._crash_streak < 2
+
     #: The scheduler counters the registry exports (``serve.sched.<name>``)
     #: and stats() mirrors — ONE spelling for both surfaces.
     _METRIC_COUNTERS = (

@@ -1,0 +1,553 @@
+"""Persistent executable store + shared fleet state (round 22).
+
+Fleet-scale serving means many scheduler processes, and before this
+module every AOT executable — and every learned serving verdict — died
+with its process. Two cross-process tiers live here:
+
+* :class:`ExecutableStore` — a disk tier under the serve cache. Every
+  successful compile is serialized (``utils.compat.serialize_compiled``,
+  the probed ``jax.experimental.serialize_executable`` surface) into a
+  single-writer-atomic blob keyed by the CANONICAL cross-process
+  spelling of the plan-resolved :class:`~dhqr_tpu.serve.cache.CacheKey`
+  (:func:`canonical_key` — the plan segment routes through
+  ``Plan.describe()``, tune's one deterministic plan spelling). A new
+  replica's ``prewarm()`` then deserializes instead of compiling and
+  starts at ZERO compiles. Degradation is null-WITH-reason all the way
+  down: a corrupt, truncated or version-skewed blob (or the
+  ``serve.store`` fault site firing) becomes a counted plain recompile
+  (``deserialize_failures``), never an exception on a dispatch path.
+* **Fleet state** (:func:`save_fleet_state` / :func:`load_fleet_state`)
+  — the PlanDB's last-write-wins JSON discipline extended to the
+  verdicts a replica learns against live traffic: compile quarantines
+  (the serve cache's cooldowns, spelled canonically), plan numeric-gate
+  failure counts (``tune.search``), and armor wire-trip counts. Replica
+  N+1 adopts replica N's verdicts instead of re-learning them; counts
+  merge by MAX and quarantine expiries by latest, so concurrent
+  replicas union their knowledge (the same reasoning as PlanDB:
+  contended entries are all honest measurements of the same traffic).
+
+Accounting rides the shared profiling utilities and registers under
+``fleet.store.*`` dotted names on the process metrics registry
+(disk_hits / disk_misses / deserialize_seconds / read_bytes / ...), so
+the benchmark artifact and the dry run read the numbers the store
+itself maintains. Eviction semantics are deliberately split: the
+in-memory LRU dropping a handle does NOT delete the disk blob (a
+re-miss re-deserializes — that is the point of the tier);
+:meth:`ExecutableStore.evict` is the explicit disk-side deletion, with
+``disk_evictions`` counted separately from the cache's memory
+``evictions``.
+
+See docs/DESIGN.md "Fleet serving" and docs/OPERATIONS.md
+"Warm-starting a replica" / "Triaging a deserialize storm".
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+import threading
+import time
+import warnings
+
+from dhqr_tpu.faults import harness as _faults
+from dhqr_tpu.obs import metrics as _obs_metrics
+from dhqr_tpu.serve.cache import CacheKey, default_cache
+from dhqr_tpu.utils import compat as _compat
+from dhqr_tpu.utils.config import FleetConfig
+from dhqr_tpu.utils.profiling import Counters, PhaseTimer
+
+#: Version tag of the canonical key spelling. Bumping it (a field
+#: added to CacheKey, a change to Plan.describe()) orphans every
+#: existing blob into a clean miss rather than a wrong hit.
+CANONICAL_VERSION = "dhqr-exe-v1"
+
+#: On-disk blob schema (one JSON header line + raw payload).
+BLOB_SCHEMA = "dhqr-exe-store"
+BLOB_VERSION = 1
+
+STATE_SCHEMA = "dhqr-fleet-state"
+STATE_VERSION = 1
+
+
+def canonical_key(key) -> str:
+    """The ONE cross-process spelling of a serve cache key.
+
+    For a :class:`CacheKey` the plan segment (block_size / panel_impl /
+    trailing_precision) renders through
+    ``engine.cache_key_plan(key).describe()`` — tune's deterministic
+    plan spelling, shared with the plan DB — and the remaining fields
+    append in declaration order. Two processes that mint the same
+    CacheKey produce this string byte-for-byte (pinned by the
+    two-process parity test), and two DISTINCT CacheKeys never collide
+    on it (audited by the DHQR503 atlas probe): the spelling is
+    injective because every describe() segment and every appended field
+    is delimited and order-fixed.
+
+    bench.py's prewarm stages key the same cache with flat tuples of
+    primitives and plain strings; those render deterministically too
+    (``repr`` of primitives is stable across processes). Anything else
+    raises ``ValueError`` — the store then skips that key with the
+    reason, it never guesses a spelling.
+    """
+    if isinstance(key, CacheKey):
+        from dhqr_tpu.serve.engine import cache_key_plan
+
+        plan = cache_key_plan(key).describe()
+        sketch = "-" if key.sketch is None else \
+            ":".join(repr(x) for x in key.sketch)
+        return "|".join([
+            CANONICAL_VERSION, key.kind, f"b{key.batch}",
+            f"{key.m}x{key.n}", key.dtype, plan,
+            f"p={key.precision}", f"a={key.apply_precision or '-'}",
+            f"r={key.refine}", f"norm={key.norm}", f"sk={sketch}",
+        ])
+    if isinstance(key, str):
+        return f"{CANONICAL_VERSION}|raw|{key}"
+    if isinstance(key, tuple) and all(
+            isinstance(x, (str, int, float, bool, type(None)))
+            for x in key):
+        return (CANONICAL_VERSION + "|tuple|"
+                + "|".join(repr(x) for x in key))
+    raise ValueError(
+        f"no canonical cross-process spelling for cache key "
+        f"{key!r:.120} (type {type(key).__name__}); the fleet store "
+        "persists CacheKeys, strings and flat primitive tuples only")
+
+
+def _env_fingerprint() -> str:
+    """What must match for a persisted executable to be loadable here:
+    the jax/jaxlib build pair and the backend platform. Part of the
+    blob filename digest, so a version-skewed store reads as a clean
+    miss (recompile) rather than a deserialize error storm."""
+    import jax
+    import jaxlib
+
+    return f"{jax.__version__}|{jaxlib.__version__}|{jax.default_backend()}"
+
+
+class ExecutableStore:
+    """Disk tier of the serve executable cache — one directory of
+    atomically-written, integrity-checked executable blobs shared by
+    every replica on the host (or a shared filesystem).
+
+    ``load(key)``/``save(key, compiled)`` return null-WITH-reason
+    (``(exe | None, reason | None)`` / ``reason | None``) and NEVER
+    raise on the serving path: the cache treats a load miss/failure as
+    a plain compile and a save failure as a counted shrug. Layout: one
+    ``<sha256>.dhqrx`` file per key, the digest covering the canonical
+    key spelling AND the jax/jaxlib/backend fingerprint; each file is
+    one JSON header line (schema, key, fingerprint, payload sha256)
+    followed by the serialized executable, written tempfile-then-rename
+    so a reader can never observe a torn blob.
+    """
+
+    def __init__(self, root: "str | None" = None,
+                 clock=time.monotonic) -> None:
+        if root is None:
+            root = FleetConfig.from_env().store_dir
+        if not root:
+            raise ValueError(
+                "ExecutableStore needs a directory: pass root= or set "
+                "DHQR_FLEET_STORE")
+        self.root = str(root)
+        os.makedirs(self.root, exist_ok=True)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.counters = Counters()
+        self.timer = PhaseTimer()
+        # fleet.store.* dotted names on the process registry (weakly
+        # held, like serve.cache.*) — one set of numbers for the
+        # benchmark artifact, the dry run and operators.
+        _obs_metrics.registry().register("fleet.store", self)
+
+    def _path(self, key_str: str) -> str:
+        digest = hashlib.sha256(
+            (_env_fingerprint() + "\n" + key_str).encode("utf-8")
+        ).hexdigest()
+        return os.path.join(self.root, digest + ".dhqrx")
+
+    # -- read --------------------------------------------------------------
+    def load(self, key) -> "tuple[object | None, str | None]":
+        """``(executable, None)`` on a disk hit, ``(None, reason)``
+        otherwise. An absent blob counts ``disk_misses``; a present but
+        unreadable/corrupt/skewed one (or the ``serve.store`` fault
+        site firing) additionally counts ``deserialize_failures`` —
+        either way the caller recompiles, it never sees an exception.
+        """
+        try:
+            key_str = canonical_key(key)
+        except ValueError as e:
+            self.counters.bump("disk_misses")
+            return None, str(e)
+        path = self._path(key_str)
+        if not os.path.exists(path):
+            self.counters.bump("disk_misses")
+            return None, "absent"
+        before = self.timer.total("deserialize")
+        try:
+            with self.timer.measure("deserialize"):
+                _faults.fire("serve.store")
+                with open(path, "rb") as fh:
+                    raw = fh.read()
+                head, sep, payload = raw.partition(b"\n")
+                if not sep:
+                    raise ValueError("truncated blob (no header line)")
+                header = json.loads(head.decode("utf-8"))
+                if header.get("schema") != BLOB_SCHEMA or \
+                        header.get("version") != BLOB_VERSION:
+                    raise ValueError(
+                        f"foreign/stale blob schema {header.get('schema')!r}"
+                        f" v{header.get('version')!r}")
+                if header.get("key") != key_str:
+                    raise ValueError(
+                        "digest collision or renamed blob: header key "
+                        f"{header.get('key')!r:.120} != requested")
+                if header.get("fingerprint") != _env_fingerprint():
+                    raise ValueError(
+                        f"version skew: blob built under "
+                        f"{header.get('fingerprint')!r}")
+                sha = hashlib.sha256(payload).hexdigest()
+                if header.get("sha256") != sha:
+                    raise ValueError("payload checksum mismatch "
+                                     "(truncated or corrupt blob)")
+                exe, reason = _compat.deserialize_compiled(payload)
+                if exe is None:
+                    raise ValueError(reason)
+        except Exception as e:
+            self.counters.bump("disk_misses")
+            self.counters.bump("deserialize_failures")
+            return None, (f"{type(e).__name__}: {e}"
+                          if not isinstance(e, ValueError) else str(e))
+        self.counters.bump("disk_hits")
+        self.counters.bump(
+            "deserialize_seconds",
+            self.timer.total("deserialize") - before)
+        self.counters.bump("read_bytes", len(raw))
+        return exe, None
+
+    # -- write -------------------------------------------------------------
+    def save(self, key, compiled) -> "str | None":
+        """Persist one compiled executable; returns ``None`` on success
+        or the degradation reason (counted ``serialize_failures``).
+        Write is single-writer atomic: serialize to a tempfile in the
+        store directory, then ``os.replace`` — two concurrent writers
+        of the same key both succeed and the later rename wins with a
+        complete blob (the two-writer race test holds this)."""
+        try:
+            key_str = canonical_key(key)
+        except ValueError as e:
+            self.counters.bump("serialize_failures")
+            return str(e)
+        payload, reason = _compat.serialize_compiled(compiled)
+        if payload is None:
+            self.counters.bump("serialize_failures")
+            return reason
+        header = json.dumps({
+            "schema": BLOB_SCHEMA, "version": BLOB_VERSION,
+            "key": key_str, "fingerprint": _env_fingerprint(),
+            "sha256": hashlib.sha256(payload).hexdigest(),
+            "payload_bytes": len(payload),
+        }, sort_keys=True).encode("utf-8")
+        path = self._path(key_str)
+        try:
+            fd, tmp = tempfile.mkstemp(prefix=".dhqrx-", dir=self.root)
+            try:
+                with os.fdopen(fd, "wb") as fh:
+                    fh.write(header + b"\n" + payload)
+                os.replace(tmp, path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                # dhqr: ignore[DHQR006] best-effort temp cleanup on the error path; the original failure is what gets reported
+                except OSError:
+                    pass
+                raise
+        except Exception as e:
+            # Disk full / permissions / read-only store: persistence is
+            # an optimization, the compile that produced `compiled`
+            # already succeeded — degrade with the reason.
+            self.counters.bump("serialize_failures")
+            return f"store write failed: {type(e).__name__}: {e}"
+        self.counters.bump("puts")
+        self.counters.bump("put_bytes", len(payload))
+        return None
+
+    # -- maintenance -------------------------------------------------------
+    def evict(self, key) -> bool:
+        """Delete ``key``'s disk blob (the EXPLICIT disk-side eviction;
+        the in-memory LRU dropping its handle never touches the disk
+        tier). True if a blob existed. Counted ``disk_evictions`` —
+        distinguishable from the cache's memory ``evictions``."""
+        try:
+            path = self._path(canonical_key(key))
+        except ValueError:
+            return False
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            return False
+        self.counters.bump("disk_evictions")
+        return True
+
+    def clear(self) -> int:
+        """Delete every blob in the store directory; returns the count
+        (tests and the deserialize-storm runbook's reset step)."""
+        n = 0
+        for name in os.listdir(self.root):
+            if not name.endswith(".dhqrx"):
+                continue
+            try:
+                os.unlink(os.path.join(self.root, name))
+                n += 1
+            except OSError:
+                continue  # dhqr: ignore[DHQR006] concurrent evict/clear: the blob is gone either way
+        if n:
+            self.counters.bump("disk_evictions", n)
+        return n
+
+    def keys(self) -> "list[str]":
+        """Canonical key spellings of every readable blob (sorted)."""
+        out = []
+        for name in sorted(os.listdir(self.root)):
+            if not name.endswith(".dhqrx"):
+                continue
+            try:
+                with open(os.path.join(self.root, name), "rb") as fh:
+                    header = json.loads(
+                        fh.readline().decode("utf-8"))
+                key = header.get("key")
+                if isinstance(key, str):
+                    out.append(key)
+            except (OSError, ValueError):
+                continue  # dhqr: ignore[DHQR006] a torn/foreign file lists as absent; load() is where corruption is counted
+        return sorted(out)
+
+    # -- accounting --------------------------------------------------------
+    def stats(self) -> dict:
+        """Counter snapshot + occupancy, JSON-ready (the fleet
+        benchmark artifact and the dry run embed this verbatim) —
+        identical to :meth:`metrics_snapshot` by construction."""
+        return self.metrics_snapshot()
+
+    def metrics_snapshot(self) -> dict:
+        """The registry-facing snapshot (``fleet.store.*``)."""
+        with self._lock:
+            snap = self.counters.snapshot()
+            try:
+                blobs = sum(1 for name in os.listdir(self.root)
+                            if name.endswith(".dhqrx"))
+            except OSError:
+                blobs = 0
+            return {
+                "blobs": blobs,
+                "disk_hits": int(snap.get("disk_hits", 0)),
+                "disk_misses": int(snap.get("disk_misses", 0)),
+                "deserialize_seconds": round(
+                    float(snap.get("deserialize_seconds", 0)), 4),
+                "deserialize_failures": int(
+                    snap.get("deserialize_failures", 0)),
+                "serialize_failures": int(
+                    snap.get("serialize_failures", 0)),
+                "puts": int(snap.get("puts", 0)),
+                "put_bytes": int(snap.get("put_bytes", 0)),
+                "read_bytes": int(snap.get("read_bytes", 0)),
+                "disk_evictions": int(snap.get("disk_evictions", 0)),
+            }
+
+
+# -- process-default store --------------------------------------------------
+# Lazy like the default cache: a malformed DHQR_FLEET_* must fail the
+# serve call that reads it, never `import dhqr_tpu`, and DHQR_FLEET_STORE
+# set programmatically before first use must take effect.
+_DEFAULT_STORE: "ExecutableStore | None" = None
+_DEFAULT_STORE_LOCK = threading.Lock()
+
+
+def default_store() -> "ExecutableStore | None":
+    """The process-default executable store, or None when
+    ``DHQR_FLEET_STORE`` is unset (the store-disabled path — exactly
+    the pre-round-22 per-process cache)."""
+    global _DEFAULT_STORE
+    if _DEFAULT_STORE is None:
+        with _DEFAULT_STORE_LOCK:
+            if _DEFAULT_STORE is None:
+                fcfg = FleetConfig.from_env()
+                if not fcfg.store_dir:
+                    return None
+                _DEFAULT_STORE = ExecutableStore(fcfg.store_dir)
+    return _DEFAULT_STORE
+
+
+def reset_default_store() -> None:
+    """Drop the cached process-default store (tests; or after changing
+    ``DHQR_FLEET_STORE``)."""
+    global _DEFAULT_STORE
+    with _DEFAULT_STORE_LOCK:
+        _DEFAULT_STORE = None
+
+
+# ---------------------------------------------------------------------------
+# Shared fleet state: quarantines + gate demotions + wire trips.
+
+# One warning per (path, reason) per process, like tune/db.py: a
+# serving loop polling a corrupt state file must not drown its logs.
+_WARNED: "set[tuple[str, str]]" = set()
+_WARN_LOCK = threading.Lock()
+
+
+def _warn_once(path: str, reason: str, detail: str) -> None:
+    with _WARN_LOCK:
+        if (path, reason) in _WARNED:
+            return
+        _WARNED.add((path, reason))
+    warnings.warn(
+        f"fleet state {path}: {detail} — continuing with this process's "
+        "own verdicts only (delete the file to rebuild)",
+        stacklevel=3,
+    )
+
+
+def export_fleet_state(cache=None, wall=time.time) -> dict:
+    """Snapshot this process's learned serving verdicts in the shared
+    JSON spelling: active compile quarantines (canonical key -> wall
+    clock expiry), plan numeric-gate failure counts (tune plan key ->
+    count) and armor wire-trip counts."""
+    from dhqr_tpu import armor as _armor
+    from dhqr_tpu.tune.search import plan_gate_stats
+
+    cache = default_cache() if cache is None else cache
+    return {
+        "quarantines": cache.export_quarantines(wall=wall),
+        "gate_failures": {
+            k: int(v) for k, v in
+            plan_gate_stats().get("failures", {}).items()},
+        "wire_trips": _armor.export_wire_trips(),
+    }
+
+
+def adopt_fleet_state(state: dict, cache=None, wall=time.time) -> None:
+    """Inherit another replica's verdicts: quarantines land in the
+    cache's adopted-cooldown map, gate failures and wire trips merge by
+    MAX into tune/armor (a count is monotone evidence — adopting can
+    only know MORE, never forget local strikes)."""
+    from dhqr_tpu import armor as _armor
+    from dhqr_tpu.tune.search import adopt_gate_failures
+
+    cache = default_cache() if cache is None else cache
+    cache.adopt_quarantines(state.get("quarantines") or {}, wall=wall)
+    adopt_gate_failures(state.get("gate_failures") or {})
+    _armor.adopt_wire_trips(state.get("wire_trips") or {})
+
+
+def _merge_state(disk: dict, ours: dict, wall_now: float) -> dict:
+    """Union two state snapshots: counts by MAX, quarantine expiries by
+    latest, expired quarantines pruned (the file must not grow without
+    bound under a long-lived fleet)."""
+    quarantines = {}
+    for src in (disk.get("quarantines") or {}, ours.get("quarantines")
+                or {}):
+        for key, expiry in src.items():
+            try:
+                expiry = float(expiry)
+            except (TypeError, ValueError):
+                continue
+            if expiry <= wall_now:
+                continue
+            quarantines[str(key)] = max(
+                quarantines.get(str(key), expiry), expiry)
+    out = {"quarantines": quarantines}
+    for section in ("gate_failures", "wire_trips"):
+        merged: "dict[str, int]" = {}
+        for src in (disk.get(section) or {}, ours.get(section) or {}):
+            for key, count in src.items():
+                try:
+                    count = int(count)
+                except (TypeError, ValueError):
+                    continue
+                merged[str(key)] = max(merged.get(str(key), 0), count)
+        out[section] = merged
+    return out
+
+
+def _load_state_file(path: str) -> dict:
+    """Tolerantly read one fleet-state file (corrupt/foreign/stale
+    degrades to empty with a one-time warning, like the plan DB)."""
+    empty = {"quarantines": {}, "gate_failures": {}, "wire_trips": {}}
+    if not os.path.exists(path):
+        return empty
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            raw = json.load(fh)
+    except (OSError, ValueError) as e:
+        _warn_once(path, "corrupt",
+                   f"unreadable ({type(e).__name__}: {e})")
+        return empty
+    if not isinstance(raw, dict) or raw.get("schema") != STATE_SCHEMA:
+        _warn_once(path, "schema",
+                   "not a dhqr fleet-state file (missing/foreign schema)")
+        return empty
+    if raw.get("version") != STATE_VERSION:
+        _warn_once(path, "version",
+                   f"schema version {raw.get('version')!r} != "
+                   f"{STATE_VERSION} (stale or future file)")
+        return empty
+    out = {}
+    for section in ("quarantines", "gate_failures", "wire_trips"):
+        val = raw.get(section)
+        out[section] = val if isinstance(val, dict) else {}
+    return out
+
+
+def save_fleet_state(path: "str | None" = None, cache=None,
+                     wall=time.time) -> str:
+    """Merge-write this process's verdicts to the shared state file
+    (last-write-wins under the same advisory-flock read-merge-replace
+    discipline as ``PlanDB.save`` — concurrent replicas UNION their
+    verdicts, and counts merge by MAX so nobody's strikes are lost)."""
+    from dhqr_tpu.tune.db import PlanDB
+
+    path = path or FleetConfig.from_env().state_path
+    if not path:
+        raise ValueError(
+            "no state path: pass save_fleet_state(path) or set "
+            "DHQR_FLEET_STATE")
+    ours = export_fleet_state(cache=cache, wall=wall)
+    directory = os.path.dirname(os.path.abspath(path))
+    os.makedirs(directory, exist_ok=True)
+    with PlanDB._file_lock(path):
+        merged = _merge_state(_load_state_file(path), ours, wall())
+        payload = {"schema": STATE_SCHEMA, "version": STATE_VERSION,
+                   **{k: dict(sorted(v.items()))
+                      for k, v in merged.items()}}
+        fd, tmp = tempfile.mkstemp(prefix=".dhqrfleet-", dir=directory)
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as fh:
+                json.dump(payload, fh, indent=1, sort_keys=True)
+                fh.write("\n")
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            # dhqr: ignore[DHQR006] best-effort temp cleanup on the error path; the original exception reraises below
+            except OSError:
+                pass
+            raise
+    return path
+
+
+def load_fleet_state(path: "str | None" = None, cache=None,
+                     wall=time.time) -> dict:
+    """Read the shared state file (tolerantly) and adopt its verdicts
+    into this process; returns the adopted snapshot. The warm-start
+    twin of :func:`save_fleet_state` — a new replica calls this (and
+    ``prewarm()``) before taking traffic."""
+    path = path or FleetConfig.from_env().state_path
+    if not path:
+        raise ValueError(
+            "no state path: pass load_fleet_state(path) or set "
+            "DHQR_FLEET_STATE")
+    state = _load_state_file(path)
+    adopt_fleet_state(state, cache=cache, wall=wall)
+    return state

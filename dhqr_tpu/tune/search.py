@@ -136,6 +136,24 @@ def plan_gate_stats() -> dict:
         }
 
 
+def adopt_gate_failures(failures: "dict[str, int]") -> None:
+    """Inherit another replica's numeric-gate failure counts (the
+    shared fleet state, round 22): per plan key, merge by MAX — a count
+    is monotone evidence against the key's stored plan, so adopting can
+    raise this process's count to the fleet's but never forget a local
+    strike. A key at/over :data:`PLAN_DEMOTE_AFTER` after adoption is
+    demoted on its next ``resolve_plan`` lookup exactly as if this
+    process had witnessed the failures itself."""
+    with _GATE_LOCK:
+        for key, count in failures.items():
+            try:
+                count = int(count)
+            except (TypeError, ValueError):
+                continue
+            if count > _GATE_FAILURES.get(str(key), 0):
+                _GATE_FAILURES[str(key)] = count
+
+
 def reset_gate_failures() -> None:
     """Clear the demotion state (tests; or after re-tuning a key)."""
     with _GATE_LOCK:

@@ -89,6 +89,55 @@ def executable_memory_analysis(compiled) -> "tuple[dict | None, str | None]":
     return out, None
 
 
+def serialize_compiled(compiled) -> "tuple[bytes | None, str | None]":
+    """``(blob, None)`` or ``(None, reason)`` for a compiled executable
+    serialized into one self-contained byte string.
+
+    The fleet store (``dhqr_tpu.serve.store``, round 22) persists serve
+    executables across processes with this; the jax surface is
+    ``jax.experimental.serialize_executable.serialize``, which returns
+    ``(payload, in_tree, out_tree)`` — the tree defs are needed to
+    rebuild the callable, so the blob pickles all three together.
+    Backends whose PJRT client cannot serialize (some plugins raise
+    UNIMPLEMENTED), executables that embed unpicklable callbacks, and
+    any future API move degrade to ``(None, reason)`` — NEVER an
+    exception: persistence is an optimization, and a store that cannot
+    serialize must cost exactly one reason string, not a compile."""
+    try:
+        import pickle
+
+        from jax.experimental.serialize_executable import serialize
+
+        payload, in_tree, out_tree = serialize(compiled)
+        return pickle.dumps((payload, in_tree, out_tree),
+                            protocol=pickle.HIGHEST_PROTOCOL), None
+    except Exception as e:
+        return None, f"serialize unsupported: {type(e).__name__}: {e}"
+
+
+def deserialize_compiled(blob: bytes) -> "tuple[object | None, str | None]":
+    """``(compiled, None)`` or ``(None, reason)`` for a blob produced by
+    :func:`serialize_compiled`, loaded onto THIS process's devices.
+
+    A truncated/corrupt blob, a version-skewed executable (jaxlib
+    refuses payloads from a different build), or a backend mismatch all
+    degrade to ``(None, reason)`` — the fleet store turns that into a
+    counted plain recompile, so a poisoned disk tier can never crash a
+    dispatch (the contract tests/test_fleet.py pins with a truncated
+    blob and the ``serve.store`` fault site)."""
+    try:
+        import pickle
+
+        from jax.experimental.serialize_executable import (
+            deserialize_and_load,
+        )
+
+        payload, in_tree, out_tree = pickle.loads(blob)
+        return deserialize_and_load(payload, in_tree, out_tree), None
+    except Exception as e:
+        return None, f"deserialize failed: {type(e).__name__}: {e}"
+
+
 def multiprocess_cpu_supported() -> bool:
     """Can THIS jaxlib run multi-process collectives on the CPU backend?
 

@@ -905,3 +905,75 @@ class ArmorConfig:
                 .lower() not in ("0", "false", "no", "off", "n", "")
         env.update(overrides)
         return ArmorConfig(**env)
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Knobs for fleet-scale serving (``dhqr_tpu.serve.store`` /
+    ``dhqr_tpu.serve.router``, round 22) — the cross-process tier. All
+    overridable from ``DHQR_FLEET_*`` environment variables; with no
+    ``store_dir`` configured the disk tier is absent and the serving
+    stack is byte-for-byte the per-process pre-round-22 system.
+
+    Attributes:
+      store_dir: directory of the persistent executable store
+        (``DHQR_FLEET_STORE``; None/unset = disabled). Every successful
+        serve compile is serialized there keyed by the canonical
+        cross-process CacheKey spelling, and a new replica's
+        ``prewarm()`` deserializes instead of compiling — zero
+        compiles on a warm fleet. The directory is shared between
+        replicas on one host (or a shared filesystem); writes are
+        single-writer atomic (tempfile + rename), so a torn blob is
+        impossible and a corrupt/version-skewed one degrades to a
+        counted recompile.
+      state_path: JSON file the learned serving verdicts are shared
+        through (``DHQR_FLEET_STATE``; None/unset = per-process
+        learning only): compile quarantines, plan numeric-gate failure
+        counts, and armor wire-trip counts, merged last-write-wins
+        exactly like the plan DB so replica N+1 inherits replica N's
+        verdicts instead of re-learning them against live traffic.
+      replicas: how many in-process scheduler replicas
+        ``serve.router.Router()`` builds when not handed schedulers
+        explicitly (``DHQR_FLEET_REPLICAS``).
+      failovers: how many times the router re-routes one accepted
+        request to a sibling replica after its replica died under it
+        (``DHQR_FLEET_FAILOVERS``). Exhausting the budget resolves the
+        future with the typed :class:`~dhqr_tpu.serve.errors.ReplicaLost`
+        — never a hang, never an untyped error.
+    """
+
+    store_dir: "str | None" = None
+    state_path: "str | None" = None
+    replicas: int = 2
+    failovers: int = 1
+
+    def __post_init__(self):
+        # expanduser like TuneConfig.db_path: an env-provided "~/x"
+        # must expand identically to a programmatic one.
+        if self.store_dir is not None:
+            object.__setattr__(self, "store_dir",
+                               os.path.expanduser(self.store_dir))
+        if self.state_path is not None:
+            object.__setattr__(self, "state_path",
+                               os.path.expanduser(self.state_path))
+        if self.replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {self.replicas}")
+        if self.failovers < 0:
+            raise ValueError(
+                f"failovers must be >= 0, got {self.failovers}")
+
+    @staticmethod
+    def from_env(**overrides) -> "FleetConfig":
+        """Build a fleet config from ``DHQR_FLEET_*`` variables +
+        overrides."""
+        env = {}
+        if "DHQR_FLEET_STORE" in os.environ:
+            env["store_dir"] = os.environ["DHQR_FLEET_STORE"] or None
+        if "DHQR_FLEET_STATE" in os.environ:
+            env["state_path"] = os.environ["DHQR_FLEET_STATE"] or None
+        if "DHQR_FLEET_REPLICAS" in os.environ:
+            env["replicas"] = int(os.environ["DHQR_FLEET_REPLICAS"])
+        if "DHQR_FLEET_FAILOVERS" in os.environ:
+            env["failovers"] = int(os.environ["DHQR_FLEET_FAILOVERS"])
+        env.update(overrides)
+        return FleetConfig(**env)

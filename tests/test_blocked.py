@@ -308,7 +308,7 @@ def test_split_pallas_through_engine(monkeypatch):
     (300, 256, 16),   # two-level scan path (16 panels)
     (64, 48, 48),     # single panel: lookahead degenerates to the default
 ])
-@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("dtype", [np.float64, pytest.param(np.complex128, marks=pytest.mark.slow)])
 def test_lookahead_matches_default(m, n, nb, dtype):
     """One-panel lookahead reorders the schedule, not the arithmetic: per
     column the panel transforms apply in the same sequence, so the result
@@ -413,7 +413,7 @@ def test_lookahead_factorization_checkpoints():
     (300, 256, 8, 4),   # exactly one group per super-block
     (300, 256, 16, 4),  # ppo=2 < k: falls back to the per-panel scan
 ])
-@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("dtype", [np.float64, pytest.param(np.complex128, marks=pytest.mark.slow)])
 def test_agg_panels_matches_default(m, n, nb, k, dtype):
     """Aggregated trailing updates apply the same product of panel
     transforms as the per-panel schedule — one aggregated compact-WY GEMM
@@ -571,3 +571,12 @@ def test_policy_error_ladder_1024_blocked():
         assert e1 <= 1e-5, (tprec, e1)
         # refinement must not make the solve worse (it converges on CPU)
         assert e1 <= 2.0 * e0, (tprec, e0, e1)
+# Round-22 tier-1 wall-clock triage (--durations=40 on this container,
+# docs/OPERATIONS.md "Tier-1 wall clock triage"): the complex128 twins
+# of the lookahead/agg SCHEDULE parity sweeps ride -m slow — the
+# schedule branches are dtype-generic (the shape/nb/k axes that select
+# program structure all stay tier-1 at float64), and complex blocked
+# arithmetic keeps tier-1 covers in test_scanned_panels_match_unblocked
+# [complex128-*] and test_split_pallas/complex engine tests. One-line
+# param swaps on purpose: mid-file line shifts would re-key the
+# persistent compile cache of every program traced below them.

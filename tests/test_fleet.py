@@ -1,0 +1,462 @@
+"""Fleet tier (round 22): disk executable store, shared serving state,
+and the replica router.
+
+Tier-1 budget note: ONE test here pays for subprocesses (the
+warm-start parity pair — the acceptance bar of the round is literally
+"process B compiles nothing", which only a second interpreter can
+prove); everything else runs in-process against tmp_path stores and
+manual-mode schedulers. The multi-replica chaos matrix is ``-m slow``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import dhqr_tpu
+from dhqr_tpu.serve.cache import CacheKey, ExecutableCache
+from dhqr_tpu.serve.errors import (
+    BackpressureError,
+    Quarantined,
+    ReplicaLost,
+    ServeError,
+)
+from dhqr_tpu.serve.router import Router
+from dhqr_tpu.serve.scheduler import AsyncScheduler
+from dhqr_tpu.serve.store import (
+    ExecutableStore,
+    canonical_key,
+    load_fleet_state,
+    save_fleet_state,
+)
+from dhqr_tpu.utils.config import FleetConfig, SchedulerConfig
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+KEY = CacheKey("lstsq", 2, 64, 32, "float32", 32, "highest", None, None,
+               0, "accurate", "loop")
+
+
+def _lower(mult=1.0):
+    """A cheap real lowering whose executable round-trips the store."""
+    return jax.jit(lambda x: (x * mult) @ x.T).lower(
+        jax.ShapeDtypeStruct((8, 8), jnp.float32))
+
+
+# ------------------------------------------------------- canonical spelling
+
+
+def test_canonical_key_spelling_is_pinned():
+    """The disk store's cross-process key string is part of the blob
+    format: changing it silently orphans every fleet's warm blobs, so
+    the exact spelling is pinned here (bump CANONICAL_VERSION to
+    migrate deliberately)."""
+    assert canonical_key(KEY) == (
+        "dhqr-exe-v1|lstsq|b2|64x32|float32|householder+nb32"
+        "|p=highest|a=-|r=0|norm=accurate|sk=-")
+    # The plan segment rides Plan.describe() — trailing precision and
+    # panel impl land in the one spelling the tune tier already pins.
+    tp = KEY._replace(trailing_precision="highest", panel_impl="recursive")
+    assert "|householder+nb32+recursive+tp-highest|" in canonical_key(tp)
+    # str and flat-tuple keys (the cache accepts them) spell too.
+    assert canonical_key("custom") == "dhqr-exe-v1|raw|custom"
+    assert canonical_key(("a", 1)) == "dhqr-exe-v1|tuple|'a'|1"
+    with pytest.raises(ValueError):
+        canonical_key(("nested", (1, 2)))
+
+
+def test_canonical_key_injective_over_field_changes():
+    """Every CacheKey field change must change the spelling — a
+    two-keys-one-string collision hands a warm-starting process the
+    WRONG executable (the atlas DHQR503 fleet probe audits the real
+    registry; this pins the per-field mechanics)."""
+    seen = {canonical_key(KEY)}
+    for variant in (
+        KEY._replace(kind="qr"),
+        KEY._replace(batch=4),
+        KEY._replace(m=128),
+        KEY._replace(dtype="float64"),
+        KEY._replace(block_size=16),
+        KEY._replace(precision="default"),
+        KEY._replace(trailing_precision="high"),
+        KEY._replace(apply_precision="highest"),
+        KEY._replace(refine=1),
+        KEY._replace(norm="fast"),
+        KEY._replace(panel_impl="recursive"),
+        KEY._replace(sketch=("srht", 128)),
+    ):
+        spelled = canonical_key(variant)
+        assert spelled not in seen, spelled
+        seen.add(spelled)
+
+
+# ------------------------------------------------------------- disk store
+
+
+def test_store_roundtrip_and_memory_evict_keeps_blob(tmp_path):
+    """The LRU memory tier and the disk tier evict INDEPENDENTLY: a
+    memory eviction never deletes the blob (a re-miss re-deserializes
+    instead of recompiling); only store.evict() touches disk."""
+    store = ExecutableStore(str(tmp_path))
+    cache = ExecutableCache(max_size=1, store=store)
+    k2 = KEY._replace(m=128)
+    x = np.ones((8, 8), np.float32)
+    ref = np.asarray(cache.get_or_compile(KEY, _lower)(x))
+    cache.get_or_compile(k2, lambda: _lower(2.0))  # evicts KEY from memory
+    st = store.stats()
+    assert st["blobs"] == 2 and st["puts"] == 2
+    assert cache.stats()["evictions"] == 1
+    # Re-miss on KEY: served from disk, not recompiled.
+    exe = cache.get_or_compile(KEY, _fail_lower)
+    assert np.array_equal(np.asarray(exe(x)), ref)
+    assert store.stats()["disk_hits"] == 1
+    # cache.clear() drops memory only; the blobs survive for siblings.
+    cache.clear()
+    assert store.stats()["blobs"] == 2
+    # Explicit disk eviction is its own counted act.
+    assert store.evict(KEY) is True
+    assert store.evict(KEY) is False
+    st = store.stats()
+    assert st["blobs"] == 1 and st["disk_evictions"] == 1
+
+
+def _fail_lower():
+    raise AssertionError("a disk hit must not reach the compiler")
+
+
+def test_deserialize_failure_degrades_to_recompile(tmp_path):
+    """A truncated/corrupt blob is a COUNTED recompile, never a typed
+    (or anonymous) dispatch failure — the store can make a miss
+    cheaper, never make one fail."""
+    store = ExecutableStore(str(tmp_path))
+    ExecutableCache(max_size=4, store=store).get_or_compile(KEY, _lower)
+    blob = tmp_path / os.listdir(tmp_path)[0]
+    blob.write_bytes(blob.read_bytes()[: 200])  # torn mid-payload
+    fresh = ExecutableCache(max_size=4, store=store)
+    exe = fresh.get_or_compile(KEY, _lower)
+    x = np.ones((8, 8), np.float32)
+    assert np.asarray(exe(x)).shape == (8, 8)
+    st = store.stats()
+    assert st["deserialize_failures"] == 1
+    assert st["disk_hits"] == 0
+    assert fresh.stats()["compile_seconds"] >= 0  # compiled, not raised
+    # And a header-level fake (foreign file) lists as absent, same path.
+    (tmp_path / "zz.dhqrx").write_bytes(b"not a header\njunk")
+    assert canonical_key(KEY) in store.keys()
+
+
+def test_store_injected_corruption_is_counted_not_typed(tmp_path):
+    """The closed-registry ``serve.store`` fault site models blob rot:
+    armed at p=1 every load degrades to a counted recompile."""
+    from dhqr_tpu import faults
+    from dhqr_tpu.utils.config import FaultConfig
+
+    store = ExecutableStore(str(tmp_path))
+    cache = ExecutableCache(max_size=4, store=store)
+    cache.get_or_compile(KEY, _lower)
+    fresh = ExecutableCache(max_size=4, store=store)
+    with faults.injected(FaultConfig(sites=(("serve.store", 1.0, None),))):
+        exe = fresh.get_or_compile(KEY, _lower)
+    assert np.asarray(exe(np.ones((8, 8), np.float32))).shape == (8, 8)
+    assert store.stats()["deserialize_failures"] == 1
+
+
+def test_two_writer_race_never_tears_a_blob(tmp_path):
+    """Two replicas compiling the same key concurrently write through
+    mkstemp + os.replace: whichever save lands last, the blob always
+    reads back whole."""
+    compiled = _lower().compile()
+    stores = [ExecutableStore(str(tmp_path)) for _ in range(2)]
+    errs = []
+
+    def hammer(store):
+        for _ in range(10):
+            reason = store.save(KEY, compiled)
+            if reason is not None:
+                errs.append(reason)
+
+    threads = [threading.Thread(target=hammer, args=(s,)) for s in stores]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    exe, reason = stores[0].load(KEY)
+    assert reason is None and exe is not None
+    x = np.ones((8, 8), np.float32)
+    assert np.array_equal(np.asarray(exe(x)), np.asarray(compiled(x)))
+
+
+# --------------------------------------------- cross-process warm start
+
+
+_CHILD = """
+import hashlib, json, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import dhqr_tpu
+from dhqr_tpu.serve.cache import default_cache
+from dhqr_tpu.serve.store import default_store
+
+rng = np.random.default_rng(7)
+A = jnp.asarray(rng.standard_normal((64, 32)), jnp.float32)
+b = jnp.asarray(rng.standard_normal((64,)), jnp.float32)
+x = dhqr_tpu.batched_lstsq([A], [b])[0]
+store = default_store()
+print(json.dumps({
+    "cache": default_cache().stats(),
+    "store": store.stats(),
+    "keys": store.keys(),
+    "digest": hashlib.sha256(np.asarray(x).tobytes()).hexdigest(),
+}))
+"""
+
+
+def test_warm_start_second_process_compiles_nothing(tmp_path):
+    """THE acceptance bar of the round: process A pays the compiles and
+    publishes blobs; process B, pointed at the same DHQR_FLEET_STORE,
+    serves the same traffic with ZERO compiles (puts == 0,
+    compile_seconds == 0) off disk hits alone — and returns
+    bit-identical bytes. The identical ``keys`` lists double as the
+    two-process canonical-spelling parity pin (satellite: _plan_key's
+    plan segment must spell deterministically across interpreters)."""
+    sys.path.insert(0, _REPO)
+    try:
+        from _axon_env import scrubbed_cpu_env
+    finally:
+        sys.path.pop(0)
+    env = scrubbed_cpu_env(1, DHQR_FLEET_STORE=str(tmp_path / "store"))
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD)
+    reports = []
+    for label in ("A", "B"):
+        proc = subprocess.run(
+            [sys.executable, str(script)], env=env, cwd=_REPO,
+            capture_output=True, text=True, timeout=240)
+        assert proc.returncode == 0, (
+            f"process {label} rc={proc.returncode}\n"
+            f"stdout:{proc.stdout[-2000:]}\nstderr:{proc.stderr[-2000:]}")
+        reports.append(json.loads(proc.stdout.strip().splitlines()[-1]))
+    a, b = reports
+    assert a["store"]["puts"] >= 1 and a["store"]["blobs"] >= 1
+    assert a["cache"]["compile_seconds"] > 0
+    # B: every executable came off disk — zero compiles, zero new blobs.
+    assert b["store"]["puts"] == 0, b["store"]
+    assert b["store"]["disk_hits"] == len(b["keys"]) >= 1, b["store"]
+    assert b["store"]["deserialize_failures"] == 0
+    assert b["cache"]["compile_seconds"] == 0, b["cache"]
+    # Cross-process parity: same canonical spellings, same result bytes.
+    assert a["keys"] == b["keys"]
+    assert a["digest"] == b["digest"]
+
+
+# ------------------------------------------------------ shared fleet state
+
+
+def test_fleet_state_inheritance_roundtrip(tmp_path):
+    """Replica N's verdicts — compile quarantines, plan gate-failure
+    demotion counts, armor wire trips — reach replica N+1 through the
+    shared state file, typed end to end (the adopted quarantine raises
+    Quarantined, not a recompile)."""
+    from dhqr_tpu import armor
+    from dhqr_tpu.tune import search as tune_search
+
+    path = str(tmp_path / "fleet.json")
+    cache_a = ExecutableCache(max_size=4, quarantine_s=60.0, store=None)
+
+    def boom():
+        raise RuntimeError("injected compile failure")
+
+    with pytest.raises(ServeError):
+        cache_a.get_or_compile(KEY, boom)
+    tune_search.reset_gate_failures()
+    armor.reset_wire_trips()
+    try:
+        tune_search.note_gate_failure("lstsq", 64, 32)
+        armor.note_wire_trip("lstsq", 64, 32, "float32", 4)
+        save_fleet_state(path, cache=cache_a)
+        # A fresh replica (fresh cache, reset process verdicts).
+        tune_search.reset_gate_failures()
+        armor.reset_wire_trips()
+        cache_b = ExecutableCache(max_size=4, store=None)
+        state = load_fleet_state(path, cache=cache_b)
+        assert canonical_key(KEY) in state["quarantines"]
+        with pytest.raises(Quarantined) as exc:
+            cache_b.get_or_compile(KEY, _lower)
+        assert exc.value.retry_after > 0
+        assert tune_search.plan_gate_stats()["failures"] == {
+            "cpu:lstsq:64x32:float32:p1:-": 1}
+        assert armor.export_wire_trips() == {"lstsq|64|32|float32|4": 1}
+        # Counts merge by MAX (monotone evidence), never sum.
+        save_fleet_state(path, cache=cache_b)
+        with open(path, encoding="utf-8") as fh:
+            on_disk = json.load(fh)
+        assert on_disk["gate_failures"] == {
+            "cpu:lstsq:64x32:float32:p1:-": 1}
+    finally:
+        tune_search.reset_gate_failures()
+        armor.reset_wire_trips()
+
+
+def test_fleet_state_corrupt_file_degrades_to_empty(tmp_path):
+    path = tmp_path / "fleet.json"
+    path.write_text("{ torn")
+    cache = ExecutableCache(max_size=4, store=None)
+    state = load_fleet_state(str(path), cache=cache)
+    assert state == {"quarantines": {}, "gate_failures": {},
+                     "wire_trips": {}}
+    # And saving over the corpse repairs it.
+    save_fleet_state(str(path), cache=cache)
+    with open(path, encoding="utf-8") as fh:
+        assert json.load(fh)["schema"] == "dhqr-fleet-state"
+
+
+# ------------------------------------------------------------ replica router
+
+
+def _manual_replicas(n, depth=1):
+    return [AsyncScheduler(sched_config=SchedulerConfig(queue_depth=depth),
+                           start=False) for _ in range(n)]
+
+
+def _problem():
+    rng = np.random.default_rng(3)
+    A = jnp.asarray(rng.standard_normal((64, 32)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((64,)), jnp.float32)
+    return A, b
+
+
+def test_router_wrr_spreads_and_composes_backpressure():
+    """Smooth-WRR spreads a tenant's stream evenly; a full replica is a
+    REROUTE, not a refusal; the fleet refuses only when every healthy
+    replica did, with the minimum priced retry hint."""
+    A, b = _problem()
+    reps = _manual_replicas(2, depth=2)
+    router = Router(replicas=reps, fleet=FleetConfig(replicas=2))
+    futs = [router.submit("lstsq", A, b, tenant="acme") for _ in range(4)]
+    assert [r.queue_depth() for r in reps] == [2, 2]
+    with pytest.raises(BackpressureError) as exc:
+        router.submit("lstsq", A, b, tenant="acme")
+    assert exc.value.retry_after > 0
+    snap = router.metrics_snapshot()
+    assert snap["rejected"] == 1 and snap["routed"] == 4
+    for rep in reps:
+        rep.drain()
+    for f in futs:
+        assert np.asarray(f.result(timeout=10)).shape == (32,)
+    router.shutdown()
+    with pytest.raises(RuntimeError):
+        router.submit("lstsq", A, b)
+
+
+def test_router_weighted_credits_skew_traffic():
+    A, b = _problem()
+    reps = _manual_replicas(2, depth=16)
+    router = Router(replicas=reps, weights=[3.0, 1.0],
+                    fleet=FleetConfig(replicas=2))
+    for _ in range(8):
+        router.submit("lstsq", A, b, tenant="t")
+    assert [r.queue_depth() for r in reps] == [6, 2]
+    router.shutdown(drain=False)
+
+
+def test_router_kill_fails_over_typed():
+    """Kill a replica with requests queued: every future the router
+    handed out resolves — a result off a sibling (counted failover) or
+    ReplicaLost — never an anonymous CancelledError, never a hang."""
+    A, b = _problem()
+    router = Router(replicas=2, fleet=FleetConfig(replicas=2, failovers=1),
+                    workers=1)
+    futs = [router.submit("lstsq", A, b, deadline=30.0) for _ in range(6)]
+    router.kill(0)
+    ok = lost = 0
+    for f in futs:
+        try:
+            assert np.asarray(f.result(timeout=30)).shape == (32,)
+            ok += 1
+        except ReplicaLost as e:
+            assert e.attempts >= 1
+            lost += 1
+    assert ok + lost == 6 and ok >= 1
+    snap = router.metrics_snapshot()
+    assert snap["replicas_healthy"] == 1
+    assert snap["replica_kills"] == 1
+    # The survivor keeps serving — monotone degradation, not collapse.
+    assert np.asarray(
+        router.submit("lstsq", A, b).result(timeout=30)).shape == (32,)
+    router.shutdown()
+
+
+def test_router_no_healthy_replica_is_typed():
+    A, b = _problem()
+    router = Router(replicas=_manual_replicas(2),
+                    fleet=FleetConfig(replicas=2))
+    router.kill(0)
+    router.kill(1)
+    with pytest.raises(ReplicaLost):
+        router.submit("lstsq", A, b)
+
+
+def test_router_update_sessions_stick_to_one_replica():
+    """UpdatableQR ops are serialized per-session inside one scheduler;
+    the router must never spread one session across two."""
+    rng = np.random.default_rng(5)
+    A = jnp.asarray(rng.standard_normal((16, 4)), jnp.float32)
+    session = dhqr_tpu.UpdatableQR(A)
+    reps = _manual_replicas(2, depth=16)
+    router = Router(replicas=reps, fleet=FleetConfig(replicas=2))
+    u = jnp.asarray(rng.standard_normal(16), jnp.float32)
+    v = jnp.asarray(rng.standard_normal(4), jnp.float32)
+    for _ in range(4):
+        router.submit("update", session, ("update", u, v))
+    depths = [r.queue_depth() for r in reps]
+    assert sorted(depths) == [0, 4], depths
+    router.shutdown(drain=False)
+
+
+@pytest.mark.slow
+def test_fleet_chaos_matrix_kill_replicas_mid_stream():
+    """Fleet-level chaos bar: kill replicas one by one under a live
+    request stream; every accepted future resolves typed, survivors
+    keep serving after each kill, and the router never hands back an
+    anonymous cancellation."""
+    A, b = _problem()
+    x_ref = np.asarray(dhqr_tpu.batched_lstsq([A], [b])[0])
+    router = Router(replicas=3, fleet=FleetConfig(replicas=3, failovers=2),
+                    workers=1)
+    outcomes = {"ok": 0, "lost": 0, "typed": 0}
+    futs = []
+    for wave, kill in ((0, None), (1, 0), (2, 1)):
+        futs.extend(router.submit("lstsq", A, b, deadline=60.0)
+                    for _ in range(10))
+        if kill is not None:
+            router.kill(kill)
+        # Survivors must still accept and serve new work post-kill.
+        assert np.allclose(
+            np.asarray(router.submit("lstsq", A, b,
+                                     deadline=60.0).result(timeout=60)),
+            x_ref, atol=1e-4)
+    for f in futs:
+        try:
+            x = f.result(timeout=60)
+            assert np.allclose(np.asarray(x), x_ref, atol=1e-4)
+            outcomes["ok"] += 1
+        except ReplicaLost:
+            outcomes["lost"] += 1
+        except ServeError:
+            outcomes["typed"] += 1
+        # Anything else (CancelledError, raw RuntimeError) fails the test.
+    assert sum(outcomes.values()) == 30, outcomes
+    assert outcomes["ok"] >= 10, outcomes
+    snap = router.metrics_snapshot()
+    assert snap["replicas_healthy"] == 1
+    router.shutdown()

@@ -432,6 +432,12 @@ def test_serve_pairs_comms_block_into_xray_report(monkeypatch):
         assert ps.stats()["captures"] == 1
 
 
+@pytest.mark.slow  # 71 s on the round-22 container (--durations=40,
+# tier-1 wall-clock triage): this is the SAME run_pulse_smoke() gate
+# that `python -m dhqr_tpu.analysis check` and tools/lint.sh execute
+# on every PR — tier-1 was paying the profiler-traced dispatch twice
+# per run. The lint gate keeps DHQR402 enforced; -m slow keeps the
+# pytest spelling for hardware windows.
 def test_pulse_smoke_is_green():
     """DHQR402 (the lint-gate smoke) must be clean on this topology —
     the same gate `analysis check .` and tools/lint.sh run."""

@@ -25,7 +25,7 @@ from dhqr_tpu.utils.testing import (
 )
 
 
-@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("dtype", [np.float64, pytest.param(np.complex128, marks=pytest.mark.slow)])
 @pytest.mark.parametrize("shape", [(96, 64), (100, 63), (40, 40)])
 def test_recursive_matches_loop_panel(dtype, shape):
     A, _ = random_problem(*shape, dtype, seed=61)
@@ -301,3 +301,12 @@ class TestReconstructPanel:
         for bad in ("reconstruct:", "reconstruct:-8", "reconstruct:abc"):
             with pytest.raises(ValueError, match="malformed"):
                 _reconstruct_chunk(bad)
+# Round-22 tier-1 wall-clock triage (--durations=40 on this container,
+# docs/OPERATIONS.md "Tier-1 wall clock triage"): the complex128 twins
+# of the recursive-vs-loop panel parity sweep ride -m slow — the
+# recursion structure is dtype-generic and all three shape branches
+# (even, ragged, square) stay tier-1 at float64; complex recursive
+# coverage keeps a tier-1 cover in TestReconstructPanel and the
+# complex blocked-engine tests. One-line param swap on purpose:
+# mid-file line shifts would re-key the persistent compile cache of
+# programs traced below.
