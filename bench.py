@@ -903,6 +903,15 @@ _TPU_STAGES = [
     # persistent cache (the seeds ARE the measured optima), so its
     # marginal window cost is one warm compile.
     dict(n=N, pallas=True, watchdog=300, chain=25, plan="auto"),
+    # Pipeline stage (round 23, dhqr-pipeline): depth-k double-buffered
+    # panel broadcast vs its one-panel-lookahead control, on a column
+    # mesh over every visible chip. overlap_depth is mesh-only, so this
+    # row routes through sharded_blocked_qr (not _blocked_qr_impl) via
+    # the dedicated handler in main()'s stage loop; a single-chip host
+    # emits a loud ::stage_skipped line instead of silently passing,
+    # and the prewarm child skips it (the mesh programs compile at the
+    # stage's own watchdog, not in the single-device cache).
+    dict(n=N, watchdog=420, overlap=2, repeats=2),
 ]
 
 
@@ -978,6 +987,10 @@ def _prewarm() -> None:
 
     done, last_pair, last_n = [], 30.0, 512
     for st in _TPU_STAGES:
+        if "overlap" in st:
+            # The sharded pipeline stage compiles mesh programs its own
+            # handler owns — there is no single-device twin to prewarm.
+            continue
         n_ = st["n"]
         st_nb, st_panel = st.get("nb"), st.get("panel", "loop")
         st_la, st_agg, st_tp = (st.get("lookahead"), st.get("agg"),
@@ -1479,6 +1492,66 @@ def main() -> None:
             print(f"::stage_failed {name} {type(e).__name__}: {e}",
                   file=sys.stderr, flush=True)
 
+    def sharded_overlap_stage(n_, overlap=2, watchdog=420, repeats=REPEATS):
+        """The round-23 pipeline stage: time the depth-``overlap``
+        double-buffered panel broadcast against its one-panel-lookahead
+        control on a column mesh over every visible chip, and emit one
+        JSON row carrying both times. overlap_depth is mesh-only, so a
+        single-chip host SKIPS loudly (::stage_skipped on stderr) —
+        a silent pass would read as 'measured, no difference'."""
+        name = f"qr_sharded_overlap{overlap}_{n_}"
+        _stage(name)
+        ndev = jax.device_count()
+        if ndev < 2:
+            print(f"::stage_skipped {name} needs >= 2 devices for the "
+                  f"depth-{overlap} pipeline (overlap_depth is mesh-only; "
+                  f"have {ndev})", file=sys.stderr, flush=True)
+            return None
+        if out_of_budget(name, watchdog):
+            return None
+        try:
+            with _Watchdog(name, watchdog):
+                from dhqr_tpu.parallel.mesh import column_mesh
+                from dhqr_tpu.parallel.sharded_qr import sharded_blocked_qr
+
+                mesh = column_mesh(ndev)
+                A = jnp.asarray(rng.random((n_, n_)), dtype=jnp.float32)
+                sync(A)
+                row = {
+                    "metric": f"qr_sharded_overlap{overlap}_{n_}x{n_}",
+                    "unit": "GFLOP/s", "platform": platform,
+                    "device_kind": device_kind, "devices": ndev,
+                    "overlap_depth": overlap, "block_size": BLOCK,
+                    "comparison_only": True, "stage": name,
+                }
+                flops = (4.0 / 3.0) * n_**3
+                for tag, depth in (("lookahead", None), ("pipeline", overlap)):
+                    fn = jax.jit(lambda A, d=depth: sharded_blocked_qr(
+                        A, mesh, block_size=BLOCK, lookahead=True,
+                        overlap_depth=d))
+                    t0 = time.perf_counter()
+                    H, alpha = fn(A)
+                    sync(alpha)
+                    row[f"compile_seconds_{tag}"] = round(
+                        time.perf_counter() - t0, 2)
+                    ts = []
+                    for _ in range(repeats):
+                        t0 = time.perf_counter()
+                        H, alpha = fn(A)
+                        sync(alpha)
+                        ts.append(time.perf_counter() - t0)
+                    row[f"seconds_{tag}"] = round(min(ts), 4)
+                row["value"] = round(
+                    flops / row["seconds_pipeline"] / 1e9, 2)
+                row["pipeline_speedup_vs_lookahead"] = round(
+                    row["seconds_lookahead"] / row["seconds_pipeline"], 4)
+                _emit(row)
+                return row
+        except Exception as e:
+            print(f"::stage_failed {name} {type(e).__name__}: {e}",
+                  file=sys.stderr, flush=True)
+            return None
+
     if platform != "tpu" and not os.environ.get("DHQR_BENCH_FORCE_STAGED"):
         # CPU (scrubbed-env fallback): one direct measurement at full size —
         # the escalation exists to survive the fragile relay, which isn't a
@@ -1570,6 +1643,12 @@ def main() -> None:
     # per-stage reasoning.
     for st in _TPU_STAGES:
         st = dict(st)
+        if "overlap" in st:
+            # Round 23: the sharded pipeline stage has its own handler —
+            # it never competes for the headline (comparison_only), so
+            # it bypasses run_stage's best-record re-emission.
+            sharded_overlap_stage(st.pop("n"), **st)
+            continue
         run_stage(st.pop("n"), **st)
     if not results:
         return

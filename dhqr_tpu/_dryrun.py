@@ -75,6 +75,76 @@ def run(n_devices: int) -> None:
     assert bool(jnp.all(jnp.isfinite(x))), "non-finite x (agg+lookahead)"
     print("dryrun: sharded_lstsq agg_panels=2 lookahead ok", flush=True)
 
+    # Depth-k pipelined schedule / dhqr-pipeline (round 23): the
+    # double-buffered panel ring must (a) compile and run through the
+    # whole distributed solve, (b) launch exactly the same collective
+    # census as the one-panel lookahead it generalizes, (c) return
+    # BIT-IDENTICAL factors to the lookahead schedule, (d) issue panel
+    # q+2's broadcast psum before panel q's wide trailing GEMM in the
+    # TRACED program order (audited on an unrolled-tier shape — scan
+    # bodies are traced once, so the order walk needs every panel
+    # spelled out), and (e) compile each depth exactly once — a warm
+    # repeat rebuilds nothing.
+    if n_devices >= 2:
+        from dhqr_tpu.analysis.comms_pass import (
+            collect_comms,
+            overlap_distance,
+        )
+        from dhqr_tpu.parallel.sharded_qr import (
+            _build_blocked as _pipe_builds,
+        )
+        from dhqr_tpu.parallel.sharded_qr import (
+            sharded_blocked_qr as _pipe_qr,
+        )
+
+        x = sharded_lstsq(A, b, cmesh, block_size=block_size,
+                          layout="cyclic", lookahead=True, overlap_depth=2)
+        assert x.shape == (n,)
+        assert bool(jnp.all(jnp.isfinite(x))), "non-finite x (pipeline)"
+
+        def _pipe_trace(depth):
+            return jax.make_jaxpr(
+                lambda A_: _pipe_qr(A_, cmesh, block_size=block_size,
+                                    lookahead=True,
+                                    overlap_depth=depth))(A)
+
+        la_launch = collect_comms(_pipe_trace(None)).launches()
+        p2_launch = collect_comms(_pipe_trace(2)).launches()
+        assert la_launch == p2_launch, (
+            "depth-2 ring changed the collective census",
+            la_launch, p2_launch)
+        # Order audit on a guaranteed-unrolled shape (6 panels <=
+        # MAX_UNROLLED_PANELS) over a 2-device sub-mesh.
+        mesh2 = column_mesh(2)
+        A_aud = jnp.asarray(rng.random((48, 24)), jnp.float32)
+        dist = overlap_distance(jax.make_jaxpr(
+            lambda A_: _pipe_qr(A_, mesh2, block_size=block_size,
+                                lookahead=True,
+                                overlap_depth=2))(A_aud), block_size)
+        assert dist is not None and dist >= 2, (
+            "traced program order does not hide >= 2 panels", dist)
+        Hl, al = _pipe_qr(A, cmesh, block_size=block_size, lookahead=True)
+        Hp, ap = _pipe_qr(A, cmesh, block_size=block_size, lookahead=True,
+                          overlap_depth=2)
+        assert bool(jnp.all(Hl == Hp)) and bool(jnp.all(al == ap)), (
+            "depth-2 pipeline is not bit-identical to lookahead")
+        n_built = _pipe_builds.cache_info().currsize
+        Hp2, _ = _pipe_qr(A, cmesh, block_size=block_size, lookahead=True,
+                          overlap_depth=2)
+        jax.block_until_ready(Hp2)
+        assert _pipe_builds.cache_info().currsize == n_built, (
+            "warm depth-2 repeat rebuilt its program",
+            _pipe_builds.cache_info())
+        print(f"dryrun: pipeline ok (overlap distance {dist} panels at "
+              "depth 2, census identical to lookahead, bit-identical "
+              "factors, warm repeat 0 rebuilds)", flush=True)
+    else:
+        print("dryrun: pipeline SKIPPED (needs >= 2 devices: "
+              "overlap_depth is mesh-only and a 1-device mesh has no "
+              "broadcast latency to hide — rerun with "
+              "XLA_FLAGS=--xla_force_host_platform_device_count=8)",
+              flush=True)
+
     # Awkward n (not divisible by the mesh): the internal orthogonal-
     # extension padding must compile and run on the mesh too.
     n_awk = n - 3

@@ -241,6 +241,72 @@ def collect_comms(closed_jaxpr) -> CommsStats:
     return stats
 
 
+def issue_order(closed_jaxpr, nb: int) -> "list[str]":
+    """Program-order event stream of one traced engine: ``"psum"`` per
+    collective launch and ``"wide_dot"`` per trailing-update GEMM
+    (a ``dot_general`` whose output is wider than the panel width
+    ``nb`` — panel-interior and narrow lookahead-apply dots are at most
+    ``nb`` columns wide by construction). Sub-jaxprs (pjit/shard_map
+    bodies, custom-vjp calls) are inlined at their call site, so the
+    stream reflects the order XLA receives the operations in — the
+    round-23 pipeline property ("panel q+k's broadcast issues before
+    panel q's trailing GEMM") is a statement about exactly this
+    stream. ``scan`` bodies contribute one iteration's events (the
+    walk does not unroll trip counts), so order audits should trace
+    shapes the engine unrolls."""
+    events: "list[str]" = []
+
+    def walk(jaxpr):
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            if prim in COMMS_COLLECTIVES:
+                events.append("psum" if prim == "psum" else prim)
+            elif prim == "dot_general":
+                out_aval = getattr(eqn.outvars[0], "aval", None)
+                shape = getattr(out_aval, "shape", ())
+                if shape and int(shape[-1]) > nb:
+                    events.append("wide_dot")
+            for val in eqn.params.values():
+                for j in sub_jaxprs(val):
+                    walk(j)
+
+    walk(closed_jaxpr.jaxpr)
+    return events
+
+
+def overlap_distance(closed_jaxpr, nb: int) -> "int | None":
+    """Measured broadcast-ahead distance of a traced blocked-QR
+    schedule: for the j-th trailing-update GEMM, count the panel
+    broadcasts (psum PAIRS — the factor launches two one-hot psums per
+    panel) already issued before it; the panel being trailed is panel
+    j, so ``pairs_before - (j + 1)`` is how many panels PAST it were
+    already broadcast. The minimum over all identifiable trailing GEMMs
+    is the schedule's guaranteed overlap depth: 0 for the classic
+    blocking schedule, 1 for the one-panel lookahead, k for the
+    round-23 depth-k pipeline. None when the trace exposes no trailing
+    GEMM wider than ``nb`` (shape too narrow to audit)."""
+    events = issue_order(closed_jaxpr, nb)
+    psums = 0
+    dist = None
+    j = 0
+    in_group = False
+    for ev in events:
+        if ev == "psum":
+            psums += 1
+            in_group = False
+        elif ev == "wide_dot":
+            # One trailing update lowers to several consecutive wide
+            # dots (W^H C, T @ _, W @ _) with no collective between
+            # them — coalesce the run and date the group by its first
+            # dot (the earliest the GEMM could issue).
+            if not in_group:
+                d = psums // 2 - (j + 1)
+                dist = d if dist is None else min(dist, d)
+                j += 1
+            in_group = True
+    return dist
+
+
 # ---------------------------------------------------------------------------
 # Contracts
 
@@ -520,7 +586,7 @@ def _comms_builders(P: int, preset: str, pol):
         return lambda: jax.make_jaxpr(fn)(*args)
 
     def blocked(layout=None, lookahead=False, agg_panels=None,
-                comms=None, pod_mesh=False):
+                comms=None, pod_mesh=False, overlap_depth=None):
         kw = {}
         if layout:
             kw["layout"] = layout
@@ -528,6 +594,12 @@ def _comms_builders(P: int, preset: str, pol):
             kw["lookahead"] = True
         if agg_panels:
             kw["agg_panels"] = agg_panels
+        if overlap_depth:
+            # Round 23 (dhqr-pipeline): the engine clamps the depth to
+            # num_panels - 1 at the trace shape, so the pipeline4 route
+            # traces the deepest ring the shape admits — exactly what a
+            # caller passing the same depth would run.
+            kw["overlap_depth"] = overlap_depth
         if pod_mesh:
             pmesh, taxes = pod()
             return jx(lambda A: sharded_blocked_qr(

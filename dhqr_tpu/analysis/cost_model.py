@@ -33,7 +33,13 @@ def unblocked_qr_words(m: int, n: int, nb: int, P: int, nrhs: int = 1) -> int:
 def blocked_qr_words(m: int, n: int, nb: int, P: int, nrhs: int = 1) -> int:
     """One psum per nb-wide panel of the shrinking (m-k, nb) factored
     panel plus its nb-word alpha block (sharded_qr._blocked_shard_body,
-    unrolled schedule)."""
+    unrolled schedule). The round-23 depth-k pipeline keeps this SAME
+    budget: launch count is unchanged (two one-hot psums per panel) and
+    the only volume delta is the delayed trailing update's frame — each
+    pf psum ships up to ``depth * nb`` extra rows of already-finished R
+    (the lookahead schedule already ships ``nb``), which the pipeline
+    contracts' slack absorbs rather than a new model pricing in
+    (analysis/comms_contracts.json, 'blocked_qr_pipeline*')."""
     return sum((m - k) * nb + nb for k in range(0, n, nb))
 
 

@@ -50,6 +50,31 @@ def _check_sched_knobs(cfg: DHQRConfig, mesh=None) -> None:
             "the pair is the grouped-lookahead composition — pass mesh= "
             "(see parallel/sharded_qr._blocked_shard_agg)"
         )
+    if cfg.overlap_depth is not None:
+        if cfg.overlap_depth < 1:
+            raise ValueError(
+                f"overlap_depth must be >= 1 (got {cfg.overlap_depth}); "
+                "None means the default schedule"
+            )
+        if not cfg.lookahead:
+            raise ValueError(
+                "overlap_depth generalizes the lookahead order and "
+                "requires lookahead=True (depth 1 IS the one-panel "
+                "lookahead)"
+            )
+        if cfg.agg_panels:
+            raise ValueError(
+                "overlap_depth and agg_panels are mutually exclusive "
+                "(the grouped-lookahead composition already overlaps "
+                "one full group per collective)"
+            )
+        if mesh is None:
+            raise ValueError(
+                "overlap_depth is mesh-only: a deeper pipeline exists "
+                "to keep panel-broadcast collectives in flight, and a "
+                "single device has no collective to hide — pass mesh= "
+                "(see parallel/sharded_qr._blocked_shard_pipeline)"
+            )
 
 
 def _resolve_policy_cfg(cfg: DHQRConfig):
@@ -157,7 +182,7 @@ def _resolve_plan_cfg(cfg: DHQRConfig, kind: str, shape, dtype, mesh,
     # choice while asking for a tuned plan would apply knobs to a
     # program family the tuner never timed — refuse loudly instead.
     for knob in ("engine", "block_size", "panel_impl", "lookahead",
-                 "agg_panels", "use_pallas"):
+                 "agg_panels", "overlap_depth", "use_pallas"):
         if getattr(cfg, knob) != getattr(defaults, knob):
             raise ValueError(
                 f"pass either plan= or {knob}=, not both (a plan names "
@@ -521,11 +546,12 @@ def qr(
                 use_pallas=cfg.use_pallas, panel_impl=cfg.panel_impl,
                 trailing_precision=cfg.trailing_precision,
                 lookahead=cfg.lookahead, agg_panels=cfg.agg_panels,
-                comms=cfg.comms,
+                overlap_depth=cfg.overlap_depth, comms=cfg.comms,
             )
         else:
             _reject_nonblocked_knobs(cfg.use_pallas, cfg.trailing_precision,
-                                     cfg.lookahead, cfg.agg_panels)
+                                     cfg.lookahead, cfg.agg_panels,
+                                     cfg.overlap_depth)
             H, alpha = _sharded.sharded_householder_qr(
                 A, mesh, axis_name=col_axis, precision=cfg.precision,
                 layout=cfg.layout, norm=cfg.norm, comms=cfg.comms,
@@ -547,7 +573,8 @@ def qr(
         if donate:
             raise ValueError("donate=True is only supported on the blocked path")
         _reject_nonblocked_knobs(cfg.use_pallas, cfg.trailing_precision,
-                                 cfg.lookahead, cfg.agg_panels)
+                                 cfg.lookahead, cfg.agg_panels,
+                                 cfg.overlap_depth)
         H, alpha = _hh.householder_qr(A, precision=cfg.precision, norm=cfg.norm)
     return QRFactorization(
         H, alpha, block_size=cfg.block_size, precision=apply_prec,
@@ -582,7 +609,8 @@ def qr_explicit(
 def _reject_nonblocked_knobs(use_pallas: str,
                              trailing_precision: "str | None",
                              lookahead: bool = False,
-                             agg_panels: "int | None" = None) -> None:
+                             agg_panels: "int | None" = None,
+                             overlap_depth: "int | None" = None) -> None:
     """Refuse blocked-only knobs on an unblocked path — one place, so a
     future blocked-only knob (or message tweak) cannot silently drift
     between the qr/lstsq tiers (code-review r4)."""
@@ -605,6 +633,11 @@ def _reject_nonblocked_knobs(use_pallas: str,
         raise ValueError(
             "agg_panels applies to the blocked engines only (the unblocked "
             "panel loop has no panel-level updates to aggregate)"
+        )
+    if overlap_depth:
+        raise ValueError(
+            "overlap_depth applies to the blocked engines only (the "
+            "unblocked panel loop has no panel-level schedule to pipeline)"
         )
 
 
@@ -643,6 +676,11 @@ def _validate_alt_engine_cfg(cfg: DHQRConfig) -> None:
         raise ValueError(
             "agg_panels applies to the blocked householder engines only "
             f"(engine={cfg.engine!r})"
+        )
+    if cfg.overlap_depth:
+        raise ValueError(
+            "overlap_depth applies to the blocked householder engines "
+            f"only (engine={cfg.engine!r})"
         )
 
 
@@ -689,10 +727,10 @@ def _lstsq_sketch(A, b, cfg: DHQRConfig, mesh):
             "panel_impl applies to the blocked householder engines "
             f"(engine='sketch', panel_impl={cfg.panel_impl!r})"
         )
-    if cfg.lookahead or cfg.agg_panels:
+    if cfg.lookahead or cfg.agg_panels or cfg.overlap_depth:
         raise ValueError(
-            "lookahead/agg_panels apply to the blocked householder "
-            "engines only (engine='sketch')"
+            "lookahead/agg_panels/overlap_depth apply to the blocked "
+            "householder engines only (engine='sketch')"
         )
     if not cfg.blocked:
         raise ValueError(
@@ -1087,12 +1125,14 @@ def lstsq(
             )
         if not cfg.blocked or cfg.use_pallas != "auto" \
                 or cfg.trailing_precision is not None or cfg.lookahead \
-                or cfg.agg_panels or cfg.apply_precision is not None:
+                or cfg.agg_panels or cfg.overlap_depth \
+                or cfg.apply_precision is not None:
             raise ValueError(
                 "m < n supports only the default blocked XLA path "
                 f"(got blocked={cfg.blocked}, use_pallas={cfg.use_pallas!r}, "
                 f"trailing_precision={cfg.trailing_precision!r}, "
                 f"lookahead={cfg.lookahead}, agg_panels={cfg.agg_panels}, "
+                f"overlap_depth={cfg.overlap_depth}, "
                 f"apply_precision={cfg.apply_precision!r})"
             )
         if cfg.refine:
@@ -1133,7 +1173,8 @@ def lstsq(
         col_axis = cfg.mesh_axis or DEFAULT_AXIS
         if not cfg.blocked:
             _reject_nonblocked_knobs(cfg.use_pallas, cfg.trailing_precision,
-                                     cfg.lookahead, cfg.agg_panels)
+                                     cfg.lookahead, cfg.agg_panels,
+                                     cfg.overlap_depth)
             from dhqr_tpu.parallel import topology as _topo
 
             m, n = A.shape
@@ -1167,6 +1208,7 @@ def lstsq(
             use_pallas=cfg.use_pallas, panel_impl=cfg.panel_impl,
             trailing_precision=cfg.trailing_precision,
             lookahead=cfg.lookahead, agg_panels=cfg.agg_panels,
+            overlap_depth=cfg.overlap_depth,
             apply_precision=cfg.apply_precision, comms=cfg.comms,
         )
     with _blocked._pallas_cache_guard(_lstsq_interp(A, cfg)):

@@ -813,6 +813,7 @@ def blocked_householder_qr(
     trailing_precision: "str | None" = None,
     lookahead: bool = False,
     agg_panels: "int | None" = None,
+    overlap_depth: "int | None" = None,
     policy=None,
 ):
     """Factor ``A`` (m x n, m >= n): returns ``(H, alpha)`` in packed storage.
@@ -886,6 +887,13 @@ def blocked_householder_qr(
             "single-device engine (both only add flops here); the mesh "
             "tier composes them as grouped lookahead — use qr()/lstsq() "
             "with mesh= (parallel/sharded_qr._blocked_shard_agg)"
+        )
+    if overlap_depth is not None:
+        raise ValueError(
+            "overlap_depth is mesh-only: the depth-k pipeline exists to "
+            "keep panel-broadcast collectives in flight, and a single "
+            "device has no collective to hide — use qr()/lstsq() with "
+            "mesh= (parallel/sharded_qr._blocked_shard_pipeline)"
         )
     # (complex + panel_impl='reconstruct' is rejected at the _panel_factor
     # chokepoint — every XLA-path route converges there, and the Pallas

@@ -188,7 +188,8 @@ def _blocked_shard_body(
     norm: str = "accurate", pallas: bool = False, pallas_interpret: bool = False,
     panel_impl: str = "loop", pallas_flat: "int | None" = None,
     trailing_precision: "str | None" = None, lookahead: bool = False,
-    agg_panels: "int | None" = None, comms: "str | None" = None,
+    agg_panels: "int | None" = None, overlap_depth: "int | None" = None,
+    comms: "str | None" = None,
 ):
     """Per-device body for the compact-WY engine.
 
@@ -242,6 +243,15 @@ def _blocked_shard_body(
             layout=layout, factor=_factor, done_cols=_done_cols, tprec=tprec,
             gidx_base=gidx_base, p=p, nproc=nproc, lookahead=lookahead,
             comms=comms,
+        )
+
+    if (lookahead and overlap_depth and num_panels > 1
+            and min(overlap_depth, num_panels - 1) > 1):
+        return _blocked_shard_pipeline(
+            Al, n=n, nb=nb, depth=min(overlap_depth, num_panels - 1),
+            axis=axis, precision=precision, layout=layout, factor=_factor,
+            psum_owner=_psum_owner, done_cols=_done_cols, tprec=tprec,
+            gidx_base=gidx_base, p=p, nproc=nproc,
         )
 
     if lookahead and num_panels > 1:
@@ -462,6 +472,181 @@ def _blocked_shard_lookahead(
         if pcount > 1:
             alpha = alpha.at[K + nb : K + pcount * nb].set(
                 a_rest.reshape((pcount - 1) * nb))
+    return Al, alpha
+
+
+def _blocked_shard_pipeline(
+    Al, *, n, nb, depth, axis, precision, layout, factor, psum_owner,
+    done_cols, tprec, gidx_base, p, nproc,
+):
+    """Depth-``depth`` pipelined panel-broadcast order (dhqr-pipeline).
+
+    Generalizes :func:`_blocked_shard_lookahead` — exactly the ``depth=1``
+    member of this family — to a double-buffered ring of up to ``depth``
+    factored pending panels: panel q's psum is issued ``depth`` panels
+    BEFORE the wide trailing GEMM that consumes it, so the latency-hiding
+    scheduler holds ``depth`` wide compact-WY GEMMs of MXU work to overlay
+    on every collective instead of one. Per-column arithmetic is identical
+    to the lookahead order by construction: a column in panel j receives
+    transforms j-depth..j-1 through narrow single-panel applies (the same
+    row frames the lookahead order uses for its one narrow apply) and
+    transforms < j-depth through the wide masked applies, in ascending
+    order either way. Collective count is unchanged (two one-hot psums
+    per panel: pf + alpha) and the psums still route through the wire
+    seam, so the bf16/int8 rungs pipeline too; the pf psum frame grows by
+    at most ``depth*nb`` rows of already-final R (the lookahead order
+    already ships ``nb`` of them), which the blocked_qr contract slack
+    absorbs — volume model unchanged. Program-size strategy matches the
+    other schedules: unrolled below MAX_UNROLLED_PANELS, else
+    super-blocks (rounded up so each holds at least two full pipelines)
+    with an inner ``lax.scan`` whose carry stacks the pending ring; each
+    super-block boundary is a depth-panel bubble, filled by an unrolled
+    startup and drained by masked fix-up applies.
+    """
+    m, nloc = Al.shape
+    num_panels = n // nb
+    alpha = jnp.zeros((n,), dtype=Al.dtype)
+    # Callers (sharded_blocked_qr) clamp and normalize: depth 1 IS the
+    # lookahead order and must resolve to that cached program instead.
+    assert 2 <= depth <= num_panels - 1, (depth, num_panels)
+
+    if num_panels <= MAX_UNROLLED_PANELS:
+        ring = []  # (k_p, pf_p): pf framed at rows k_p:, diag at 0
+        for q1 in range(num_panels):
+            k1 = q1 * nb
+            owner1, kl1 = _panel_owner(k1, n, nloc, nb, layout)
+            mine1 = p == owner1
+            k_old = ring[0][0] if ring else k1
+            C1 = lax.slice(Al, (k_old, kl1), (m, kl1 + nb))
+            for k_p, pf_p in ring:  # oldest -> newest, lookahead frames
+                with jax.named_scope("lookahead_update"):
+                    sub = lax.slice(C1, (k_p - k_old, 0), (m - k_old, nb))
+                    sub = apply_block_reflector_h(
+                        jnp.tril(pf_p), sub, precision,
+                        gemm_precision=tprec)
+                    C1 = C1.at[k_p - k_old:, :].set(sub)
+            with jax.named_scope("panel_factor"):
+                pf1, a1 = factor(C1, k1 - k_old)
+                pf1 = psum_owner(pf1, mine1)
+                a1 = psum_owner(a1, mine1)
+            alpha = alpha.at[k1 : k1 + nb].set(a1)
+            if len(ring) == depth:
+                # Wide apply of the OLDEST pending — panel q1's psum
+                # (above) is already in flight, as are the depth-1
+                # younger pendings'.
+                k_p, pf_p = ring.pop(0)
+                drop = done_cols(k_p // nb)
+                with jax.named_scope("trailing_update"):
+                    # Reads Al BEFORE the pf1 write: the wide GEMM must
+                    # not depend on any in-flight psum (the mask
+                    # excludes every pipelined panel's columns — those
+                    # take the narrow path above).
+                    C = lax.slice(Al, (k_p, drop), (m, nloc))
+                    C_new = apply_block_reflector_h(
+                        jnp.tril(pf_p), C, precision, gemm_precision=tprec)
+                    cmask = (gidx_base[drop:] >= k1 + nb)[None, :]
+                    Al = Al.at[k_p:, drop:].set(jnp.where(cmask, C_new, C))
+            Al = jnp.where(mine1,
+                           Al.at[k_old:, kl1 : kl1 + nb].set(pf1), Al)
+            ring.append((k1, lax.slice(pf1, (k1 - k_old, 0),
+                                       (m - k_old, nb))))
+        # Drain: every column right of a still-pending panel already
+        # received its transform through the narrow applies above —
+        # nothing is left to apply once the last panel factors.
+        return Al, alpha
+
+    _, _, ppo = _panels_schedule(n, nb)
+    # Each super-block must hold at least two full pipelines so the scan
+    # has a steady state — the grouped-lookahead order's guard, with the
+    # pipeline depth in the group-width role.
+    ppo = max(ppo, 2 * depth)
+    for ob in range(0, num_panels, ppo):
+        pcount = min(ppo, num_panels - ob)
+        K = ob * nb
+        drop = done_cols(ob)  # static: done before this super-block
+        Sl = lax.slice(Al, (K, drop), (m, nloc))
+        ms = m - K
+        gidx_live = gidx_base[drop:]
+        d0 = min(depth, pcount)
+        # Startup bubble: fill the ring. Pendings are carried at full
+        # super-block height with the diag at (panel - ob)*nb, exactly
+        # like the lookahead scan's carry, so the scan below can rotate
+        # them through one stacked array.
+        ring = []
+        for j in range(d0):
+            k1 = (ob + j) * nb
+            owner1, kl1 = _panel_owner(k1, n, nloc, nb, layout)
+            kl1 -= drop
+            mine1 = p == owner1
+            C1 = lax.slice(Sl, (0, kl1), (ms, kl1 + nb))
+            for i, pf_p in enumerate(ring):
+                with jax.named_scope("lookahead_update"):
+                    C1 = apply_block_reflector_h(
+                        shifted_tril(pf_p, i * nb), C1, precision,
+                        gemm_precision=tprec)
+            with jax.named_scope("panel_factor"):
+                pf1, a1 = factor(C1, j * nb)
+                pf1 = psum_owner(pf1, mine1)
+                a1 = psum_owner(a1, mine1)
+            alpha = alpha.at[k1 : k1 + nb].set(a1)
+            Sl = jnp.where(mine1, Sl.at[:, kl1 : kl1 + nb].set(pf1), Sl)
+            ring.append(pf1)
+
+        nsteps = pcount - d0  # 0 when the last super-block is all bubble
+        if nsteps:
+            ring_arr = jnp.stack(ring)
+
+            def body(carry, q, ob=ob, ms=ms, K=K, drop=drop):
+                Sl, ring = carry  # ring[i]: panel ob+q+i, diag (q+i)*nb
+                kb1 = ob + q + depth
+                k1 = kb1 * nb
+                c1 = k1 - K
+                owner1, kl1 = _panel_owner_traced(kb1, nproc, nloc, nb,
+                                                  layout)
+                kl1 = kl1 - drop
+                mine1 = p == owner1
+                C1 = lax.dynamic_slice(Sl, (jnp.int32(0), kl1), (ms, nb))
+                for i in range(depth):
+                    with jax.named_scope("lookahead_update"):
+                        C1 = apply_block_reflector_h(
+                            shifted_tril(ring[i], c1 - (depth - i) * nb),
+                            C1, precision, gemm_precision=tprec)
+                with jax.named_scope("panel_factor"):
+                    pf1, a1 = factor(C1, c1)
+                    pf1 = psum_owner(pf1, mine1)
+                    a1 = psum_owner(a1, mine1)
+                with jax.named_scope("trailing_update"):
+                    # Pre-write Sl, as in the lookahead scan: the wide
+                    # GEMM consumes only the OLDEST pending and must not
+                    # depend on any of the depth in-flight psums.
+                    C_new = apply_block_reflector_h(
+                        shifted_tril(ring[0], c1 - depth * nb), Sl,
+                        precision, gemm_precision=tprec)
+                    cmask = (gidx_live >= k1 + nb)[None, :]
+                    Sl = jnp.where(cmask, C_new, Sl)
+                Sl_upd = lax.dynamic_update_slice(Sl, pf1,
+                                                  (jnp.int32(0), kl1))
+                Sl = jnp.where(mine1, Sl_upd, Sl)
+                ring = jnp.concatenate([ring[1:], pf1[None]], axis=0)
+                return (Sl, ring), a1
+
+            (Sl, ring_arr), a_rest = lax.scan(
+                body, (Sl, ring_arr), jnp.arange(nsteps, dtype=jnp.int32))
+            alpha = alpha.at[K + d0 * nb : K + pcount * nb].set(
+                a_rest.reshape(nsteps * nb))
+            ring = [ring_arr[i] for i in range(depth)]
+        # Drain the boundary bubble: the remaining pendings' transforms
+        # reach every column past this super-block through masked fix-up
+        # applies, oldest first (pending i is panel ob+pcount-len+i).
+        for i, pf_p in enumerate(ring):
+            with jax.named_scope("trailing_update"):
+                c = (pcount - len(ring) + i) * nb
+                C_new = apply_block_reflector_h(
+                    shifted_tril(pf_p, c), Sl, precision,
+                    gemm_precision=tprec)
+                cmask = (gidx_live >= K + pcount * nb)[None, :]
+                Sl = jnp.where(cmask, C_new, Sl)
+        Al = Al.at[K:, drop:].set(Sl)
     return Al, alpha
 
 
@@ -706,8 +891,8 @@ def _build_blocked(
     norm: str = "accurate", pallas: bool = False, pallas_interpret: bool = False,
     panel_impl: str = "loop", pallas_flat: "int | None" = None,
     trailing_precision: "str | None" = None, lookahead: bool = False,
-    agg_panels: "int | None" = None, comms: "str | None" = None,
-    seam=None,
+    agg_panels: "int | None" = None, overlap_depth: "int | None" = None,
+    comms: "str | None" = None, seam=None,
 ):
     # ``seam``: round-19 cache-key material only (see _build_unblocked).
     body = partial(
@@ -716,7 +901,7 @@ def _build_blocked(
         norm=norm, pallas=pallas, pallas_interpret=pallas_interpret,
         panel_impl=panel_impl, pallas_flat=pallas_flat,
         trailing_precision=trailing_precision, lookahead=lookahead,
-        agg_panels=agg_panels, comms=comms,
+        agg_panels=agg_panels, overlap_depth=overlap_depth, comms=comms,
     )
     spec = _topo.spec_axes(axis_name)
     return jax.jit(
@@ -906,6 +1091,7 @@ def sharded_blocked_qr(
     trailing_precision: "str | None" = None,
     lookahead: bool = False,
     agg_panels: "int | None" = None,
+    overlap_depth: "int | None" = None,
     comms: "str | None" = None,
     policy=None,
 ):
@@ -921,6 +1107,15 @@ def sharded_blocked_qr(
     panel's wide trailing GEMM (one-panel lookahead, same per-column
     arithmetic — see :func:`_blocked_shard_lookahead`), giving the
     scheduler room to overlap the collective with MXU work.
+
+    ``overlap_depth=k`` (with ``lookahead=True``) deepens that window to
+    a k-panel pipeline: the NEXT k panels' psums are in flight before
+    the oldest pending panel's wide trailing GEMM retires, same
+    per-column arithmetic again (see :func:`_blocked_shard_pipeline`).
+    Depth 1 IS the lookahead order and resolves to its cached program;
+    the depth is statically clamped to ``num_panels - 1``. Mutually
+    exclusive with ``agg_panels`` (the grouped order owns its own
+    overlap composition).
 
     ``agg_panels=k`` (k > 1) gathers each k-panel group with ONE psum,
     factors the group replicated, and applies the aggregated compact-WY
@@ -957,6 +1152,22 @@ def sharded_blocked_qr(
     if agg_panels is not None and agg_panels < 2:
         raise ValueError(f"agg_panels must be >= 2 (got {agg_panels}); "
                          "use None to disable aggregation")
+    if overlap_depth is not None:
+        if overlap_depth < 1:
+            raise ValueError(
+                f"overlap_depth must be >= 1 (got {overlap_depth}); "
+                "use None for the default schedule")
+        if not lookahead:
+            raise ValueError(
+                "overlap_depth generalizes the lookahead order and "
+                "requires lookahead=True (depth 1 IS the one-panel "
+                "lookahead)")
+        if agg_panels:
+            raise ValueError(
+                "overlap_depth composes with the per-panel lookahead "
+                "order only; it is mutually exclusive with agg_panels "
+                "(the grouped-lookahead composition already overlaps "
+                "one full group per collective)")
     if agg_panels and lookahead and nproc == 1:
         # The composition's entire win is hiding the gather psum behind
         # the wide trailing GEMM; a 1-device mesh has no collective to
@@ -991,10 +1202,19 @@ def sharded_blocked_qr(
             axis_name=axis_name, precision=precision, layout=layout,
             norm=norm, use_pallas=use_pallas, panel_impl=panel_impl,
             trailing_precision=trailing_precision, lookahead=lookahead,
-            agg_panels=agg_panels, comms=comms,
+            agg_panels=agg_panels, overlap_depth=overlap_depth,
+            comms=comms,
         )
         return H[:m, :n], alpha[:n]
     _check_divisibility(m, n, nproc, nb, layout)
+    if overlap_depth is not None:
+        # Clamp to the deepest pipeline the panel count supports, then
+        # normalize depth <= 1 AWAY so it resolves to the one-panel
+        # lookahead's IDENTICAL cached program (same _build_blocked key,
+        # same labels: zero extra compiles, bitwise-equal by identity).
+        overlap_depth = min(overlap_depth, max(n // nb - 1, 1))
+        if overlap_depth <= 1:
+            overlap_depth = None
     from dhqr_tpu.ops.blocked import _resolve_pallas
 
     from dhqr_tpu.ops.blocked import PALLAS_FLAT_WIDTH
@@ -1009,8 +1229,9 @@ def sharded_blocked_qr(
                                      device=mesh.devices.flat[0])
     from dhqr_tpu.ops.blocked import _pallas_cache_guard
 
-    sched = ("la" if lookahead else "") + (
-        f"agg{agg_panels}" if agg_panels else "")
+    sched = (((f"la{overlap_depth}" if overlap_depth else "la")
+              if lookahead else "")
+             + (f"agg{agg_panels}" if agg_panels else ""))
     base_label = (f"blocked_qr[P={ptag},{m}x{n},nb={nb},{layout}"
                   + (f",{sched}" if sched else "") + "]")
     comms = _armor.effective_comms(base_label, comms)
@@ -1020,7 +1241,7 @@ def sharded_blocked_qr(
             fn = _build_blocked(
                 mesh, axis_name, n, nb, precision, layout, norm, pallas,
                 interp, panel_impl, PALLAS_FLAT_WIDTH, trailing_precision,
-                lookahead, agg_panels, wire_comms,
+                lookahead, agg_panels, overlap_depth, wire_comms,
                 _wire.seam_token(wire_comms),
             )
             if _pulse.active() is None:

@@ -356,6 +356,7 @@ def sharded_lstsq(
     trailing_precision: "str | None" = None,
     lookahead: bool = False,
     agg_panels: "int | None" = None,
+    overlap_depth: "int | None" = None,
     apply_precision: "str | None" = None,
     comms: "str | None" = None,
     policy=None,
@@ -427,7 +428,8 @@ def sharded_lstsq(
             _store_layout_output=True, norm=norm, use_pallas=use_pallas,
             panel_impl=panel_impl,
             trailing_precision=trailing_precision, lookahead=lookahead,
-            agg_panels=agg_panels, comms=wire_comms,
+            agg_panels=agg_panels, overlap_depth=overlap_depth,
+            comms=wire_comms,
         )
         return sharded_solve(
             H, alpha, b, mesh,

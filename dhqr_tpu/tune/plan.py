@@ -52,6 +52,15 @@ class Plan:
         via a policy — see ``search.candidate_plans``.
       lookahead / agg_panels: mesh schedule levers (1-device plans keep
         the defaults; the pair composes only on multi-device meshes).
+      overlap_depth: depth-k pipelined panel broadcast (dhqr-pipeline,
+        round 19): None/1 = the classic one-panel lookahead, k >= 2
+        keeps k panel broadcasts in flight ahead of the trailing GEMM.
+        Requires ``lookahead`` and excludes ``agg_panels`` (the
+        aggregated schedule has its own panel grouping). Arithmetic is
+        per-column identical to the lookahead schedule, so unlike
+        ``comms`` it never moves the error bar — the grid offers it
+        purely on the pulse-measured exposed comms floor (see
+        ``search.candidate_plans``).
       comms: collective wire format on the sharded tier (dhqr-wire,
         round 18): None = uncompressed, "bf16"/"int8" route every
         sharded collective through the compression seam
@@ -71,6 +80,7 @@ class Plan:
     trailing_precision: Optional[str] = None
     lookahead: bool = False
     agg_panels: Optional[int] = None
+    overlap_depth: Optional[int] = None
     comms: Optional[str] = None
 
     def __post_init__(self):
@@ -98,6 +108,24 @@ class Plan:
             raise ValueError(
                 f"Plan.agg_panels must be >= 2 or None, got {self.agg_panels}"
             )
+        if self.overlap_depth is not None:
+            if self.overlap_depth < 2:
+                raise ValueError(
+                    "Plan.overlap_depth must be >= 2 or None (depth 1 IS "
+                    f"the lookahead schedule), got {self.overlap_depth}"
+                )
+            if not self.lookahead:
+                raise ValueError(
+                    "Plan.overlap_depth requires lookahead=True: the "
+                    "pipeline generalizes the lookahead broadcast, it "
+                    "does not replace the blocking schedule"
+                )
+            if self.agg_panels:
+                raise ValueError(
+                    "Plan.overlap_depth is mutually exclusive with "
+                    "agg_panels (the aggregated schedule already groups "
+                    "panel broadcasts its own way)"
+                )
         from dhqr_tpu.precision import resolve_comms
 
         object.__setattr__(self, "comms", resolve_comms(self.comms))
@@ -108,11 +136,12 @@ class Plan:
             # (comms IS allowed: the sharded tsqr/cholqr routes have a
             # combine gather / Gram psum to compress.)
             if (self.panel_impl != "loop" or self.trailing_precision
-                    or self.lookahead or self.agg_panels):
+                    or self.lookahead or self.agg_panels
+                    or self.overlap_depth):
                 raise ValueError(
                     f"engine={self.engine!r} plans carry only block_size "
-                    "(panel_impl/trailing_precision/lookahead/agg_panels "
-                    "are blocked-householder knobs)"
+                    "(panel_impl/trailing_precision/lookahead/agg_panels/"
+                    "overlap_depth are blocked-householder knobs)"
                 )
 
     # -- serialization -----------------------------------------------------
@@ -126,9 +155,11 @@ class Plan:
             "lookahead": self.lookahead,
             "agg_panels": self.agg_panels,
         }
-        # Written only when set: plan payloads without a wire format
-        # stay byte-identical to the pre-round-18 schema, so shipped
-        # seed DBs and older readers keep working.
+        # Written only when set: plan payloads without a wire format /
+        # pipeline depth stay byte-identical to the pre-round-18/19
+        # schema, so shipped seed DBs and older readers keep working.
+        if self.overlap_depth is not None:
+            out["overlap_depth"] = self.overlap_depth
         if self.comms is not None:
             out["comms"] = self.comms
         return out
@@ -146,7 +177,7 @@ class Plan:
         if extra:
             raise ValueError(f"unknown plan fields {sorted(extra)}")
         kwargs = dict(d)
-        for int_field in ("block_size", "agg_panels"):
+        for int_field in ("block_size", "agg_panels", "overlap_depth"):
             if kwargs.get(int_field) is not None:
                 kwargs[int_field] = int(kwargs[int_field])
         if "lookahead" in kwargs:
@@ -163,7 +194,9 @@ class Plan:
         if self.trailing_precision:
             parts.append(f"tp-{self.trailing_precision}")
         if self.lookahead:
-            parts.append("la")
+            parts.append(
+                f"la{self.overlap_depth}" if self.overlap_depth else "la"
+            )
         if self.agg_panels:
             parts.append(f"agg{self.agg_panels}")
         if self.comms:

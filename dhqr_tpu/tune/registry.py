@@ -71,6 +71,16 @@ GRID_MESH_LEVERS = (
     {"agg_panels": 2, "lookahead": True},
 )
 
+#: Rule-6d (round 23, dhqr-pipeline) depth-k pipelined panel-broadcast
+#: rungs, in offer order. Depth 1 IS the plain lookahead lever above —
+#: these are the deeper ring schedules, offered only where the
+#: pulse-measured exposed comms floor says there is collective time the
+#: one-panel lookahead could not hide (tune/search.candidate_plans).
+GRID_OVERLAP_PLANS = (
+    {"lookahead": True, "overlap_depth": 2},
+    {"lookahead": True, "overlap_depth": 4},
+)
+
 #: Rule-6b flat compressed-collective rungs for the householder mesh
 #: path, in offer order.
 GRID_WIRE_PLANS = (
@@ -122,6 +132,7 @@ class Route:
     layout: str = "block"
     lookahead: bool = False
     agg_panels: int = 0
+    overlap_depth: int = 0
     donated: bool = False
     batched: bool = False
     min_devices: int = 1
@@ -234,6 +245,22 @@ ROUTES: "tuple[Route, ...]" = (
           lookahead=True, min_devices=2, contract="blocked_qr_lookahead",
           comms_trace=dict(builder="blocked", shape="col", sweep=True,
                            lookahead=True)),
+    # Round 23 (dhqr-pipeline): the depth-k double-buffered panel
+    # broadcast — identical per-column arithmetic and launch count to
+    # the lookahead schedule, with k panel broadcasts in flight ahead of
+    # the trailing GEMM (the pf psum frames are up to depth*nb rows of R
+    # taller; the blocked_qr slack absorbs that like it absorbs
+    # lookahead's one-panel-taller frame).
+    Route("blocked_qr_pipeline2", "householder", "qr", "column",
+          lookahead=True, overlap_depth=2, min_devices=2,
+          contract="blocked_qr_pipeline2",
+          comms_trace=dict(builder="blocked", shape="col", sweep=True,
+                           lookahead=True, overlap_depth=2)),
+    Route("blocked_qr_pipeline4", "householder", "qr", "column",
+          lookahead=True, overlap_depth=4, min_devices=2,
+          contract="blocked_qr_pipeline4",
+          comms_trace=dict(builder="blocked", shape="col", sweep=True,
+                           lookahead=True, overlap_depth=4)),
     Route("blocked_qr_agg", "householder", "qr", "column", agg_panels=2,
           min_devices=2, contract="blocked_qr_agg",
           comms_trace=dict(builder="blocked", shape="col", sweep=True,
@@ -271,6 +298,15 @@ ROUTES: "tuple[Route, ...]" = (
           contract="blocked_qr_agg_wire_bf16",
           comms_trace=dict(builder="blocked", shape="col", agg_panels=2,
                            comms="bf16")),
+    # Round 23: the pipeline ring runs THROUGH the round-18 wire seam —
+    # one traced rung proves compressed broadcasts pipeline too (the
+    # contract's slack is widened to absorb the ring's taller psum
+    # frames on top of the bf16 wire budget; see comms_contracts.json).
+    Route("blocked_qr_pipeline2_wire_bf16", "householder", "qr", "column",
+          comms="bf16", lookahead=True, overlap_depth=2, min_devices=2,
+          contract="blocked_qr_pipeline2_wire_bf16",
+          comms_trace=dict(builder="blocked", shape="col", comms="bf16",
+                           lookahead=True, overlap_depth=2)),
     Route("unblocked_qr_wire_bf16", "householder", "qr", "column",
           comms="bf16", min_devices=2, contract="unblocked_qr_wire_bf16",
           comms_trace=dict(builder="unblocked", shape="col", comms="bf16")),
@@ -441,12 +477,18 @@ def grid_route_for(kind: str, plan: Plan, nproc: int = 1) -> "str | None":
         if plan.comms == "dcn:int8":
             return "lstsq_pod_dcn_int8"
         if plan.comms == "bf16":
+            if plan.overlap_depth:
+                return ("blocked_qr_pipeline2_wire_bf16"
+                        if plan.overlap_depth == 2 else None)
             return "blocked_qr_agg_wire_bf16" if plan.agg_panels \
                 else "blocked_qr_wire_bf16"
         if plan.comms == "int8":
             return "blocked_qr_wire_int8"
         if plan.comms is not None:
             return None
+        if plan.overlap_depth:
+            return {2: "blocked_qr_pipeline2",
+                    4: "blocked_qr_pipeline4"}.get(plan.overlap_depth)
         if plan.agg_panels and plan.lookahead:
             return "blocked_qr_agg_lookahead"
         if plan.agg_panels:
@@ -530,6 +572,20 @@ def self_check() -> "list[str]":
         if r.schedule in ("column", "row", "pod") and r.min_devices < 2:
             problems.append(
                 f"{where}: sharded schedules need min_devices >= 2")
+        if r.overlap_depth:
+            if r.overlap_depth < 2:
+                problems.append(
+                    f"{where}: overlap_depth must be >= 2 (depth 1 IS "
+                    "the lookahead route) or 0")
+            if not r.lookahead:
+                problems.append(
+                    f"{where}: pipeline routes require lookahead — the "
+                    "ring generalizes the lookahead broadcast")
+            if r.agg_panels:
+                problems.append(
+                    f"{where}: overlap_depth is mutually exclusive with "
+                    "agg_panels (the aggregated schedule groups panels "
+                    "its own way)")
         if r.comms_trace is not None and not r.contract:
             problems.append(
                 f"{where}: comms-traced routes must name a contract")

@@ -38,7 +38,21 @@ autotuner" carries the same table):
 6. Mesh schedule levers (``lookahead``, ``agg_panels``, their grouped
    composition) only when the mesh axis has ``nproc > 1`` devices — on
    one device there is no collective to hide (the same degenerate case
-   ``sharded_blocked_qr`` warns about). Round 18 adds the
+   ``sharded_blocked_qr`` warns about). Round 23 (dhqr-pipeline) adds
+   the depth-k pipelined broadcast rungs (``overlap_depth`` in {2, 4},
+   riding lookahead) here, gated on MEASUREMENT rather than a policy:
+   ``tune()`` pulse-probes the lookahead schedule first
+   (``obs.pulse.measure`` -> ``obs.netmodel.comms_roofline``) and the
+   deeper rungs are offered only when the measured ``exposed_floor_s``
+   is positive — collective time the one-panel lookahead could not
+   hide. A compute-bound probe (floor 0) prunes them: a deeper ring
+   cannot hide comms under compute that already covers it. When no
+   pulse measurement exists (profiler refused, stubbed searches, pure
+   ``candidate_plans`` calls) the rungs are offered — the timer and
+   accuracy gate still decide, measurement only PRUNES. The probe's
+   headroom/floor numbers are recorded into the plan-DB entry so a
+   shipped DB documents why the depth axis was (not) searched per key.
+   Round 18 adds the
    compressed-comms rungs here (``comms="bf16"``/``"int8"``, plain and
    composed with ``agg_panels``, plus bf16 twins of the aspect-gated
    alt engines for lstsq): offered only when the caller did NOT pin
@@ -83,6 +97,7 @@ from dhqr_tpu.tune.registry import (
     GRID_ALT_WIRE,
     GRID_DCN_PLANS,
     GRID_MESH_LEVERS,
+    GRID_OVERLAP_PLANS,
     GRID_WIRE_PLANS,
     TUNE_KINDS,
 )
@@ -229,7 +244,8 @@ def candidate_plans(kind: str, m: int, n: int, dtype="float32",
                     nproc: int = 1, policy=None,
                     platform: "str | None" = None,
                     budget: "int | None" = None,
-                    topology: "tuple[int, int] | None" = None) -> List[Plan]:
+                    topology: "tuple[int, int] | None" = None,
+                    exposed_floor_s: "float | None" = None) -> List[Plan]:
     """The pruned, deterministically-ordered candidate grid (module
     docstring rules 1-7). Pure — no timing, no device access (pass
     ``platform`` explicitly to keep it that way; None asks jax).
@@ -238,7 +254,11 @@ def candidate_plans(kind: str, m: int, n: int, dtype="float32",
     the rule-6b ``dcn:*`` tiered-compression rungs, which are pointless
     on a 1-D mesh (the seam degrades them to the exact f32
     passthrough there, so a candidate would time a duplicate of the
-    uncompressed plan)."""
+    uncompressed plan). ``exposed_floor_s`` (round 23, dhqr-pipeline)
+    is the pulse-measured exposed collective floor of the lookahead
+    schedule at this key — a measured 0.0 (compute already covers the
+    comms) prunes the deeper ``overlap_depth`` rungs; None (no
+    measurement) keeps them on offer."""
     if kind not in TUNE_KINDS:
         raise ValueError(f"kind must be one of {TUNE_KINDS}, got {kind!r}")
     if n < 1 or m < n:
@@ -302,6 +322,16 @@ def candidate_plans(kind: str, m: int, n: int, dtype="float32",
         base_nb = ladder[-1] if ladder else None
         out.extend(Plan(block_size=base_nb, **lever)
                    for lever in GRID_MESH_LEVERS)
+        # Rule 6d (round 23, dhqr-pipeline) — deeper broadcast rings,
+        # measurement-pruned: a pulse-probed lookahead whose exposed
+        # collective floor is 0 proved compute already hides the comms,
+        # so a deeper ring would only time duplicates of the lookahead
+        # winner. Depth 1 is the lookahead lever above; the engine
+        # clamps depth to num_panels - 1 at dispatch, so narrow shapes
+        # stay safe to offer.
+        if exposed_floor_s is None or exposed_floor_s > 0.0:
+            out.extend(Plan(block_size=base_nb, **lever)
+                       for lever in GRID_OVERLAP_PLANS)
         # Rule 6b (round 18) — compressed collectives (dhqr-wire),
         # lstsq-only (the solve surfaces carry CSNE recovery by
         # contract, so a compressed candidate can actually hold the
@@ -360,7 +390,7 @@ def apply_plan_to_config(cfg, plan: Plan):
         cfg, engine=plan.engine, block_size=plan.block_size,
         panel_impl=plan.panel_impl, trailing_precision=trailing,
         lookahead=plan.lookahead, agg_panels=plan.agg_panels,
-        comms=comms, plan=None,
+        overlap_depth=plan.overlap_depth, comms=comms, plan=None,
     )
 
 
@@ -584,6 +614,36 @@ def _verify(kind: str, out, args, baseline_err: "float | None"):
     return gram_err <= max(8.0 * baseline_err, 1e-5), float(gram_err)
 
 
+def _probe_overlap_headroom(kind: str, m: int, n: int, dtype, mesh,
+                            nproc: int, policy, seed: int) -> "dict | None":
+    """Pulse-probe the one-panel lookahead schedule at this key and
+    return its measured comms roofline (``obs.netmodel.comms_roofline``
+    fields — ``overlap_headroom_s``, ``exposed_floor_s``,
+    ``comms_fraction``), or None when the measurement degrades (no
+    profiler on this backend, no collective events, probe raised).
+
+    This is the round-23 tune signal: the exposed floor is the
+    collective time a perfectly-overlapped one-panel lookahead still
+    cannot hide, i.e. exactly what a DEEPER broadcast ring exists to
+    attack — so the grid's ``overlap_depth`` rungs are offered (and the
+    DB entry annotated) from measurement, not from a heuristic."""
+    from dhqr_tpu.obs import pulse as _pulse
+
+    try:
+        runner = _build_runner(kind, Plan(lookahead=True), policy, mesh)
+        args = _problem(kind, m, n, dtype, seed)
+        _, report = _pulse.measure(
+            f"tune_probe[{kind},{m}x{n},P={nproc}]",
+            lambda: runner(*args), n_devices=nproc)
+    # dhqr: ignore[DHQR006] the probe is advisory — a backend where it cannot run must degrade to the unpruned grid, never fail the tune
+    except Exception:
+        return None
+    comms = report.comms
+    if not comms or comms.get("comms_bound") is None:
+        return None
+    return comms
+
+
 def tune(kind: str, m: int, n: int, dtype="float32", *,
          mesh=None, policy=None, db: "PlanDB | None" = None,
          budget: "int | None" = None, repeats: "int | None" = None,
@@ -611,9 +671,21 @@ def tune(kind: str, m: int, n: int, dtype="float32", *,
         nproc = int(np.prod(list(mesh.shape.values())))
         topology = _mesh_topology(mesh)
     key = plan_key(kind, m, n, dtype, nproc=nproc, policy_tag=policy_tag(pol))
-    candidates = candidate_plans(kind, m, n, dtype, nproc=nproc, policy=pol,
-                                 budget=budget, topology=topology)
     stubbed = measure is not None
+    # Round 23 (dhqr-pipeline): measure before enumerating — the
+    # lookahead probe's comms roofline gates the overlap_depth rungs
+    # and annotates the recorded entry. Stubbed searches skip it (a
+    # stub's grid must stay deterministic and device-free).
+    headroom = None
+    if not stubbed and mesh is not None and nproc > 1 \
+            and kind in ("qr", "lstsq"):
+        headroom = _probe_overlap_headroom(kind, m, n, dtype, mesh,
+                                           nproc, policy, seed)
+    candidates = candidate_plans(
+        kind, m, n, dtype, nproc=nproc, policy=pol, budget=budget,
+        topology=topology,
+        exposed_floor_s=(headroom.get("exposed_floor_s")
+                         if headroom is not None else None))
     timer = measure or _measure_wall
     args = None if stubbed else _problem(kind, m, n, dtype, seed)
     rows: "list[Measurement]" = []
@@ -665,6 +737,15 @@ def tune(kind: str, m: int, n: int, dtype="float32", *,
         if analytic and winner.seconds > 0:
             extra["analytic_flops"] = analytic
             extra["gflops"] = round(analytic / winner.seconds / 1e9, 2)
+    if headroom is not None:
+        # dhqr-pipeline (round 23): the probe's roofline rides the DB
+        # entry so a shipped DB documents, per key, the measured
+        # overlap headroom / exposed floor that gated (or pruned) the
+        # overlap_depth axis on this backend.
+        for field in ("overlap_headroom_s", "exposed_floor_s",
+                      "comms_fraction"):
+            if headroom.get(field) is not None:
+                extra[field] = headroom[field]
     db.record(
         key, winner.plan,
         seconds=round(winner.seconds, 6),

@@ -109,6 +109,21 @@ class DHQRConfig:
         scanned-path lever there; the SHARDED unrolled path does
         aggregate (its win, one gather psum per group, exists at every
         panel count).
+      overlap_depth: depth-k pipelined panel broadcast (sharded blocked
+        householder engine, MESH-ONLY; requires ``lookahead=True`` and
+        excludes ``agg_panels``): generalizes the lookahead order so the
+        NEXT k panels' one-hot psums are in flight before the oldest
+        pending panel's wide trailing GEMM retires — k wide compact-WY
+        GEMMs of scheduler slack per collective instead of one (see
+        parallel/sharded_qr._blocked_shard_pipeline). Per-column
+        arithmetic is identical to the lookahead order (the accurate
+        tier stays bitwise-equal schedule to schedule); collective count
+        and the volume budget are unchanged. Depth 1 IS the lookahead
+        order (it resolves to the same cached program); the depth is
+        statically clamped to num_panels - 1. None (default) = the
+        plain/lookahead schedule. Choose a depth from a pulse report's
+        ``exposed_floor_s`` (OPERATIONS.md runbook) or let ``tune()``
+        pick it from measured headroom.
       apply_precision: matmul precision of the solve stage's Q/Q^H
         applies (the blocked householder engines' solve paths). None
         (the default) follows ``precision``. Usually set via ``policy``
@@ -191,6 +206,7 @@ class DHQRConfig:
     trailing_precision: "str | None" = None
     lookahead: bool = False
     agg_panels: "int | None" = None
+    overlap_depth: "int | None" = None
     apply_precision: "str | None" = None
     comms: "str | None" = None
     policy: object = None
@@ -231,6 +247,9 @@ class DHQRConfig:
         if "DHQR_AGG_PANELS" in os.environ:
             raw = os.environ["DHQR_AGG_PANELS"].strip()
             env["agg_panels"] = int(raw) if raw and raw != "0" else None
+        if "DHQR_OVERLAP_DEPTH" in os.environ:
+            raw = os.environ["DHQR_OVERLAP_DEPTH"].strip()
+            env["overlap_depth"] = int(raw) if raw and raw != "0" else None
         if "DHQR_APPLY_PRECISION" in os.environ:
             env["apply_precision"] = os.environ["DHQR_APPLY_PRECISION"]
         if "DHQR_COMMS" in os.environ:

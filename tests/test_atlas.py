@@ -207,6 +207,23 @@ def test_grid_route_for_folds_ladder_knobs():
         "lstsq", Plan(engine="cholqr2", comms="int8"), nproc=4) is None
 
 
+def test_grid_route_for_pipeline_depths():
+    la2 = Plan(lookahead=True, overlap_depth=2)
+    la4 = Plan(lookahead=True, overlap_depth=4)
+    assert registry.grid_route_for("lstsq", la2, nproc=4) \
+        == "blocked_qr_pipeline2"
+    assert registry.grid_route_for("lstsq", la4, nproc=4) \
+        == "blocked_qr_pipeline4"
+    # bf16 wire composes with the ring at depth 2 only; a deeper
+    # compressed ring has no registered route (grid must not offer it)
+    assert registry.grid_route_for(
+        "lstsq", Plan(lookahead=True, overlap_depth=2, comms="bf16"),
+        nproc=4) == "blocked_qr_pipeline2_wire_bf16"
+    assert registry.grid_route_for(
+        "lstsq", Plan(lookahead=True, overlap_depth=4, comms="bf16"),
+        nproc=4) is None
+
+
 # -- satellite: warn-only missing-reason DHQR000 ----------------------------
 
 def test_missing_reason_suppression_warns():

@@ -67,6 +67,52 @@ def test_comms_roofline_fields():
     assert null["comms_bound"] is None and "comms_reason" in null
 
 
+def test_comms_roofline_zero_compute():
+    # A comms-only program (round 23: the tune probe can see this on a
+    # degenerate shape): everything is exposed, nothing is hideable —
+    # the depth grid must see a positive floor, not a crash or a 0/0.
+    r = netmodel.comms_roofline(3e-3, 0.0)
+    assert r["comms_bound"] == "comms"
+    assert r["comms_fraction"] == pytest.approx(1.0)
+    assert r["overlap_headroom_s"] == 0.0
+    assert r["exposed_floor_s"] == pytest.approx(3e-3)
+
+
+def test_comms_roofline_compute_dominated_floor_is_zero():
+    # Compute hides ALL the collective time: the exposed floor is
+    # exactly 0.0 (not epsilon) — this is the value rule 6d reads to
+    # PRUNE the overlap_depth rungs, so the zero must be exact.
+    r = netmodel.comms_roofline(1e-3, 5e-3)
+    assert r["comms_bound"] == "compute"
+    assert r["overlap_headroom_s"] == pytest.approx(1e-3)
+    assert r["exposed_floor_s"] == 0.0
+    # Degenerate both-zero split: fraction defined as 0.0, never 0/0.
+    z = netmodel.comms_roofline(0.0, 0.0)
+    assert z["comms_fraction"] == 0.0
+    assert z["exposed_floor_s"] == 0.0
+
+
+def test_comms_roofline_null_with_reason_one_sided():
+    # EITHER side missing degrades the whole verdict to null-with-
+    # reason (a one-sided split would mislabel the bound): no numeric
+    # fields may leak next to the null.
+    for args in ((None, 2e-3), (1e-3, None)):
+        r = netmodel.comms_roofline(*args)
+        assert r["comms_bound"] is None
+        assert "comms_reason" in r
+        assert "overlap_headroom_s" not in r
+        assert "exposed_floor_s" not in r
+
+
+def test_comms_roofline_bandwidth_fields_need_both_inputs():
+    # effective_gbps/bandwidth_pct appear only with link_gbps AND a
+    # wire-byte census; a lone link speed adds nothing.
+    r = netmodel.comms_roofline(2e-3, 1e-3, link_gbps=100.0)
+    assert "effective_gbps" not in r and "bandwidth_pct" not in r
+    r2 = netmodel.comms_roofline(2e-3, 1e-3, wire_bytes_moved=1e6)
+    assert "effective_gbps" not in r2 and "bandwidth_pct" not in r2
+
+
 def test_platform_interconnect_table():
     from dhqr_tpu.utils import platform as plat
 

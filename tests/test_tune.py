@@ -63,10 +63,32 @@ def test_plan_from_dict_rejects_unknown_fields():
     dict(engine="tsqr", panel_impl="recursive"),
     dict(engine="cholqr2", trailing_precision="high"),
     dict(engine="tsqr", lookahead=True),
+    # pipeline depth (round 23): >= 2, rides lookahead, excludes agg,
+    # blocked-householder only
+    dict(lookahead=True, overlap_depth=1),
+    dict(overlap_depth=2),
+    dict(lookahead=True, agg_panels=2, overlap_depth=2),
+    dict(engine="cholqr2", lookahead=True, overlap_depth=2),
 ])
 def test_plan_validation(kwargs):
     with pytest.raises(ValueError):
         Plan(**kwargs)
+
+
+def test_plan_pipeline_roundtrip_and_tag():
+    p = Plan(block_size=32, lookahead=True, overlap_depth=2)
+    d = p.to_dict()
+    assert d["overlap_depth"] == 2
+    assert Plan.from_dict(d) == p
+    # JSON-sourced payloads (and sloppy string depths) coerce back
+    assert Plan.from_dict(json.loads(json.dumps(d))) == p
+    assert Plan.from_dict({**d, "overlap_depth": "2"}) == p
+    assert "la2" in p.describe()
+    # depth-free plans keep the pre-round-19 payload schema, and the
+    # plain lookahead tag stays unnumbered
+    la = Plan(lookahead=True)
+    assert "overlap_depth" not in la.to_dict()
+    assert la.describe().endswith("la")
 
 
 def test_plan_key_and_policy_tag():
@@ -246,6 +268,43 @@ def test_candidates_mesh_levers_gated_on_nproc():
     assert any(p.lookahead for p in eight)
     assert any(p.agg_panels for p in eight)
     assert any(p.agg_panels and p.lookahead for p in eight)
+
+
+def test_candidates_overlap_rungs_measurement_pruned():
+    # Rule 6d (round 23): the deeper broadcast rings ride the mesh gate
+    # AND the pulse-measured exposed collective floor of the lookahead
+    # schedule. Budget is widened past the default 16 so truncation
+    # (rule 7) cannot mask the gating under test.
+    kw = dict(nproc=8, platform="cpu", budget=64)
+
+    def depths(cands):
+        return sorted({p.overlap_depth for p in cands if p.overlap_depth})
+
+    # No measurement -> both rungs on offer, composed on lookahead only.
+    unmeasured = candidate_plans("lstsq", 1024, 256, **kw)
+    assert depths(unmeasured) == [2, 4]
+    assert all(p.lookahead and not p.agg_panels
+               for p in unmeasured if p.overlap_depth)
+    # Measured positive exposed floor -> comms to hide, rungs stay.
+    exposed = candidate_plans("lstsq", 1024, 256,
+                              exposed_floor_s=2e-3, **kw)
+    assert depths(exposed) == [2, 4]
+    # Measured 0.0 floor: compute already covers the comms, a deeper
+    # ring would only time a duplicate of the lookahead winner.
+    covered = candidate_plans("lstsq", 1024, 256,
+                              exposed_floor_s=0.0, **kw)
+    assert depths(covered) == []
+    # Single-process grids never offer the rungs, measured or not.
+    one = candidate_plans("lstsq", 1024, 256, nproc=1, platform="cpu",
+                          budget=64, exposed_floor_s=2e-3)
+    assert depths(one) == []
+    # Deterministic, and every offered rung is registry-expressible
+    # (the DHQR505 contract the atlas audits).
+    from dhqr_tpu.tune.registry import grid_route_for
+
+    assert unmeasured == candidate_plans("lstsq", 1024, 256, **kw)
+    assert all(grid_route_for("lstsq", p, nproc=8) is not None
+               for p in unmeasured if p.overlap_depth)
 
 
 def test_candidates_budget_truncates_from_the_end():
