@@ -1009,6 +1009,22 @@ def run(n_devices: int) -> None:
               "like the sharded stages; registry self-check ran — run "
               "tools/lint.sh for the full audited gate)", flush=True)
 
+    # Lock discipline (dhqr-warden, round 20): the DHQR6xx static
+    # self-scan plus one armed lock-witness burst over a tiny
+    # scheduler/router stream — the witnessed acquisition-order edges
+    # must be a subset of the committed graph with zero held-set
+    # violations, device-count-independent (the serving tier is
+    # host-side threading).
+    from dhqr_tpu.analysis.concurrency_pass import run_concurrency_pass
+
+    conc_findings = [f for f in run_concurrency_pass(witness=True)
+                     if not f.suppressed]
+    assert not conc_findings, "concurrency findings:\n" + "\n".join(
+        f.render() for f in conc_findings)
+    print("dryrun: concurrency ok (DHQR601-604 static scan green, "
+          "lock-witness burst: witnessed edges within the committed "
+          "lock_order.json graph, 0 held-set violations)", flush=True)
+
     # TSQR wants a genuinely tall problem: local row blocks must stay tall
     nt = 8
     mt = 2 * nt * n_devices

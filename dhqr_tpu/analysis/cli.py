@@ -6,12 +6,16 @@ check, the multi-device comms-contract audit (dhqr-audit,
 ``analysis/comms_pass.py``), the xray introspection smoke
 (``analysis/xray_smoke.py``, DHQR401), and the pulse runtime-comms
 smoke (``analysis/pulse_smoke.py``, DHQR402), and the route-registry drift
-audit (dhqr-atlas, ``analysis/atlas.py``, DHQR501-DHQR505) run
+audit (dhqr-atlas, ``analysis/atlas.py``, DHQR501-DHQR505), and the
+lock-discipline & deadlock-order pass (dhqr-warden,
+``analysis/concurrency_pass.py``, DHQR601-DHQR604) run
 whenever the dhqr_tpu package itself is among the scan targets (they
 validate the package, not arbitrary files), unless disabled with
 ``--no-jaxpr`` / ``--no-api`` / ``--no-comms`` / ``--no-xray`` /
-``--no-pulse`` / ``--no-atlas`` — or all at once with ``--fast``
-(AST-only, for edit loops). ``--format {text,json}`` selects the
+``--no-pulse`` / ``--no-atlas`` / ``--no-concurrency`` — or all at
+once with ``--fast`` (AST-only, for edit loops; the concurrency pass's
+static half still runs, only its runtime lock-witness burst is
+skipped). ``--format {text,json}`` selects the
 output shape (``--json`` is the legacy alias). ``comms`` is the audit
 alone (the subprocess vehicle ``check`` uses when the backend
 initialized before the multi-device CPU topology could be forced).
@@ -56,6 +60,7 @@ def rule_catalogue() -> "list[tuple[str, str, str]]":
         api_check,
         atlas,
         comms_pass,
+        concurrency_pass,
         jaxpr_pass,
         pulse_smoke,
         xray_smoke,
@@ -68,7 +73,7 @@ def rule_catalogue() -> "list[tuple[str, str, str]]":
     # (DHQR009 — the dhqr-wire seam rule — rides in AST_RULES like the
     # other pass-1 rows.)
     for mod in (jaxpr_pass, api_check, comms_pass, pulse_smoke,
-                xray_smoke, atlas):
+                xray_smoke, atlas, concurrency_pass):
         rows += list(mod.RULES)
     return sorted(rows, key=lambda row: row[0])
 
@@ -147,6 +152,9 @@ def main(argv=None) -> int:
     check.add_argument("--no-atlas", action="store_true",
                        help="skip the route-registry drift audit "
                        "(DHQR501-DHQR505)")
+    check.add_argument("--no-concurrency", action="store_true",
+                       help="skip the lock-discipline & deadlock-order "
+                       "pass (DHQR601-DHQR604)")
     check.add_argument(
         "--preset", action="append", default=None,
         help="restrict the jaxpr/comms passes to these policy presets "
@@ -224,6 +232,9 @@ def main(argv=None) -> int:
     if args.fast:
         args.no_jaxpr = args.no_api = args.no_comms = True
         args.no_xray = args.no_pulse = args.no_atlas = True
+        # The concurrency pass's STATIC half stays on even under --fast
+        # (it is AST-speed); only the runtime lock-witness burst — which
+        # compiles and dispatches — is skipped.
     if _scans_package(paths) and not args.no_comms:
         # Before ANY jax device touch (the jaxpr pass initializes the
         # backend), so the comms audit can run in-process.
@@ -260,6 +271,10 @@ def main(argv=None) -> int:
         from dhqr_tpu.analysis.atlas import run_atlas_pass
 
         findings.extend(run_atlas_pass())
+    if _scans_package(paths) and not args.no_concurrency:
+        from dhqr_tpu.analysis.concurrency_pass import run_concurrency_pass
+
+        findings.extend(run_concurrency_pass(witness=not args.fast))
 
     if args.write_baseline:
         write_baseline(args.write_baseline, findings)

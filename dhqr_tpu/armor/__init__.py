@@ -55,6 +55,7 @@ from dhqr_tpu.armor.errors import (
 from dhqr_tpu.armor import checks
 from dhqr_tpu.faults import harness as _faults
 from dhqr_tpu.obs import trace as _obs
+from dhqr_tpu.utils import lockwitness as _lockwitness
 from dhqr_tpu.utils.config import ArmorConfig
 from dhqr_tpu.utils.profiling import Counters
 
@@ -107,20 +108,24 @@ class ArmorState:
             "verifications", "detections", "recovered_redispatch",
             "recovered_degrade", "typed_failures")}
         out.update(self.counters.snapshot())
-        out["degraded_labels"] = len(_DEGRADED)
-        out["wire_trips"] = sum(_WIRE_TRIPS.values())
+        # Under the lock: a concurrent trip inserting a NEW key while
+        # sum() iterates would raise "dict changed size during
+        # iteration" — telemetry must never take the caller down.
+        with _TRIP_LOCK:
+            out["degraded_labels"] = len(_DEGRADED)
+            out["wire_trips"] = sum(_WIRE_TRIPS.values())
         return out
 
 
 _ACTIVE: "ArmorState | None" = None
-_ARM_LOCK = threading.Lock()
+_ARM_LOCK = _lockwitness.make_lock("armor._ARM_LOCK")
 
 # Persistent (module-lifetime, like tune's gate failures) transport
 # health memory: labels degraded to the f32 wire, and per-plan-key
 # verification-trip counts feeding tune's compressed-plan demotion.
 _DEGRADED: "set[str]" = set()
 _WIRE_TRIPS: "dict[tuple, int]" = {}
-_TRIP_LOCK = threading.Lock()
+_TRIP_LOCK = _lockwitness.make_lock("armor._TRIP_LOCK")
 
 # Bumped before every recovery re-dispatch WHILE wire fault sites are
 # armed: the trace-time fault schedules bake into the lru-cached engine

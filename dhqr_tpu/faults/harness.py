@@ -88,6 +88,7 @@ import time
 import zlib
 from typing import Iterator, Optional
 
+from dhqr_tpu.utils import lockwitness as _lockwitness
 from dhqr_tpu.utils.config import FaultConfig
 from dhqr_tpu.utils.profiling import Counters
 
@@ -152,8 +153,10 @@ class FaultHarness:
         self.config = config
         self.counters = Counters()
         self._sleep = sleeper
-        self._lock = threading.Lock()
-        self._sites: "dict[str, _SiteState]" = {}
+        self._lock = _lockwitness.make_lock("FaultHarness._lock")
+        # Dict SHAPE is frozen after __init__ (sites never appear or
+        # vanish); the per-site _SiteState fields mutate under _lock.
+        self._sites: "dict[str, _SiteState]" = {}  # guarded by: frozen
         for entry in config.sites:
             site, prob, count = entry[0], entry[1], entry[2]
             from_visit = entry[3] if len(entry) == 4 else None
@@ -217,7 +220,7 @@ class FaultHarness:
 # The one armed harness (or None — the fast path). Assignment is atomic
 # under the GIL; injection points read it exactly once per visit.
 _ACTIVE: "FaultHarness | None" = None
-_INSTALL_LOCK = threading.Lock()
+_INSTALL_LOCK = _lockwitness.make_lock("harness._INSTALL_LOCK")
 # Monotone arm/disarm generation (round 19). The "wire"-kind sites fire
 # at TRACE time inside lru-cached engine builds (parallel/wire.py), so
 # re-arming a schedule must re-key those caches or a stale baked fault

@@ -47,6 +47,8 @@ import time
 import weakref
 from typing import Callable, Union
 
+from dhqr_tpu.utils import lockwitness as _lockwitness
+
 Number = Union[int, float]
 
 
@@ -78,9 +80,9 @@ class MetricsRegistry:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = _lockwitness.make_lock("MetricsRegistry._lock")
         # prefix -> list of (weakref-to-instance | callable)
-        self._sources: "dict[str, list]" = {}
+        self._sources: "dict[str, list]" = {}  # guarded by: _lock
 
     def register(self, prefix: str,
                  source: "object | Callable[[], dict]") -> None:
@@ -345,7 +347,7 @@ def _solvers_provider() -> dict:
 
 
 _REGISTRY: "MetricsRegistry | None" = None
-_REGISTRY_LOCK = threading.Lock()
+_REGISTRY_LOCK = _lockwitness.make_lock("metrics._REGISTRY_LOCK")
 
 
 def _new_default_registry() -> MetricsRegistry:

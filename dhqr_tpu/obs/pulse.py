@@ -61,6 +61,7 @@ import threading
 from typing import Callable, Optional
 
 from dhqr_tpu.obs import netmodel as _net
+from dhqr_tpu.utils import lockwitness as _lockwitness
 
 __all__ = [
     "DEFAULT_SLACK",
@@ -578,9 +579,9 @@ class PulseStore:
                 f"max_reports must be >= 1, got {max_reports}")
         self.max_reports = int(max_reports)
         self.slack = float(slack)
-        self._lock = threading.Lock()
-        self._reports: "dict[str, PulseReport]" = {}
-        self._seen: "set[str]" = set()
+        self._lock = _lockwitness.make_lock("PulseStore._lock")
+        self._reports: "dict[str, PulseReport]" = {}  # guarded by: _lock
+        self._seen: "set[str]" = set()                # guarded by: _lock
         self._captures = 0
         self._unsupported = 0
         self._failed_306 = 0
@@ -650,7 +651,7 @@ class PulseStore:
 # The one armed store (or None — the fast path); same module-global
 # discipline as faults.harness / obs.trace / obs.xray.
 _ACTIVE: "PulseStore | None" = None
-_ARM_LOCK = threading.Lock()
+_ARM_LOCK = _lockwitness.make_lock("pulse._ARM_LOCK")
 
 
 def arm(max_reports: int = 256, slack: float = DEFAULT_SLACK,

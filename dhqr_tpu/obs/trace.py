@@ -49,6 +49,7 @@ import threading
 import time
 from typing import Iterator, NamedTuple, Optional
 
+from dhqr_tpu.utils import lockwitness as _lockwitness
 from dhqr_tpu.utils.config import ObsConfig
 
 
@@ -89,7 +90,8 @@ class TraceRecorder:
                  clock=time.monotonic) -> None:
         self.config = config or ObsConfig(enabled=True)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = _lockwitness.make_lock("TraceRecorder._lock")
+        # guarded by: _lock
         self._spans: "collections.deque[Span]" = collections.deque(
             maxlen=self.config.buffer_spans)
         # Per-trace index over the SAME bounded span set: flight dumps
@@ -101,7 +103,7 @@ class TraceRecorder:
         # is, within its own trace, also the oldest — deque head (a
         # deque per trace so eviction is O(1) even when one long trace
         # dominates the ring).
-        self._by_trace: "dict[int, collections.deque[Span]]" = {}
+        self._by_trace: "dict[int, collections.deque[Span]]" = {}  # guarded by: _lock
         self._next_trace = 0
         self._next_seq = 0
         self._minted = 0
@@ -238,7 +240,7 @@ class TraceRecorder:
 # The one armed recorder (or None — the fast path). Assignment is atomic
 # under the GIL; instrumentation points read it exactly once per visit.
 _ACTIVE: "TraceRecorder | None" = None
-_ARM_LOCK = threading.Lock()
+_ARM_LOCK = _lockwitness.make_lock("trace._ARM_LOCK")
 # Trace-id floor across ARMED recorders: instrumentation records spans
 # into whatever recorder is active AT SPAN TIME, so a request minted by
 # recorder A and still in flight when recorder B arms will record its

@@ -47,6 +47,7 @@ import threading
 from typing import Optional
 
 from dhqr_tpu.obs import flops as _flops
+from dhqr_tpu.utils import lockwitness as _lockwitness
 
 __all__ = [
     "XrayReport",
@@ -281,8 +282,8 @@ class XrayStore:
             raise ValueError(
                 f"max_reports must be >= 1, got {max_reports}")
         self.max_reports = int(max_reports)
-        self._lock = threading.Lock()
-        self._reports: "dict[str, XrayReport]" = {}
+        self._lock = _lockwitness.make_lock("XrayStore._lock")
+        self._reports: "dict[str, XrayReport]" = {}  # guarded by: _lock
         self._captures = 0
         self._unsupported = 0
         self._evicted = 0
@@ -357,7 +358,7 @@ class XrayStore:
 # The one armed store (or None — the fast path); same module-global
 # discipline as faults.harness / obs.trace.
 _ACTIVE: "XrayStore | None" = None
-_ARM_LOCK = threading.Lock()
+_ARM_LOCK = _lockwitness.make_lock("xray._ARM_LOCK")
 
 
 def arm(max_reports: int = 512) -> XrayStore:

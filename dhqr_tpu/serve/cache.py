@@ -59,6 +59,7 @@ from dhqr_tpu.obs import metrics as _obs_metrics
 # reached lazily from capture paths) — acyclic for the same reason.
 from dhqr_tpu.obs import xray as _obs_xray
 from dhqr_tpu.serve.errors import CompileFailed, Quarantined
+from dhqr_tpu.utils import lockwitness as _lockwitness
 from dhqr_tpu.utils.config import ServeConfig
 from dhqr_tpu.utils.profiling import Counters, PhaseTimer
 
@@ -131,16 +132,17 @@ class ExecutableCache:
         self.max_size = int(max_size)
         self.quarantine_s = float(quarantine_s)
         self._clock = clock
+        # guarded by: _lock
         self._entries: "OrderedDict[object, object]" = OrderedDict()
         # key -> cooldown expiry (clock seconds) after a failed compile.
-        self._quarantine: "dict[object, float]" = {}
+        self._quarantine: "dict[object, float]" = {}  # guarded by: _lock
         # canonical key spelling -> cooldown expiry, INHERITED from
         # another replica via the shared fleet state (round 22). Kept
         # separate from the local dict: local keys are CacheKey objects,
         # adopted verdicts arrive as cross-process strings, and the
         # lookup below only pays the canonical rendering when this map
         # is non-empty (zero cost for per-process serving).
-        self._quarantine_adopted: "dict[str, float]" = {}
+        self._quarantine_adopted: "dict[str, float]" = {}  # guarded by: _lock
         self.counters = Counters()
         self.timer = PhaseTimer()
         # One lock for lookup + insert + evict + counters: a serving tier
@@ -150,7 +152,7 @@ class ExecutableCache:
         # compiles of the SAME key is the point (one compile, N waiters),
         # and concurrent compiles of different keys would contend on
         # XLA's own compilation locks anyway.
-        self._lock = threading.RLock()
+        self._lock = _lockwitness.make_rlock("ExecutableCache._lock")
         # Unified metrics (round 14): every cache's numbers roll up
         # under serve.cache.* dotted names. Weakly held — a test-scoped
         # cache leaves the registry with garbage collection.
@@ -223,6 +225,7 @@ class ExecutableCache:
             try:
                 with self.timer.measure("aot_compile"):
                     _faults.fire("serve.compile")
+                    # dhqr: ignore[DHQR603] compile-under-lock is the design: one compile per key, N waiters (see the _lock comment above)
                     exe = lower_fn().compile()
             except Exception as e:
                 self.counters.bump("compile_failures")
@@ -379,7 +382,7 @@ class ExecutableCache:
 # still take effect. Tests that need isolation construct their own
 # ExecutableCache and pass it in.
 _DEFAULT_CACHE: "ExecutableCache | None" = None
-_DEFAULT_CACHE_LOCK = threading.Lock()
+_DEFAULT_CACHE_LOCK = _lockwitness.make_lock("cache._DEFAULT_CACHE_LOCK")
 
 
 def default_cache() -> ExecutableCache:

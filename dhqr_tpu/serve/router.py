@@ -59,6 +59,7 @@ from dhqr_tpu.obs import metrics as _obs_metrics
 from dhqr_tpu.serve.cache import default_cache
 from dhqr_tpu.serve.errors import BackpressureError, ReplicaLost, ServeError
 from dhqr_tpu.serve.scheduler import AsyncScheduler
+from dhqr_tpu.utils import lockwitness as _lockwitness
 from dhqr_tpu.utils.config import FleetConfig
 from dhqr_tpu.utils.profiling import Counters
 
@@ -134,9 +135,10 @@ class Router:
                 raise ValueError(f"replicas must be >= 1, got {replicas}")
             factory = scheduler_factory or \
                 (lambda: AsyncScheduler(**sched_kwargs))
+            # guarded by: frozen
             self._replicas = [factory() for _ in range(replicas)]
         else:
-            self._replicas = list(replicas)
+            self._replicas = list(replicas)     # guarded by: frozen
             if not self._replicas:
                 raise ValueError("replicas list must be non-empty")
         k = len(self._replicas)
@@ -146,11 +148,14 @@ class Router:
         if len(weights) != k or any(w <= 0 for w in weights):
             raise ValueError(
                 f"weights must be {k} positive numbers, got {weights!r}")
-        self._weights = weights
-        self._lock = threading.Lock()
-        self._credits: "dict[str, list[float]]" = {}  # tenant -> per-replica
-        self._dead = [False] * k   # router-side verdict (kill/shutdown seen)
-        self._sticky: "dict[int, int]" = {}  # id(session) -> replica idx
+        self._weights = weights                 # guarded by: frozen
+        self._lock = _lockwitness.make_lock("Router._lock")
+        # tenant -> per-replica smooth-WRR credits
+        self._credits: "dict[str, list[float]]" = {}  # guarded by: _lock
+        # router-side death verdict (kill/shutdown seen)
+        self._dead = [False] * k                # guarded by: _lock
+        # id(update session) -> pinned replica idx
+        self._sticky: "dict[int, int]" = {}     # guarded by: _lock
         self._closed = False
         self.counters = Counters()
         _obs_metrics.registry().register("fleet.router", self)
@@ -163,8 +168,15 @@ class Router:
     # ------------------------------------------------------------ balancing
 
     def _healthy_indices(self) -> "list[int]":
+        # The death verdicts are snapshotted under the lock, but each
+        # replica's ``healthy`` property is read OUTSIDE it: that
+        # property takes the scheduler's own lock, and nesting it under
+        # ours would add a Router._lock -> AsyncScheduler._lock edge
+        # the graph does not need.
+        with self._lock:
+            dead = list(self._dead)
         return [i for i, r in enumerate(self._replicas)
-                if not self._dead[i] and r.healthy]
+                if not dead[i] and r.healthy]
 
     def _pick_order(self, tenant: str, healthy: "list[int]",
                     exclude: "int | None" = None) -> "list[int]":
@@ -385,8 +397,10 @@ class Router:
         failover lands synchronously, so two passes suffice for any
         single kill wave)."""
         for _ in range(2):
+            with self._lock:
+                dead = list(self._dead)
             for i, rep in enumerate(self._replicas):
-                if not self._dead[i]:
+                if not dead[i]:
                     rep.drain(timeout=timeout)
 
     def shutdown(self, drain: bool = True,
@@ -399,8 +413,9 @@ class Router:
             if self._closed:
                 return
             self._closed = True
+            dead = list(self._dead)
         for i, rep in enumerate(self._replicas):
-            rep.shutdown(drain=drain and not self._dead[i], timeout=timeout)
+            rep.shutdown(drain=drain and not dead[i], timeout=timeout)
             self._mark_dead(i)
         if self._fleet.state_path:
             from dhqr_tpu.serve import store as _store_mod
@@ -419,8 +434,10 @@ class Router:
         return list(self._replicas)
 
     def queue_depth(self) -> int:
+        with self._lock:
+            dead = list(self._dead)
         return sum(r.queue_depth() for i, r in enumerate(self._replicas)
-                   if not self._dead[i])
+                   if not dead[i])
 
     _METRIC_COUNTERS = (
         "submitted", "routed", "backpressure_reroutes", "rejected",
@@ -443,8 +460,10 @@ class Router:
         """JSON-ready operational snapshot: the router metrics plus
         each replica's own ``metrics_snapshot()`` and health verdict."""
         out = self.metrics_snapshot()
+        with self._lock:
+            dead = list(self._dead)
         out["per_replica"] = [
-            {"healthy": (not self._dead[i]) and rep.healthy,
+            {"healthy": (not dead[i]) and rep.healthy,
              **rep.metrics_snapshot()}
             for i, rep in enumerate(self._replicas)
         ]

@@ -90,6 +90,7 @@ from dhqr_tpu.serve.errors import (
     Quarantined,
     ServeError,
 )
+from dhqr_tpu.utils import lockwitness as _lockwitness
 from dhqr_tpu.utils.config import DHQRConfig, SchedulerConfig, ServeConfig
 from dhqr_tpu.utils.profiling import (
     Counters,
@@ -207,17 +208,20 @@ class AsyncScheduler:
         self._kcfg = sched_config or SchedulerConfig.from_env()
         self._cache = cache if cache is not None else default_cache()
         self._base_config = config
-        self._overrides = dict(overrides)
-        # Fail fast on a bad base config (same checks the sync tier runs)
-        # rather than on the first submit; also seeds the resolution memo.
-        self._resolved: dict = {}
-        self._resolve(None, None, "lstsq")
+        self._overrides = dict(overrides)   # guarded by: frozen
 
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = _lockwitness.make_lock("AsyncScheduler._lock")
         self._work = threading.Condition(self._lock)
         self._idle = threading.Condition(self._lock)
-        self._groups: "dict[tuple, _Group]" = {}
+        # Resolution memo is read/written from every submitting thread,
+        # so it lives under the lock like the queues, even though
+        # resolution itself is pure given the base config.
+        self._resolved: dict = {}              # guarded by: _lock
+        # Fail fast on a bad base config (same checks the sync tier runs)
+        # rather than on the first submit; also seeds the resolution memo.
+        self._resolve(None, None, "lstsq")
+        self._groups: "dict[tuple, _Group]" = {}   # guarded by: _lock
         self._depth = 0            # queued, not yet popped for dispatch
         self._inflight = 0         # popped, dispatch not yet completed
         self._seq = 0
@@ -228,8 +232,8 @@ class AsyncScheduler:
 
         self.counters = Counters()
         self.latency = LatencyHistogram()
-        self._ewma: "dict[Bucket, Ewma]" = {}
-        self.keys_seen: set = set()
+        self._ewma: "dict[Bucket, Ewma]" = {}  # guarded by: _lock
+        self.keys_seen: set = set()            # guarded by: _lock
         # Unified metrics (round 14): serve.sched.* dotted names on the
         # process registry; weakly held, so test schedulers leave with GC.
         _obs_metrics.registry().register("serve.sched", self)
@@ -244,7 +248,7 @@ class AsyncScheduler:
         # and group selection under the lock is atomic.
         if workers < 1:
             raise ValueError(f"workers must be >= 1, got {workers}")
-        self._threads: "list[threading.Thread]" = []
+        self._threads: "list[threading.Thread]" = []  # guarded by: _lock
         if start:
             self._threads = [
                 threading.Thread(target=self._run,
@@ -262,7 +266,8 @@ class AsyncScheduler:
         is pure given the base config)."""
         try:
             memo_key = (kind, policy, plan)
-            hit = self._resolved.get(memo_key)
+            with self._lock:
+                hit = self._resolved.get(memo_key)
         except TypeError:           # unhashable policy/plan object
             memo_key, hit = None, None
         if hit is not None:
@@ -272,9 +277,12 @@ class AsyncScheduler:
             ov["policy"] = policy
         if plan is not None:
             ov["plan"] = plan
+        # Resolution runs OUTSIDE the lock (it may validate configs);
+        # a racing duplicate just recomputes the same pure value.
         resolved = _engine._resolve_dispatch_cfg(kind, self._base_config, ov)
         if memo_key is not None:
-            self._resolved[memo_key] = resolved
+            with self._lock:
+                self._resolved[memo_key] = resolved
         return resolved
 
     def submit(
@@ -664,7 +672,8 @@ class AsyncScheduler:
                                                 group.qr_solve_args)
 
         def consume(chunk, key, outs):
-            self.keys_seen.add(key)
+            with self._lock:
+                self.keys_seen.add(key)
             raw_outs.append(outs)
             consume_inner(chunk, key, outs)
 
@@ -1242,7 +1251,9 @@ class AsyncScheduler:
         (flush reason "drain"). Blocks until the queue and in-flight
         dispatches are empty. Works with or without the dispatcher
         thread (manual mode drains inline)."""
-        if not any(t.is_alive() for t in self._threads):
+        with self._lock:
+            threads = list(self._threads)
+        if not any(t.is_alive() for t in threads):
             with self._lock:
                 self._draining = True
             try:
@@ -1283,23 +1294,31 @@ class AsyncScheduler:
             self._closed = True
         if drain:
             self.drain(timeout=timeout)
+        cancelled: "list[_Pending]" = []
         with self._lock:
             if not drain:
                 for group in self._groups.values():
                     while group.queue:
-                        p = group.queue.popleft()
+                        cancelled.append(group.queue.popleft())
                         self._depth -= 1
-                        if not p.future.cancel():
-                            # A requeued retry is already claimed
-                            # (RUNNING) and cannot be cancelled —
-                            # resolve it typed instead; the contract is
-                            # that no submitted future EVER hangs.
-                            self.counters.bump("failed")
-                            p.future.set_exception(ServeError(
-                                "scheduler shut down (drain=False) "
-                                "before the request's retry could run"))
             self._work.notify_all()
-        for t in self._threads:
+            threads = list(self._threads)
+        # Futures resolve OUTSIDE the lock: Future.cancel() /
+        # set_exception() run done-callbacks synchronously, and a fleet
+        # router's relay callback takes the router lock and may resubmit
+        # into a SIBLING replica's scheduler lock — two concurrent
+        # drain=False shutdowns resolving under their own locks would be
+        # a classic ABBA deadlock window (DHQR602).
+        for p in cancelled:
+            if not p.future.cancel():
+                # A requeued retry is already claimed (RUNNING) and
+                # cannot be cancelled — resolve it typed instead; the
+                # contract is that no submitted future EVER hangs.
+                self.counters.bump("failed")
+                p.future.set_exception(ServeError(
+                    "scheduler shut down (drain=False) "
+                    "before the request's retry could run"))
+        for t in threads:
             if t.is_alive():
                 t.join(timeout=5.0)
 

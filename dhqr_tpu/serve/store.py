@@ -55,6 +55,7 @@ from dhqr_tpu.faults import harness as _faults
 from dhqr_tpu.obs import metrics as _obs_metrics
 from dhqr_tpu.serve.cache import CacheKey, default_cache
 from dhqr_tpu.utils import compat as _compat
+from dhqr_tpu.utils import lockwitness as _lockwitness
 from dhqr_tpu.utils.config import FleetConfig
 from dhqr_tpu.utils.profiling import Counters, PhaseTimer
 
@@ -154,7 +155,7 @@ class ExecutableStore:
         self.root = str(root)
         os.makedirs(self.root, exist_ok=True)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = _lockwitness.make_lock("ExecutableStore._lock")
         self.counters = Counters()
         self.timer = PhaseTimer()
         # fleet.store.* dotted names on the process registry (weakly
@@ -362,7 +363,7 @@ class ExecutableStore:
 # serve call that reads it, never `import dhqr_tpu`, and DHQR_FLEET_STORE
 # set programmatically before first use must take effect.
 _DEFAULT_STORE: "ExecutableStore | None" = None
-_DEFAULT_STORE_LOCK = threading.Lock()
+_DEFAULT_STORE_LOCK = _lockwitness.make_lock("store._DEFAULT_STORE_LOCK")
 
 
 def default_store() -> "ExecutableStore | None":
@@ -394,7 +395,7 @@ def reset_default_store() -> None:
 # One warning per (path, reason) per process, like tune/db.py: a
 # serving loop polling a corrupt state file must not drown its logs.
 _WARNED: "set[tuple[str, str]]" = set()
-_WARN_LOCK = threading.Lock()
+_WARN_LOCK = _lockwitness.make_lock("store._WARN_LOCK")
 
 
 def _warn_once(path: str, reason: str, detail: str) -> None:

@@ -33,6 +33,7 @@ import warnings
 from typing import Optional
 
 from dhqr_tpu.tune.plan import Plan
+from dhqr_tpu.utils import lockwitness as _lockwitness
 
 SCHEMA = "dhqr-plan-db"
 SCHEMA_VERSION = 1
@@ -44,7 +45,7 @@ SEED_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # One warning per (path, reason) per process: a serving loop that polls
 # a corrupt DB must not drown its own logs.
 _WARNED: "set[tuple[str, str]]" = set()
-_WARN_LOCK = threading.Lock()
+_WARN_LOCK = _lockwitness.make_lock("db._WARN_LOCK")
 
 
 def _warn_once(path: str, reason: str, detail: str) -> None:
@@ -105,9 +106,9 @@ class PlanDB:
     def __init__(self, path: "str | None" = None,
                  seed_path: "str | None" = None) -> None:
         self.path = path
-        self._lock = threading.RLock()
-        self.entries: "dict[str, dict]" = {}
-        self._seeds: "dict[str, dict]" = {}
+        self._lock = _lockwitness.make_rlock("PlanDB._lock")
+        self.entries: "dict[str, dict]" = {}   # guarded by: _lock
+        self._seeds: "dict[str, dict]" = {}    # guarded by: frozen
         if seed_path:
             self._seeds = self._load_file(seed_path)
         if path:
@@ -210,7 +211,10 @@ class PlanDB:
         fd = os.open(path + ".lock", os.O_CREAT | os.O_RDWR, 0o644)
         try:
             fcntl.flock(fd, fcntl.LOCK_EX)
-            yield
+            # The witness sees the flock window as a lock-like region,
+            # so a threading acquisition inside it records an edge.
+            with _lockwitness.witness_region("PlanDB._file_lock"):
+                yield
         finally:
             os.close(fd)  # closing releases the flock
 
@@ -256,7 +260,7 @@ class PlanDB:
 
 # -- process default -------------------------------------------------------
 _DEFAULT_DB: "PlanDB | None" = None
-_DEFAULT_DB_LOCK = threading.Lock()
+_DEFAULT_DB_LOCK = _lockwitness.make_lock("db._DEFAULT_DB_LOCK")
 
 
 def default_db() -> PlanDB:

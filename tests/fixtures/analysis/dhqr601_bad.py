@@ -1,0 +1,19 @@
+"""DHQR601 bad: guarded-field discipline violations."""
+import threading
+
+
+class Shared:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items: list = []          # guarded by: _lock
+        self._names = {"a": 1}          # guarded by: frozen
+        self._table = {}
+
+    def bad_read(self):
+        return len(self._items)
+
+    def bad_write(self, item):
+        self._items.append(item)
+
+    def bad_rebind(self):
+        self._names = {}

@@ -1,0 +1,27 @@
+"""DHQR601 good: guarded fields honored (lock, frozen, entry-held)."""
+import threading
+
+
+class Shared:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items: list = []          # guarded by: _lock
+        self._names = {"a": 1}          # guarded by: frozen
+
+    def read(self):
+        with self._lock:
+            return len(self._items)
+
+    def names(self):
+        return dict(self._names)
+
+    def _locked_size(self):
+        return len(self._items)
+
+    def sized(self):
+        with self._lock:
+            return self._locked_size()
+
+    def racy_size(self):
+        # dhqr: ignore[DHQR601] approximate size is fine for telemetry; a torn read of len() is still an int
+        return len(self._items)

@@ -1,0 +1,18 @@
+"""DHQR602 good: one global acquisition order (a before b, always)."""
+import threading
+
+
+class TwoLocks:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def also_ab(self):
+        with self._a:
+            with self._b:
+                return True

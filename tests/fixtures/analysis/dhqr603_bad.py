@@ -1,0 +1,25 @@
+"""DHQR603 bad: blocking calls while holding a lock."""
+import subprocess
+import threading
+import time
+
+
+class Blocky:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def wait_result(self, fut):
+        with self._lock:
+            return fut.result()
+
+    def nap(self):
+        with self._lock:
+            time.sleep(0.1)
+
+    def shell(self):
+        with self._lock:
+            subprocess.check_call(["true"])
+
+    def build(self, lowered):
+        with self._lock:
+            return lowered.compile()

@@ -1,0 +1,23 @@
+"""DHQR603 good: block outside the lock; lock only the bookkeeping."""
+import re
+import threading
+import time
+
+
+class Blocky:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pat = None                # guarded by: _lock
+
+    def wait_result(self, fut):
+        with self._lock:
+            pending = fut
+        return pending.result()
+
+    def nap(self):
+        time.sleep(0.0)
+
+    def pattern(self):
+        with self._lock:
+            self._pat = re.compile("x")
+            return self._pat

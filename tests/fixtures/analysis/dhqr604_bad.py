@@ -1,0 +1,11 @@
+"""DHQR604 bad: unsynchronized post-__init__ publication."""
+import threading
+
+
+class Pub:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ready = False
+
+    def late(self):
+        self.cache = {}

@@ -1,0 +1,15 @@
+"""DHQR604 good: publish under the lock, or bind in __init__."""
+import threading
+
+
+class Pub:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.cache = None
+
+    def rebind(self):
+        self.cache = {}
+
+    def late(self):
+        with self._lock:
+            self.extra = {}

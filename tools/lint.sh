@@ -6,9 +6,11 @@
 #
 # Usage: tools/lint.sh [--fast] [--format json]
 #   --fast         AST-only dhqr-lint (skips the traced/compiled passes:
-#                  jaxpr, api, comms, xray, pulse, atlas) and the
-#                  regress gate — seconds instead of minutes, for edit
-#                  loops; CI runs the full gate.
+#                  jaxpr, api, comms, xray, pulse, atlas, and the
+#                  concurrency pass's runtime lock-witness burst — its
+#                  static DHQR6xx scan still runs) and the regress gate
+#                  — seconds instead of minutes, for edit loops; CI
+#                  runs the full gate.
 #   --format json  forward machine-readable findings from dhqr-lint
 #                  (the {"findings", "warnings", "suppressed",
 #                  "baselined"} shape of `check --format json`).
@@ -49,7 +51,11 @@ fi
 # whenever the package is a scan target — and since round 21 so does
 # the dhqr-atlas route-registry drift audit (DHQR501-505: route
 # coverage, contract bijection, serve cache-key collisions, grid/bench
-# drift against tune/registry.py).
+# drift against tune/registry.py) and the dhqr-warden concurrency pass
+# (DHQR601-604: guarded-field discipline, the committed
+# dhqr_tpu/analysis/lock_order.json acquisition-order graph two-way +
+# acyclic, blocking-under-lock, plus the runtime lock-witness burst —
+# witnessed edges must already be in the committed graph).
 JAX_PLATFORMS=cpu \
 XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
 python -m dhqr_tpu.analysis check dhqr_tpu tests \
